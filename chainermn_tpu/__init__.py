@@ -1,0 +1,28 @@
+"""chainermn_tpu — a TPU-native distributed training framework with the
+capabilities of ChainerMN, built from scratch on JAX/XLA (pjit, shard_map,
+pallas).  See SURVEY.md for the structural analysis of the reference and
+README.md for the design.
+
+Public surface mirrors ``chainermn``'s (create_communicator,
+create_multi_node_optimizer, scatter_dataset, ...) re-designed for the
+single-controller SPMD model: collectives lower to XLA ops over the ICI/DCN
+mesh instead of MPI/NCCL calls.
+"""
+
+from chainermn_tpu import ops
+from chainermn_tpu.communicators import (
+    CommunicatorBase,
+    LoopbackCommunicator,
+    TpuXlaCommunicator,
+    create_communicator,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CommunicatorBase",
+    "LoopbackCommunicator",
+    "TpuXlaCommunicator",
+    "create_communicator",
+    "ops",
+]

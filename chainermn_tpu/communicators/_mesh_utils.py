@@ -1,0 +1,79 @@
+"""Mesh construction & rank-topology math.
+
+TPU-native replacement for ChainerMN's ``_communication_utility.py``
+(``init_ranks`` discovered intra/inter ranks by allgathering hostnames over
+MPI; ``init_nccl_comm`` broadcast NCCL unique ids).  On TPU none of that
+exists: the JAX runtime already knows the device topology, so "rank
+discovery" is reading ``jax.devices()`` / ``jax.process_index()``, and there
+is no NCCL communicator to initialise — XLA lowers collectives onto ICI/DCN
+from the mesh itself.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def world_devices(devices: Optional[Sequence] = None):
+    """Flat list of devices forming the world, in global-rank order."""
+    if devices is None:
+        devices = jax.devices()
+    return sorted(devices, key=lambda d: d.id)
+
+
+def make_world_mesh(
+    devices: Optional[Sequence] = None, axis_name: str = "world"
+) -> Mesh:
+    """1-D mesh over all devices — the flat world every communicator wraps."""
+    devs = world_devices(devices)
+    return Mesh(np.asarray(devs, dtype=object), (axis_name,))
+
+
+def make_named_mesh(
+    axis_sizes: dict,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """N-D mesh from ``{axis_name: size}`` (insertion order = major→minor).
+
+    Axes should be ordered so that the *fastest-communicating* axis (tensor/
+    sequence parallel) is minor — adjacent device ids sit on the same ICI
+    link/host, so minor-axis collectives ride ICI while major axes (data,
+    pipeline) may cross DCN.  A size of -1 means "whatever is left".
+    """
+    devs = world_devices(devices)
+    sizes = dict(axis_sizes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([v for v in sizes.values() if v != -1]))
+    if unknown:
+        if len(devs) % known:
+            raise ValueError(
+                f"{len(devs)} devices not divisible by fixed axes {sizes}"
+            )
+        sizes[unknown[0]] = len(devs) // known
+    total = int(np.prod(list(sizes.values())))
+    if total != len(devs):
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {len(devs)}")
+    arr = np.asarray(devs, dtype=object).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def intra_rank(process_index: Optional[int] = None) -> int:
+    """Local device index contract (ChainerMN used intra_rank to pick the GPU;
+    on TPU the runtime pins devices, so this is informational)."""
+    return 0  # single-controller: the controller's "first local device"
+
+
+def topology() -> dict:
+    """Describe the world: device/process counts and per-process spans."""
+    return {
+        "num_devices": jax.device_count(),
+        "num_local_devices": jax.local_device_count(),
+        "num_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+    }

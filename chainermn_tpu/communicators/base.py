@@ -1,0 +1,201 @@
+"""Communicator protocol — the TPU-native analogue of ChainerMN's
+``CommunicatorBase`` (reference: ``chainermn/communicators/communicator_base.py``,
+unverified — reference mount empty; see SURVEY.md caveat).
+
+Design note (TPU-first, not a port)
+-----------------------------------
+ChainerMN's communicator is an *eager, per-process* object: every rank is a
+separate OS process holding its own arrays, and each collective is a blocking
+MPI/NCCL call. JAX on TPU is a *single-controller SPMD* world: one Python
+process (per host) drives N devices, arrays are sharded over a
+:class:`jax.sharding.Mesh`, and collectives are XLA ops (``psum``,
+``all_gather``, ``all_to_all``, ``ppermute``) traced inside ``jit``.
+
+So this communicator has two faces:
+
+1. **In-program (hot path)** — ``comm.axis_name`` names the mesh axis; the
+   differentiable functional collectives in :mod:`chainermn_tpu.ops` take that
+   axis name and are used *inside* jitted step functions. This is where
+   gradient allreduce actually happens (XLA lowers it onto ICI).
+
+2. **Eager/host path (control plane)** — methods on this class. Array
+   collectives operate on *world-stacked* arrays: an array whose leading axis
+   has length ``size`` and is sharded one-slice-per-rank over the mesh
+   (the SPMD analogue of "each rank holds its local array"). Object
+   (``*_obj``) collectives move picklable Python values between *processes*
+   (hosts); with a single controller they are host-local and cheap.
+
+Rank model (per SURVEY.md §5): ``rank``/``size`` index the flat world of
+devices participating in the mesh axis; ``process_rank`` ↔
+``jax.process_index()``; ``intra_rank`` ↔ local device index (the reference's
+device-placement contract, ``chainermn`` used it to pick the GPU).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional, Sequence
+
+
+class CommunicatorBase(abc.ABC):
+    """Abstract communicator with ChainerMN's full collective/p2p surface.
+
+    All array collectives use the *world-stacked* convention: an argument
+    ``x`` with shape ``(size, ...)`` represents "rank ``i`` holds ``x[i]``",
+    sharded over the mesh axis.  Methods return world-stacked results so that
+    they compose; use :meth:`local` to pull out one rank's slice.
+    """
+
+    # ------------------------------------------------------------------ #
+    # topology
+    # ------------------------------------------------------------------ #
+
+    @property
+    @abc.abstractmethod
+    def size(self) -> int:
+        """Number of ranks (devices) in this communicator's world."""
+
+    @property
+    @abc.abstractmethod
+    def rank(self) -> int:
+        """This controller's rank for object/control-plane purposes.
+
+        In multi-host mode this is the first global device index owned by
+        this process; in single-controller mode it is 0.  Per-device identity
+        inside jitted code comes from ``lax.axis_index(comm.axis_name)``.
+        """
+
+    @property
+    @abc.abstractmethod
+    def intra_rank(self) -> int:
+        """Local (within-host) device index — device placement contract."""
+
+    @property
+    @abc.abstractmethod
+    def inter_rank(self) -> int:
+        """Host index (``jax.process_index()``)."""
+
+    @property
+    @abc.abstractmethod
+    def inter_size(self) -> int:
+        """Number of hosts (``jax.process_count()``)."""
+
+    @property
+    @abc.abstractmethod
+    def axis_name(self) -> str:
+        """Mesh axis name for in-jit collectives over this world."""
+
+    @property
+    @abc.abstractmethod
+    def mesh(self):
+        """The :class:`jax.sharding.Mesh` backing this communicator."""
+
+    @abc.abstractmethod
+    def split(self, color: int, key: int) -> "CommunicatorBase":
+        """New communicator over the subset of ranks sharing ``color``,
+        ranked by ``key`` (MPI_Comm_split semantics)."""
+
+    # ------------------------------------------------------------------ #
+    # world-stacked array collectives (eager control plane)
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def bcast(self, x, root: int = 0):
+        """Every rank gets ``x[root]``. Returns world-stacked ``(size, ...)``."""
+
+    @abc.abstractmethod
+    def allreduce(self, x, op: str = "sum"):
+        """Elementwise reduce ``x[0..size)`` with ``op``; every rank gets it."""
+
+    @abc.abstractmethod
+    def allgather(self, x):
+        """Every rank gets the full stack: ``(size, size, ...)``."""
+
+    @abc.abstractmethod
+    def alltoall(self, x):
+        """Rank i's j-th slice goes to rank j's i-th slice (transpose of the
+        leading two world axes). ``x`` is ``(size, size, ...)``."""
+
+    @abc.abstractmethod
+    def gather(self, x, root: int = 0):
+        """Root gets the stack ``(size, ...)`` (SPMD: computed everywhere)."""
+
+    @abc.abstractmethod
+    def scatter(self, x, root: int = 0):
+        """Rank i gets ``x[root][i]``; ``x`` is world-stacked ``(size, size, ...)``."""
+
+    @abc.abstractmethod
+    def reduce_scatter(self, x):
+        """Rank i gets ``sum_j x[j, i]``; ``x`` is ``(size, size, ...)``."""
+
+    @abc.abstractmethod
+    def send(self, x, dest: int, source: int):
+        """Point-to-point move of ``x[source]`` into slot ``dest`` (ppermute)."""
+
+    # ------------------------------------------------------------------ #
+    # object (host/control) collectives
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any: ...
+
+    @abc.abstractmethod
+    def gather_obj(self, obj: Any, root: int = 0) -> Optional[Sequence[Any]]: ...
+
+    @abc.abstractmethod
+    def allgather_obj(self, obj: Any) -> Sequence[Any]: ...
+
+    @abc.abstractmethod
+    def allreduce_obj(self, obj: Any, op: str = "sum") -> Any: ...
+
+    @abc.abstractmethod
+    def scatter_obj(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any: ...
+
+    @abc.abstractmethod
+    def send_obj(self, obj: Any, dest: int) -> None: ...
+
+    @abc.abstractmethod
+    def recv_obj(self, source: int) -> Any: ...
+
+    @abc.abstractmethod
+    def barrier(self) -> None: ...
+
+    # ------------------------------------------------------------------ #
+    # model/training helpers (ChainerMN parity:
+    # bcast_data / multi_node_mean_grad on pytrees)
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def bcast_data(self, params, root: int = 0):
+        """Broadcast a pytree of arrays from ``root`` so every rank/device
+        holds identical values (ChainerMN's first-``update()`` weight sync)."""
+
+    @abc.abstractmethod
+    def multi_node_mean_grad(self, grads, dtype=None):
+        """Mean a world-stacked pytree of gradients across ranks.
+
+        ``dtype`` mirrors ``allreduce_grad_dtype``: cast before the reduce
+        (e.g. ``jnp.bfloat16``) and back after — the TPU analogue of
+        ChainerMN's fp16 allreduce.
+        """
+
+    # alias, ChainerMN kept both names
+    def allreduce_grad(self, grads, dtype=None):
+        return self.multi_node_mean_grad(grads, dtype)
+
+    # ------------------------------------------------------------------ #
+    # conveniences
+    # ------------------------------------------------------------------ #
+
+    def local(self, x, rank: Optional[int] = None):
+        """Pull rank ``rank``'s slice out of a world-stacked array."""
+        import jax
+
+        r = self.rank if rank is None else rank
+        return jax.tree.map(lambda a: a[r], x)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"<{type(self).__name__} size={self.size} rank={self.rank} "
+            f"axis={self.axis_name!r}>"
+        )

@@ -1,0 +1,102 @@
+"""``loopback`` communicator — single-rank world for tests and single-device
+runs.  The fake the reference never had (SURVEY.md §4): ChainerMN tests
+required a real ``mpiexec -n 2``; here a size-1 communicator makes every
+collective an identity/copy so the full training stack runs unmodified on
+one chip (or CPU) with zero communication.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .base import CommunicatorBase
+
+
+class LoopbackCommunicator(CommunicatorBase):
+    def __init__(self, device=None, axis_name: str = "world"):
+        self._device = device or jax.devices()[0]
+        self._axis = axis_name
+        self._mesh = Mesh(np.asarray([self._device], dtype=object), (axis_name,))
+        self._queue: list = []
+
+    size = property(lambda self: 1)
+    rank = property(lambda self: 0)
+    intra_rank = property(lambda self: 0)
+    inter_rank = property(lambda self: 0)
+    inter_size = property(lambda self: 1)
+    axis_name = property(lambda self: self._axis)
+    mesh = property(lambda self: self._mesh)
+
+    def split(self, color: int, key: int) -> "LoopbackCommunicator":
+        return self
+
+    # world-stacked arrays have leading dim 1; all collectives are identity
+    def _chk(self, x):
+        x = jnp.asarray(x)
+        if x.shape[:1] != (1,):
+            raise ValueError(f"world-stacked leading dim must be 1, got {x.shape}")
+        return x
+
+    def bcast(self, x, root: int = 0):
+        return self._chk(x)
+
+    def allreduce(self, x, op: str = "sum"):
+        return self._chk(x)
+
+    def allgather(self, x):
+        return self._chk(x)[None]
+
+    def alltoall(self, x):
+        return self._chk(x)
+
+    def gather(self, x, root: int = 0):
+        return self.allgather(x)
+
+    def scatter(self, x, root: int = 0):
+        return self._chk(x)[:, 0]
+
+    def reduce_scatter(self, x):
+        return self._chk(x)[:, 0]
+
+    def send(self, x, dest: int, source: int):
+        return self._chk(x)
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        return obj
+
+    def gather_obj(self, obj: Any, root: int = 0):
+        return [obj]
+
+    def allgather_obj(self, obj: Any) -> Sequence[Any]:
+        return [obj]
+
+    def allreduce_obj(self, obj: Any, op: str = "sum") -> Any:
+        return obj
+
+    def scatter_obj(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
+        return objs[0] if objs else None
+
+    def send_obj(self, obj: Any, dest: int) -> None:
+        # round-trip through pickle to keep loopback faithful to transport
+        self._queue.append(pickle.dumps(obj))
+
+    def recv_obj(self, source: int) -> Any:
+        if not self._queue:
+            raise RuntimeError("recv_obj: empty mailbox")
+        return pickle.loads(self._queue.pop(0))
+
+    def barrier(self) -> None:
+        pass
+
+    def bcast_data(self, params, root: int = 0):
+        return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), self._device),
+                            params)
+
+    def multi_node_mean_grad(self, grads, dtype=None):
+        return jax.tree.map(self._chk, grads)

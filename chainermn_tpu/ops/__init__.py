@@ -1,0 +1,35 @@
+"""Differentiable communication ops for use inside jitted SPMD code.
+
+Replaces ChainerMN's ``chainermn.functions`` FunctionNode layer
+(collective + point-to-point autograd functions) with axis-name-based
+wrappers over ``jax.lax`` collectives, whose transpose rules supply the
+reversed-direction backward passes the reference wrote by hand.
+"""
+
+from .collectives import (
+    allgather,
+    allreduce,
+    alltoall,
+    bcast,
+    gather,
+    pmean,
+    psum,
+    reduce_scatter,
+    scatter,
+)
+from .point_to_point import (
+    ppermute,
+    pseudo_connect,
+    recv,
+    send,
+    send_recv,
+    shift_down,
+    shift_up,
+)
+
+__all__ = [
+    "allgather", "allreduce", "alltoall", "bcast", "gather", "pmean",
+    "psum", "reduce_scatter", "scatter",
+    "ppermute", "pseudo_connect", "recv", "send", "send_recv",
+    "shift_down", "shift_up",
+]

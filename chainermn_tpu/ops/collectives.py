@@ -1,0 +1,120 @@
+"""Differentiable collective ops, used *inside* jitted/shard_mapped code.
+
+TPU-native replacement for ChainerMN's collective ``FunctionNode``s
+(reference: ``chainermn/functions/collective_communication.py`` —
+``AllGather``, ``AllToAll``, ``Bcast``, ``Gather``, ``Scatter``; unverified,
+mount empty, see SURVEY.md).
+
+The reference had to hand-write backward passes that fired reversed MPI
+collectives (allgather's backward is an alltoall-reduce of grads, etc.).
+In JAX the ``lax`` collectives already carry their transpose rules —
+``psum`` ⇄ identity-broadcast, ``all_gather`` ⇄ ``psum_scatter``,
+``ppermute`` ⇄ inverse permutation — so these wrappers exist to (a) give
+reference users the names and calling conventions they know, (b) pin down
+root-collective semantics (bcast/scatter/gather) which have no direct lax
+op, with VJPs that match the reference's mathematical behaviour.
+
+All functions take ``axis_name`` — the mesh axis of the enclosing
+``shard_map``/``pjit`` — instead of a communicator object: inside traced
+code the mesh axis *is* the communicator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "allreduce", "pmean", "psum",
+    "allgather", "alltoall", "bcast", "gather", "scatter",
+    "reduce_scatter",
+]
+
+
+def psum(x, axis_name: str):
+    """Sum across the mesh axis (differentiable; transpose = broadcast)."""
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    """Mean across the mesh axis — the gradient-allreduce hot path."""
+    return lax.pmean(x, axis_name)
+
+
+def allreduce(x, axis_name: str, op: str = "sum"):
+    """ChainerMN-parity allreduce. ``op`` in {sum, mean, max, min}."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unsupported allreduce op {op!r}")
+
+
+def allgather(x, axis_name: str, axis: int = 0, tiled: bool = False):
+    """Gather every rank's ``x`` along ``axis`` on all ranks.
+
+    Backward (from lax's transpose rule) is ``psum_scatter`` — exactly the
+    reduce-scatter the reference implemented by hand in
+    ``AllGather.backward``.
+    """
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def alltoall(x, axis_name: str, split_axis: int = 0, concat_axis: int = 0):
+    """Scatter ``split_axis`` across ranks, gather received along
+    ``concat_axis``. Self-transposing: backward is the inverse alltoall."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis)
+
+
+def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0,
+                   tiled: bool = True):
+    """Sum across ranks then scatter slices — backward of allgather,
+    exposed first-class (the reference buried it inside pure_nccl)."""
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def bcast(x, axis_name: str, root: int = 0):
+    """Every rank returns root's ``x``.
+
+    Implemented as ``psum(mask * x)`` — one collective, and the automatic
+    transpose gives the correct backward: root's gradient is the *sum* of
+    all ranks' output gradients, other ranks get zero (matching the
+    reference's ``Bcast.backward`` gather-sum).
+    """
+    idx = lax.axis_index(axis_name)
+    # where-mask, not multiply: keeps NaN/inf in non-root buffers (which the
+    # reference's Bcast never read) from poisoning the sum.
+    return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis_name)
+
+
+def gather(x, axis_name: str, root: int = 0, axis: int = 0):
+    """Gather every rank's ``x`` to ``root``.
+
+    SPMD note: all ranks compute the gathered value (XLA all_gather); the
+    result is only *meaningful* at root if callers discard it elsewhere.
+    Backward matches the reference's ``Gather.backward`` (scatter of grads
+    from root).
+    """
+    del root
+    return lax.all_gather(x, axis_name, axis=axis, tiled=False)
+
+
+def scatter(x, axis_name: str, root: int = 0, axis: int = 0):
+    """Rank ``i`` returns slice ``i`` (along ``axis``) of root's ``x``.
+
+    ``x`` must carry a leading world-sized dimension on every rank (only
+    root's is read).  Backward: root receives the allgather of output
+    grads — the reference's ``Scatter.backward``.
+    """
+    full = bcast(x, axis_name, root=root)
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_index_in_dim(full, idx, axis=axis, keepdims=False)

@@ -1,0 +1,103 @@
+"""Differentiable point-to-point communication for model/pipeline parallelism.
+
+TPU-native replacement for ChainerMN's ``Send``/``Recv`` FunctionNodes and
+``pseudo_connect`` (reference: ``chainermn/functions/point_to_point_communication.py``,
+unverified — mount empty, see SURVEY.md).
+
+Design shift (the SURVEY §7 "hard part (b)"): the reference used *blocking
+MPI p2p between different programs* on each rank, with hand-written backward
+passes that fired communication in the reverse direction, and
+``pseudo_connect`` to keep the autograd graph alive across the wire so
+``backward()`` wouldn't deadlock.  On TPU, p2p between pipeline stages is
+``lax.ppermute`` inside one SPMD program: deadlock-freedom comes from
+program identicality, and the transpose rule of ``ppermute`` (the inverse
+permutation) *is* the reversed-direction backward — no hand-written
+backward, no graph surgery.
+
+``send``/``recv`` are provided as parity names over ``ppermute`` shifts;
+``pseudo_connect`` survives as a graph-tie that stops XLA dead-code-
+eliminating an otherwise-unused permute (the moral descendant of the
+reference's dummy-variable trick).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "ppermute", "send", "recv", "send_recv",
+    "shift_up", "shift_down", "pseudo_connect",
+]
+
+
+def ppermute(x, axis_name: str, perm: Sequence[Tuple[int, int]]):
+    """Raw collective-permute: ``perm`` is [(source, dest), ...]; ranks with
+    no source receive zeros. Differentiable (backward = inverse perm)."""
+    return jax.tree.map(
+        lambda a: lax.ppermute(a, axis_name, perm=list(perm)), x)
+
+
+def send(x, axis_name: str, dest: int, source: int):
+    """Move ``x`` from rank ``source`` to ``dest`` (zeros elsewhere).
+
+    Unlike the reference's per-rank call sites (rank A calls ``send``,
+    rank B calls ``recv``, both block), SPMD code states the *whole*
+    transfer once; every rank traces the same program.  Backward moves the
+    cotangent ``dest → source`` automatically.
+    """
+    return ppermute(x, axis_name, [(source, dest)])
+
+
+# recv is the same op viewed from the receiving side; parity alias.
+recv = send
+
+
+def send_recv(x, axis_name: str, perm: Sequence[Tuple[int, int]]):
+    """Simultaneous multi-pair exchange (the general ChainerMN use)."""
+    return ppermute(x, axis_name, perm)
+
+
+def _shift_perm(n: int, delta: int, wrap: bool) -> List[Tuple[int, int]]:
+    if wrap:
+        return [(i, (i + delta) % n) for i in range(n)]
+    return [(i, i + delta) for i in range(n) if 0 <= i + delta < n]
+
+
+def shift_up(x, axis_name: str, axis_size: Optional[int] = None,
+             wrap: bool = False):
+    """Stage ``i`` → stage ``i+1`` (activation flow in a pipeline).
+    Stage 0 receives zeros unless ``wrap`` (ring)."""
+    n = axis_size or lax.axis_size(axis_name)
+    return ppermute(x, axis_name, _shift_perm(n, +1, wrap))
+
+
+def shift_down(x, axis_name: str, axis_size: Optional[int] = None,
+               wrap: bool = False):
+    """Stage ``i`` → stage ``i-1`` (gradient flow / ring reverse)."""
+    n = axis_size or lax.axis_size(axis_name)
+    return ppermute(x, axis_name, _shift_perm(n, -1, wrap))
+
+
+def pseudo_connect(delegate, *actuals):
+    """Tie ``delegate`` (e.g. a ``send`` result the local rank doesn't use)
+    into the data flow of ``actuals`` so the transfer is neither dead-code-
+    eliminated nor dropped from the autodiff graph.
+
+    Reference parity: ChainerMN's ``pseudo_connect`` kept a live autograd
+    edge so the send side's ``backward()`` blocked until the gradient
+    arrived.  JAX needs no blocking, but an unused ``ppermute`` output
+    *would* be DCE'd by XLA — adding a zero-valued dependency preserves it.
+    Returns ``actuals`` (single value if one was passed).
+    """
+    leaves = jax.tree.leaves(delegate)
+    tie = jnp.zeros((), dtype=jnp.float32)
+    for leaf in leaves:
+        tie = tie + jnp.sum(leaf).astype(jnp.float32) * 0.0
+    tied = tuple(
+        jax.tree.map(lambda a: a + tie.astype(a.dtype), x) for x in actuals
+    )
+    return tied[0] if len(tied) == 1 else tied
