@@ -16,13 +16,47 @@ from chainermn_tpu.communicators import (
     TpuXlaCommunicator,
     create_communicator,
 )
+from chainermn_tpu.datasets import (
+    create_empty_dataset,
+    scatter_dataset,
+    scatter_index,
+)
+from chainermn_tpu.iterators import (
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+from chainermn_tpu.training import (
+    Evaluator,
+    LogReport,
+    PrintReport,
+    StandardUpdater,
+    Trainer,
+    create_multi_node_evaluator,
+    create_multi_node_optimizer,
+    cross_replica_mean,
+)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "CommunicatorBase",
+    "Evaluator",
+    "LogReport",
     "LoopbackCommunicator",
+    "PrintReport",
+    "SerialIterator",
+    "StandardUpdater",
     "TpuXlaCommunicator",
+    "Trainer",
     "create_communicator",
+    "create_empty_dataset",
+    "create_multi_node_evaluator",
+    "create_multi_node_iterator",
+    "create_multi_node_optimizer",
+    "create_synchronized_iterator",
+    "cross_replica_mean",
     "ops",
+    "scatter_dataset",
+    "scatter_index",
 ]

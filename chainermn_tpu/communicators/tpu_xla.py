@@ -92,8 +92,16 @@ class TpuXlaCommunicator(CommunicatorBase):
         communication needed (the reference allgathered (color, key) pairs).
         Callers pass per-rank colors/keys via vectors of length ``size``.
         """
-        colors = np.broadcast_to(np.asarray(color), (self.size,))
-        keys = np.broadcast_to(np.asarray(key), (self.size,))
+        color = np.asarray(color)
+        key = np.asarray(key)
+        if color.ndim == 0 or key.ndim == 0:
+            raise ValueError(
+                "single-controller split needs per-rank vectors: MPI's "
+                "per-process `split(color, key)` call sites become one call "
+                "with length-`size` arrays here, e.g. "
+                "split(np.arange(comm.size) % 2, np.arange(comm.size))")
+        colors = np.broadcast_to(color, (self.size,))
+        keys = np.broadcast_to(key, (self.size,))
         mine = colors[self.rank]
         members = [i for i in range(self.size) if colors[i] == mine]
         members.sort(key=lambda i: (keys[i], i))
@@ -243,15 +251,19 @@ class TpuXlaCommunicator(CommunicatorBase):
     #
     # With one controller per host, object transport is a *process*-level
     # concern (ChainerMN: pickled MPI messages).  Single process → local;
-    # multi-process → pickle to uint8 arrays moved by the same XLA
-    # collectives over a process-spanning mesh (see _process_bcast_bytes).
+    # multi-process → pickle to uint8 arrays moved over the process-spanning
+    # runtime.  ``root`` is a DEVICE rank (consistent with the array API);
+    # it resolves to the process owning that device.
+
+    def _root_process(self, root: int) -> int:
+        return self._devices[root].process_index
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
         if jax.process_count() == 1:
             return obj
         from jax.experimental import multihost_utils
 
-        is_src = self.inter_rank == root
+        is_src = self.inter_rank == self._root_process(root)
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
         # length-prefix exchange, then fixed-size broadcast
         n = int(multihost_utils.broadcast_one_to_all(
@@ -283,9 +295,9 @@ class TpuXlaCommunicator(CommunicatorBase):
 
     def gather_obj(self, obj: Any, root: int = 0):
         objs = self.allgather_obj(obj)
-        # ChainerMN contract: only root receives the list (lets ported code
-        # use ``gather_obj(x) is not None`` as a root check).
-        return objs if self.inter_rank == root else None
+        # ChainerMN contract: only root's process receives the list (lets
+        # ported code use ``gather_obj(x) is not None`` as a root check).
+        return objs if self.inter_rank == self._root_process(root) else None
 
     def allreduce_obj(self, obj: Any, op: str = "sum") -> Any:
         objs = self.allgather_obj(obj)
@@ -294,7 +306,7 @@ class TpuXlaCommunicator(CommunicatorBase):
     def scatter_obj(self, objs, root: int = 0) -> Any:
         if jax.process_count() == 1:
             return objs[0] if objs else None
-        all_lists = self.bcast_obj(objs, root)
+        all_lists = self.bcast_obj(objs, root)  # root = device rank
         return all_lists[self.inter_rank]
 
     def send_obj(self, obj: Any, dest: int) -> None:

@@ -1,0 +1,128 @@
+"""Dataset scattering across processes — analogue of ``chainermn.datasets``
+(reference: ``chainermn/datasets/scatter_dataset.py``, ``empty_dataset.py``;
+unverified — mount empty, see SURVEY.md).
+
+Process model shift: ChainerMN scattered pickled sub-datasets from rank 0 to
+every rank over MPI (one rank = one GPU).  On TPU one *process* feeds many
+devices: datasets are scattered per-process (``jax.process_index()``), and
+the per-process batch is then sharded across local devices inside the jitted
+step.  With a single controller, "scattering" reduces to picking this
+process's slice — no bytes move, which is itself the idiomatic design: every
+process computes the same permutation from a shared seed instead of shipping
+data through a root (the reference had to ship because ranks couldn't see
+the dataset; TPU hosts usually mount the same storage).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "scatter_dataset",
+    "scatter_index",
+    "create_empty_dataset",
+    "SubDataset",
+    "EmptyDataset",
+]
+
+
+class SubDataset:
+    """A view of ``dataset`` through an index list (order = iteration order)."""
+
+    def __init__(self, dataset, indices: np.ndarray):
+        self._dataset = dataset
+        self._indices = np.asarray(indices)
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._dataset[int(j)] for j in self._indices[i]]
+        return self._dataset[int(self._indices[i])]
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+
+def _partition(n: int, size: int, shuffle: bool, seed: Optional[int],
+               force_equal_length: bool):
+    order = np.arange(n)
+    if shuffle:
+        rng = np.random.RandomState(seed if seed is not None else 0)
+        rng.shuffle(order)
+    base = n // size
+    rem = n % size
+    parts = []
+    start = 0
+    for r in range(size):
+        stop = start + base + (1 if r < rem else 0)
+        parts.append(order[start:stop])
+        start = stop
+    if force_equal_length and rem:
+        # pad short shards by wrapping (reference behaviour: equal-length
+        # sub-datasets so every rank runs the same number of iterations —
+        # SPMD requires identical step counts or collectives deadlock)
+        target = base + 1
+        parts = [
+            p if len(p) == target else np.concatenate([p, order[: target - len(p)]])
+            for p in parts
+        ]
+    return parts
+
+
+def scatter_dataset(
+    dataset,
+    comm,
+    root: int = 0,
+    shuffle: bool = False,
+    seed: Optional[int] = None,
+    force_equal_length: bool = True,
+):
+    """Split ``dataset`` into near-equal shards, one per *process*.
+
+    Every process derives the same partition from ``seed`` (deterministic
+    SPMD agreement); only the metadata (length) is synchronised from root via
+    ``bcast_obj`` so processes whose local dataset object is a stub still
+    agree on the partition.
+    """
+    n = comm.bcast_obj(len(dataset), root=root)
+    parts = _partition(n, comm.inter_size, shuffle, seed, force_equal_length)
+    return SubDataset(dataset, parts[comm.inter_rank])
+
+
+def scatter_index(
+    n_total: int, comm, root: int = 0, force_equal_length: bool = True
+):
+    """Scatter only the index range [0, n_total) — rank's (start, stop) pairs
+    without touching data (reference: ``scatter_index``)."""
+    n_total = comm.bcast_obj(n_total, root=root)
+    parts = _partition(n_total, comm.inter_size, False, None,
+                       force_equal_length)
+    return parts[comm.inter_rank]
+
+
+class EmptyDataset:
+    """Length-preserving empty stubs (reference: ``create_empty_dataset``) —
+    for model-parallel processes that must iterate in lockstep but consume
+    no data."""
+
+    def __init__(self, n: int):
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [()] * len(range(*i.indices(self._n)))
+        if not -self._n <= i < self._n:
+            raise IndexError(i)
+        return ()
+
+
+def create_empty_dataset(dataset) -> EmptyDataset:
+    return EmptyDataset(len(dataset))

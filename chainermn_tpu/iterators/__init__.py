@@ -1,0 +1,159 @@
+"""Iterators — minimal Chainer-style batch iterators plus the multi-node
+wrappers (reference: ``chainermn/iterators/``: ``create_multi_node_iterator``
+master/slave bcast pairs, ``create_synchronized_iterator`` RNG sync;
+unverified — mount empty, see SURVEY.md).
+
+Since this framework stands alone (no Chainer), it ships its own
+``SerialIterator`` implementing the protocol the reference assumed
+(``next()``, ``epoch``, ``is_new_epoch``, ``epoch_detail``, ``reset()``).
+
+Single-controller shift: the reference needed a master/slave pair because
+each rank was a separate process that might draw different batches; the
+master ran the real iterator and MPI-broadcast every batch.  With one
+controller feeding all devices, identical-batch semantics are free.  In
+multi-process mode the same guarantee comes from *seed agreement*
+(synchronized shuffling) instead of shipping batches — the broadcast
+variant exists for iterators that are genuinely non-deterministic
+(e.g. streaming sources only the master can see).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "SerialIterator",
+    "create_multi_node_iterator",
+    "create_synchronized_iterator",
+]
+
+
+class SerialIterator:
+    """Sequential batch iterator with epoch bookkeeping."""
+
+    def __init__(self, dataset, batch_size: int, repeat: bool = True,
+                 shuffle: bool = False, seed: Optional[int] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._repeat = repeat
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.epoch = 0
+        self.is_new_epoch = False
+        self._pos = 0
+        self._exhausted = False
+        self._order = np.arange(len(self.dataset))
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+
+    @property
+    def repeat(self) -> bool:
+        return self._repeat
+
+    @property
+    def epoch_detail(self) -> float:
+        return self.epoch + self._pos / max(len(self.dataset), 1)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        n = len(self.dataset)
+        start = self._pos
+        stop = min(start + self.batch_size, n)
+        batch = [self.dataset[int(i)] for i in self._order[start:stop]]
+        self._pos = stop
+        if self._pos >= n:
+            # epoch completes WITH this batch (Chainer contract: ``epoch``
+            # counts finished sweeps at the moment the closing batch is
+            # returned, so epoch-triggered extensions see the right value)
+            self.epoch += 1
+            self.is_new_epoch = True
+            self._pos = 0
+            if self._repeat:
+                if self._shuffle:
+                    self._rng.shuffle(self._order)
+            else:
+                self._exhausted = True
+        else:
+            self.is_new_epoch = False
+        return batch
+
+    next = __next__
+
+
+class _BroadcastIterator:
+    """Wraps a master iterator; every process yields the master's batches.
+
+    Multi-process: master materialises the batch and ``bcast_obj``s it; with
+    a single controller the wrap is a transparent passthrough.
+    """
+
+    def __init__(self, iterator, comm, rank_master: int = 0):
+        self._it = iterator
+        self._comm = comm
+        self._master = rank_master
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        comm, master = self._comm, self._master
+        if comm.inter_size == 1:
+            return next(self._it)
+        if comm.inter_rank == master:
+            try:
+                batch = next(self._it)
+                payload = ("batch", batch,
+                           self._it.epoch, self._it.is_new_epoch)
+            except StopIteration:
+                payload = ("stop", None, None, None)
+            payload = comm.bcast_obj(payload, root=master)
+        else:
+            payload = comm.bcast_obj(None, root=master)
+        kind, batch, epoch, new_epoch = payload
+        if kind == "stop":
+            raise StopIteration
+        self.epoch = epoch
+        self.is_new_epoch = new_epoch
+        return batch
+
+    next = __next__
+
+    def __getattr__(self, name):
+        return getattr(self._it, name)
+
+    def reset(self):
+        self._it.reset()
+
+
+def create_multi_node_iterator(iterator, comm, rank_master: int = 0):
+    """Identical batches on every process (model-parallel requirement).
+
+    Reference parity: ``chainermn.iterators.create_multi_node_iterator``
+    (master runs the real iterator, slaves receive each batch via bcast).
+    """
+    return _BroadcastIterator(iterator, comm, rank_master)
+
+
+def create_synchronized_iterator(iterator, comm, seed: int = 0):
+    """Synchronise the iterator's RNG across processes so shuffle order
+    matches (reference: ``create_synchronized_iterator``).
+
+    The agreed seed is broadcast from process 0 and reseeds the iterator's
+    RNG — afterwards every process draws identical shuffle permutations
+    without any per-batch communication (cheaper than the broadcast
+    iterator; this was the reference's point too).
+    """
+    agreed = comm.bcast_obj(seed, root=0)
+    if hasattr(iterator, "_rng"):
+        iterator._rng = np.random.RandomState(agreed)
+        iterator.reset()
+    return iterator

@@ -1,0 +1,7 @@
+"""Model zoo covering the reference's example models (MNIST MLP, ImageNet
+ResNet-50, seq2seq NMT) re-built TPU-first, plus the flagship transformer
+exercising every parallelism axis."""
+
+from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
+
+__all__ = ["accuracy", "init_mlp", "mlp_apply", "softmax_cross_entropy"]

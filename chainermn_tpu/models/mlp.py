@@ -1,0 +1,43 @@
+"""MLP — the ``examples/mnist`` model (reference: 3-layer MLP in
+``examples/mnist/train_mnist.py``; unverified — mount empty, see SURVEY.md).
+
+Written as plain pytree init + pure apply (not flax) so the minimal slice
+has zero framework magic; larger models in this package use flax.linen.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_mlp", "mlp_apply", "softmax_cross_entropy", "accuracy"]
+
+
+def init_mlp(key, sizes: Sequence[int], dtype=jnp.float32):
+    """He-initialised dense stack: sizes = [in, hidden..., out]."""
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (fan_in, fan_out), dtype) * jnp.sqrt(
+            2.0 / fan_in)
+        params.append({"w": w, "b": jnp.zeros((fan_out,), dtype)})
+    return params
+
+
+def mlp_apply(params, x):
+    h = x.reshape(x.shape[0], -1)
+    for layer in params[:-1]:
+        h = jax.nn.relu(h @ layer["w"] + layer["b"])
+    last = params[-1]
+    return h @ last["w"] + last["b"]
+
+
+def softmax_cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+
+
+def accuracy(logits, labels):
+    return (logits.argmax(axis=1) == labels).mean()

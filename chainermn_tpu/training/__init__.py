@@ -1,0 +1,31 @@
+"""Training integration layer (reference L3/L5: optimizers, evaluators,
+trainer extension protocol)."""
+
+from .evaluators import (
+    Evaluator,
+    GenericMultiNodeEvaluator,
+    create_multi_node_evaluator,
+)
+from .optimizers import (
+    create_multi_node_optimizer,
+    cross_replica_mean,
+)
+from .trainer import LogReport, PrintReport, Trainer, make_extension
+from .triggers import IntervalTrigger, get_trigger
+from .updater import StandardUpdater, default_converter
+
+__all__ = [
+    "Evaluator",
+    "GenericMultiNodeEvaluator",
+    "IntervalTrigger",
+    "LogReport",
+    "PrintReport",
+    "StandardUpdater",
+    "Trainer",
+    "create_multi_node_evaluator",
+    "create_multi_node_optimizer",
+    "cross_replica_mean",
+    "default_converter",
+    "get_trigger",
+    "make_extension",
+]

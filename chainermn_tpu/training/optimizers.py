@@ -1,0 +1,132 @@
+"""Multi-node optimizer — analogue of ``chainermn.create_multi_node_optimizer``
+and ``_DoubleBufferingOptimizer`` (reference: ``chainermn/optimizers.py``,
+unverified — mount empty, see SURVEY.md).
+
+The SURVEY §7 "hard part (a)": ChainerMN wrapped a mutable Chainer Optimizer
+in an attribute-forwarding proxy that allreduced ``model.grads`` before
+delegating.  JAX optimisers (optax) are pure gradient transformations inside
+a jitted step — so the multi-node optimizer becomes a *transformation
+stack*: ``[cast → cross-replica mean → cast back → inner optimiser]``.
+There is no "first update broadcasts the weights" special case either:
+parameters start replicated (``comm.bcast_data`` at init), which is the
+first-call ``bcast_data(model)`` of the reference moved to where TPU wants
+it.
+
+Double buffering: the reference overlapped iteration *i*'s allreduce with
+iteration *i+1*'s fwd/bwd using a worker thread and applied 1-step-stale
+averaged grads.  On TPU the *overlap* is XLA's job (async collectives get
+scheduled over independent compute automatically); what we preserve is the
+**semantics** — applying 1-iteration-stale averaged gradients — because that
+staleness is what unlocks the overlap window when the collective is on the
+critical path.  Implemented as pure optax state (previous reduced grads),
+no threads.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = [
+    "cross_replica_mean",
+    "create_multi_node_optimizer",
+    "DoubleBufferState",
+]
+
+
+def cross_replica_mean(axis_name: str, dtype=None) -> optax.GradientTransformation:
+    """Optax transform: mean gradients across ``axis_name``.
+
+    ``dtype`` is the ``allreduce_grad_dtype`` analogue — cast to (e.g.)
+    bfloat16 for the wire, cast back after.  XLA fuses both casts into the
+    collective's neighbourhood (the reference needed custom CuPy kernels for
+    this; here it's free).
+
+    Semantics note (idempotency): under shard_map's varying-axes tracking,
+    ``pmean`` of an already cross-replica-reduced (invariant) gradient is an
+    identity, while ``pmean`` of a device-varying gradient is the true mean.
+    So this transform is safe in both regimes: as the sole reducer when the
+    user differentiates a *local* loss with grads entering as data, and as a
+    no-op safety net when the step differentiates a ``pmean``'d loss (the
+    StandardUpdater pattern, where shard_map AD already psums cotangents of
+    replicated params).  "Mean of a mean is the mean" — the reference's
+    allreduce had the same idempotent shape.
+
+    Only meaningful inside ``shard_map`` (manual SPMD). Under plain
+    ``pjit``/``jit`` with a batch-sharded loss *mean*, XLA already inserts
+    the collective — then this transform must NOT be added (it would have
+    no axis to reduce over).
+    """
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(grads, state, params=None):
+        del params
+
+        def reduce_one(g):
+            if dtype is not None and g.dtype != dtype:
+                return jax.lax.pmean(g.astype(dtype), axis_name).astype(g.dtype)
+            return jax.lax.pmean(g, axis_name)
+
+        return jax.tree.map(reduce_one, grads), state
+
+    return optax.GradientTransformation(init, update)
+
+
+class DoubleBufferState(NamedTuple):
+    prev_grads: optax.Updates
+
+
+def _double_buffer() -> optax.GradientTransformation:
+    """Apply the *previous* step's (already reduced) grads; stash current.
+
+    Matches the reference's pipelined-SGD semantics: weights at step t are
+    updated with mean grads from step t-1 (step 0 applies the zero init),
+    giving the scheduler a full step of slack to overlap the allreduce with
+    compute.
+    """
+
+    def init(params):
+        return DoubleBufferState(
+            prev_grads=jax.tree.map(jnp.zeros_like, params))
+
+    def update(grads, state, params=None):
+        del params
+        return state.prev_grads, DoubleBufferState(prev_grads=grads)
+
+    return optax.GradientTransformation(init, update)
+
+
+def create_multi_node_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    comm=None,
+    double_buffering: bool = False,
+    zero_loss_scale: Optional[float] = None,
+    axis_name: Optional[str] = None,
+    allreduce_grad_dtype=None,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimiser with cross-replica gradient averaging.
+
+    Args:
+      actual_optimizer: any ``optax.GradientTransformation`` (the reference
+        wrapped any Chainer ``Optimizer`` the same way).
+      comm: communicator whose ``axis_name`` defines the reduction axis
+        (or pass ``axis_name`` directly).
+      double_buffering: apply 1-step-stale reduced grads (overlap window —
+        reference's ``_DoubleBufferingOptimizer``).
+      allreduce_grad_dtype: wire dtype for the mean (bf16 recommended).
+    """
+    ax = axis_name or (comm.axis_name if comm is not None else None)
+    if ax is None:
+        raise ValueError("need comm or axis_name")
+    chain = [cross_replica_mean(ax, allreduce_grad_dtype)]
+    if double_buffering:
+        chain.append(_double_buffer())
+    chain.append(actual_optimizer)
+    del zero_loss_scale  # reserved
+    return optax.chain(*chain)

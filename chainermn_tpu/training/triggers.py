@@ -1,0 +1,35 @@
+"""Interval triggers for the trainer loop (Chainer-protocol analogue: the
+reference's extensions fire on ``(period, 'epoch'|'iteration')`` tuples)."""
+
+from __future__ import annotations
+
+
+class IntervalTrigger:
+    def __init__(self, period: float, unit: str):
+        if unit not in ("epoch", "iteration"):
+            raise ValueError(f"unit must be epoch|iteration, got {unit!r}")
+        self.period = period
+        self.unit = unit
+        self._last_fired_count = -1
+
+    def __call__(self, trainer) -> bool:
+        if self.unit == "iteration":
+            it = trainer.updater.iteration
+            fire = it > 0 and it % self.period == 0
+            return fire
+        # epoch unit: fire when an epoch boundary was crossed this iteration
+        prev = trainer.updater.previous_epoch_detail
+        cur = trainer.updater.epoch_detail
+        return int(cur / self.period) > int(prev / self.period)
+
+    def __repr__(self):  # pragma: no cover
+        return f"IntervalTrigger({self.period}, {self.unit!r})"
+
+
+def get_trigger(trigger):
+    if trigger is None:
+        return lambda trainer: False
+    if callable(trigger):
+        return trigger
+    period, unit = trigger
+    return IntervalTrigger(period, unit)

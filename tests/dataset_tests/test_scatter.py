@@ -1,0 +1,73 @@
+"""Dataset scattering — analogue of the reference's ``dataset_tests``."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import (create_communicator, create_empty_dataset,
+                           scatter_dataset, scatter_index)
+from chainermn_tpu.datasets import EmptyDataset, SubDataset, _partition
+
+
+@pytest.fixture()
+def comm():
+    return create_communicator("tpu_xla")
+
+
+class TestPartition:
+    def test_covers_all_indices(self):
+        parts = _partition(103, 8, shuffle=False, seed=None,
+                           force_equal_length=False)
+        got = np.concatenate(parts)
+        np.testing.assert_array_equal(np.sort(got), np.arange(103))
+
+    def test_near_equal(self):
+        parts = _partition(103, 8, False, None, False)
+        sizes = [len(p) for p in parts]
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_force_equal_length_pads(self):
+        parts = _partition(10, 4, False, None, True)
+        assert all(len(p) == 3 for p in parts)
+
+    def test_shuffle_deterministic_by_seed(self):
+        a = _partition(100, 4, True, 7, True)
+        b = _partition(100, 4, True, 7, True)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        c = _partition(100, 4, True, 8, True)
+        assert any((x != y).any() for x, y in zip(a, c))
+
+
+class TestScatterDataset:
+    def test_single_process_gets_full_slice(self, comm):
+        data = list(range(100))
+        sub = scatter_dataset(data, comm)
+        # single process world: inter_size == 1 → whole dataset
+        assert len(sub) == 100
+        assert sub[5] == 5
+
+    def test_shuffled_scatter(self, comm):
+        data = list(range(50))
+        sub = scatter_dataset(data, comm, shuffle=True, seed=3)
+        assert sorted(sub[i] for i in range(len(sub))) == data
+
+    def test_subdataset_slicing(self):
+        sub = SubDataset(list(range(10)), np.array([3, 1, 4]))
+        assert len(sub) == 3
+        assert sub[0] == 3
+        assert sub[0:2] == [3, 1]
+
+    def test_scatter_index(self, comm):
+        idx = scatter_index(10, comm)
+        np.testing.assert_array_equal(idx, np.arange(10))
+
+
+class TestEmptyDataset:
+    def test_length_preserved(self):
+        e = create_empty_dataset(list(range(42)))
+        assert isinstance(e, EmptyDataset)
+        assert len(e) == 42
+        assert e[0] == ()
+        assert e[41] == ()
+        with pytest.raises(IndexError):
+            e[42]

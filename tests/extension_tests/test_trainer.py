@@ -1,0 +1,127 @@
+"""Trainer / updater / evaluator integration — the minimum end-to-end DP
+slice (SURVEY §7 step 2) as a test: MNIST-shaped problem must converge and
+all extension plumbing must fire."""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models import (accuracy, init_mlp, mlp_apply,
+                                  softmax_cross_entropy)
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla")
+
+
+def toy_problem(n=512, dim=16, classes=4, seed=0):
+    """Same class prototypes for every seed (it's one problem); ``seed``
+    only varies the noise, so train/test splits share the distribution."""
+    protos = np.random.RandomState(42).randn(classes, dim).astype(
+        np.float32) * 2
+    rng = np.random.RandomState(seed)
+    data = [(protos[i % classes] + 0.2 * rng.randn(dim).astype(np.float32),
+             np.int32(i % classes)) for i in range(n)]
+    return data
+
+
+class TestEndToEnd:
+    def test_mnist_style_training_converges(self, comm, tmp_path):
+        train = cmn.scatter_dataset(toy_problem(), comm, shuffle=True, seed=0)
+        test = cmn.scatter_dataset(toy_problem(seed=9), comm)
+        train_it = cmn.SerialIterator(train, 64, shuffle=True, seed=1)
+        test_it = cmn.SerialIterator(test, 64, repeat=False)
+
+        params = init_mlp(jax.random.PRNGKey(0), [16, 32, 4])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        def metrics_fn(p, x, y):
+            logits = mlp_apply(p, x)
+            return {"loss": softmax_cross_entropy(logits, y),
+                    "accuracy": accuracy(logits, y)}
+
+        updater = cmn.StandardUpdater(train_it, opt, loss_fn, params, comm)
+        trainer = cmn.Trainer(updater, (3, "epoch"), out=str(tmp_path))
+        ev = cmn.create_multi_node_evaluator(
+            cmn.Evaluator(test_it, metrics_fn, comm), comm)
+        trainer.extend(ev, trigger=(1, "epoch"))
+        log = cmn.LogReport(trigger=(1, "epoch"))
+        trainer.extend(log)
+        trainer.run()
+
+        assert updater.iteration == 8 * 3  # 512/64 per epoch
+        final = log.log[-1]
+        assert final["validation/accuracy"] > 0.95
+        assert os.path.exists(tmp_path / "log")
+
+    def test_extension_trigger_counts(self, comm, tmp_path):
+        train = toy_problem(128)
+        it = cmn.SerialIterator(train, 32)
+        params = init_mlp(jax.random.PRNGKey(0), [16, 4])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.01), comm)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        updater = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+        trainer = cmn.Trainer(updater, (2, "epoch"), out=str(tmp_path))
+        fired = {"epoch": 0, "iter": 0}
+
+        @cmn.training.make_extension(trigger=(1, "epoch"))
+        def on_epoch(tr):
+            fired["epoch"] += 1
+
+        @cmn.training.make_extension(trigger=(2, "iteration"))
+        def on_iter(tr):
+            fired["iter"] += 1
+
+        trainer.extend(on_epoch)
+        trainer.extend(on_iter)
+        trainer.run()
+        assert fired["epoch"] == 2
+        assert fired["iter"] == 4  # 8 iterations / every 2
+
+    def test_double_buffered_training_still_converges(self, comm, tmp_path):
+        train = cmn.scatter_dataset(toy_problem(), comm, shuffle=True, seed=0)
+        it = cmn.SerialIterator(train, 64, shuffle=True, seed=1)
+        params = init_mlp(jax.random.PRNGKey(0), [16, 32, 4])
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), comm, double_buffering=True)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        updater = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+        trainer = cmn.Trainer(updater, (4, "epoch"), out=str(tmp_path))
+        trainer.run()
+        # evaluate manually
+        test = toy_problem(seed=7)
+        ev = cmn.Evaluator(cmn.SerialIterator(test, 64, repeat=False),
+                           lambda p, x, y: {"acc": accuracy(mlp_apply(p, x), y)},
+                           comm)
+        out = ev.evaluate(updater.params)
+        assert out["acc"] > 0.9
+
+    def test_loopback_world_runs_too(self, tmp_path):
+        """Whole stack on a size-1 communicator (single-chip path)."""
+        lb = cmn.create_communicator("loopback")
+        train = toy_problem(64)
+        it = cmn.SerialIterator(train, 16)
+        params = init_mlp(jax.random.PRNGKey(0), [16, 4])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), lb)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        updater = cmn.StandardUpdater(it, opt, loss_fn, params, lb)
+        trainer = cmn.Trainer(updater, (8, "iteration"), out=str(tmp_path))
+        trainer.run()
+        assert updater.iteration == 8

@@ -1,0 +1,64 @@
+"""Iterator tests — analogue of the reference's ``iterator_tests``."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import (SerialIterator, create_communicator,
+                           create_multi_node_iterator,
+                           create_synchronized_iterator)
+
+
+@pytest.fixture()
+def comm():
+    return create_communicator("tpu_xla")
+
+
+class TestSerialIterator:
+    def test_epoch_bookkeeping(self):
+        it = SerialIterator(list(range(10)), 4)
+        b1 = next(it)
+        assert len(b1) == 4 and not it.is_new_epoch
+        next(it)
+        b3 = next(it)
+        assert len(b3) == 2 and it.is_new_epoch
+        next(it)
+        assert it.epoch == 1
+
+    def test_no_repeat_stops(self):
+        it = SerialIterator(list(range(6)), 4, repeat=False)
+        batches = list(it)
+        assert [len(b) for b in batches] == [4, 2]
+
+    def test_shuffle_covers_everything(self):
+        it = SerialIterator(list(range(20)), 5, shuffle=True, seed=0)
+        seen = []
+        for _ in range(4):
+            seen += next(it)
+        assert sorted(seen) == list(range(20))
+
+    def test_epoch_detail(self):
+        it = SerialIterator(list(range(8)), 4)
+        assert it.epoch_detail == 0.0
+        next(it)
+        assert it.epoch_detail == 0.5
+
+    def test_reset(self):
+        it = SerialIterator(list(range(8)), 4)
+        next(it); next(it); next(it)
+        it.reset()
+        assert it.epoch == 0 and it.epoch_detail == 0.0
+
+
+class TestMultiNodeIterator:
+    def test_single_process_passthrough(self, comm):
+        base = SerialIterator(list(range(8)), 4)
+        it = create_multi_node_iterator(base, comm)
+        assert next(it) == [0, 1, 2, 3]
+        assert it.batch_size == 4  # attribute forwarding
+
+    def test_synchronized_iterator_reseeds(self, comm):
+        a = SerialIterator(list(range(30)), 10, shuffle=True, seed=111)
+        b = SerialIterator(list(range(30)), 10, shuffle=True, seed=222)
+        a = create_synchronized_iterator(a, comm, seed=5)
+        b = create_synchronized_iterator(b, comm, seed=5)
+        assert next(a) == next(b)  # identical shuffle order after sync
