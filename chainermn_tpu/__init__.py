@@ -9,7 +9,7 @@ single-controller SPMD model: collectives lower to XLA ops over the ICI/DCN
 mesh instead of MPI/NCCL calls.
 """
 
-from chainermn_tpu import ops
+from chainermn_tpu import links, ops
 from chainermn_tpu.communicators import (
     CommunicatorBase,
     LoopbackCommunicator,
@@ -56,6 +56,7 @@ __all__ = [
     "create_multi_node_optimizer",
     "create_synchronized_iterator",
     "cross_replica_mean",
+    "links",
     "ops",
     "scatter_dataset",
     "scatter_index",

@@ -1,0 +1,28 @@
+"""Distributed "links" — TPU-native analogues of ``chainermn/links/``.
+
+- :mod:`chainermn_tpu.links.batch_normalization` — cross-replica (sync)
+  batch normalisation (reference: ``chainermn/links/batch_normalization.py``,
+  ``MultiNodeBatchNormalization``; unverified — mount empty, see SURVEY.md).
+- :mod:`chainermn_tpu.links.multi_node_chain_list` — declarative cross-rank
+  model graph (reference: ``chainermn/links/multi_node_chain_list.py``,
+  ``MultiNodeChainList``).
+
+The high-throughput pipeline-parallel path (homogeneous stacked stages,
+micro-batching, stage-sharded parameters) lives in
+:mod:`chainermn_tpu.parallel.pipeline`; the classes here keep the
+reference's declarative per-rank-graph API.
+"""
+
+from chainermn_tpu.links.batch_normalization import (
+    BatchNormState,
+    init_batch_norm,
+    multi_node_batch_normalization,
+)
+from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+
+__all__ = [
+    "BatchNormState",
+    "MultiNodeChainList",
+    "init_batch_norm",
+    "multi_node_batch_normalization",
+]
