@@ -9,7 +9,12 @@ single-controller SPMD model: collectives lower to XLA ops over the ICI/DCN
 mesh instead of MPI/NCCL calls.
 """
 
-from chainermn_tpu import links, ops
+from chainermn_tpu import extensions, links, ops, utils
+from chainermn_tpu.extensions import (
+    add_global_except_hook,
+    create_multi_node_checkpointer,
+    multi_node_snapshot,
+)
 from chainermn_tpu.communicators import (
     CommunicatorBase,
     LoopbackCommunicator,
@@ -55,9 +60,14 @@ __all__ = [
     "create_multi_node_iterator",
     "create_multi_node_optimizer",
     "create_synchronized_iterator",
+    "add_global_except_hook",
+    "create_multi_node_checkpointer",
     "cross_replica_mean",
+    "extensions",
     "links",
+    "multi_node_snapshot",
     "ops",
+    "utils",
     "scatter_dataset",
     "scatter_index",
 ]

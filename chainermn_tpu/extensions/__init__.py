@@ -1,0 +1,39 @@
+"""Ops/reliability extensions — TPU-native analogues of
+``chainermn/extensions/`` + ``chainermn/global_except_hook.py`` (unverified
+— mount empty, see SURVEY.md):
+
+- :func:`create_multi_node_checkpointer` — per-process sharded snapshots
+  with latest-common-set resume and GC (fault tolerance for preemptible
+  TPU slices, the reference's spot-instance story).
+- :func:`multi_node_snapshot` — classic single-logical-snapshot semantics
+  distributed-safely (writer process + barrier).
+- :class:`ObservationAggregator` — cross-process mean of logged scalars.
+- :class:`AllreducePersistentValues` — average persistent (non-gradient)
+  state, e.g. BN running stats, across processes.
+- :func:`add_global_except_hook` — uncaught exception on any process kills
+  the whole job instead of deadlocking the collective.
+"""
+
+from chainermn_tpu.extensions.allreduce_persistent import (
+    AllreducePersistentValues,
+)
+from chainermn_tpu.extensions.checkpoint import (
+    MultiNodeCheckpointer,
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.extensions.global_except_hook import (
+    add_global_except_hook,
+)
+from chainermn_tpu.extensions.observation_aggregator import (
+    ObservationAggregator,
+)
+from chainermn_tpu.extensions.snapshot import multi_node_snapshot
+
+__all__ = [
+    "AllreducePersistentValues",
+    "MultiNodeCheckpointer",
+    "ObservationAggregator",
+    "add_global_except_hook",
+    "create_multi_node_checkpointer",
+    "multi_node_snapshot",
+]

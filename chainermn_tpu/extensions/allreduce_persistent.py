@@ -1,0 +1,60 @@
+"""AllreducePersistentValues — average persistent (non-gradient) state.
+
+Reference: ``chainermn/extensions/allreduce_persistent.py`` (unverified —
+mount empty, see SURVEY.md): allreduce-mean persistent values such as
+BatchNorm running mean/var across ranks on demand, so evaluation and
+checkpoints see consensus statistics even when each rank tracked its own.
+
+TPU shift: with sync BN (:mod:`chainermn_tpu.links.batch_normalization`)
+statistics are computed with an in-graph ``pmean`` and are identical by
+construction — then this extension is an identity.  It matters when models
+use *local* BN per device/process (cheaper forward, the reference's default
+BN) or accumulate any other device-varying persistent state: call it before
+eval/snapshot to install the cross-replica mean.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["AllreducePersistentValues"]
+
+
+class AllreducePersistentValues:
+    priority = 80  # before evaluators/snapshotters in the same fire
+
+    def __init__(self, comm, get_state=None, set_state=None):
+        """``get_state(updater) -> pytree`` / ``set_state(updater, pytree)``
+        select which persistent values to average; default targets
+        ``updater.params['persistent']`` if present, else no-op."""
+        self.comm = comm
+        self._get = get_state or self._default_get
+        self._set = set_state or self._default_set
+
+    @staticmethod
+    def _default_get(updater):
+        p = updater.params
+        if isinstance(p, dict) and "persistent" in p:
+            return p["persistent"]
+        return None
+
+    @staticmethod
+    def _default_set(updater, value):
+        updater.params = {**updater.params, "persistent": value}
+
+    def allreduce_persistent(self, updater) -> None:
+        state = self._get(updater)
+        if state is None:
+            return
+        if self.comm.inter_size > 1:
+            # host-side object-path mean over processes (persistent values
+            # are tiny — BN stats — so the pickle path is the right tool)
+            local = jax.tree.map(lambda a: np.asarray(a), state)
+            summed = self.comm.allreduce_obj(local, op="sum")
+            state = jax.tree.map(
+                lambda a: a / self.comm.inter_size, summed)
+        self._set(updater, state)
+
+    def __call__(self, trainer) -> None:
+        self.allreduce_persistent(trainer.updater)

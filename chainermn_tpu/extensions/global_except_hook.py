@@ -1,0 +1,66 @@
+"""Global except hook — one process's crash kills the whole job.
+
+Reference: ``chainermn/global_except_hook.py`` (unverified — mount empty,
+see SURVEY.md): installs ``sys.excepthook`` that prints the rank-prefixed
+traceback then ``MPI_Abort``s COMM_WORLD, converting a one-rank crash into
+whole-job termination instead of the surviving ranks deadlocking inside a
+collective.
+
+TPU analogue: an uncaught exception on one host of a multi-host JAX job
+leaves the other hosts blocked in an XLA collective exactly the same way.
+The hook prints the traceback tagged with ``jax.process_index``, attempts a
+clean ``jax.distributed.shutdown()`` (which drops the coordinator heartbeat
+so peers fail fast), then hard-exits — ``os._exit`` rather than
+``sys.exit`` so no atexit/flush machinery can hang the abort, mirroring
+MPI_Abort's semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+__all__ = ["add_global_except_hook"]
+
+_installed = False
+
+
+def _make_hook(prev_hook):
+    def _global_except_hook(exc_type, exc_value, exc_traceback):
+        try:
+            try:
+                import jax
+                rank = jax.process_index()
+                nprocs = jax.process_count()
+            except Exception:
+                rank, nprocs = 0, 1
+            sys.stderr.write(
+                f"\nUncaught exception on process {rank}/{nprocs} — "
+                "aborting the whole job (global except hook):\n")
+            traceback.print_exception(
+                exc_type, exc_value, exc_traceback, file=sys.stderr)
+            sys.stderr.flush()
+            if nprocs > 1:
+                try:
+                    import jax
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                os._exit(1)  # MPI_Abort analogue: no cleanup, no hangs
+            # single process: defer to the previous hook (normal exit path)
+            prev_hook(exc_type, exc_value, exc_traceback)
+        except Exception:
+            os._exit(1)
+
+    return _global_except_hook
+
+
+def add_global_except_hook() -> None:
+    """Idempotently install the hook (the reference auto-installed on
+    import; we keep it explicit so embedding applications stay in control)."""
+    global _installed
+    if _installed:
+        return
+    sys.excepthook = _make_hook(sys.excepthook)
+    _installed = True

@@ -1,0 +1,5 @@
+"""Utility subsystems: serialization, profiling/tracing, logging."""
+
+from chainermn_tpu.utils.serialization import load_state, save_state
+
+__all__ = ["load_state", "save_state"]

@@ -1,0 +1,53 @@
+"""Pytree snapshot serialization — the package's own serializer (the
+reference leaned on ``chainer.serializers.save_npz``; SURVEY §7 step 4 calls
+for an orbax-style layout but our own implementation, no orbax dependency).
+
+Format: one ``.npz`` per snapshot holding every leaf as a named array
+(``leaf_00000``, ...) plus the pickled treedef — self-contained, atomic
+(write to ``.tmp`` then rename), resumable within the same code version.
+Device arrays are pulled to host with ``jax.device_get`` so saving works
+for sharded/replicated params alike (each process saves its addressable
+view — the per-process *shard* file of the multi-node checkpointer).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+__all__ = ["save_state", "load_state"]
+
+
+def save_state(path: str, pytree) -> None:
+    """Atomically write ``pytree`` (arrays / numeric scalars) to ``path``."""
+    leaves, treedef = jax.tree.flatten(jax.device_get(pytree))
+    payload = {f"leaf_{i:05d}": np.asarray(v) for i, v in enumerate(leaves)}
+    # npz keeps only stock numpy dtypes; ml_dtypes leaves (bfloat16, fp8)
+    # come back as raw void records — record true dtypes to view-cast back.
+    dtypes = [str(np.asarray(v).dtype) for v in leaves]
+    payload["__meta__"] = np.frombuffer(
+        pickle.dumps({"treedef": treedef, "dtypes": dtypes}), dtype=np.uint8)
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic on POSIX — no torn snapshots
+
+
+def load_state(path: str):
+    """Inverse of :func:`save_state`; returns the restored pytree."""
+    import ml_dtypes  # noqa: F401  (registers bfloat16/fp8 with numpy)
+
+    with np.load(path, allow_pickle=False) as z:
+        meta = pickle.loads(z["__meta__"].tobytes())
+        leaves = []
+        for i, dt in enumerate(meta["dtypes"]):
+            arr = z[f"leaf_{i:05d}"]
+            want = np.dtype(dt)
+            if arr.dtype != want:
+                arr = arr.view(want)
+            leaves.append(arr)
+    return jax.tree.unflatten(meta["treedef"], leaves)
