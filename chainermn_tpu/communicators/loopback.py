@@ -95,8 +95,10 @@ class LoopbackCommunicator(CommunicatorBase):
         pass
 
     def bcast_data(self, params, root: int = 0):
-        return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), self._device),
-                            params)
+        # jnp.copy: donation-safe, see TpuXlaCommunicator.bcast_data
+        return jax.tree.map(
+            lambda a: jnp.copy(jax.device_put(jnp.asarray(a), self._device)),
+            params)
 
     def multi_node_mean_grad(self, grads, dtype=None):
         return jax.tree.map(self._chk, grads)

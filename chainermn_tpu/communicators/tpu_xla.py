@@ -349,7 +349,13 @@ class TpuXlaCommunicator(CommunicatorBase):
         sync via :meth:`bcast_obj` first.
         """
         repl = NamedSharding(self._mesh, P())
-        return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), repl),
+        # jnp.copy: callers feed the result into donating jitted steps
+        # (StandardUpdater) — device_put may alias the input buffer (even
+        # with may_alias=False, observed on the CPU backend), and donation
+        # would then delete the caller's original arrays out from under
+        # them; an explicit copy guarantees an independent buffer
+        return jax.tree.map(
+            lambda a: jnp.copy(jax.device_put(jnp.asarray(a), repl)),
                             params)
 
     def multi_node_mean_grad(self, grads, dtype=None):

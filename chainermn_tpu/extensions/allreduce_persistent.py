@@ -22,7 +22,8 @@ __all__ = ["AllreducePersistentValues"]
 
 
 class AllreducePersistentValues:
-    priority = 80  # before evaluators/snapshotters in the same fire
+    priority = 85  # strictly above Evaluator (80): averaged persistents
+    #               must be installed before evaluation in the same fire
 
     def __init__(self, comm, get_state=None, set_state=None):
         """``get_state(updater) -> pytree`` / ``set_state(updater, pytree)``

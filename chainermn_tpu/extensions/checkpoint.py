@@ -86,6 +86,7 @@ class MultiNodeCheckpointer:
         it = updater.iteration
         state = {
             "iteration": it,
+            "world_size": self.comm.inter_size,
             "params": updater.params,
             "opt_state": updater.opt_state,
         }
@@ -117,15 +118,21 @@ class MultiNodeCheckpointer:
         Returns the resumed iteration, or ``None`` when nothing to resume
         (fresh start — the reference's behaviour on first launch).
         """
-        world = self.comm.allgather_obj(self.comm.inter_size)
-        if len(set(world)) != 1:
-            raise RuntimeError(f"inconsistent world views: {world}")
         common = self._common_iterations()
         if not common:
             return None
         it = common[-1]
         fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
         state = load_state(os.path.join(self.path, fn))
+        saved_world = int(state.get("world_size", self.comm.inter_size))
+        if saved_world != self.comm.inter_size:
+            # same-world-size restart contract (the reference's implicit
+            # mpiexec -n N requirement, made explicit here)
+            raise RuntimeError(
+                f"snapshot at iteration {it} was saved with world size "
+                f"{saved_world}, but this job has {self.comm.inter_size} "
+                "processes — sharded checkpoints resume at identical world "
+                "size only (use multi_node_snapshot for resize-safe saves)")
         updater.params = state["params"]
         updater.opt_state = state["opt_state"]
         updater.iteration = int(state["iteration"])

@@ -41,9 +41,14 @@ class ObservationAggregator:
             if isinstance(v, (int, float)) or getattr(v, "ndim", None) == 0
         ]
         local = {k: float(obs[k]) for k in keys if k in obs}
-        summed = self.comm.allreduce_obj(local, op="sum")
-        for k, v in summed.items():
-            trainer.observation[k] = v / self.comm.inter_size
+        # processes may report divergent key sets (rank-0-only extensions,
+        # filtered keys) — allgather and average each key over the ranks
+        # that actually reported it, instead of a structural allreduce
+        gathered = self.comm.allgather_obj(local)
+        union = set().union(*(d.keys() for d in gathered))
+        for k in union:
+            vals = [d[k] for d in gathered if k in d]
+            trainer.observation[k] = sum(vals) / len(vals)
 
     def __call__(self, trainer) -> None:
         # aggregation happens in observe(); the triggered call is a no-op

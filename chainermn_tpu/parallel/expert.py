@@ -1,0 +1,103 @@
+"""Expert parallelism — Switch-style MoE with all-to-all token dispatch.
+
+Absent from the reference; built on the alltoall primitive the reference
+exposed as its most general collective (SURVEY.md §2: "EP — alltoall is the
+building block").  Shape of the strategy:
+
+- tokens live data-sharded over the ``expert`` mesh axis (the axis does
+  double duty: between MoE blocks it is an extra data axis, inside them it
+  is the expert home grid — the standard TPU MoE layout);
+- a linear router picks top-1 expert per token (Switch); tokens are packed
+  into per-expert capacity slots by a dispatch one-hot, so every shape
+  stays static for XLA (dropped overflow tokens pass through as zeros —
+  the residual connection carries them, standard Switch semantics);
+- ONE ``all_to_all`` ships slots to the experts' home devices, the expert
+  FFNs run batched (vmap over local experts → one big MXU matmul), and the
+  inverse ``all_to_all`` brings results home to be gate-combined;
+- the load-balancing auxiliary loss (fraction·probability product) is
+  returned for the trainer to add — ``psum``'d so it is the global value.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["expert_parallel_moe"]
+
+
+def expert_parallel_moe(
+    x,
+    router_w,
+    expert_params,
+    expert_fn: Callable,
+    *,
+    axis_name: str = "expert",
+    capacity_factor: float = 1.25,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 (Switch) mixture-of-experts over the ``expert`` mesh axis.
+    Call INSIDE ``shard_map``.
+
+    Args:
+      x: ``(N, D)`` local tokens (flatten batch×seq first).
+      router_w: ``(D, E)`` router weights, replicated; ``E`` = global
+        expert count = axis size × local experts.
+      expert_params: pytree with leading local-expert axis ``E_local``
+        (shard the global ``(E, ...)`` stack over ``axis_name``).
+      expert_fn: ``expert_fn(params_one_expert, tokens) -> tokens`` — the
+        per-expert network, vmapped over local experts here.
+      capacity_factor: slots per expert = ``cf · N / E`` (rounded up).
+
+    Returns ``(out, aux_loss)``: ``out`` is ``(N, D)`` with overflow
+    tokens zeroed; ``aux_loss`` the global Switch balancing loss (scalar).
+    """
+    S = lax.axis_size(axis_name)
+    N, D = x.shape
+    E = router_w.shape[-1]
+    if E % S:
+        raise ValueError(f"{E} experts not divisible by axis size {S}")
+    e_local = E // S
+    cap = max(1, math.ceil(capacity_factor * N / E))
+
+    # --- route (local, no comm) -------------------------------------- #
+    logits = x @ router_w                               # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = probs.max(axis=-1)                           # (N,)
+    choice = probs.argmax(axis=-1)                      # (N,)
+    onehot = jax.nn.one_hot(choice, E, dtype=x.dtype)   # (N, E)
+
+    # position of each token within its expert's queue; drop past capacity
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # (N, E)
+    keep = pos < cap
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=x.dtype)
+    dispatch = onehot[..., None] * slot * keep[..., None]   # (N, E, C)
+
+    # --- dispatch all-to-all ------------------------------------------ #
+    slots = jnp.einsum("nec,nd->ecd", dispatch, x)      # (E, C, D)
+    if S > 1:
+        # (E, C, D) → (E_local, S·C, D): chunk e-dim to peers, stack their
+        # slot blocks — every expert now holds its global token queue
+        slots = lax.all_to_all(slots, axis_name, split_axis=0,
+                               concat_axis=1, tiled=True)
+
+    # --- expert compute (batched over local experts) ------------------ #
+    hidden = jax.vmap(expert_fn)(expert_params, slots)  # (E_local, S·C, D)
+
+    # --- combine all-to-all (inverse) --------------------------------- #
+    if S > 1:
+        hidden = lax.all_to_all(hidden, axis_name, split_axis=1,
+                                concat_axis=0, tiled=True)
+    out = jnp.einsum("ecd,nec->nd", hidden, dispatch) * gate[:, None]
+
+    # --- Switch load-balancing loss (global) -------------------------- #
+    frac_tokens = onehot.mean(axis=0)                   # (E,)
+    frac_probs = probs.mean(axis=0)                     # (E,)
+    if S > 1:
+        frac_tokens = lax.pmean(frac_tokens, axis_name)
+        frac_probs = lax.pmean(frac_probs, axis_name)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return out, aux

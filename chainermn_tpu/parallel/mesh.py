@@ -1,0 +1,112 @@
+"""MeshConfig — the named-axis device mesh every strategy composes over.
+
+The reference's topology object was the MPI communicator (+ hierarchical
+sub-communicators built in ``_communication_utility.py``).  The TPU-native
+equivalent is one :class:`jax.sharding.Mesh` whose *named axes* carry the
+parallelism semantics; sub-communicators become axis names, and "which
+collective algorithm" (the reference's seven communicator classes) becomes
+"which axis the collective runs over" — XLA picks ring/tree per topology.
+
+Axis order is chosen so the chattiest axes are minor (contiguous device
+ids ⇒ same host / direct ICI): ``pipe`` (rare p2p) > ``data`` (one grad
+allreduce per step, can ride DCN) > ``expert`` > ``seq`` > ``model``
+(per-layer collectives, must be ICI-local).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MeshConfig"]
+
+# canonical major→minor order (see module docstring)
+_AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Factory + helpers for the 5-axis parallelism mesh.
+
+    Any axis of size 1 still exists in the mesh (size-1 collectives are
+    free and keep one code path for every configuration).
+
+    Example::
+
+        cfg = MeshConfig(data=2, model=2, pipe=2)   # 8 devices
+        with cfg.mesh:
+            ...
+    """
+
+    data: int = -1       # -1: absorb remaining devices
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+    devices: Optional[Sequence] = None
+    _mesh: Mesh = field(init=False, repr=False, compare=False, default=None)
+
+    def __post_init__(self):
+        sizes = {
+            "pipe": self.pipe, "data": self.data, "expert": self.expert,
+            "seq": self.seq, "model": self.model,
+        }
+        devs = sorted(self.devices or jax.devices(), key=lambda d: d.id)
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError("at most one axis may be -1")
+        known = int(np.prod([v for v in sizes.values() if v != -1]))
+        if unknown:
+            if len(devs) % known:
+                raise ValueError(
+                    f"{len(devs)} devices not divisible by {known}")
+            sizes[unknown[0]] = len(devs) // known
+            object.__setattr__(self, unknown[0], sizes[unknown[0]])
+        total = int(np.prod(list(sizes.values())))
+        if total != len(devs):
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices, have {len(devs)}")
+        arr = np.asarray(devs, dtype=object).reshape(
+            tuple(sizes[a] for a in _AXIS_ORDER))
+        object.__setattr__(
+            self, "_mesh", Mesh(arr, _AXIS_ORDER))
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return _AXIS_ORDER
+
+    def axis_size(self, name: str) -> int:
+        return self._mesh.shape[name]
+
+    # ---------------------------------------------------------------- #
+    # sharding helpers
+    # ---------------------------------------------------------------- #
+
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding from a PartitionSpec-style tuple."""
+        return NamedSharding(self._mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self._mesh, P())
+
+    def batch_spec(self) -> P:
+        """Batch dim sharded over data (and expert, which is data-like
+        between MoE blocks) — activations' leading-axis spec."""
+        return P(("data", "expert"))
+
+    def constraint(self, x, *spec):
+        """``with_sharding_constraint`` sugar usable inside pjit'ted code."""
+        return jax.lax.with_sharding_constraint(x, self.sharding(*spec))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        s = self._mesh.shape
+        return ("MeshConfig(" +
+                ", ".join(f"{a}={s[a]}" for a in _AXIS_ORDER) + ")")

@@ -1,0 +1,154 @@
+"""Pipeline parallelism with micro-batching (GPipe schedule).
+
+Reference parity-plus: ChainerMN's pipeline (``MultiNodeChainList`` +
+blocking p2p) kept exactly ONE activation in flight — fill/drain bubbles
+were unmitigated (SURVEY.md §3.3).  This module adds the micro-batched
+schedule the reference lacked: ``M`` micro-batches stream through ``S``
+stages in ``M + S - 1`` ticks, bubble fraction ``(S-1)/(M+S-1)``.
+
+TPU-native shape: ONE SPMD program over the ``pipe`` mesh axis —
+
+- stage parameters are *sharded* over the axis (device ``s`` holds only
+  stage ``s``'s weights: true memory scaling, unlike the replicated
+  ``MultiNodeChainList``);
+- activation hand-off is ``lax.ppermute`` (ICI neighbour copy);
+- the tick loop is ``lax.scan`` — compiled once, no Python per tick;
+- backward needs no hand-written reverse schedule: the transpose of
+  (scan ∘ ppermute) IS the reverse-order pipeline, with grads flowing
+  stage ``s`` ← ``s+1`` automatically.
+
+Composition: wrap in ``shard_map`` with the batch dim also sharded over
+``data`` and weights over ``model`` — the schedule is orthogonal to
+TP/DP/SP because it only touches the ``pipe`` axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["stack_stage_params", "pipeline_apply", "unstack_stage_params"]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _replicate_from(x, axis_name, src):
+    """Broadcast ``x`` from rank ``src`` with grad-correct transpose.
+
+    Forward: masked psum (zeros off ``src`` ⇒ the sum IS the broadcast).
+    Backward: under the SPMD convention every rank seeds the same cotangent
+    (each differentiates its identical copy of the loss), so the raw psum
+    transpose would hand ``src`` the cotangent summed over all ranks —
+    scaling pipeline-stage grads by the axis size.  The custom rule takes
+    the *mean* of the cotangents instead, restoring the logical gradient.
+    """
+    idx = lax.axis_index(axis_name)
+    return lax.psum(
+        jnp.where(idx == src, x, jnp.zeros_like(x)), axis_name)
+
+
+def _replicate_fwd(x, axis_name, src):
+    return _replicate_from(x, axis_name, src), None
+
+
+def _replicate_bwd(axis_name, src, _, ct):
+    idx = lax.axis_index(axis_name)
+    g = lax.pmean(ct, axis_name)
+    return (jnp.where(idx == src, g, jnp.zeros_like(g)),)
+
+
+_replicate_from.defvjp(_replicate_fwd, _replicate_bwd)
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage pytrees along a new leading ``stage`` axis (to be
+    sharded over ``pipe``).  All stages must share one structure — the
+    homogeneous-stack contract that lets stage weights shard instead of
+    replicate (heterogeneous graphs: use ``links.MultiNodeChainList``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def unstack_stage_params(stacked):
+    """Inverse of :func:`stack_stage_params` (host-side convenience)."""
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    return [jax.tree.map(lambda a: a[i], stacked) for i in range(n)]
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x,
+    *,
+    axis_name: str = "pipe",
+    num_microbatches: int,
+    remat: bool = True,
+):
+    """Run the GPipe schedule.  Call INSIDE ``shard_map`` over ``axis_name``.
+
+    Args:
+      stage_fn: ``stage_fn(params, mb) -> mb`` — one stage's computation;
+        must preserve the micro-batch's shape/dtype (chainable stages).
+      stage_params: THIS device's stage weights — pass the stacked params
+        into shard_map with the leading stage axis sharded over
+        ``axis_name`` and a leading axis of size 1 here (it is squeezed).
+      x: full local batch ``(B, ...)`` with ``B % num_microbatches == 0``;
+        replicated over the pipe axis (only stage 0 reads it).
+      num_microbatches: ``M``; larger M shrinks the bubble
+        ``(S-1)/(M+S-1)`` at the cost of smaller per-tick matmuls — keep
+        micro-batches big enough to fill the MXU.
+      remat: rematerialise each stage application in backward (GPipe's
+        memory trick: store only stage boundaries, recompute inside).
+
+    Returns the full batch output ``(B, ...)``, replicated over the pipe
+    axis (masked psum from the last stage — so downstream loss code is
+    identical with and without pipelining).
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = num_microbatches
+
+    # squeeze the sharded leading stage axis (shard size 1 per device)
+    params = jax.tree.map(
+        lambda a: jnp.squeeze(a, axis=0), stage_params)
+
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mbs = x.reshape(M, B // M, *x.shape[1:])
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    up_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        act, outputs = carry
+        # neighbour hand-off: device s receives device s-1's last output
+        recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
+        # stage 0 injects micro-batch t (clamped; ticks ≥ M push don't-care
+        # values that drain past the last stage after the loop window)
+        xt = mbs[jnp.minimum(t, M - 1)]
+        inp = jnp.where(stage == 0, xt, recv)
+        out = fn(params, inp)
+        # last stage banks micro-batch t-(S-1) once the pipe is full
+        idx = jnp.clip(t - (S - 1), 0, M - 1)
+        updated = lax.dynamic_update_index_in_dim(outputs, out, idx, 0)
+        outputs = jnp.where(t >= S - 1, updated, outputs)
+        return (out, outputs), None
+
+    # initial carries are invariant zeros but become device-varying inside
+    # the loop — mark them varying up front (shard_map vma discipline)
+    act0 = lax.pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
+    outs0 = lax.pcast(
+        jnp.zeros((M,) + mbs.shape[1:], dtype=x.dtype),
+        (axis_name,), to="varying")
+    (_, outputs), _ = lax.scan(
+        tick, (act0, outs0), jnp.arange(M + S - 1))
+
+    # broadcast the last stage's accumulator so downstream loss code is
+    # identical with and without pipelining (grad-correct custom transpose;
+    # also runs for S=1, where the free psum marks the result replicated)
+    outputs = _replicate_from(outputs, axis_name, S - 1)
+    return outputs.reshape(B, *x.shape[1:])

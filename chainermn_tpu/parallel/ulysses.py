@@ -1,0 +1,64 @@
+"""Ulysses-style sequence parallelism — head↔sequence all-to-all.
+
+Absent from the reference; the generic ``alltoall`` FunctionNode it *did*
+expose (``chainermn/functions/collective_communication.py``) is exactly the
+primitive this strategy is built from (SURVEY.md §2: "EP/SP — alltoall is
+the building block"), so this module is the TPU-native completion of that
+thread:
+
+1. activations arrive sequence-sharded ``(B, T/S, H, D)``;
+2. one ``all_to_all`` re-shards heads and gathers sequence →
+   ``(B, T, H/S, D)`` — each device now sees the FULL sequence for a
+   subset of heads;
+3. attention runs locally (any kernel — the pallas flash kernel slots in
+   here) with no further communication, exact softmax, any mask;
+4. the inverse ``all_to_all`` restores sequence sharding.
+
+Trade-off vs ring attention: Ulysses moves activations twice but keeps
+attention exact-local (better for short-ish T with many heads, and any
+non-causal mask pattern); ring keeps activations resident and rotates K/V
+(better for very long T).  Both compose with DP/TP over other mesh axes;
+``H`` must be divisible by the ``seq`` axis size here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+from chainermn_tpu.parallel.ring_attention import local_attention
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "seq",
+                      causal: bool = False,
+                      attn_fn: Optional[Callable] = None):
+    """Sequence-parallel exact attention.  Call INSIDE ``shard_map`` over
+    ``axis_name`` with Q/K/V sequence-sharded ``(B, T/S, H, D)``.
+
+    ``attn_fn(q, k, v, causal=...)`` runs on full-sequence, head-sharded
+    tensors; defaults to :func:`local_attention` (swap in the pallas flash
+    kernel for production).
+
+    Returns ``(B, T/S, H, D)`` sequence-sharded, numerically identical to
+    full attention (no online-softmax approximation anywhere).
+    """
+    S = lax.axis_size(axis_name)
+    if S > 1:
+        if q.shape[2] % S:
+            raise ValueError(
+                f"heads {q.shape[2]} not divisible by seq-axis size {S}")
+        # (B, T/S, H, D) → (B, T, H/S, D): scatter heads, gather sequence
+        q, k, v = (
+            lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+            for t in (q, k, v))
+    fn = attn_fn or local_attention
+    out = fn(q, k, v, causal=causal)
+    if S > 1:
+        # inverse exchange: scatter sequence, gather heads
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                             tiled=True)
+    return out

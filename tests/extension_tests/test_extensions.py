@@ -85,18 +85,52 @@ class TestCheckpointer:
         assert files == ["snapshot_iter_30.0"]
 
     def test_resumes_latest_common(self, comm, tmp_path):
-        """A stray newer partial set (simulating another process's missing
-        shard) must not be chosen — only iterations ALL processes hold."""
         cp = create_multi_node_checkpointer(comm, str(tmp_path))
         up = FakeUpdater()
         up.iteration = 5
         cp.save(up)
-        # single-process world: local set == common set; check ordering
         up.iteration = 9
         cp.save(up)
         fresh = FakeUpdater(seed=3)
         assert create_multi_node_checkpointer(
             comm, str(tmp_path)).maybe_load(fresh) == 9
+
+    def test_partial_newer_set_not_chosen(self, comm, tmp_path, monkeypatch):
+        """The intersection logic: an iteration visible locally but missing
+        on another (simulated) process must be excluded from resume."""
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        up = FakeUpdater()
+        up.iteration = 9
+        cp.save(up)
+        # forge a NEWER shard for this rank only (bypassing save's GC) —
+        # as if this process wrote iteration 99 but a peer's shard is lost
+        save_state(str(tmp_path / "snapshot_iter_99.0"),
+                   {"iteration": 99, "world_size": 1,
+                    "params": up.params, "opt_state": up.opt_state})
+        loader = create_multi_node_checkpointer(comm, str(tmp_path))
+        assert loader._local_iterations() == {9, 99}
+        # simulate a peer that only holds iteration 9
+        monkeypatch.setattr(
+            loader.comm, "allgather_obj",
+            lambda obj: [obj, {9}] if isinstance(obj, set) else [obj])
+        fresh = FakeUpdater(seed=3)
+        assert loader.maybe_load(fresh) == 9
+        assert fresh.iteration == 9
+
+    def test_world_size_mismatch_raises(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        up = FakeUpdater()
+        up.iteration = 7
+        cp.save(up)
+        loader = create_multi_node_checkpointer(comm, str(tmp_path))
+        # rewrite the shard claiming it came from a 4-process world
+        from chainermn_tpu.utils.serialization import load_state, save_state
+        p = str(tmp_path / "snapshot_iter_7.0")
+        state = load_state(p)
+        state["world_size"] = np.int64(4)
+        save_state(p, state)
+        with pytest.raises(RuntimeError, match="world size"):
+            loader.maybe_load(FakeUpdater())
 
     def test_trainer_extension_protocol(self, comm, tmp_path):
         cp = create_multi_node_checkpointer(comm, str(tmp_path))
@@ -136,6 +170,9 @@ class _TwoProcComm:
         import jax
         return jax.tree.map(lambda a, b: a + b, obj, self._peer)
 
+    def allgather_obj(self, obj):
+        return [obj, self._peer]
+
 
 class TestObservationAggregator:
     def test_single_process_noop(self, comm):
@@ -152,6 +189,19 @@ class TestObservationAggregator:
         agg.observe(tr)
         assert tr.observation["main/loss"] == pytest.approx(3.0)
         assert tr.observation["note"] == "text"
+
+    def test_divergent_keys_averaged_over_reporters(self):
+        """A key reported by only some processes (rank-0-only extensions)
+        must be averaged over the reporting ranks, not crash or be diluted
+        by non-reporters."""
+        agg = ObservationAggregator(
+            _TwoProcComm({"main/loss": 4.0, "peer_only": 10.0}))
+        tr = FakeTrainer(FakeUpdater(), "out")
+        tr.observation = {"main/loss": 2.0, "local_only": 6.0}
+        agg.observe(tr)
+        assert tr.observation["main/loss"] == pytest.approx(3.0)
+        assert tr.observation["local_only"] == pytest.approx(6.0)
+        assert tr.observation["peer_only"] == pytest.approx(10.0)
 
 
 class TestAllreducePersistent:
