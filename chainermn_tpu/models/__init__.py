@@ -3,5 +3,26 @@ ResNet-50, seq2seq NMT) re-built TPU-first, plus the flagship transformer
 exercising every parallelism axis."""
 
 from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
+from .transformer import (
+    TransformerConfig,
+    init_transformer,
+    make_forward_fn,
+    make_train_step,
+    param_specs,
+    shard_params,
+    transformer_forward,
+)
 
-__all__ = ["accuracy", "init_mlp", "mlp_apply", "softmax_cross_entropy"]
+__all__ = [
+    "TransformerConfig",
+    "accuracy",
+    "init_mlp",
+    "init_transformer",
+    "make_forward_fn",
+    "make_train_step",
+    "mlp_apply",
+    "param_specs",
+    "shard_params",
+    "softmax_cross_entropy",
+    "transformer_forward",
+]

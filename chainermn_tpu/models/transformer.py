@@ -1,0 +1,379 @@
+"""Flagship transformer — the model that composes every parallelism axis.
+
+The reference had no transformer (its biggest model was ResNet-50 and an
+LSTM seq2seq); this is the "beyond-reference" flagship required by the task
+spec: ONE decoder-only LM whose single SPMD step exercises
+
+- **DP**    batch over ``data`` (+ ``expert`` between MoE blocks),
+- **TP**    Megatron column→row pairs over ``model``
+            (:mod:`chainermn_tpu.parallel.tensor`),
+- **SP/CP** ring attention or Ulysses all-to-all over ``seq``
+            (:mod:`parallel.ring_attention` / :mod:`parallel.ulysses`),
+- **PP**    GPipe micro-batching over ``pipe`` (:mod:`parallel.pipeline`),
+- **EP**    Switch-MoE all-to-all over ``expert`` (:mod:`parallel.expert`).
+
+Design rules (TPU-first):
+- one code path for every mesh shape — axes of size 1 cost nothing, so the
+  single-chip model IS the 5-axis model with a trivial mesh;
+- mixed precision: params fp32, matmuls bf16 (MXU native), loss fp32;
+- layers are a homogeneous stack scanned with ``lax.scan`` (compile time
+  independent of depth) and grouped ``(pipe_stages, layers_per_stage)`` so
+  stage weights *shard* over ``pipe``;
+- everything is plain pytrees + pure functions (jit/shard_map transparent).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.parallel.expert import expert_parallel_moe
+from chainermn_tpu.parallel.pipeline import pipeline_apply
+from chainermn_tpu.parallel.ring_attention import (
+    local_attention,
+    ring_attention,
+)
+from chainermn_tpu.parallel.tensor import (
+    column_parallel_dense,
+    row_parallel_dense,
+)
+from chainermn_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "TransformerConfig",
+    "init_transformer",
+    "transformer_forward",
+    "param_specs",
+    "make_forward_fn",
+    "make_train_step",
+]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    d_head: int = 64
+    d_ff: int = 2048
+    n_layers: int = 4          # total; must divide by mesh pipe size
+    max_seq: int = 2048
+    attention: str = "ring"    # "ring" | "ulysses" | "local"
+    moe: bool = False          # Switch-MoE MLP in every block
+    n_experts: int = 8         # global expert count (moe=True)
+    capacity_factor: float = 1.25
+    num_microbatches: int = 1  # GPipe M (>1 only useful when pipe > 1)
+    remat: bool = True
+    dtype: str = "bfloat16"    # compute dtype (params stay fp32)
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+# --------------------------------------------------------------------- #
+# init
+# --------------------------------------------------------------------- #
+
+
+def _init_block(key, cfg: TransformerConfig):
+    D, H, Dh, F = cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff
+    ks = jax.random.split(key, 6)
+
+    def dense_init(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)
+
+    block = {
+        "ln1": jnp.ones((D,), jnp.float32),
+        "ln2": jnp.ones((D,), jnp.float32),
+        "wqkv": dense_init(ks[0], (D, 3, H, Dh), D),
+        "wo": dense_init(ks[1], (H, Dh, D), H * Dh),
+    }
+    if cfg.moe:
+        E = cfg.n_experts
+        block["router"] = dense_init(ks[2], (D, E), D)
+        block["w1"] = dense_init(ks[3], (E, D, F), D)
+        block["w2"] = dense_init(ks[4], (E, F, D), F)
+    else:
+        block["w1"] = dense_init(ks[3], (D, F), D)
+        block["w2"] = dense_init(ks[4], (F, D), F)
+    return block
+
+
+def init_transformer(key, cfg: TransformerConfig, pipe_size: int = 1):
+    """Parameter pytree.  Blocks are stacked ``(pipe_size, L/pipe, ...)`` —
+    the leading axis shards over ``pipe``, the second is scanned locally."""
+    if cfg.n_layers % pipe_size:
+        raise ValueError(
+            f"{cfg.n_layers} layers not divisible by pipe={pipe_size}")
+    k_emb, k_pos, k_blocks = jax.random.split(key, 3)
+    blocks = [
+        _init_block(k, cfg)
+        for k in jax.random.split(k_blocks, cfg.n_layers)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    lps = cfg.n_layers // pipe_size
+    stacked = jax.tree.map(
+        lambda a: a.reshape(pipe_size, lps, *a.shape[1:]), stacked)
+    D = cfg.d_model
+    return {
+        "embed": jax.random.normal(
+            k_emb, (cfg.vocab_size, D), jnp.float32) * 0.02,
+        "pos": jax.random.normal(
+            k_pos, (cfg.max_seq, D), jnp.float32) * 0.02,
+        "blocks": stacked,
+        "ln_f": jnp.ones((D,), jnp.float32),
+    }
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpec pytree matching :func:`init_transformer`'s output.
+
+    TP shards head/ff dims over ``model``, EP shards experts over
+    ``expert``, PP shards the stage axis over ``pipe``; embeddings and
+    norms replicate.
+    """
+    blk = {
+        "ln1": P("pipe"),
+        "ln2": P("pipe"),
+        "wqkv": P("pipe", None, None, None, "model", None),
+        "wo": P("pipe", None, "model", None, None),
+    }
+    if cfg.moe:
+        blk["router"] = P("pipe")
+        blk["w1"] = P("pipe", None, "expert", None, "model")
+        blk["w2"] = P("pipe", None, "expert", "model", None)
+    else:
+        blk["w1"] = P("pipe", None, None, "model")
+        blk["w2"] = P("pipe", None, "model", None)
+    return {
+        "embed": P(),
+        "pos": P(),
+        "blocks": blk,
+        "ln_f": P(),
+    }
+
+
+# --------------------------------------------------------------------- #
+# forward (call INSIDE shard_map over the 5-axis mesh)
+# --------------------------------------------------------------------- #
+
+
+def _rms_norm(x, scale):
+    x32 = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * r * scale).astype(x.dtype)
+
+
+def _attention(cfg: TransformerConfig, h, blk):
+    """Pre-LN attention: column-parallel QKV (heads sharded over ``model``),
+    seq-parallel core (ring/Ulysses over ``seq``), row-parallel output."""
+    cd = cfg.compute_dtype
+    x = _rms_norm(h, blk["ln1"])
+    B, T, D = x.shape
+    Hl = blk["wqkv"].shape[2]          # local heads = H / model-axis size
+    qkv = column_parallel_dense(
+        x, blk["wqkv"].reshape(D, -1).astype(cd))
+    qkv = qkv.reshape(B, T, 3, Hl, cfg.d_head)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if cfg.attention == "ring":
+        o = ring_attention(q, k, v, axis_name="seq", causal=True,
+                           remat=cfg.remat)
+    elif cfg.attention == "ulysses":
+        o = ulysses_attention(q, k, v, axis_name="seq", causal=True)
+    elif cfg.attention == "local":
+        o = local_attention(q, k, v, causal=True)
+    else:
+        raise ValueError(cfg.attention)
+    o = row_parallel_dense(
+        o.reshape(B, T, -1), blk["wo"].reshape(-1, D).astype(cd))
+    return h + o
+
+
+def _mlp(cfg: TransformerConfig, h, blk):
+    """Pre-LN MLP: dense (column→row TP pair, one psum) or Switch-MoE
+    (expert all-to-alls; experts' FFNs are themselves TP-split)."""
+    cd = cfg.compute_dtype
+    x = _rms_norm(h, blk["ln2"])
+    if not cfg.moe:
+        y = jax.nn.relu(column_parallel_dense(x, blk["w1"].astype(cd)))
+        out = h + row_parallel_dense(y, blk["w2"].astype(cd))
+        return out, jnp.zeros((), jnp.float32)
+    B, T, D = x.shape
+
+    def expert_fn(p, tokens):
+        y = jax.nn.relu(column_parallel_dense(tokens, p["w1"]))
+        return row_parallel_dense(y, p["w2"])
+
+    out, aux = expert_parallel_moe(
+        x.reshape(B * T, D),
+        blk["router"].astype(cd),
+        {"w1": blk["w1"].astype(cd), "w2": blk["w2"].astype(cd)},
+        expert_fn,
+        axis_name="expert",
+        capacity_factor=cfg.capacity_factor,
+    )
+    return h + out.reshape(B, T, D), aux
+
+
+def _block(cfg: TransformerConfig, h, blk):
+    h = _attention(cfg, h, blk)
+    return _mlp(cfg, h, blk)
+
+
+def _stage(cfg: TransformerConfig, stage_params, h):
+    """One pipeline stage = scan over its ``layers_per_stage`` blocks.
+    MoE aux losses inside a pipelined stage are dropped (the Switch
+    balancing term is a regulariser; returning side outputs through the
+    GPipe schedule would break the homogeneous-stage contract)."""
+
+    def body(carry, blk):
+        out, _ = _block(cfg, carry, blk)
+        return out, None
+
+    h, _ = lax.scan(body, h, stage_params)
+    return h
+
+
+def transformer_forward(cfg: TransformerConfig, params, tokens):
+    """Logits for next-token prediction.  Call INSIDE shard_map.
+
+    Args:
+      params: local shards per :func:`param_specs` (blocks carry the
+        ``(pipe_local=1, layers_per_stage, ...)`` leading axes).
+      tokens: ``(B_local, T_local)`` int32 — batch sharded over
+        ``("data","expert")``, sequence over ``seq``.
+
+    Returns ``(B_local, T_local, vocab)`` fp32 logits and the summed MoE
+    aux loss (zero when ``moe=False`` or pipelined).
+    """
+    cd = cfg.compute_dtype
+    B, T = tokens.shape
+    r = lax.axis_index("seq")
+
+    h = params["embed"][tokens]                        # (B, T, D) fp32
+    pos = lax.dynamic_slice_in_dim(params["pos"], r * T, T, axis=0)
+    h = (h + pos).astype(cd)
+
+    S = lax.axis_size("pipe")
+    if S > 1 or cfg.num_microbatches > 1:
+        h = pipeline_apply(
+            partial(_stage, cfg),
+            params["blocks"],
+            h,
+            axis_name="pipe",
+            num_microbatches=cfg.num_microbatches,
+            remat=cfg.remat,
+        )
+        aux = jnp.zeros((), jnp.float32)
+    else:
+        blocks = jax.tree.map(
+            lambda a: jnp.squeeze(a, axis=0), params["blocks"])
+
+        def body(carry, blk):
+            h, aux = carry
+            fn = jax.checkpoint(partial(_block, cfg)) if cfg.remat \
+                else partial(_block, cfg)
+            h, a = fn(h, blk)
+            return (h, aux + a), None
+
+        # block params are pipe-sharded (varying) even at pipe size 1, so
+        # the carry must be marked pipe-varying going in; the closing psum
+        # over the size-1 axis is a free re-replication (vma discipline).
+        # aux derives from h so it inherits the batch axes' variance too.
+        vary = partial(lax.pcast, axis_name=("pipe",), to="varying")
+        aux0 = jnp.sum(h * 0, dtype=jnp.float32)
+        (h, aux), _ = lax.scan(body, (vary(h), vary(aux0)), blocks)
+        h = lax.psum(h, "pipe")
+        aux = lax.psum(aux, "pipe")
+
+    h = _rms_norm(h, params["ln_f"])
+    # weight-tied head; fp32 logits for a stable softmax
+    logits = jnp.einsum(
+        "btd,vd->btv", h.astype(jnp.float32), params["embed"])
+    return logits, aux
+
+
+def lm_loss(cfg: TransformerConfig, params, inputs, targets):
+    """Local-shard mean next-token cross-entropy (+0.01·aux)."""
+    logits, aux = transformer_forward(cfg, params, inputs)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1).squeeze(-1)
+    return nll.mean() + 0.01 * aux
+
+
+# --------------------------------------------------------------------- #
+# jitted entry points
+# --------------------------------------------------------------------- #
+
+_BATCH_SPEC = P(("data", "expert"), "seq")
+
+
+def shard_params(mesh_cfg, cfg: TransformerConfig, params):
+    """Place a host-initialised param pytree per :func:`param_specs`.
+
+    The reference's ``comm.bcast_data(model)`` moment: after this, every
+    device holds exactly its shard (replicated leaves on all)."""
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, mesh_cfg.sharding(*s)),
+        params, param_specs(cfg))
+
+
+def make_forward_fn(mesh_cfg, cfg: TransformerConfig):
+    """``fn(params, tokens) -> logits`` — jittable, shard_map'd over the
+    full mesh.  Single-chip (all axes 1) and 5-axis runs share this path."""
+
+    def fwd(params, tokens):
+        logits, _ = transformer_forward(cfg, params, tokens)
+        return logits
+
+    return jax.jit(
+        jax.shard_map(
+            fwd,
+            mesh=mesh_cfg.mesh,
+            in_specs=(param_specs(cfg), _BATCH_SPEC),
+            out_specs=P(("data", "expert"), "seq"),
+        ))
+
+
+def make_train_step(mesh_cfg, cfg: TransformerConfig, optimizer):
+    """Full jitted SPMD train step over all five axes.
+
+    ``step(params, opt_state, inputs, targets) -> (params, opt_state,
+    loss)``; inputs/targets ``(B, T)`` globally, sharded per
+    ``_BATCH_SPEC``.  The loss is pmean'd over the batch-like axes inside
+    the differentiated function, so shard_map AD inserts the gradient
+    psums exactly where ChainerMN ran ``multi_node_mean_grad`` (SURVEY
+    §3.1) — and leaves sharded (TP/PP/EP) parameter grads local.
+
+    Only grad computation needs manual SPMD (the parallel modules want
+    bound axis names); the optimiser update is elementwise, so it runs
+    under plain jit where XLA propagates the grads' shardings through
+    arbitrary optax state pytrees (which ``param_specs`` could not
+    describe structurally).
+    """
+    specs = param_specs(cfg)
+
+    grad_fn = jax.shard_map(
+        lambda p, x, y: jax.value_and_grad(
+            lambda q: lax.pmean(
+                lm_loss(cfg, q, x, y), ("data", "expert", "seq")))(p),
+        mesh=mesh_cfg.mesh,
+        in_specs=(specs, _BATCH_SPEC, _BATCH_SPEC),
+        out_specs=(P(), specs),
+    )
+
+    def step(params, opt_state, inputs, targets):
+        loss, grads = grad_fn(params, inputs, targets)
+        updates, new_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

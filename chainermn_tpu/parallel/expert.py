@@ -64,17 +64,21 @@ def expert_parallel_moe(
     cap = max(1, math.ceil(capacity_factor * N / E))
 
     # --- route (local, no comm) -------------------------------------- #
-    logits = x @ router_w                               # (N, E)
+    # routing/dispatch bookkeeping is fp32 regardless of compute dtype:
+    # a bf16 cumsum counts token queue positions exactly only up to 256,
+    # after which capacity slots collide and dispatch silently corrupts
+    logits = (x @ router_w).astype(jnp.float32)         # (N, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = probs.max(axis=-1)                           # (N,)
+    gate = probs.max(axis=-1).astype(x.dtype)           # (N,)
     choice = probs.argmax(axis=-1)                      # (N,)
-    onehot = jax.nn.one_hot(choice, E, dtype=x.dtype)   # (N, E)
+    onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)   # (N, E)
 
     # position of each token within its expert's queue; drop past capacity
     pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # (N, E)
     keep = pos < cap
-    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=x.dtype)
-    dispatch = onehot[..., None] * slot * keep[..., None]   # (N, E, C)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+    dispatch = (onehot[..., None] * slot *
+                keep[..., None]).astype(x.dtype)        # (N, E, C)
 
     # --- dispatch all-to-all ------------------------------------------ #
     slots = jnp.einsum("nec,nd->ecd", dispatch, x)      # (E, C, D)

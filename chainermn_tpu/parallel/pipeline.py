@@ -138,12 +138,11 @@ def pipeline_apply(
         outputs = jnp.where(t >= S - 1, updated, outputs)
         return (out, outputs), None
 
-    # initial carries are invariant zeros but become device-varying inside
-    # the loop — mark them varying up front (shard_map vma discipline)
-    act0 = lax.pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
-    outs0 = lax.pcast(
-        jnp.zeros((M,) + mbs.shape[1:], dtype=x.dtype),
-        (axis_name,), to="varying")
+    # initial carries are zeros that must carry the UNION of the input's
+    # varying axes (data/seq/... under composition) plus the pipe axis —
+    # deriving them from mbs inherits the vma, the multiply folds away
+    act0 = lax.pcast(mbs[0] * 0, (axis_name,), to="varying")
+    outs0 = lax.pcast(mbs * 0, (axis_name,), to="varying")
     (_, outputs), _ = lax.scan(
         tick, (act0, outs0), jnp.arange(M + S - 1))
 

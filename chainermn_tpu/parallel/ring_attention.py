@@ -25,8 +25,6 @@ context length scale linearly with ring size.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -95,12 +93,14 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
 
     step = jax.checkpoint(block_step) if remat else block_step
 
-    # initial accumulators are constant zeros but the loop makes them
-    # device-varying — mark up front (shard_map vma discipline)
-    vary = partial(lax.pcast, axis_name=(axis_name,), to="varying")
-    num0 = vary(jnp.zeros((B, H, T, D), q.dtype))
-    den0 = vary(jnp.zeros((B, H, T), q.dtype))
-    m0 = vary(jnp.full((B, H, T), _NEG, q.dtype))
+    # initial accumulators are zeros that must carry the UNION of q's
+    # varying axes (q is seq-sharded, so the ring axis is always present;
+    # under composition it may vary over data/model/pipe too) — deriving
+    # them from q inherits the vma, and the multiply folds away in XLA
+    zq = (q * 0).transpose(0, 2, 1, 3)
+    num0 = zq                                            # (B,H,T,D)
+    den0 = zq[..., 0]                                    # (B,H,T)
+    m0 = den0 + jnp.asarray(_NEG, q.dtype)
     (k, v, num, den, m), _ = lax.scan(
         step, (k, v, num0, den0, m0), jnp.arange(S))
     out = num / den[..., None]                           # (B,H,T,D)
