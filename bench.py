@@ -1,0 +1,93 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+Reference baseline: ChainerMN's 15-min-ImageNet recipe (Akiba et al.,
+arXiv:1711.04325) sustained 1.28M*90/900s over 1024 P100s ≈ **125
+images/sec/chip** (see BASELINE.md).  ``vs_baseline`` is ours / 125.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Run on whatever jax.default_backend() provides (the driver gives one real
+TPU chip); a full train step (fwd+bwd+SGD momentum, bf16 compute,
+sync-BN code path with a size-1 axis) on synthetic on-device data.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.models import (
+    ResNetConfig, init_resnet, resnet_apply, softmax_cross_entropy,
+)
+from chainermn_tpu.parallel import MeshConfig
+
+BASELINE_IMG_S_PER_CHIP = 125.0
+
+
+def make_step(mc, cfg, opt):
+    def loss_fn(params, state, x, y):
+        logits, new_state = resnet_apply(
+            cfg, params, state, x, train=True, axis_name="data")
+        nll = softmax_cross_entropy(logits, y)
+        return jax.lax.pmean(nll, "data"), new_state
+
+    def sharded_grad(params, state, x, y):
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, state, x, y)
+        return loss, new_state, jax.tree.map(
+            lambda g: jax.lax.pmean(g, "data"), grads)
+
+    grad_fn = jax.shard_map(
+        sharded_grad, mesh=mc.mesh,
+        in_specs=(P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P()),
+    )
+
+    def step(params, state, opt_state, x, y):
+        loss, new_state, grads = grad_fn(params, state, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state, \
+            opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def run(batch=256, image=224, warmup=3, iters=10):
+    cfg = ResNetConfig(depth=50, num_classes=1000, dtype="bfloat16")
+    mc = MeshConfig(data=1, devices=jax.devices()[:1])
+    params, state = init_resnet(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(opt.init)(params)
+
+    kx, ky = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
+    y = jax.random.randint(ky, (batch,), 0, cfg.num_classes)
+    x = jax.device_put(x, mc.sharding("data"))
+    y = jax.device_put(y, mc.sharding("data"))
+
+    step = make_step(mc, cfg, opt)
+    for _ in range(warmup):
+        params, state, opt_state, loss = step(params, state, opt_state, x, y)
+    # sync via host transfer: on the experimental axon platform
+    # block_until_ready() returns before execution finishes, so timing
+    # must anchor on a device->host copy of a value from the last step
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state, opt_state, loss = step(params, state, opt_state, x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+if __name__ == "__main__":
+    img_s = run()
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S_PER_CHIP, 3),
+    }))

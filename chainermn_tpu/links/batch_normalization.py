@@ -79,23 +79,29 @@ def multi_node_batch_normalization(
     """
     gamma, beta = params["gamma"], params["beta"]
     reduce_axes = tuple(range(x.ndim - 1))
+    # Statistics and the normalisation math run in fp32 regardless of the
+    # activation dtype: E[x²]−E[x]² cancels catastrophically in bf16 (can
+    # go negative → NaN rsqrt), and fp32 gamma/beta would otherwise
+    # silently promote the output.  The result is cast back to x.dtype so
+    # a bf16 model stays bf16 through the conv stack.
+    x32 = x.astype(jnp.float32)
 
     if not train:
         inv = lax.rsqrt(state.var + eps) * gamma
-        return x * inv + (beta - state.mean * inv), state
+        return (x32 * inv + (beta - state.mean * inv)).astype(x.dtype), state
 
     # Global batch statistics: local moments, then mean over the mesh axis.
     # (Mean-of-means is exact because every device holds the same local
     # batch size — the same assumption the reference's allreduce/size made.)
-    mean = jnp.mean(x, axis=reduce_axes)
-    sq_mean = jnp.mean(jnp.square(x), axis=reduce_axes)
+    mean = jnp.mean(x32, axis=reduce_axes)
+    sq_mean = jnp.mean(jnp.square(x32), axis=reduce_axes)
     if axis_name is not None:
         mean = lax.pmean(mean, axis_name)
         sq_mean = lax.pmean(sq_mean, axis_name)
     var = sq_mean - jnp.square(mean)
 
     inv = lax.rsqrt(var + eps) * gamma
-    y = x * inv + (beta - mean * inv)
+    y = (x32 * inv + (beta - mean * inv)).astype(x.dtype)
 
     # Running stats with the reference's unbiased-variance correction.
     m = x.size // x.shape[-1]

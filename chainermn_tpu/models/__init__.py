@@ -3,6 +3,7 @@ ResNet-50, seq2seq NMT) re-built TPU-first, plus the flagship transformer
 exercising every parallelism axis."""
 
 from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
+from .resnet import ResNetConfig, init_resnet, resnet_apply
 from .transformer import (
     TransformerConfig,
     init_transformer,
@@ -14,7 +15,10 @@ from .transformer import (
 )
 
 __all__ = [
+    "ResNetConfig",
     "TransformerConfig",
+    "init_resnet",
+    "resnet_apply",
     "accuracy",
     "init_mlp",
     "init_transformer",
