@@ -90,6 +90,8 @@ class MultiNodeCheckpointer:
             "params": updater.params,
             "opt_state": updater.opt_state,
         }
+        if getattr(updater, "state", None) is not None:
+            state["model_state"] = updater.state
         fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
         save_state(os.path.join(self.path, fn), state)
         self._saved_iterations.add(it)
@@ -135,6 +137,8 @@ class MultiNodeCheckpointer:
                 "size only (use multi_node_snapshot for resize-safe saves)")
         updater.params = state["params"]
         updater.opt_state = state["opt_state"]
+        if "model_state" in state:
+            updater.state = state["model_state"]
         updater.iteration = int(state["iteration"])
         self._saved_iterations = self._local_iterations()
         return it

@@ -36,6 +36,8 @@ class _MultiNodeSnapshot:
             "params": trainer.updater.params,
             "opt_state": trainer.updater.opt_state,
         }
+        if getattr(trainer.updater, "state", None) is not None:
+            state["model_state"] = trainer.updater.state
         if self.comm.inter_rank == self.writer_rank:
             path = os.path.join(
                 trainer.out,
@@ -56,5 +58,7 @@ def load_snapshot(updater, path: str) -> Optional[int]:
     state = load_state(path)
     updater.params = state["params"]
     updater.opt_state = state["opt_state"]
+    if "model_state" in state:
+        updater.state = state["model_state"]
     updater.iteration = int(state["iteration"])
     return updater.iteration

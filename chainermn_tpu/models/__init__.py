@@ -4,6 +4,12 @@ exercising every parallelism axis."""
 
 from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
 from .resnet import ResNetConfig, init_resnet, resnet_apply
+from .seq2seq import (
+    Seq2seqConfig,
+    init_seq2seq,
+    seq2seq_loss,
+    seq2seq_translate,
+)
 from .transformer import (
     TransformerConfig,
     init_transformer,
@@ -16,7 +22,11 @@ from .transformer import (
 
 __all__ = [
     "ResNetConfig",
+    "Seq2seqConfig",
     "TransformerConfig",
+    "init_seq2seq",
+    "seq2seq_loss",
+    "seq2seq_translate",
     "init_resnet",
     "resnet_apply",
     "accuracy",

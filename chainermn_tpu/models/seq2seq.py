@@ -1,0 +1,215 @@
+"""Seq2seq NMT — analogue of the reference's ``examples/seq2seq/seq2seq.py``
+encoder-decoder LSTM (reference unverified — mount empty, see SURVEY.md).
+
+The reference used Chainer's ragged ``NStepLSTM`` over variable-length
+minibatches; its distributed twist was that *ragged* gradients (embedding
+rows touched by different ranks differ per step) still allreduce cleanly.
+
+TPU-first redesign: ragged tensors are anti-XLA (dynamic shapes retrace /
+fall off the MXU), so sequences are **padded to static shapes with length
+masks**, and the LSTMs are ``lax.scan``s — one compiled program for every
+batch, masked positions contribute zero loss *and zero state update* (the
+scan carries the pre-pad state through, so final encoder states equal the
+ragged computation's, not the pad-polluted one).  The "variable-length
+allreduce" property survives as: the masked loss / its grads are dense
+fixed-shape pytrees, so the DP ``pmean`` is one static collective no
+matter how ragged the text is.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "Seq2seqConfig",
+    "init_seq2seq",
+    "seq2seq_loss",
+    "seq2seq_translate",
+]
+
+PAD, BOS, EOS = 0, 1, 2  # reserved token ids (reference convention)
+
+
+@dataclass(frozen=True)
+class Seq2seqConfig:
+    src_vocab: int = 8000
+    tgt_vocab: int = 8000
+    d_embed: int = 256
+    d_hidden: int = 256
+    n_layers: int = 2
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+# --------------------------------------------------------------------- #
+# init
+# --------------------------------------------------------------------- #
+
+
+def _lstm_init(key, d_in, d_hidden):
+    k_w, k_u = jax.random.split(key)
+    scale_w, scale_u = d_in ** -0.5, d_hidden ** -0.5
+    return {
+        "w": jax.random.normal(k_w, (d_in, 4 * d_hidden), jnp.float32)
+        * scale_w,
+        "u": jax.random.normal(k_u, (d_hidden, 4 * d_hidden), jnp.float32)
+        * scale_u,
+        "b": jnp.zeros((4 * d_hidden,), jnp.float32),
+    }
+
+
+def _stack_init(key, cfg: Seq2seqConfig):
+    keys = jax.random.split(key, cfg.n_layers)
+    return [
+        _lstm_init(k, cfg.d_embed if i == 0 else cfg.d_hidden, cfg.d_hidden)
+        for i, k in enumerate(keys)
+    ]
+
+
+def init_seq2seq(key, cfg: Seq2seqConfig):
+    ks = jax.random.split(key, 5)
+    return {
+        "src_embed": jax.random.normal(
+            ks[0], (cfg.src_vocab, cfg.d_embed), jnp.float32) * 0.1,
+        "tgt_embed": jax.random.normal(
+            ks[1], (cfg.tgt_vocab, cfg.d_embed), jnp.float32) * 0.1,
+        "encoder": _stack_init(ks[2], cfg),
+        "decoder": _stack_init(ks[3], cfg),
+        "proj": {
+            "w": jax.random.normal(
+                ks[4], (cfg.d_hidden, cfg.tgt_vocab), jnp.float32)
+            * cfg.d_hidden ** -0.5,
+            "b": jnp.zeros((cfg.tgt_vocab,), jnp.float32),
+        },
+    }
+
+
+# --------------------------------------------------------------------- #
+# LSTM stack over a scan
+# --------------------------------------------------------------------- #
+
+
+def _lstm_cell(p, h, c, x):
+    z = x @ p["w"].astype(x.dtype) + h @ p["u"].astype(x.dtype) \
+        + p["b"].astype(x.dtype)
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+def _run_stack(layers, hs, cs, xs, mask):
+    """Scan a masked multi-layer LSTM over time.
+
+    Args:
+      hs/cs: list per layer of ``(B, H)`` initial states.
+      xs: ``(T, B, E)`` time-major inputs.
+      mask: ``(T, B)`` 1.0 at real tokens — pad steps carry state through
+        unchanged, so final states match the unpadded computation.
+
+    Returns ``(top_outputs (T, B, H), final (hs, cs))``.
+    """
+
+    def step(carry, inp):
+        hs, cs = carry
+        x, m = inp
+        m = m[:, None]
+        new_hs, new_cs = [], []
+        for layer, h, c in zip(layers, hs, cs):
+            h2, c2 = _lstm_cell(layer, h, c, x)
+            h = m * h2 + (1.0 - m) * h
+            c = m * c2 + (1.0 - m) * c
+            new_hs.append(h)
+            new_cs.append(c)
+            x = h
+        return (new_hs, new_cs), x
+
+    (hs, cs), top = lax.scan(step, (hs, cs), (xs, mask))
+    return top, (hs, cs)
+
+
+def _encode(cfg, params, src):
+    """``src (B, Ts)`` padded with PAD → final (hs, cs) for the decoder."""
+    cd = cfg.compute_dtype
+    mask = (src != PAD).astype(cd).T                     # (Ts, B)
+    xs = params["src_embed"][src].astype(cd).transpose(1, 0, 2)
+    # zero state built FROM the inputs so that under shard_map the scan
+    # carry is batch-axis-varying like the activations (a literal zeros
+    # carry is device-invariant → carry-type mismatch at trace time)
+    zero = jnp.zeros_like(xs, shape=(src.shape[0], cfg.d_hidden)) \
+        + 0.0 * jnp.sum(xs, axis=(0, 2))[:, None]
+    hs = [zero for _ in range(cfg.n_layers)]
+    cs = [zero for _ in range(cfg.n_layers)]
+    _, state = _run_stack(params["encoder"], hs, cs, xs, mask)
+    return state
+
+
+def seq2seq_loss(cfg: Seq2seqConfig, params, src, tgt):
+    """Masked mean cross-entropy of teacher-forced decoding.
+
+    ``src (B, Ts)``, ``tgt (B, Tt)`` — both PAD-padded.  ``tgt`` must END
+    each sequence with ``EOS`` (so the model learns to stop — see
+    ``seq2seq_translate``); ``BOS`` must NOT be included (the decoder input
+    shift adds it here).  The mean is over *real* target tokens, matching
+    the reference's per-word loss normalisation.
+    """
+    cd = cfg.compute_dtype
+    B, Tt = tgt.shape
+    hs, cs = _encode(cfg, params, src)
+
+    bos = jnp.full((B, 1), BOS, tgt.dtype)
+    dec_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
+    # shift-in keeps PAD where tgt had PAD (tokens after EOS stay dead)
+    dec_in = jnp.where(tgt != PAD, dec_in, PAD)
+    mask_bt = (tgt != PAD).astype(jnp.float32)           # (B, Tt)
+
+    xs = params["tgt_embed"][dec_in].astype(cd).transpose(1, 0, 2)
+    top, _ = _run_stack(params["decoder"], hs, cs, xs, mask_bt.T.astype(cd))
+    logits = (top.transpose(1, 0, 2).astype(jnp.float32)
+              @ params["proj"]["w"] + params["proj"]["b"])  # (B, Tt, V)
+
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1).squeeze(-1)
+    denom = jnp.maximum(mask_bt.sum(), 1.0)
+    return (nll * mask_bt).sum() / denom
+
+
+def seq2seq_translate(cfg: Seq2seqConfig, params, src, max_len: int = 32):
+    """Greedy decode — ``(B, max_len)`` int32, PAD after EOS.
+
+    A ``lax.scan`` with a static ``max_len`` (the reference looped in
+    Python per token; under jit that would retrace per length)."""
+    cd = cfg.compute_dtype
+    B = src.shape[0]
+    state = _encode(cfg, params, src)
+
+    def step(carry, _):
+        state, tok, alive = carry
+        x = params["tgt_embed"][tok].astype(cd)
+        hs, cs = state
+        new_hs, new_cs = [], []
+        for layer, h, c in zip(params["decoder"], hs, cs):
+            h, c = _lstm_cell(layer, h, c, x)
+            new_hs.append(h)
+            new_cs.append(c)
+            x = h
+        logits = (x.astype(jnp.float32) @ params["proj"]["w"]
+                  + params["proj"]["b"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = jnp.where(alive, nxt, PAD)
+        alive = alive & (nxt != EOS)
+        return ((new_hs, new_cs), out, alive), out
+
+    # derive from src so the carry is batch-varying under shard_map
+    tag = jnp.sum(src, axis=1) * 0
+    tok0 = jnp.full((B,), BOS, jnp.int32) + tag
+    alive0 = tag == 0
+    _, outs = lax.scan(step, (state, tok0, alive0), None, length=max_len)
+    return outs.T                                        # (B, max_len)

@@ -89,10 +89,14 @@ class Evaluator:
         local = {k: v / max(weight, 1) for k, v in totals.items()}
         return local
 
+    def _resolve_params(self, trainer):
+        """What to evaluate — the ``get_params`` hook or the live params
+        (shared by the multi-node wrapper so the logic can't drift)."""
+        return (self._get_params(trainer) if self._get_params
+                else trainer.updater.params)
+
     def __call__(self, trainer):
-        params = (self._get_params(trainer) if self._get_params
-                  else trainer.updater.params)
-        obs = self.evaluate(params)
+        obs = self.evaluate(self._resolve_params(trainer))
         trainer.observation.update(
             {f"{self.name}/{k}": v for k, v in obs.items()})
         return obs
@@ -114,7 +118,9 @@ class _MultiNodeEvaluator:
         return self._comm.allreduce_obj(local, op="mean")
 
     def __call__(self, trainer):
-        params = getattr(trainer.updater, "params", None)
+        resolve = getattr(self._evaluator, "_resolve_params", None)
+        params = (resolve(trainer) if resolve
+                  else getattr(trainer.updater, "params", None))
         obs = self.evaluate(params)
         name = getattr(self, "name", "validation")
         trainer.observation.update({f"{name}/{k}": v for k, v in obs.items()})

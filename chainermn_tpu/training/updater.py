@@ -44,9 +44,16 @@ class StandardUpdater:
       iterator: yields local batches (list of examples).
       optimizer: optax transformation — normally the output of
         ``create_multi_node_optimizer`` so grads get pmean'd in-step.
-      loss_fn: ``loss_fn(params, *batch_arrays) -> scalar`` local-shard loss.
+      loss_fn: ``loss_fn(params, *batch_arrays) -> scalar`` local-shard loss;
+        with ``state`` given, ``loss_fn(params, state, *batch_arrays) ->
+        (scalar, new_state)`` instead (the Chainer "links hold mutable
+        state" pattern — BN running stats — made explicit and threaded
+        through the step).
       params: initial pytree (will be replicated via ``comm.bcast_data``).
       comm: communicator providing mesh + axis for batch sharding.
+      state: optional non-trainable model state pytree.  Must come out of
+        ``loss_fn`` cross-replica reduced (e.g. sync-BN ``pmean``'d
+        statistics) so it stays replicated.
     """
 
     def __init__(
@@ -58,6 +65,7 @@ class StandardUpdater:
         comm,
         converter: Callable = default_converter,
         drop_remainder: bool = True,
+        state=None,
     ):
         self.iterator = iterator
         self.optimizer = optimizer
@@ -68,6 +76,7 @@ class StandardUpdater:
 
         # first-update weight broadcast of the reference, done at init
         self.params = comm.bcast_data(params)
+        self.state = None if state is None else comm.bcast_data(state)
         self.opt_state = optimizer.init(self.params)
 
         self.iteration = 0
@@ -85,30 +94,36 @@ class StandardUpdater:
         ax = self.comm.axis_name
         optimizer, loss_fn = self.optimizer, self.loss_fn
 
-        def step(params, opt_state, *batch):
+        stateful = self.state is not None
+
+        def step(params, state, opt_state, *batch):
             def global_loss(p):
                 # pmean INSIDE the differentiated function: with replicated
                 # params, shard_map's AD already psums cotangents across the
                 # axis, so differentiating the pmean'd loss yields exactly
                 # the global-mean gradient (no separate grad allreduce op —
                 # this is where ChainerMN's multi_node_mean_grad went).
-                return jax.lax.pmean(loss_fn(p, *batch), ax)
+                if stateful:
+                    loss, new_model_state = loss_fn(p, state, *batch)
+                    return jax.lax.pmean(loss, ax), new_model_state
+                return jax.lax.pmean(loss_fn(p, *batch), ax), state
 
-            loss, grads = jax.value_and_grad(global_loss)(params)
+            (loss, new_model_state), grads = jax.value_and_grad(
+                global_loss, has_aux=True)(params)
             updates, new_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             # loss is already the global mean (ObservationAggregator
             # semantics for the train loss come for free inside the step)
-            return new_params, new_state, loss
+            return new_params, new_model_state, new_state, loss
 
         fn = jax.jit(
             jax.shard_map(
                 step,
                 mesh=self.comm.mesh,
-                in_specs=(P(), P()) + (P(ax),) * n_batch_args,
-                out_specs=(P(), P(), P()),
+                in_specs=(P(), P(), P()) + (P(ax),) * n_batch_args,
+                out_specs=(P(), P(), P(), P()),
             ),
-            donate_argnums=(0, 1),
+            donate_argnums=(0, 1, 2),
         )
         self._step_cache[n_batch_args] = fn
         return fn
@@ -136,8 +151,9 @@ class StandardUpdater:
         arrays = tuple(
             jax.device_put(a, self._batch_sharding) for a in arrays)
         t0 = time.perf_counter()
-        self.params, self.opt_state, loss = self._get_step(len(arrays))(
-            self.params, self.opt_state, *arrays)
+        self.params, self.state, self.opt_state, loss = \
+            self._get_step(len(arrays))(
+                self.params, self.state, self.opt_state, *arrays)
         self.iteration += 1
         self.previous_epoch_detail = self.epoch_detail
         self.epoch_detail = getattr(

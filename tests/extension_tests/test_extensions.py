@@ -256,3 +256,43 @@ class TestGlobalExceptHook:
         finally:
             sys.excepthook = prev
             geh._installed = False
+
+
+class TestStatefulCheckpoint:
+    """Non-trainable model state (BN running stats) must survive both
+    serialization paths — it lives on updater.state, not in params."""
+
+    def _stateful_updater(self, seed):
+        up = FakeUpdater(seed=seed)
+        rng = np.random.RandomState(seed + 100)
+        up.state = {"bn": {"mean": jnp.asarray(rng.randn(4), jnp.float32),
+                           "var": jnp.ones((4,), jnp.float32)}}
+        return up
+
+    def test_checkpointer_roundtrips_model_state(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        up = self._stateful_updater(seed=1)
+        up.iteration = 7
+        cp.save(up)
+
+        fresh = self._stateful_updater(seed=2)
+        it = create_multi_node_checkpointer(
+            comm, str(tmp_path)).maybe_load(fresh)
+        assert it == 7
+        np.testing.assert_array_equal(
+            np.asarray(fresh.state["bn"]["mean"]),
+            np.asarray(up.state["bn"]["mean"]))
+
+    def test_snapshot_roundtrips_model_state(self, comm, tmp_path):
+        from chainermn_tpu.extensions import multi_node_snapshot
+
+        up = self._stateful_updater(seed=3)
+        up.iteration = 4
+        trainer = FakeTrainer(up, tmp_path)
+        multi_node_snapshot(comm, "snap_{iteration}")(trainer)
+
+        fresh = self._stateful_updater(seed=4)
+        load_snapshot(fresh, os.path.join(str(tmp_path), "snap_4"))
+        np.testing.assert_array_equal(
+            np.asarray(fresh.state["bn"]["var"]),
+            np.asarray(up.state["bn"]["var"]))
