@@ -33,6 +33,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from chainermn_tpu.ops.pallas_attention import flash_attention
 from chainermn_tpu.parallel.expert import expert_parallel_moe
 from chainermn_tpu.parallel.pipeline import pipeline_apply
 from chainermn_tpu.parallel.ring_attention import (
@@ -64,7 +65,7 @@ class TransformerConfig:
     d_ff: int = 2048
     n_layers: int = 4          # total; must divide by mesh pipe size
     max_seq: int = 2048
-    attention: str = "ring"    # "ring" | "ulysses" | "local"
+    attention: str = "ring"    # "ring" | "ulysses" | "local" | "flash"
     moe: bool = False          # Switch-MoE MLP in every block
     n_experts: int = 8         # global expert count (moe=True)
     capacity_factor: float = 1.25
@@ -189,6 +190,18 @@ def _attention(cfg: TransformerConfig, h, blk):
         o = ulysses_attention(q, k, v, axis_name="seq", causal=True)
     elif cfg.attention == "local":
         o = local_attention(q, k, v, causal=True)
+    elif cfg.attention == "flash":
+        # Pallas kernel (TPU); non-TPU backends run the same kernel
+        # through the Pallas interpreter so one config works everywhere.
+        if lax.axis_size("seq") != 1:
+            raise ValueError(
+                'attention="flash" covers only the unsharded-sequence '
+                'case (mesh seq axis is '
+                f'{lax.axis_size("seq")}); use attention="ring" to '
+                "shard the sequence")
+        o = flash_attention(
+            q, k, v, causal=True,
+            interpret=jax.default_backend() != "tpu")
     else:
         raise ValueError(cfg.attention)
     o = row_parallel_dense(

@@ -6,6 +6,7 @@ wrappers over ``jax.lax`` collectives, whose transpose rules supply the
 reversed-direction backward passes the reference wrote by hand.
 """
 
+from .pallas_attention import flash_attention, flash_attention_supported
 from .collectives import (
     allgather,
     allreduce,
@@ -28,6 +29,7 @@ from .point_to_point import (
 )
 
 __all__ = [
+    "flash_attention", "flash_attention_supported",
     "allgather", "allreduce", "alltoall", "bcast", "gather", "pmean",
     "psum", "reduce_scatter", "scatter",
     "ppermute", "pseudo_connect", "recv", "send", "send_recv",

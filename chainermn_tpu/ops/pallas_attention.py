@@ -1,0 +1,370 @@
+"""Pallas flash attention — the hot-op TPU kernel.
+
+The reference's only hand-written device code was CuPy pack/unpack
+kernels (``_memory_utility.py``); XLA makes those unnecessary (SURVEY §2
+native inventory), so the Pallas budget goes where the FLOPs are:
+attention.  This is the kernel behind the flagship transformer's
+``attention="flash"`` path and the per-block compute option of ring
+attention.
+
+Design (flash-attention v2 schedule, TPU-shaped):
+
+- 3-D grid ``(B·H, T_q/block_q, T_k/block_k)`` with the K dimension
+  innermost and ``arbitrary`` semantics: the Pallas pipeline
+  double-buffers each K/V block's HBM→VMEM DMA behind the previous
+  block's math, and only ``block_k`` tokens of K/V ever sit in VMEM (so
+  context length is bounded by HBM, not the 16 MB of VMEM);
+- **online softmax** in fp32 VMEM scratch (running max ``m``,
+  normaliser ``l``, accumulator) — no (T, T) score matrix in HBM;
+- matmuls via ``jnp.dot(..., preferred_element_type=float32)`` so bf16
+  inputs hit the MXU at full rate with fp32 accumulation;
+- causal masking in *global* positions (``q_offset``/``k_offset``) so
+  sequence-sharded callers (ring attention) reuse the same kernel;
+  fully-masked K blocks skip their FLOPs via ``pl.when``;
+- backward = two recompute kernels (dq; dk/dv) off the saved softmax
+  log-sum-exp — flash's O(T) memory in the backward too;
+- ``interpret=True`` runs the identical kernels on CPU (how the test
+  suite exercises them on the virtual pod).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attention_supported"]
+
+_NEG = -1e30
+_LANE = 128  # TPU lane width: trailing dim of lse/delta and vector scratch
+
+
+def _bcast(vec, n=_LANE):
+    return jnp.broadcast_to(vec[:, None], (vec.shape[0], n))
+
+
+# --------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref, m_ref,
+                *, scale, causal, q_off, k_off):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    Bq, D = q_ref.shape[1:]
+    Bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+
+    needed = True
+    if causal:
+        # K blocks entirely in this q block's future contribute nothing
+        needed = q_off + (i + 1) * Bq - 1 >= k_off + j * Bk
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        allow = None
+        if causal:
+            qpos = q_off + i * Bq + jax.lax.broadcasted_iota(
+                jnp.int32, (Bq, 1), 0)[:, 0]
+            kpos = k_off + j * Bk + jax.lax.broadcasted_iota(
+                jnp.int32, (Bk, 1), 0)[:, 0]
+            allow = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(allow, s, _NEG)
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        if allow is not None:
+            # explicit zero: for a fully-masked row m_new == _NEG and
+            # exp(s - m_new) == 1, which would silently average this
+            # block's V rows into the output
+            p = jnp.where(allow, p, 0.0)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        l_ref[...] = _bcast(l * alpha + p.sum(axis=-1))
+        m_ref[...] = _bcast(m_new)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_ref[:, 0]
+        safe = jnp.maximum(l, 1e-30)   # fully-masked rows stay finite
+        o_ref[0] = (acc_ref[...] / safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = _bcast(m_ref[:, 0] + jnp.log(safe))
+
+
+# --------------------------------------------------------------------- #
+# backward (recompute off the saved lse, flash style)
+# --------------------------------------------------------------------- #
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, q_off, k_off):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    Bq, D = q_ref.shape[1:]
+    Bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    needed = True
+    if causal:
+        needed = q_off + (i + 1) * Bq - 1 >= k_off + j * Bk
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        allow = None
+        if causal:
+            qpos = q_off + i * Bq + jax.lax.broadcasted_iota(
+                jnp.int32, (Bq, 1), 0)[:, 0]
+            kpos = k_off + j * Bk + jax.lax.broadcasted_iota(
+                jnp.int32, (Bk, 1), 0)[:, 0]
+            allow = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(allow, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        if allow is not None:
+            p = jnp.where(allow, p, 0.0)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[...] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, q_off,
+                k_off):
+    j, i = pl.program_id(1), pl.program_id(2)   # k block outer, q inner
+    nq = pl.num_programs(2)
+    Bk, D = k_ref.shape[1:]
+    Bq = q_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    needed = True
+    if causal:
+        needed = q_off + (i + 1) * Bq - 1 >= k_off + j * Bk
+
+    @pl.when(needed)
+    def _():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        allow = None
+        if causal:
+            qpos = q_off + i * Bq + jax.lax.broadcasted_iota(
+                jnp.int32, (Bq, 1), 0)[:, 0]
+            kpos = k_off + j * Bk + jax.lax.broadcasted_iota(
+                jnp.int32, (Bk, 1), 0)[:, 0]
+            allow = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(allow, s, _NEG)
+        p = jnp.exp(s - lse[:, None])                    # (Bq, Bk)
+        if allow is not None:
+            p = jnp.where(allow, p, 0.0)
+        dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# pallas_call plumbing
+# --------------------------------------------------------------------- #
+
+
+def _q_spec(block_q, D):
+    return pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+
+
+def _k_spec(block_k, D):
+    return pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+
+
+def _qvec_spec(block_q):
+    return pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0))
+
+
+def _params():
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-mesh-axes set, so the
+    kernel composes under shard_map's check_vma discipline."""
+    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+
+
+def _fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
+         interpret):
+    BH, Tq, D = q3.shape
+    Tk = k3.shape[1]
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, q_off=q_off,
+            k_off=k_off),
+        grid=(BH, Tq // block_q, Tk // block_k),
+        in_specs=[_q_spec(block_q, D), _k_spec(block_k, D),
+                  _k_spec(block_k, D)],
+        out_specs=[_q_spec(block_q, D), _qvec_spec(block_q)],
+        out_shape=[
+            _sds((BH, Tq, D), q3.dtype, q3),
+            _sds((BH, Tq, _LANE), jnp.float32, q3),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+        ],
+        compiler_params=_params(),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
+           interpret):
+    o, _ = _fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
+                interpret)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
+               interpret):
+    o, lse = _fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q,
+                  block_k, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(scale, causal, q_off, k_off, block_q, block_k, interpret,
+               res, do):
+    q3, k3, v3, o, lse = res
+    BH, Tq, D = q3.shape
+    Tk = k3.shape[1]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (BH,Tq)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANE,))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, q_off=q_off,
+            k_off=k_off),
+        grid=(BH, Tq // block_q, Tk // block_k),
+        in_specs=[
+            _q_spec(block_q, D), _k_spec(block_k, D), _k_spec(block_k, D),
+            _q_spec(block_q, D), _qvec_spec(block_q), _qvec_spec(block_q),
+        ],
+        out_specs=_q_spec(block_q, D),
+        out_shape=_sds((BH, Tq, D), q3.dtype, q3),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_params(),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse, delta)
+
+    # k outer / q inner grid: swap the roles of the index maps
+    kq_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    qk_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    qkvec_spec = pl.BlockSpec(
+        (1, block_q, _LANE), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, q_off=q_off,
+            k_off=k_off),
+        grid=(BH, Tk // block_k, Tq // block_q),
+        in_specs=[
+            qk_spec, kq_spec, kq_spec, qk_spec, qkvec_spec, qkvec_spec,
+        ],
+        out_specs=[kq_spec, kq_spec],
+        out_shape=[
+            _sds((BH, Tk, D), k3.dtype, k3),
+            _sds((BH, Tk, D), v3.dtype, v3),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=_params(),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_supported(T_q: int, T_k: int, block_q: int = 256,
+                              block_k: int = 512) -> bool:
+    """Shapes the kernel handles (callers fall back to XLA otherwise):
+    lengths divisible by their (clamped) blocks, blocks sublane-aligned
+    (multiples of 8 — the fp32 min tile)."""
+    bq, bk = min(block_q, T_q), min(block_k, T_k)
+    return (T_q % bq == 0 and T_k % bk == 0
+            and bq % 8 == 0 and bk % 8 == 0)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, q_offset: int = 0,
+                    k_offset: int = 0, block_q: int = 256,
+                    block_k: int = 512, interpret: bool = False):
+    """Flash attention over ``(B, T, H, D)`` tensors.
+
+    ``q_offset``/``k_offset`` are *global* (static) position offsets of
+    the local blocks for sequence-sharded callers; masking follows global
+    positions exactly like
+    :func:`...parallel.ring_attention.local_attention`, with one
+    deliberate divergence: a query row whose ENTIRE K range is masked
+    (possible only when ``k_offset > q_offset``) returns **zeros**, where
+    the XLA oracle returns the meaningless uniform-softmax mean of V.
+    Zeros are the correct identity for callers that combine per-shard
+    partials via lse.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if not flash_attention_supported(Tq, Tk, block_q, block_k):
+        raise ValueError(
+            f"sequence lengths ({Tq}, {Tk}) unsupported for blocks "
+            f"({block_q}, {block_k}) — use flash_attention_supported() "
+            "and fall back to local_attention")
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+    o = _flash(to3(q), to3(k), to3(v), D ** -0.5, causal,
+               int(q_offset), int(k_offset), block_q, block_k, interpret)
+    return o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)

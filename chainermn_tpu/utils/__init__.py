@@ -1,5 +1,20 @@
 """Utility subsystems: serialization, profiling/tracing, logging."""
 
+from chainermn_tpu.utils.profiling import (
+    Profiler,
+    ProfileReport,
+    get_profiler,
+    profiled_communicator,
+    trace,
+)
 from chainermn_tpu.utils.serialization import load_state, save_state
 
-__all__ = ["load_state", "save_state"]
+__all__ = [
+    "ProfileReport",
+    "Profiler",
+    "get_profiler",
+    "load_state",
+    "profiled_communicator",
+    "save_state",
+    "trace",
+]

@@ -1,0 +1,215 @@
+"""Profiling/tracing subsystem — a first-class facility the reference
+never had (SURVEY §5: its practice was external ``nvprof``/MPI tracing;
+the only instrumentation surface was pure_nccl's CUDA stream usage).
+
+Three layers:
+
+- :class:`Profiler` — named duration/counter registry with
+  ``time_block(name)`` context timing and a stats table.  Durations are
+  *host-observed* (dispatch → value materialisation), which is what the
+  user can act on under async dispatch.
+- :func:`profiled_communicator` — wraps any communicator so every eager
+  collective (``allreduce``, ``bcast_obj``, ...) is timed into a
+  profiler, with payload byte counts — the per-collective duration
+  metrics SURVEY §5 prescribes.
+- :func:`trace` — delegates to ``jax.profiler`` for full XLA/TPU traces
+  viewable in TensorBoard/XProf (device-side truth; the Profiler is the
+  cheap always-on layer).
+
+Plus :class:`ProfileReport`, a trainer extension printing the table on a
+trigger (rank-0 convention, like the reference's LogReport usage).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "Profiler",
+    "ProfileReport",
+    "get_profiler",
+    "profiled_communicator",
+    "trace",
+]
+
+
+@dataclass
+class _Stat:
+    count: int = 0
+    total: float = 0.0
+    maximum: float = 0.0
+    bytes: int = 0
+
+    def add(self, seconds: float, nbytes: int = 0) -> None:
+        self.count += 1
+        self.total += seconds
+        self.maximum = max(self.maximum, seconds)
+        self.bytes += nbytes
+
+
+@dataclass
+class Profiler:
+    """Named timing registry.  Thread-compatible (single-writer per name)."""
+
+    stats: Dict[str, _Stat] = field(default_factory=dict)
+    enabled: bool = True
+
+    def record(self, name: str, seconds: float, nbytes: int = 0) -> None:
+        if not self.enabled:
+            return
+        self.stats.setdefault(name, _Stat()).add(seconds, nbytes)
+
+    @contextlib.contextmanager
+    def time_block(self, name: str, nbytes: int = 0, sync=None):
+        """Time a block.  ``sync`` (optional callable or array) is invoked /
+        materialised before the clock stops, so async-dispatched device
+        work is actually included (block_until_ready alone can return
+        early on experimental backends — anchor on a host transfer)."""
+        t0 = time.perf_counter()
+        box = {}
+        try:
+            yield box
+        finally:
+            out = box.get("out", sync)
+            if callable(out):
+                out()
+            elif out is not None:
+                jax.tree.map(
+                    lambda a: np.asarray(jax.device_get(a))
+                    if hasattr(a, "dtype") else a, out)
+            self.record(name, time.perf_counter() - t0, nbytes)
+
+    def summary(self) -> str:
+        if not self.stats:
+            return "(no profile data)"
+        rows = [("name", "count", "total_s", "mean_ms", "max_ms", "MB")]
+        for name in sorted(self.stats):
+            s = self.stats[name]
+            rows.append((
+                name, str(s.count), f"{s.total:.3f}",
+                f"{1e3 * s.total / max(s.count, 1):.2f}",
+                f"{1e3 * s.maximum:.2f}",
+                f"{s.bytes / 1e6:.1f}"))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        return "\n".join(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows)
+
+    def reset(self) -> None:
+        self.stats.clear()
+
+
+_GLOBAL = Profiler()
+
+
+def get_profiler() -> Profiler:
+    """The default process-global profiler."""
+    return _GLOBAL
+
+
+def _nbytes(x) -> int:
+    try:
+        return int(jax.tree.reduce(
+            lambda a, b: a + b,
+            jax.tree.map(lambda v: v.size * v.dtype.itemsize
+                         if hasattr(v, "dtype") else 0, x), 0))
+    except Exception:
+        return 0
+
+
+_COLLECTIVES = (
+    "bcast", "allreduce", "allgather", "alltoall", "gather", "scatter",
+    "reduce_scatter", "send", "bcast_obj", "allgather_obj", "gather_obj",
+    "allreduce_obj", "scatter_obj", "send_obj", "recv_obj", "barrier",
+    "bcast_data", "multi_node_mean_grad",
+)
+
+
+class _ProfiledCommunicator:
+    """Transparent proxy timing every eager collective into a profiler.
+
+    Host-observed wall time per call: dispatch, any XLA execution it
+    forces, and result materialisation (obj collectives are host-blocking
+    already; array collectives are materialised to close the async gap).
+    The jitted in-step collectives (``ops.*`` inside shard_map) are NOT
+    routed here — those belong to XLA's domain; use :func:`trace` to see
+    them.  This matches what the reference could observe per NCCL call.
+    """
+
+    def __init__(self, comm, profiler: Optional[Profiler] = None,
+                 prefix: str = "comm."):
+        self._comm = comm
+        self._profiler = profiler or get_profiler()
+        self._prefix = prefix
+
+    def __getattr__(self, name):
+        attr = getattr(self._comm, name)
+        if name not in _COLLECTIVES or not callable(attr):
+            return attr
+        profiler, label = self._profiler, self._prefix + name
+
+        def timed(*args, **kwargs):
+            nbytes = _nbytes(args)
+            with profiler.time_block(label, nbytes=nbytes) as box:
+                out = attr(*args, **kwargs)
+                box["out"] = out
+            return out
+
+        return timed
+
+    @property
+    def profiler(self) -> Profiler:
+        return self._profiler
+
+    def __repr__(self) -> str:
+        return f"ProfiledCommunicator({self._comm!r})"
+
+
+def profiled_communicator(comm, profiler: Optional[Profiler] = None):
+    """Wrap ``comm`` so every collective is timed (see module docstring)."""
+    return _ProfiledCommunicator(comm, profiler)
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, host_tracer_level: int = 2):
+    """Full device trace via ``jax.profiler`` (TensorBoard/XProf format).
+
+    The device-side complement to :class:`Profiler`: shows per-HLO and
+    per-collective device time, fusion decisions, and ICI traffic on real
+    TPUs.  Usage::
+
+        with profiling.trace("/tmp/trace"):
+            train_some_steps()
+    """
+    opts = jax.profiler.ProfileOptions()
+    opts.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(logdir, profiler_options=opts)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfileReport:
+    """Trainer extension: print (rank 0) and reset the profiler table."""
+
+    trigger = (1, "epoch")
+    priority = 60
+
+    def __init__(self, profiler: Optional[Profiler] = None, comm=None,
+                 reset: bool = True):
+        self.profiler = profiler or get_profiler()
+        self.comm = comm
+        self.reset = reset
+
+    def __call__(self, trainer) -> None:
+        if self.comm is None or self.comm.rank == 0:
+            print(f"[profile @ iter {trainer.updater.iteration}]")
+            print(self.profiler.summary())
+        if self.reset:
+            self.profiler.reset()

@@ -145,3 +145,16 @@ def test_grads_match_data_parallel_vs_single():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
         p_dp, p_1)
+
+
+def test_flash_attention_matches_oracle():
+    """attention="flash" (Pallas kernel, interpreted off-TPU) must equal
+    the XLA local-attention oracle through the full model."""
+    cfg = tiny_cfg(attention="flash")
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    toks = tokens()[:, :T]
+    ref = oracle_logits(tiny_cfg(), params, toks)
+    mc = MeshConfig(data=8)
+    out = make_forward_fn(mc, cfg)(shard_params(mc, cfg, params), toks)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)

@@ -1,0 +1,91 @@
+"""Profiling subsystem: registry math, communicator proxy timing, trace
+smoke (SURVEY §5 — the subsystem the reference lacked)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.utils.profiling import (
+    Profiler,
+    ProfileReport,
+    profiled_communicator,
+    trace,
+)
+
+
+def test_registry_math():
+    p = Profiler()
+    p.record("x", 0.5, nbytes=100)
+    p.record("x", 1.5, nbytes=300)
+    p.record("y", 0.1)
+    s = p.stats["x"]
+    assert s.count == 2 and s.total == 2.0 and s.maximum == 1.5
+    assert s.bytes == 400
+    table = p.summary()
+    assert "x" in table and "y" in table and "mean_ms" in table
+    p.reset()
+    assert p.summary() == "(no profile data)"
+
+
+def test_time_block_materialises_output():
+    p = Profiler()
+    with p.time_block("block") as box:
+        box["out"] = jnp.ones((8,))
+    assert p.stats["block"].count == 1
+    assert p.stats["block"].total > 0
+
+
+def test_disabled_profiler_records_nothing():
+    p = Profiler(enabled=False)
+    p.record("x", 1.0)
+    assert not p.stats
+
+
+def test_profiled_communicator_times_collectives(comm):
+    p = Profiler()
+    pc = profiled_communicator(comm, p)
+    x = jnp.ones((comm.size, 4), jnp.float32)
+
+    out = pc.allreduce(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x) * comm.size)
+    assert p.stats["comm.allreduce"].count == 1
+    assert p.stats["comm.allreduce"].bytes == x.size * 4
+
+    assert pc.bcast_obj({"a": 1}) == {"a": 1}
+    assert p.stats["comm.bcast_obj"].count == 1
+
+    # non-collective attributes pass through untimed
+    assert pc.rank == comm.rank
+    assert pc.size == comm.size
+    assert "rank" not in {k.split(".")[-1] for k in p.stats}
+
+
+def test_profile_report_prints_and_resets(comm, capsys):
+    p = Profiler()
+    p.record("comm.allreduce", 0.25)
+
+    class FakeUpdater:
+        iteration = 3
+
+    class FakeTrainer:
+        updater = FakeUpdater()
+
+    ProfileReport(p, comm=comm)(FakeTrainer())
+    out = capsys.readouterr().out
+    assert "comm.allreduce" in out and "iter 3" in out
+    assert not p.stats  # reset=True
+
+
+@pytest.mark.skipif(
+    os.environ.get("CI_SKIP_TRACE") == "1", reason="trace smoke disabled")
+def test_trace_writes_profile(tmp_path):
+    logdir = str(tmp_path / "trace")
+    with trace(logdir):
+        jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()
+    dumped = []
+    for root, _, files in os.walk(logdir):
+        dumped += files
+    assert dumped, "profiler wrote no trace files"
