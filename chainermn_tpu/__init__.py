@@ -20,6 +20,7 @@ from chainermn_tpu.communicators import (
     LoopbackCommunicator,
     TpuXlaCommunicator,
     create_communicator,
+    init_distributed,
 )
 from chainermn_tpu.datasets import (
     create_empty_dataset,
@@ -60,6 +61,7 @@ __all__ = [
     "create_multi_node_iterator",
     "create_multi_node_optimizer",
     "create_synchronized_iterator",
+    "init_distributed",
     "add_global_except_hook",
     "create_multi_node_checkpointer",
     "cross_replica_mean",

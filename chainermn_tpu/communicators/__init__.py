@@ -83,3 +83,47 @@ __all__ = [
     "TpuXlaCommunicator",
     "create_communicator",
 ]
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Initialise the JAX multi-host runtime — the ``mpiexec -n N`` moment.
+
+    ChainerMN's process model was MPI launch: one rank per GPU, world size
+    fixed by ``mpiexec``.  The TPU-native model is one *process per host*
+    (each driving its local chips), wired together by the JAX distributed
+    runtime.  On Cloud TPU pods all arguments are auto-detected from the
+    environment; elsewhere pass them explicitly — they correspond 1:1 to
+    MPI's (coordinator ≈ rank-0 endpoint, num_processes ≈ world size,
+    process_id ≈ rank).
+
+    Call once per process BEFORE any other JAX API, then
+    ``create_communicator("tpu_xla")`` sees the global device set
+    (``comm.size`` = all chips in the pod, ``comm.inter_size`` = hosts).
+
+    No-ops gracefully when the runtime is already initialised (so single-
+    host runs and tests can call it unconditionally).
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already initialized" not in str(e):
+            raise
+
+
+__all__.append("init_distributed")

@@ -2,6 +2,7 @@
 ResNet-50, seq2seq NMT) re-built TPU-first, plus the flagship transformer
 exercising every parallelism axis."""
 
+from .convnets import ConvNetConfig, convnet_apply, init_convnet
 from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
 from .resnet import ResNetConfig, init_resnet, resnet_apply
 from .seq2seq import (
@@ -21,7 +22,10 @@ from .transformer import (
 )
 
 __all__ = [
+    "ConvNetConfig",
     "ResNetConfig",
+    "convnet_apply",
+    "init_convnet",
     "Seq2seqConfig",
     "TransformerConfig",
     "init_seq2seq",

@@ -1,0 +1,144 @@
+"""Classic ImageNet convnets — AlexNet, NiN, VGG-16 (reference:
+``examples/imagenet/models/{alex,nin,vgg}.py`` archs selectable via
+``--arch`` in ``train_imagenet.py``; unverified — mount empty, see
+SURVEY.md).
+
+Same TPU-first conventions as :mod:`chainermn_tpu.models.resnet`: NHWC,
+params fp32 / compute bf16, functional ``(params, x) -> logits``.  These
+are stateless (no BN; NiN/VGG used none upstream, AlexNet used LRN which
+is dropped as obsolete — modern recipes replace it with nothing), so they
+also serve as the no-state contrast to ResNet in the training stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ConvNetConfig", "init_convnet", "convnet_apply"]
+
+_ARCHS = ("alex", "nin", "vgg16")
+
+
+@dataclass(frozen=True)
+class ConvNetConfig:
+    arch: str = "alex"          # "alex" | "nin" | "vgg16"
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.arch not in _ARCHS:
+            raise ValueError(f"arch {self.arch!r} not in {_ARCHS}")
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * jnp.sqrt(2.0 / fan_in))
+
+
+def _dense_init(key, fin, fout):
+    return {
+        "w": jax.random.normal(key, (fin, fout), jnp.float32)
+        * jnp.sqrt(2.0 / fin),
+        "b": jnp.zeros((fout,), jnp.float32),
+    }
+
+
+# (kind, *spec) rows build each arch; kinds:
+#   c  kh kw cin cout stride  — conv + ReLU
+#   cl kh kw cin cout stride  — conv, no ReLU (NiN's last 1x1)
+#   p  window stride          — max pool
+#   g                         — global average pool
+#   f  fin fout               — dense + ReLU
+#   fl fin fout               — dense, no ReLU (logits)
+def _rows(cfg: ConvNetConfig) -> Sequence[Tuple]:
+    n = cfg.num_classes
+    if cfg.arch == "alex":
+        return [
+            ("c", 11, 11, 3, 96, 4), ("p", 3, 2),
+            ("c", 5, 5, 96, 256, 1), ("p", 3, 2),
+            ("c", 3, 3, 256, 384, 1),
+            ("c", 3, 3, 384, 384, 1),
+            ("c", 3, 3, 384, 256, 1), ("p", 3, 2),
+            ("g",),
+            ("f", 256, 4096), ("f", 4096, 4096), ("fl", 4096, n),
+        ]
+    if cfg.arch == "nin":
+        return [
+            ("c", 11, 11, 3, 96, 4),
+            ("c", 1, 1, 96, 96, 1), ("c", 1, 1, 96, 96, 1), ("p", 3, 2),
+            ("c", 5, 5, 96, 256, 1),
+            ("c", 1, 1, 256, 256, 1), ("c", 1, 1, 256, 256, 1),
+            ("p", 3, 2),
+            ("c", 3, 3, 256, 384, 1),
+            ("c", 1, 1, 384, 384, 1), ("c", 1, 1, 384, 384, 1),
+            ("p", 3, 2),
+            ("c", 3, 3, 384, 1024, 1),
+            ("c", 1, 1, 1024, 1024, 1), ("cl", 1, 1, 1024, n, 1),
+            ("g",),
+        ]
+    # vgg16
+    rows = []
+    cin = 3
+    for cout, reps in ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3)):
+        for _ in range(reps):
+            rows.append(("c", 3, 3, cin, cout, 1))
+            cin = cout
+        rows.append(("p", 2, 2))
+    rows += [("g",), ("f", 512, 4096), ("f", 4096, 4096),
+             ("fl", 4096, n)]
+    return rows
+
+
+def init_convnet(key, cfg: ConvNetConfig):
+    params = []
+    for row in _rows(cfg):
+        kind = row[0]
+        if kind in ("c", "cl"):
+            key, sub = jax.random.split(key)
+            _, kh, kw, cin, cout, _ = row
+            params.append({"w": _conv_init(sub, kh, kw, cin, cout),
+                           "b": jnp.zeros((cout,), jnp.float32)})
+        elif kind in ("f", "fl"):
+            key, sub = jax.random.split(key)
+            params.append(_dense_init(sub, row[1], row[2]))
+        else:
+            params.append({})
+    return params
+
+
+def convnet_apply(cfg: ConvNetConfig, params, x):
+    """``(B, H, W, 3)`` images → ``(B, num_classes)`` fp32 logits."""
+    cd = cfg.compute_dtype
+    h = x.astype(cd)
+    for row, p in zip(_rows(cfg), params):
+        kind = row[0]
+        if kind in ("c", "cl"):
+            _, _, _, _, _, stride = row
+            h = lax.conv_general_dilated(
+                h, p["w"].astype(cd), (stride, stride), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + p["b"].astype(cd)
+            if kind == "c":
+                h = jax.nn.relu(h)
+        elif kind == "p":
+            _, win, stride = row
+            h = lax.reduce_window(
+                h, -jnp.inf, lax.max,
+                (1, win, win, 1), (1, stride, stride, 1), "SAME")
+        elif kind == "g":
+            h = jnp.mean(h, axis=(1, 2))
+        elif kind in ("f", "fl"):
+            h = h.astype(jnp.float32) @ p["w"] + p["b"]
+            if kind == "f":
+                h = jax.nn.relu(h).astype(cd)
+    return h.astype(jnp.float32)

@@ -3,9 +3,10 @@
 The reference's only hand-written device code was CuPy pack/unpack
 kernels (``_memory_utility.py``); XLA makes those unnecessary (SURVEY §2
 native inventory), so the Pallas budget goes where the FLOPs are:
-attention.  This is the kernel behind the flagship transformer's
-``attention="flash"`` path and the per-block compute option of ring
-attention.
+attention.  This kernel backs the flagship transformer's
+``attention="flash"`` path and the per-block math of
+:func:`chainermn_tpu.parallel.ring_attention.ring_attention`
+(``use_flash=True``).
 
 Design (flash-attention v2 schedule, TPU-shaped):
 
@@ -18,11 +19,15 @@ Design (flash-attention v2 schedule, TPU-shaped):
   normaliser ``l``, accumulator) — no (T, T) score matrix in HBM;
 - matmuls via ``jnp.dot(..., preferred_element_type=float32)`` so bf16
   inputs hit the MXU at full rate with fp32 accumulation;
-- causal masking in *global* positions (``q_offset``/``k_offset``) so
-  sequence-sharded callers (ring attention) reuse the same kernel;
-  fully-masked K blocks skip their FLOPs via ``pl.when``;
-- backward = two recompute kernels (dq; dk/dv) off the saved softmax
-  log-sum-exp — flash's O(T) memory in the backward too;
+- causal masking in *global* positions: ``q_offset``/``k_offset`` ride
+  in SMEM, so they may be **traced values** (ring attention's rotating
+  block offsets) — fully-masked K blocks skip their FLOPs via
+  ``pl.when``;
+- optionally returns the softmax log-sum-exp, with its own VJP path, so
+  sequence-sharded callers can combine per-shard partial attentions
+  exactly (``o = Σ o_i·exp(lse_i − lse)``);
+- backward = two recompute kernels (dq; dk/dv) off the saved lse —
+  flash's O(T) memory in the backward too;
 - ``interpret=True`` runs the identical kernels on CPU (how the test
   suite exercises them on the virtual pod).
 """
@@ -46,17 +51,23 @@ def _bcast(vec, n=_LANE):
     return jnp.broadcast_to(vec[:, None], (vec.shape[0], n))
 
 
+def _positions(off, base, count):
+    return off + base + jax.lax.broadcasted_iota(
+        jnp.int32, (count, 1), 0)[:, 0]
+
+
 # --------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------- #
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref, m_ref,
-                *, scale, causal, q_off, k_off):
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, l_ref, m_ref, *, scale, causal):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     Bq, D = q_ref.shape[1:]
     Bk = k_ref.shape[1]
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     @pl.when(j == 0)
     def _():
@@ -64,10 +75,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref, m_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         m_ref[...] = jnp.full_like(m_ref, _NEG)
 
-    needed = True
-    if causal:
-        # K blocks entirely in this q block's future contribute nothing
-        needed = q_off + (i + 1) * Bq - 1 >= k_off + j * Bk
+    # K blocks entirely in this q block's future contribute nothing
+    # Non-causal predicate is a tautology but must stay TRACED: an
+    # unconditioned kernel body trips the hlo-interpreter's vma check
+    # under shard_map (jax bug); pl.when(cond) routes discharge safely.
+    needed = (j >= 0) if not causal else (
+        q_off + (i + 1) * Bq - 1 >= k_off + j * Bk)
 
     @pl.when(needed)
     def _():
@@ -77,10 +90,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref, m_ref,
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
         allow = None
         if causal:
-            qpos = q_off + i * Bq + jax.lax.broadcasted_iota(
-                jnp.int32, (Bq, 1), 0)[:, 0]
-            kpos = k_off + j * Bk + jax.lax.broadcasted_iota(
-                jnp.int32, (Bk, 1), 0)[:, 0]
+            qpos = _positions(q_off, i * Bq, Bq)
+            kpos = _positions(k_off, j * Bk, Bk)
             allow = qpos[:, None] >= kpos[None, :]
             s = jnp.where(allow, s, _NEG)
         m = m_ref[:, 0]
@@ -111,20 +122,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref, m_ref,
 # --------------------------------------------------------------------- #
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, q_off, k_off):
+def _recompute_p(q, kb, scale, lse, causal, q_off, k_off, i, j, Bq, Bk):
+    s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = _positions(q_off, i * Bq, Bq)
+        kpos = _positions(k_off, j * Bk, Bk)
+        allow = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(allow, s, _NEG)
+        return jnp.where(allow, jnp.exp(s - lse[:, None]), 0.0)
+    return jnp.exp(s - lse[:, None])
+
+
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, scale, causal):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     Bq, D = q_ref.shape[1:]
     Bk = k_ref.shape[1]
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     @pl.when(j == 0)
     def _():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    needed = True
-    if causal:
-        needed = q_off + (i + 1) * Bq - 1 >= k_off + j * Bk
+    # Non-causal predicate is a tautology but must stay TRACED: an
+    # unconditioned kernel body trips the hlo-interpreter's vma check
+    # under shard_map (jax bug); pl.when(cond) routes discharge safely.
+    needed = (j >= 0) if not causal else (
+        q_off + (i + 1) * Bq - 1 >= k_off + j * Bk)
 
     @pl.when(needed)
     def _():
@@ -134,18 +159,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0][:, 0]
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        allow = None
-        if causal:
-            qpos = q_off + i * Bq + jax.lax.broadcasted_iota(
-                jnp.int32, (Bq, 1), 0)[:, 0]
-            kpos = k_off + j * Bk + jax.lax.broadcasted_iota(
-                jnp.int32, (Bk, 1), 0)[:, 0]
-            allow = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(allow, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
-        if allow is not None:
-            p = jnp.where(allow, p, 0.0)
+        p = _recompute_p(q, kb, scale, lse, causal, q_off, k_off,
+                         i, j, Bq, Bk)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dq_acc[...] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
@@ -155,22 +170,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, q_off,
-                k_off):
+def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal):
     j, i = pl.program_id(1), pl.program_id(2)   # k block outer, q inner
     nq = pl.num_programs(2)
     Bk, D = k_ref.shape[1:]
     Bq = q_ref.shape[1]
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     @pl.when(i == 0)
     def _():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    needed = True
-    if causal:
-        needed = q_off + (i + 1) * Bq - 1 >= k_off + j * Bk
+    # Non-causal predicate is a tautology but must stay TRACED: an
+    # unconditioned kernel body trips the hlo-interpreter's vma check
+    # under shard_map (jax bug); pl.when(cond) routes discharge safely.
+    needed = (j >= 0) if not causal else (
+        q_off + (i + 1) * Bq - 1 >= k_off + j * Bk)
 
     @pl.when(needed)
     def _():
@@ -180,18 +197,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
-        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        allow = None
-        if causal:
-            qpos = q_off + i * Bq + jax.lax.broadcasted_iota(
-                jnp.int32, (Bq, 1), 0)[:, 0]
-            kpos = k_off + j * Bk + jax.lax.broadcasted_iota(
-                jnp.int32, (Bk, 1), 0)[:, 0]
-            allow = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(allow, s, _NEG)
-        p = jnp.exp(s - lse[:, None])                    # (Bq, Bk)
-        if allow is not None:
-            p = jnp.where(allow, p, 0.0)
+        p = _recompute_p(q, kb, scale, lse, causal, q_off, k_off,
+                         i, j, Bq, Bk)                   # (Bq, Bk)
         dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -206,6 +213,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # --------------------------------------------------------------------- #
 # pallas_call plumbing
 # --------------------------------------------------------------------- #
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _q_spec(block_q, D):
@@ -231,16 +242,13 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
 
 
-def _fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
-         interpret):
+def _fwd(q3, k3, v3, offs, scale, causal, block_q, block_k, interpret):
     BH, Tq, D = q3.shape
     Tk = k3.shape[1]
     o, lse = pl.pallas_call(
-        functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, q_off=q_off,
-            k_off=k_off),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
         grid=(BH, Tq // block_q, Tk // block_k),
-        in_specs=[_q_spec(block_q, D), _k_spec(block_k, D),
+        in_specs=[_smem_spec(), _q_spec(block_q, D), _k_spec(block_k, D),
                   _k_spec(block_k, D)],
         out_specs=[_q_spec(block_q, D), _qvec_spec(block_q)],
         out_shape=[
@@ -254,41 +262,41 @@ def _fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
         ],
         compiler_params=_params(),
         interpret=interpret,
-    )(q3, k3, v3)
-    return o, lse
+    )(offs, q3, k3, v3)
+    return o, lse[..., 0]
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
-           interpret):
-    o, _ = _fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q3, k3, v3, offs, scale, causal, block_q, block_k, interpret):
+    return _fwd(q3, k3, v3, offs, scale, causal, block_q, block_k,
                 interpret)
-    return o
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q, block_k,
+def _flash_fwd(q3, k3, v3, offs, scale, causal, block_q, block_k,
                interpret):
-    o, lse = _fwd(q3, k3, v3, scale, causal, q_off, k_off, block_q,
-                  block_k, interpret)
-    return o, (q3, k3, v3, o, lse)
+    o, lse = _fwd(q3, k3, v3, offs, scale, causal, block_q, block_k,
+                  interpret)
+    return (o, lse), (q3, k3, v3, offs, o, lse)
 
 
-def _flash_bwd(scale, causal, q_off, k_off, block_q, block_k, interpret,
-               res, do):
-    q3, k3, v3, o, lse = res
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, cts):
+    q3, k3, v3, offs, o, lse = res
+    do, dlse = cts
     BH, Tq, D = q3.shape
     Tk = k3.shape[1]
+    # d s_ij = p_ij (dp_ij − delta_i) from o's cotangent, plus p_ij·dlse_i
+    # from lse's — both fold into one "delta_eff = delta − dlse" term.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (BH,Tq)
+    delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANE,))
+    lse3 = jnp.broadcast_to(lse[..., None], lse.shape + (_LANE,))
 
     dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, scale=scale, causal=causal, q_off=q_off,
-            k_off=k_off),
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
         grid=(BH, Tq // block_q, Tk // block_k),
         in_specs=[
+            _smem_spec(),
             _q_spec(block_q, D), _k_spec(block_k, D), _k_spec(block_k, D),
             _q_spec(block_q, D), _qvec_spec(block_q), _qvec_spec(block_q),
         ],
@@ -297,7 +305,7 @@ def _flash_bwd(scale, causal, q_off, k_off, block_q, block_k, interpret,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=_params(),
         interpret=interpret,
-    )(q3, k3, v3, do, lse, delta)
+    )(offs, q3, k3, v3, do, lse3, delta)
 
     # k outer / q inner grid: swap the roles of the index maps
     kq_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
@@ -305,11 +313,10 @@ def _flash_bwd(scale, causal, q_off, k_off, block_q, block_k, interpret,
     qkvec_spec = pl.BlockSpec(
         (1, block_q, _LANE), lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, q_off=q_off,
-            k_off=k_off),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
         grid=(BH, Tk // block_k, Tq // block_q),
         in_specs=[
+            _smem_spec(),
             qk_spec, kq_spec, kq_spec, qk_spec, qkvec_spec, qkvec_spec,
         ],
         out_specs=[kq_spec, kq_spec],
@@ -323,8 +330,9 @@ def _flash_bwd(scale, causal, q_off, k_off, block_q, block_k, interpret,
         ],
         compiler_params=_params(),
         interpret=interpret,
-    )(q3, k3, v3, do, lse, delta)
-    return dq, dk, dv
+    )(offs, q3, k3, v3, do, lse3, delta)
+    d_offs = jnp.zeros(offs.shape, jax.dtypes.float0)
+    return dq, dk, dv, d_offs
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -340,20 +348,24 @@ def flash_attention_supported(T_q: int, T_k: int, block_q: int = 256,
             and bq % 8 == 0 and bk % 8 == 0)
 
 
-def flash_attention(q, k, v, *, causal: bool = False, q_offset: int = 0,
-                    k_offset: int = 0, block_q: int = 256,
-                    block_k: int = 512, interpret: bool = False):
+def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
+                    k_offset=0, block_q: int = 256, block_k: int = 512,
+                    return_lse: bool = False, interpret: bool = False):
     """Flash attention over ``(B, T, H, D)`` tensors.
 
-    ``q_offset``/``k_offset`` are *global* (static) position offsets of
-    the local blocks for sequence-sharded callers; masking follows global
+    ``q_offset``/``k_offset`` are *global* position offsets of the local
+    blocks for sequence-sharded callers — python ints or traced int
+    scalars (they ride to the kernel in SMEM); masking follows global
     positions exactly like
     :func:`...parallel.ring_attention.local_attention`, with one
     deliberate divergence: a query row whose ENTIRE K range is masked
-    (possible only when ``k_offset > q_offset``) returns **zeros**, where
-    the XLA oracle returns the meaningless uniform-softmax mean of V.
-    Zeros are the correct identity for callers that combine per-shard
-    partials via lse.
+    (possible only when ``k_offset > q_offset``) returns **zeros** and an
+    lse of ≈``-1e30``, where the XLA oracle returns the meaningless
+    uniform-softmax mean of V.  Zeros/-inf are the correct identities for
+    callers that combine per-shard partials via lse.
+
+    With ``return_lse=True`` returns ``(out, lse)`` where ``lse`` is
+    ``(B, T, H)`` fp32 — both outputs are differentiable.
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -364,7 +376,13 @@ def flash_attention(q, k, v, *, causal: bool = False, q_offset: int = 0,
             "and fall back to local_attention")
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
+    offs = jnp.asarray(
+        jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                   jnp.asarray(k_offset, jnp.int32)]))
     to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
-    o = _flash(to3(q), to3(k), to3(v), D ** -0.5, causal,
-               int(q_offset), int(k_offset), block_q, block_k, interpret)
-    return o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    o, lse = _flash(to3(q), to3(k), to3(v), offs, D ** -0.5, causal,
+                    block_q, block_k, interpret)
+    o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    if return_lse:
+        return o, lse.reshape(B, H, Tq).transpose(0, 2, 1)
+    return o

@@ -50,7 +50,9 @@ def local_attention(q, k, v, *, causal: bool = False, q_offset=0,
 
 
 def ring_attention(q, k, v, *, axis_name: str = "seq",
-                   causal: bool = False, remat: bool = True):
+                   causal: bool = False, remat: bool = True,
+                   use_flash: bool = False, block_q: int = 256,
+                   block_k: int = 512, interpret: bool = False):
     """Blockwise ring attention.  Call INSIDE ``shard_map`` over
     ``axis_name`` with Q/K/V sequence-sharded: ``(B, T_blk, H, D)`` each.
 
@@ -60,6 +62,14 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         full-sequence causal attention).
       remat: rematerialise each block step in backward (grads recompute
         the blockwise forward instead of storing per-step products).
+      use_flash: compute each (local Q × visiting K/V) pair with the
+        Pallas flash kernel (:mod:`chainermn_tpu.ops.pallas_attention`)
+        instead of XLA einsums; per-pair partials ``(o_i, lse_i)`` are
+        merged exactly in log-space.  The traced block offsets ride to
+        the kernel in SMEM.  Requires
+        ``flash_attention_supported(T_blk, T_blk, block_q, block_k)``.
+      interpret: run the flash kernel in the Pallas interpreter
+        (non-TPU backends).
 
     Returns ``(B, T_blk, H, D)`` — this device's attended block.
     """
@@ -68,6 +78,11 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
     B, T, H, D = q.shape
     scale = D ** -0.5
     ring = [(i, (i + 1) % S) for i in range(S)]
+
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
+                           remat=remat, block_q=block_q, block_k=block_k,
+                           interpret=interpret, S=S, r=r, ring=ring)
 
     def block_step(carry, i):
         k_blk, v_blk, num, den, m = carry
@@ -105,3 +120,65 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         step, (k, v, num0, den0, m0), jnp.arange(S))
     out = num / den[..., None]                           # (B,H,T,D)
     return out.transpose(0, 2, 1, 3)                     # (B,T,H,D)
+
+
+def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
+                interpret, S, r, ring):
+    """Ring schedule with the Pallas kernel as the per-pair compute.
+
+    Under the causal ring each visiting pair is one of three STATIC
+    shapes — so no global offsets ever reach the kernel:
+
+    - step 0: the device's own block — ordinary causal flash;
+    - a block from an earlier ring position — FULL attention (every key
+      precedes every query);
+    - a block from a later position — fully masked: skipped via
+      ``lax.cond`` (the ring's built-in 2× causal FLOP saving).
+
+    Per-pair partials ``(o_i, lse_i)`` merge exactly in log-space:
+    ``lse = logaddexp(lse, lse_i)``, ``o = o·e^{lse_prev−lse} +
+    o_i·e^{lse_i−lse}``.  Autodiff differentiates the merge; the
+    kernel's custom VJP covers ``∂(o_i, lse_i)/∂(q, k, v)``."""
+    from chainermn_tpu.ops.pallas_attention import flash_attention
+
+    def pair(qq, kb, vb, causal_pair):
+        return flash_attention(
+            qq, kb, vb, causal=causal_pair, block_q=block_q,
+            block_k=block_k, return_lse=True, interpret=interpret)
+
+    # step 0: self block
+    o, lse = pair(q, k, v, causal)
+    o = o.astype(jnp.float32)
+    if S == 1:
+        return o.astype(q.dtype)
+
+    def block_step(q, k_blk, v_blk, o, lse, i):
+        k_blk = lax.ppermute(k_blk, axis_name, perm=ring)
+        v_blk = lax.ppermute(v_blk, axis_name, perm=ring)
+        src = (r - i) % S                                # block now held
+
+        o_i, lse_i = pair(q, k_blk, v_blk, False)
+        o_i = o_i.astype(jnp.float32)
+        if causal:
+            # only blocks from earlier ring positions contribute; later
+            # ones are fully masked → neutral merge elements.  (A select,
+            # not lax.cond: the pair's FLOPs are symmetric anyway on the
+            # ring's critical path, and pallas-under-cond trips the
+            # interpreter.)
+            keep = src < r
+            o_i = jnp.where(keep, o_i, 0.0)
+            lse_i = jnp.where(keep, lse_i, _NEG)
+        lse_new = jnp.logaddexp(lse, lse_i)              # (B,T,H)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_new = jnp.exp(lse_i - lse_new)[..., None]
+        o = o * w_old + o_i * w_new
+        return k_blk, v_blk, o, lse_new
+
+    step = jax.checkpoint(block_step, static_argnums=(5,)) if remat \
+        else block_step
+    # python-unrolled ring (S is static): lax.scan around an interpreted
+    # pallas_call currently trips JAX's vma checking, and unrolling also
+    # lets XLA overlap each step's ppermute with the previous one's math
+    for i in range(1, S):
+        k, v, o, lse = step(q, k, v, o, lse, i)
+    return o.astype(q.dtype)

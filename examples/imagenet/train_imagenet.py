@@ -51,6 +51,10 @@ def make_dataset(n, image, classes, npz=None, seed=0):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--communicator", default="tpu_xla")
+    p.add_argument("--arch", default="resnet50",
+                   choices=["resnet50", "resnet101", "resnet152",
+                            "alex", "nin", "vgg16"],
+                   help="model architecture (reference --arch parity)")
     p.add_argument("--batchsize", type=int, default=256,
                    help="global batch size")
     p.add_argument("--epoch", type=int, default=2)
@@ -81,13 +85,22 @@ def main():
     if comm.rank == 0:
         print(f"world: {comm.size} devices, {comm.inter_size} processes")
 
+    from chainermn_tpu.models import (
+        ConvNetConfig, convnet_apply, init_convnet,
+    )
+
+    resnet = args.arch.startswith("resnet")
     if args.tiny:
         image, classes, n = 32, 8, 512
-        cfg = ResNetConfig(depth=50, num_classes=classes, width=8,
-                           dtype="float32")
+        cfg = (ResNetConfig(depth=50, num_classes=classes, width=8,
+                            dtype="float32") if resnet
+               else ConvNetConfig(arch=args.arch, num_classes=classes,
+                                  dtype="float32"))
     else:
         image, classes, n = 224, 1000, 50000
-        cfg = ResNetConfig(depth=50, num_classes=classes)
+        cfg = (ResNetConfig(depth=int(args.arch[6:]), num_classes=classes)
+               if resnet
+               else ConvNetConfig(arch=args.arch, num_classes=classes))
 
     from chainermn_tpu.datasets import SubDataset
 
@@ -98,15 +111,23 @@ def main():
     train = cmn.scatter_dataset(train, comm, shuffle=True, seed=0)
     test = cmn.scatter_dataset(test, comm)
 
-    params, state = init_resnet(jax.random.PRNGKey(0), cfg)
+    if resnet:
+        params, state = init_resnet(jax.random.PRNGKey(0), cfg)
+
+        def loss_fn(params, state, x, y):
+            logits, new_state = resnet_apply(
+                cfg, params, state, x, train=True,
+                axis_name=comm.axis_name)
+            return softmax_cross_entropy(logits, y), new_state
+    else:
+        params, state = init_convnet(jax.random.PRNGKey(0), cfg), None
+
+        def loss_fn(params, x, y):
+            return softmax_cross_entropy(convnet_apply(cfg, params, x), y)
+
     opt = cmn.create_multi_node_optimizer(
         optax.sgd(args.lr, momentum=0.9), comm,
         allreduce_grad_dtype=args.grad_dtype)
-
-    def loss_fn(params, state, x, y):
-        logits, new_state = resnet_apply(
-            cfg, params, state, x, train=True, axis_name=comm.axis_name)
-        return softmax_cross_entropy(logits, y), new_state
 
     train_it = cmn.SerialIterator(
         train, args.batchsize, shuffle=True, seed=1)
@@ -118,7 +139,10 @@ def main():
 
     def metrics_fn(bundle, x, y):
         params, state = bundle
-        logits, _ = resnet_apply(cfg, params, state, x, train=False)
+        if resnet:
+            logits, _ = resnet_apply(cfg, params, state, x, train=False)
+        else:
+            logits = convnet_apply(cfg, params, x)
         return {"loss": softmax_cross_entropy(logits, y),
                 "accuracy": accuracy(logits, y)}
 
