@@ -17,6 +17,7 @@ from chainermn_tpu.extensions import (
 )
 from chainermn_tpu.communicators import (
     CommunicatorBase,
+    DataSizeError,
     LoopbackCommunicator,
     TpuXlaCommunicator,
     create_communicator,
@@ -47,6 +48,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "CommunicatorBase",
+    "DataSizeError",
     "Evaluator",
     "LogReport",
     "LoopbackCommunicator",

@@ -1,0 +1,115 @@
+"""Cross-process object p2p channel over the JAX coordination service.
+
+ChainerMN's object transport was pickled MPI messages: a header
+("msgtype": shapes/dtype) then raw chunks under the 2**31-byte MPI count
+limit (reference: ``chainermn/communicators/mpi_communicator_base.py``,
+unverified — mount empty, see SURVEY.md).  The TPU-native runtime has no
+MPI; what every process *does* share is the JAX distributed
+coordination service, whose key-value store accepts bytes.  This module
+implements MPI-ordered p2p object send/recv on top of it:
+
+- Message identity is ``(src_rank, dst_rank, seq)``; both ends keep a
+  local per-peer sequence counter, so matching is deterministic exactly
+  like MPI's per-(source, tag) message ordering — no header exchange.
+- Payloads are chunked into KV-value frames (the service is gRPC-backed,
+  so single values must stay well under the gRPC message ceiling).  The
+  chunk keys are written first and the metadata key last, so a receiver
+  blocked on the metadata key never observes a partial message.
+- Keys are deleted after receipt, so the store does not grow with
+  traffic.
+
+This is a *control-plane* channel (datasets, checkpoint agreement,
+user-level ``send_obj``), not a tensor path — tensors ride XLA
+collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+
+class DataSizeError(ValueError):
+    """Raised when a single object exceeds the channel's hard size cap.
+
+    ChainerMN raised ``DataSizeError`` when a scatter chunk exceeded the
+    2**31-byte MPI count limit; this channel streams payloads in frames
+    so the practical limit is much higher, but a hard cap still guards
+    the coordination service from multi-GiB control messages (use the
+    array collectives / dataset sharding for bulk data instead).
+    """
+
+
+# One KV value per frame; gRPC messages default to a low-MB ceiling, so
+# stay comfortably below it.
+FRAME_BYTES = 2 * 1024 * 1024
+# Hard cap on a single p2p object (MPI-parity: 2**31).  Larger payloads
+# should go through the chunked *_obj collectives or dataset sharding.
+MAX_OBJ_BYTES = 2**31
+
+
+class KVObjectChannel:
+    """MPI-ordered object p2p between processes via the KV store."""
+
+    def __init__(self, tag: str = "cmnobj", timeout_ms: int = 120_000):
+        self._tag = tag
+        self._timeout_ms = timeout_ms
+        self._send_seq: dict = {}
+        self._recv_seq: dict = {}
+
+    @property
+    def _client(self):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "KVObjectChannel needs the JAX distributed runtime; call "
+                "chainermn_tpu.init_distributed(...) first")
+        return client
+
+    def _key(self, src: int, dst: int, seq: int, part: str) -> str:
+        return f"{self._tag}/{src}.{dst}.{seq}/{part}"
+
+    def send(self, obj: Any, src: int, dst: int) -> None:
+        """Send ``obj`` on the (src, dst) lane; returns when published."""
+        payload = pickle.dumps(obj)
+        if len(payload) > MAX_OBJ_BYTES:
+            raise DataSizeError(
+                f"send_obj payload is {len(payload)} bytes, over the "
+                f"{MAX_OBJ_BYTES}-byte p2p cap; scatter large data with "
+                "the chunked *_obj collectives or scatter_dataset instead")
+        client = self._client
+        seq = self._send_seq.get((src, dst), 0)
+        self._send_seq[(src, dst)] = seq + 1
+        nframes = max(1, -(-len(payload) // FRAME_BYTES))
+        for k in range(nframes):
+            client.key_value_set_bytes(
+                self._key(src, dst, seq, f"c{k}"),
+                payload[k * FRAME_BYTES : (k + 1) * FRAME_BYTES])
+        # metadata last: its presence implies every chunk is readable
+        client.key_value_set(
+            self._key(src, dst, seq, "meta"), f"{nframes},{len(payload)}")
+
+    def recv(self, src: int, dst: int) -> Any:
+        """Receive the next in-order object on the (src, dst) lane."""
+        client = self._client
+        seq = self._recv_seq.get((src, dst), 0)
+        meta = client.blocking_key_value_get(
+            self._key(src, dst, seq, "meta"), self._timeout_ms)
+        # advance the lane only once the message is known to exist, so a
+        # timed-out recv can be retried without desynchronising sequences
+        self._recv_seq[(src, dst)] = seq + 1
+        nframes, total = (int(v) for v in meta.split(","))
+        buf = bytearray()
+        for k in range(nframes):
+            buf += client.blocking_key_value_get_bytes(
+                self._key(src, dst, seq, f"c{k}"), self._timeout_ms)
+        for k in range(nframes):
+            client.key_value_delete(self._key(src, dst, seq, f"c{k}"))
+        client.key_value_delete(self._key(src, dst, seq, "meta"))
+        if len(buf) != total:
+            raise RuntimeError(
+                f"obj channel corruption: expected {total} bytes, "
+                f"reassembled {len(buf)}")
+        return pickle.loads(bytes(buf))

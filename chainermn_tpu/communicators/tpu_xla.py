@@ -28,9 +28,16 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import _mesh_utils
+from ._obj_channel import KVObjectChannel
 from .base import CommunicatorBase
 
 _REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
+
+# Chunk size for multi-host *_obj collectives: payloads stream through the
+# process-spanning runtime in frames instead of one monolithic buffer
+# (ChainerMN chunked MPI messages under the 2**31-byte count limit; here
+# the limit is host memory for the gather staging buffers).
+_OBJ_FRAME_BYTES = 64 * 1024 * 1024
 
 
 class TpuXlaCommunicator(CommunicatorBase):
@@ -46,7 +53,18 @@ class TpuXlaCommunicator(CommunicatorBase):
         self._axis = axis_name
         self._mesh = Mesh(np.asarray(self._devices, dtype=object), (axis_name,))
         self._grad_dtype = grad_dtype
-        self._obj_queues: dict = {}  # single-controller p2p object mailbox
+        self._obj_queues: dict = {}  # same-process p2p object mailbox
+        # KV namespace must (a) be identical on every process creating the
+        # logically-same communicator and (b) differ between distinct
+        # communicators (split() children renumber ranks from 0, so key
+        # collisions with the parent would cross-deliver messages).  The
+        # member device-id set is exactly that identity.
+        import hashlib
+
+        ident = hashlib.md5(
+            ",".join(str(d.id) for d in self._devices).encode()
+        ).hexdigest()[:10]
+        self._obj_channel = KVObjectChannel(tag=f"cmnobj-{axis_name}-{ident}")
         self._jit_cache: dict = {}  # per-instance (avoids lru_cache self leak)
 
     # -- topology ------------------------------------------------------ #
@@ -65,7 +83,15 @@ class TpuXlaCommunicator(CommunicatorBase):
 
     @property
     def intra_rank(self) -> int:
-        return 0 if jax.process_count() == 1 else jax.local_devices()[0].id
+        """Index of this controller's rank device among this host's local
+        devices — the reference's device-placement contract (ChainerMN used
+        ``intra_rank`` to pick the local GPU, so it must be a LOCAL index,
+        never a global device id)."""
+        own = self._devices[self.rank]
+        for i, d in enumerate(jax.local_devices()):
+            if d.id == own.id:
+                return i
+        return 0
 
     @property
     def inter_rank(self) -> int:
@@ -264,34 +290,44 @@ class TpuXlaCommunicator(CommunicatorBase):
         from jax.experimental import multihost_utils
 
         is_src = self.inter_rank == self._root_process(root)
-        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        # length-prefix exchange, then fixed-size broadcast
+        payload = pickle.dumps(obj) if is_src else b""
+        # length-prefix exchange, then frame-by-frame broadcast: the wire
+        # never carries more than _OBJ_FRAME_BYTES at once
         n = int(multihost_utils.broadcast_one_to_all(
             np.asarray(len(payload), dtype=np.int64), is_source=is_src))
-        buf = np.zeros(n, dtype=np.uint8)
-        if is_src:
-            buf[: len(payload)] = payload
-        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
-        return pickle.loads(np.asarray(out).tobytes())
+        out = bytearray()
+        for off in range(0, n, _OBJ_FRAME_BYTES):
+            ln = min(_OBJ_FRAME_BYTES, n - off)
+            buf = np.zeros(ln, dtype=np.uint8)
+            if is_src:
+                buf[:] = np.frombuffer(payload[off : off + ln], dtype=np.uint8)
+            out += np.asarray(multihost_utils.broadcast_one_to_all(
+                buf, is_source=is_src)).tobytes()
+        return pickle.loads(bytes(out))
 
     def allgather_obj(self, obj: Any) -> Sequence[Any]:
         if jax.process_count() == 1:
             return [obj]
         from jax.experimental import multihost_utils
 
-        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        n = int(multihost_utils.process_allgather(
-            np.asarray(len(payload), dtype=np.int64)).max())
-        buf = np.zeros(n + 8, dtype=np.uint8)
-        buf[:8] = np.frombuffer(
-            np.asarray(len(payload), dtype=np.int64).tobytes(), dtype=np.uint8)
-        buf[8 : 8 + len(payload)] = payload
-        rows = multihost_utils.process_allgather(buf)
-        out = []
-        for row in np.asarray(rows):
-            ln = int(np.frombuffer(row[:8].tobytes(), dtype=np.int64)[0])
-            out.append(pickle.loads(row[8 : 8 + ln].tobytes()))
-        return out
+        payload = pickle.dumps(obj)
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(payload)], dtype=np.int64))).reshape(-1)
+        n_max = int(lens.max())
+        bufs = [bytearray() for _ in lens]
+        # frame-by-frame gather, every process padded to the global frame
+        # length so the collective stays SPMD-identical
+        for off in range(0, n_max, _OBJ_FRAME_BYTES):
+            ln = min(_OBJ_FRAME_BYTES, n_max - off)
+            mine = np.zeros(ln, dtype=np.uint8)
+            chunk = payload[off : off + ln]
+            if chunk:
+                mine[: len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+            rows = np.asarray(multihost_utils.process_allgather(mine))
+            for p in range(len(lens)):
+                bufs[p] += rows[p].tobytes()
+        return [pickle.loads(bytes(bufs[p][: int(lens[p])]))
+                for p in range(len(lens))]
 
     def gather_obj(self, obj: Any, root: int = 0):
         objs = self.allgather_obj(obj)
@@ -310,26 +346,49 @@ class TpuXlaCommunicator(CommunicatorBase):
         return all_lists[self.inter_rank]
 
     def send_obj(self, obj: Any, dest: int) -> None:
-        if jax.process_count() == 1:
+        """Point-to-point object send to device rank ``dest``.
+
+        Same-process destinations use a local mailbox; cross-process ones
+        ride the coordination-service KV channel with MPI-ordered
+        (src, dst, seq) message matching — the TPU-native replacement for
+        ChainerMN's pickled MPI p2p messages.
+        """
+        if self._root_process(dest) == jax.process_index():
+            # This controller plays every local rank, so the only real
+            # same-process destination is itself (loopback mailbox).
             if dest != self.rank:
                 raise ValueError(
-                    f"send_obj: single-controller world has no peer process "
-                    f"{dest} to deliver to (own rank {self.rank}); object "
-                    "p2p only loops back to self here")
+                    f"send_obj: rank {dest} lives in this process — there "
+                    f"is no peer process to deliver to (own rank "
+                    f"{self.rank}); same-process object p2p only loops "
+                    "back to self")
             self._obj_queues.setdefault(dest, []).append(obj)
             return
-        raise NotImplementedError(
-            "cross-process send_obj requires the grpc object channel "
-            "(multi-host deployment); use *_obj collectives instead")
+        self._check_controller_rank(dest, "send_obj dest")
+        self._obj_channel.send(obj, src=self.rank, dst=dest)
+
+    def _check_controller_rank(self, r: int, what: str) -> None:
+        """Object p2p endpoints are *controllers* (one per process), not
+        devices: the remote peer only ever receives as its own first-owned
+        rank, so any other device rank would publish an unreceivable
+        message."""
+        proc = self._root_process(r)
+        controller = next(
+            i for i, d in enumerate(self._devices) if d.process_index == proc)
+        if r != controller:
+            raise ValueError(
+                f"{what}={r} is device rank {r} of process {proc}, but "
+                f"object p2p addresses controllers: use rank {controller} "
+                f"(that process's first device rank)")
 
     def recv_obj(self, source: int) -> Any:
-        if jax.process_count() == 1:
+        if self._root_process(source) == jax.process_index():
             q = self._obj_queues.get(self.rank, [])
             if not q:
                 raise RuntimeError("recv_obj: empty mailbox")
             return q.pop(0)
-        raise NotImplementedError(
-            "cross-process recv_obj requires the grpc object channel")
+        self._check_controller_rank(source, "recv_obj source")
+        return self._obj_channel.recv(src=source, dst=self.rank)
 
     def barrier(self) -> None:
         if jax.process_count() > 1:

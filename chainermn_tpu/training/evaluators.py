@@ -62,6 +62,19 @@ class Evaluator:
         self._step_cache[n_batch_args] = fn
         return fn
 
+    def _get_remainder_step(self, n_batch_args: int):
+        """Unsharded eval step for batch rows that don't divide the world
+        size — evaluated replicated on one logical device so that every
+        validation example contributes (the reference evaluated all
+        examples; dropping the remainder would make metrics a function of
+        batch divisibility)."""
+        key = ("rem", n_batch_args)
+        if key in self._step_cache:
+            return self._step_cache[key]
+        fn = jax.jit(self._metrics_fn)
+        self._step_cache[key] = fn
+        return fn
+
     def evaluate(self, params) -> Dict[str, float]:
         if getattr(self.iterator, "repeat", False):
             raise ValueError(
@@ -74,18 +87,21 @@ class Evaluator:
         for batch in self.iterator:
             arrays = self.converter(batch)
             b = arrays[0].shape[0]
-            if b % n:
-                keep = (b // n) * n
-                if keep == 0:
-                    continue
-                arrays = tuple(a[:keep] for a in arrays)
-                b = keep
-            arrays = tuple(
-                jax.device_put(a, self._batch_sharding) for a in arrays)
-            m = self._get_eval_step(len(arrays))(params, *arrays)
-            for k, v in m.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * b
-            weight += b
+            keep = (b // n) * n
+            if keep:
+                main = tuple(
+                    jax.device_put(a[:keep], self._batch_sharding)
+                    for a in arrays)
+                m = self._get_eval_step(len(main))(params, *main)
+                for k, v in m.items():
+                    totals[k] = totals.get(k, 0.0) + float(v) * keep
+                weight += keep
+            if keep < b:
+                rem = tuple(a[keep:] for a in arrays)
+                m = self._get_remainder_step(len(rem))(params, *rem)
+                for k, v in m.items():
+                    totals[k] = totals.get(k, 0.0) + float(v) * (b - keep)
+                weight += b - keep
         local = {k: v / max(weight, 1) for k, v in totals.items()}
         return local
 

@@ -1,0 +1,269 @@
+"""Worker program for the multi-process test harness.
+
+The reference ran its whole suite under ``mpiexec -n 2`` (SURVEY.md §4).
+The TPU-native analogue: N OS processes, each with one CPU device,
+joined into one JAX distributed world via
+``jax.distributed.initialize`` — exercising every ``inter_size > 1``
+branch (gloo collectives, the coordination-service KV object channel,
+cross-process checkpoint agreement) that single-process tests cannot
+reach.
+
+Invoked by the ``mp_run`` fixture as::
+
+    python _mp_worker.py <coordinator_addr> <num_procs> <proc_id> <scenario>
+
+A scenario is a function ``scenario_<name>(comm)`` below; workers exit 0
+on success and print tracebacks to stderr on failure.
+"""
+
+import os
+import sys
+import tempfile
+
+# Pin to CPU before any jax import: the container's sitecustomize pins
+# JAX to a TPU plugin whose backend init can hang (see tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+# --------------------------------------------------------------------- #
+# scenarios
+# --------------------------------------------------------------------- #
+
+def scenario_topology(comm):
+    """The rank-model contract (SURVEY.md §5): rank = first owned global
+    device index, inter_rank = process index, intra_rank = LOCAL index."""
+    assert comm.size == jax.device_count()
+    assert comm.inter_size == jax.process_count()
+    assert comm.inter_rank == jax.process_index()
+    own = [d for d in jax.devices() if d.process_index == jax.process_index()]
+    assert comm.rank == jax.devices().index(own[0])
+    # intra_rank is an index into jax.local_devices(), NOT a global id —
+    # with one device per process it must be 0 on EVERY process.
+    assert comm.intra_rank == 0, comm.intra_rank
+    ranks = comm.allgather_obj(comm.rank)
+    assert sorted(ranks) == list(range(comm.inter_size)), ranks
+
+
+def scenario_obj_collectives(comm):
+    import chainermn_tpu.communicators.tpu_xla as tx
+
+    r = comm.inter_rank
+    # bcast_obj: root's object everywhere
+    assert comm.bcast_obj({"v": r} if r == 0 else None, root=0) == {"v": 0}
+    # multi-frame path: shrink the frame so a modest payload chunks
+    old = tx._OBJ_FRAME_BYTES
+    tx._OBJ_FRAME_BYTES = 1024
+    try:
+        big = bytes(range(256)) * 40  # 10240 bytes -> 10 frames
+        assert comm.bcast_obj(big if r == 0 else None, root=0) == big
+        # asymmetric payload sizes across processes
+        mine = "x" * (100 + 5000 * r)
+        out = comm.allgather_obj(mine)
+        assert [len(s) for s in out] == [100 + 5000 * p
+                                         for p in range(comm.inter_size)]
+    finally:
+        tx._OBJ_FRAME_BYTES = old
+    # allreduce_obj over nested structures
+    red = comm.allreduce_obj({"loss": float(r), "n": 1}, op="sum")
+    ws = comm.inter_size
+    assert red == {"loss": sum(range(ws)) * 1.0, "n": ws}
+    assert comm.allreduce_obj(2.0, op="mean") == 2.0
+    # gather_obj: only root's process gets the list
+    got = comm.gather_obj(r * 10, root=0)
+    if r == 0:
+        assert got == [p * 10 for p in range(ws)]
+    else:
+        assert got is None
+    # scatter_obj
+    objs = [f"piece{p}" for p in range(ws)] if r == 0 else None
+    assert comm.scatter_obj(objs, root=0) == f"piece{r}"
+    comm.barrier()
+
+
+def scenario_p2p_obj(comm):
+    from chainermn_tpu.communicators import _obj_channel
+
+    r = comm.inter_rank
+    peer_rank = 1 - r  # device rank == process rank here (1 dev/proc)
+    # ordered multi-message exchange, both directions
+    if r == 0:
+        comm.send_obj({"msg": 1}, dest=1)
+        comm.send_obj([2, "two"], dest=1)
+        assert comm.recv_obj(source=1) == "reply"
+    else:
+        assert comm.recv_obj(source=0) == {"msg": 1}
+        assert comm.recv_obj(source=0) == [2, "two"]
+        comm.send_obj("reply", dest=0)
+    comm.barrier()
+    # multi-frame p2p: shrink the KV frame so the payload chunks
+    old = _obj_channel.FRAME_BYTES
+    _obj_channel.FRAME_BYTES = 512
+    try:
+        payload = np.arange(4096, dtype=np.int64)  # ~32 KiB pickled
+        if r == 0:
+            comm.send_obj(payload, dest=1)
+        else:
+            got = comm.recv_obj(source=0)
+            np.testing.assert_array_equal(got, payload)
+    finally:
+        _obj_channel.FRAME_BYTES = old
+    comm.barrier()
+    # oversize single object raises the named error
+    old_cap = _obj_channel.MAX_OBJ_BYTES
+    _obj_channel.MAX_OBJ_BYTES = 100
+    try:
+        if r == 0:
+            try:
+                comm.send_obj("y" * 1000, dest=1)
+            except _obj_channel.DataSizeError:
+                pass
+            else:
+                raise AssertionError("DataSizeError not raised")
+    finally:
+        _obj_channel.MAX_OBJ_BYTES = old_cap
+    comm.barrier()
+
+
+def scenario_array_collectives(comm):
+    """The jitted shard_map collectives over a process-spanning mesh."""
+    ws = comm.size
+    x = np.arange(ws * 3, dtype=np.float32).reshape(ws, 3)
+    out = comm.allreduce(x, op="sum")
+    expect = np.broadcast_to(x.sum(0), (ws, 3))
+    local = np.asarray(out.addressable_shards[0].data)
+    np.testing.assert_allclose(
+        local, expect[comm.rank : comm.rank + 1])
+    out = comm.bcast(x, root=1)
+    local = np.asarray(out.addressable_shards[0].data)
+    np.testing.assert_allclose(local, x[1:2])
+
+
+def scenario_scatter_dataset(comm):
+    from chainermn_tpu import scatter_dataset
+
+    data = list(range(103))
+    shard = scatter_dataset(data, comm, shuffle=True, seed=7)
+    lens = comm.allgather_obj(len(shard))
+    assert len(set(lens)) == 1, f"unequal shard lengths {lens}"
+    all_idx = comm.allgather_obj(sorted(shard.indices.tolist()))
+    covered = set()
+    for idx in all_idx:
+        covered.update(idx)
+    assert covered == set(range(103))
+
+
+def scenario_checkpoint(comm):
+    from chainermn_tpu import create_multi_node_checkpointer
+
+    class FakeUpdater:
+        def __init__(self):
+            self.iteration = 0
+            self.params = {"w": np.zeros(3)}
+            self.opt_state = {"m": np.zeros(3)}
+            self.state = None
+
+    # every process must agree on the directory: created by proc 0,
+    # broadcast to the rest (node-local disks would each make their own)
+    path = comm.bcast_obj(
+        tempfile.mkdtemp(prefix="cmn_ckpt_") if comm.inter_rank == 0
+        else None, root=0)
+    cp = create_multi_node_checkpointer(comm, path)
+    cp._cleanup = lambda keep: None  # keep both sets alive for the test
+    up = FakeUpdater()
+    for it in (5, 10):
+        up.iteration = it
+        up.params = {"w": np.full(3, float(it))}
+        cp.save(up)
+    # wreck iteration 10 on process 1 only -> latest COMMON set is 5
+    if comm.inter_rank == 1:
+        os.remove(os.path.join(path, f"snapshot_iter_10.1"))
+    comm.barrier()
+    fresh = FakeUpdater()
+    cp2 = create_multi_node_checkpointer(comm, path)
+    resumed = cp2.maybe_load(fresh)
+    assert resumed == 5, f"expected agreement on 5, got {resumed}"
+    np.testing.assert_allclose(fresh.params["w"], 5.0)
+    comm.barrier()
+
+
+def scenario_evaluator(comm):
+    from chainermn_tpu import create_multi_node_evaluator
+
+    class LocalEval:
+        name = "validation"
+
+        def __init__(self, value):
+            self._value = value
+
+        def evaluate(self, params):
+            return {"acc": self._value}
+
+    # process r reports acc=r; the multi-node wrapper must average
+    ev = create_multi_node_evaluator(LocalEval(float(comm.inter_rank)), comm)
+    obs = ev.evaluate(None)
+    ws = comm.inter_size
+    assert abs(obs["acc"] - sum(range(ws)) / ws) < 1e-9, obs
+
+
+def scenario_broadcast_iterator(comm):
+    from chainermn_tpu import SerialIterator, create_multi_node_iterator
+
+    # only the master process can see the "real" data source
+    if comm.inter_rank == 0:
+        base = SerialIterator(list(range(10)), batch_size=4,
+                              repeat=False, shuffle=True, seed=3)
+    else:
+        base = SerialIterator([None] * 10, batch_size=4, repeat=False)
+    it = create_multi_node_iterator(base, comm, rank_master=0)
+    batches = []
+    for batch in it:
+        batches.append(batch)
+    gathered = comm.allgather_obj(batches)
+    for other in gathered[1:]:
+        assert other == gathered[0], "slave batches diverge from master"
+    assert sorted(sum(gathered[0], [])) == list(range(10))
+
+
+def scenario_observation_aggregator(comm):
+    from chainermn_tpu.extensions import ObservationAggregator
+
+    class FakeTrainer:
+        def __init__(self):
+            self.observation = {}
+
+    agg = ObservationAggregator(comm)
+    tr = FakeTrainer()
+    tr.observation = {"loss": float(comm.inter_rank + 1)}
+    agg.observe(tr)
+    ws = comm.inter_size
+    expect = sum(range(1, ws + 1)) / ws
+    assert abs(tr.observation["loss"] - expect) < 1e-9, tr.observation
+
+
+SCENARIOS = {
+    name[len("scenario_"):]: fn
+    for name, fn in list(globals().items())
+    if name.startswith("scenario_")
+}
+
+
+def main():
+    addr, n, i, scenario = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                            sys.argv[4])
+    import chainermn_tpu
+
+    chainermn_tpu.init_distributed(
+        coordinator_address=addr, num_processes=n, process_id=i)
+    comm = chainermn_tpu.create_communicator("tpu_xla")
+    SCENARIOS[scenario](comm)
+    print(f"WORKER_OK {i} {scenario}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Two-process cluster tests — every ``inter_size > 1`` code path that
+single-process tests cannot reach, run as real OS processes (the
+reference's ``mpiexec -n 2 pytest``; SURVEY.md §4)."""
+
+import pytest
+
+
+@pytest.mark.multiprocess
+class TestTwoProcess:
+    def test_topology_contract(self, mp_run):
+        mp_run("topology")
+
+    def test_obj_collectives(self, mp_run):
+        mp_run("obj_collectives")
+
+    def test_p2p_obj_channel(self, mp_run):
+        mp_run("p2p_obj")
+
+    def test_array_collectives(self, mp_run):
+        mp_run("array_collectives")
+
+    def test_scatter_dataset(self, mp_run):
+        mp_run("scatter_dataset")
+
+    def test_checkpoint_agreement_resume(self, mp_run):
+        mp_run("checkpoint")
+
+    def test_evaluator_averaging(self, mp_run):
+        mp_run("evaluator")
+
+    def test_broadcast_iterator(self, mp_run):
+        mp_run("broadcast_iterator")
+
+    def test_observation_aggregator(self, mp_run):
+        mp_run("observation_aggregator")
