@@ -1,0 +1,115 @@
+"""Long-context attention microbench: Pallas flash kernel vs XLA einsum.
+
+Measures a causal 8k-context attention forward+backward on one chip and
+reports the speedup of the kernel path over the einsum path (the
+per-pair compute that the ring schedule multiplies across the ``seq``
+mesh axis — if the kernel wins here, the composed ring wins too).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} where
+value = kernel-path images of speedup (xla_ms / flash_ms) and
+vs_baseline uses 1.0 (parity with the einsum path) as the baseline.
+Same child-process timeout/retry pattern as bench.py (the TPU backend
+init on this host can hang).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+METRIC = "flash_attention_8k_speedup_vs_xla"
+UNIT = "x"
+
+
+def run(batch=4, seq=8192, heads=8, d_head=128, iters=20, warmup=3):
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.ops.pallas_attention import flash_attention
+    from chainermn_tpu.parallel.ring_attention import local_attention
+
+    interpret = jax.default_backend() != "tpu"
+    kx = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (batch, seq, heads, d_head)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in kx)
+
+    def time_path(fn):
+        loss = lambda q, k, v: jnp.sum(
+            fn(q, k, v).astype(jnp.float32) ** 2)
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        for _ in range(warmup):
+            g = step(q, k, v)
+        float(jnp.sum(g[0][0, 0, 0]))  # device->host sync (axon quirk)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = step(q, k, v)
+        float(jnp.sum(g[0][0, 0, 0]))
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    flash_ms = time_path(
+        lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                        interpret=interpret))
+    xla_ms = time_path(
+        lambda q, k, v: local_attention(q, k, v, causal=True))
+    speedup = xla_ms / flash_ms
+    return {
+        "metric": METRIC,
+        "value": round(speedup, 3),
+        "unit": UNIT,
+        "vs_baseline": round(speedup, 3),
+        "flash_ms": round(flash_ms, 2),
+        "xla_ms": round(xla_ms, 2),
+        "config": f"B{batch} T{seq} H{heads} D{d_head} causal bf16 fwd+bwd",
+    }
+
+
+def main(argv):
+    p = argparse.ArgumentParser()
+    p.add_argument("--child", action="store_true")
+    p.add_argument("--seq", type=int, default=8192)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--timeouts", type=int, nargs="+", default=[420, 360])
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    if args.child:
+        if args.platform:
+            os.environ["JAX_PLATFORMS"] = args.platform
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        print("BENCH_RESULT " + json.dumps(
+            run(batch=args.batch, seq=args.seq, iters=args.iters)))
+        return 0
+
+    here = os.path.abspath(__file__)
+    cmd = [sys.executable, here, "--child", "--seq", str(args.seq),
+           "--batch", str(args.batch), "--iters", str(args.iters)]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    errors = []
+    for attempt, budget in enumerate(args.timeouts):
+        try:
+            proc = subprocess.run(cmd, timeout=budget, capture_output=True,
+                                  text=True, cwd=os.path.dirname(here))
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt + 1}: timed out after {budget}s")
+            continue
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("BENCH_RESULT "):
+                print(line[len("BENCH_RESULT "):])
+                return 0
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        errors.append(f"attempt {attempt + 1}: rc={proc.returncode}, "
+                      f"{' | '.join(tail[-3:]) if tail else '<none>'}")
+    print(json.dumps({"metric": METRIC, "value": None, "unit": UNIT,
+                      "vs_baseline": None,
+                      "error": "; ".join(errors)[-1800:]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

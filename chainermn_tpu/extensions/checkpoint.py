@@ -46,7 +46,12 @@ class MultiNodeCheckpointer:
     reference's usage in its README recipe).
     """
 
-    priority = 70  # after evaluators, before log writers
+    # LOWEST priority: the checkpointer now serializes extension state
+    # (LogReport history), so it must run AFTER log writers flush on a
+    # shared trigger tick — otherwise a resume restores a pre-flush
+    # LogReport and that interval's entry is lost (Chainer gave snapshot
+    # the lowest priority for the same reason).
+    priority = 30
 
     def __init__(self, comm, path: str, name: str = "snapshot"):
         self.comm = comm
@@ -80,15 +85,18 @@ class MultiNodeCheckpointer:
     # ------------------------------------------------------------------ #
 
     def __call__(self, trainer) -> None:
-        self.save(trainer.updater)
+        self.save(trainer.updater, trainer)
 
-    def save(self, updater) -> None:
+    def save(self, updater, trainer=None) -> None:
+        from chainermn_tpu.training._resume import collect_train_state
+
         it = updater.iteration
         state = {
             "iteration": it,
             "world_size": self.comm.inter_size,
             "params": updater.params,
             "opt_state": updater.opt_state,
+            "train_state": collect_train_state(updater, trainer),
         }
         if getattr(updater, "state", None) is not None:
             state["model_state"] = updater.state
@@ -100,7 +108,11 @@ class MultiNodeCheckpointer:
         self._cleanup(keep=it)
 
     def _cleanup(self, keep: int) -> None:
-        for it in sorted(self._saved_iterations):
+        """Remove every superseded shard of THIS rank — including orphans
+        from before a crash (the disk inventory, not just this process's
+        in-memory save set: a shard written by a dead run is equally
+        superseded once a newer complete set exists)."""
+        for it in self._local_iterations() | self._saved_iterations:
             if it == keep:
                 continue
             fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
@@ -114,12 +126,16 @@ class MultiNodeCheckpointer:
     # resume
     # ------------------------------------------------------------------ #
 
-    def maybe_load(self, updater) -> Optional[int]:
-        """Restore the newest globally-complete snapshot into ``updater``.
+    def maybe_load(self, updater, trainer=None) -> Optional[int]:
+        """Restore the newest globally-complete snapshot into ``updater``
+        (and, when given, ``trainer``: iterator position/epoch/RNG,
+        extension state like the LogReport history, and the wall clock —
+        the reference serialized the whole trainer object graph).
 
         Returns the resumed iteration, or ``None`` when nothing to resume
         (fresh start — the reference's behaviour on first launch).
         """
+        from chainermn_tpu.training._resume import restore_train_state
         common = self._common_iterations()
         if not common:
             return None
@@ -140,6 +156,7 @@ class MultiNodeCheckpointer:
         if "model_state" in state:
             updater.state = state["model_state"]
         updater.iteration = int(state["iteration"])
+        restore_train_state(state.get("train_state"), updater, trainer)
         self._saved_iterations = self._local_iterations()
         return it
 

@@ -23,7 +23,7 @@ __all__ = ["multi_node_snapshot", "load_snapshot"]
 
 
 class _MultiNodeSnapshot:
-    priority = 70
+    priority = 30  # after log writers flush (it serializes their state)
 
     def __init__(self, comm, filename: str, writer_rank: int):
         self.comm = comm
@@ -31,10 +31,13 @@ class _MultiNodeSnapshot:
         self.writer_rank = writer_rank
 
     def __call__(self, trainer) -> None:
+        from chainermn_tpu.training._resume import collect_train_state
+
         state = {
             "iteration": trainer.updater.iteration,
             "params": trainer.updater.params,
             "opt_state": trainer.updater.opt_state,
+            "train_state": collect_train_state(trainer.updater, trainer),
         }
         if getattr(trainer.updater, "state", None) is not None:
             state["model_state"] = trainer.updater.state
@@ -53,12 +56,16 @@ def multi_node_snapshot(comm, filename: str = "snapshot_iter_{iteration}",
     return _MultiNodeSnapshot(comm, filename, writer_rank)
 
 
-def load_snapshot(updater, path: str) -> Optional[int]:
-    """Restore a :func:`multi_node_snapshot` file into ``updater``."""
+def load_snapshot(updater, path: str, trainer=None) -> Optional[int]:
+    """Restore a :func:`multi_node_snapshot` file into ``updater`` (and,
+    when given, ``trainer`` — iterator/extension/clock state)."""
+    from chainermn_tpu.training._resume import restore_train_state
+
     state = load_state(path)
     updater.params = state["params"]
     updater.opt_state = state["opt_state"]
     if "model_state" in state:
         updater.state = state["model_state"]
     updater.iteration = int(state["iteration"])
+    restore_train_state(state.get("train_state"), updater, trainer)
     return updater.iteration

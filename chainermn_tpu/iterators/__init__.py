@@ -88,6 +88,35 @@ class SerialIterator:
 
     next = __next__
 
+    # -- resume protocol (reference: Chainer serialized the iterator into
+    # the trainer snapshot, so a resumed run continues mid-epoch with the
+    # same shuffle order instead of silently restarting the epoch) ------ #
+
+    def state_dict(self) -> dict:
+        s = self._rng.get_state()
+        return {
+            "epoch": self.epoch,
+            "is_new_epoch": self.is_new_epoch,
+            "pos": self._pos,
+            "exhausted": self._exhausted,
+            "order": np.asarray(self._order).copy(),
+            "rng_keys": np.asarray(s[1], np.uint32),
+            "rng_pos": int(s[2]),
+            "rng_has_gauss": int(s[3]),
+            "rng_cached": float(s[4]),
+        }
+
+    def load_state_dict(self, st: dict) -> None:
+        self.epoch = int(st["epoch"])
+        self.is_new_epoch = bool(st["is_new_epoch"])
+        self._pos = int(st["pos"])
+        self._exhausted = bool(st["exhausted"])
+        self._order = np.asarray(st["order"])
+        self._rng.set_state((
+            "MT19937", np.asarray(st["rng_keys"], np.uint32),
+            int(st["rng_pos"]), int(st["rng_has_gauss"]),
+            float(st["rng_cached"])))
+
 
 class _BroadcastIterator:
     """Wraps a master iterator; every process yields the master's batches.

@@ -8,12 +8,23 @@ params fp32 / compute bf16, functional ``(params, x) -> logits``.  These
 are stateless (no BN; NiN/VGG used none upstream, AlexNet used LRN which
 is dropped as obsolete — modern recipes replace it with nothing), so they
 also serve as the no-state contrast to ResNet in the training stack.
+
+Head parity: with the default ``head="flatten"`` every arch uses the
+reference's exact geometry — explicit conv paddings (AlexNet conv1 is
+VALID), ceil-mode max pooling (Chainer's ``cover_all=True``), and the
+flatten→FC stacks (AlexNet 9216→4096 at its native 227px; VGG
+25088→4096 at 224px) — the exact parameter shapes of the upstream
+models.  ``head="gap"`` selects a deliberately different modern variant:
+all-SAME padding and a global-average-pool head (256→4096 / 512→4096)
+that works at any input size (the ``--tiny`` smoke runs use it).  NiN is
+natively all-conv + GAP in the reference, so for NiN ``head`` only picks
+the geometry (reference pads + ceil pools vs all-SAME).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +33,7 @@ from jax import lax
 __all__ = ["ConvNetConfig", "init_convnet", "convnet_apply"]
 
 _ARCHS = ("alex", "nin", "vgg16")
+_NATIVE_SIZE = {"alex": 227, "nin": 227, "vgg16": 224}
 
 
 @dataclass(frozen=True)
@@ -29,10 +41,18 @@ class ConvNetConfig:
     arch: str = "alex"          # "alex" | "nin" | "vgg16"
     num_classes: int = 1000
     dtype: str = "bfloat16"
+    head: str = "flatten"       # "flatten" (reference parity) | "gap"
+    image_size: Optional[int] = None  # default: the arch's native insize
 
     def __post_init__(self):
         if self.arch not in _ARCHS:
             raise ValueError(f"arch {self.arch!r} not in {_ARCHS}")
+        if self.head not in ("flatten", "gap"):
+            raise ValueError(f"head {self.head!r} not in (flatten, gap)")
+
+    @property
+    def insize(self) -> int:
+        return self.image_size or _NATIVE_SIZE[self.arch]
 
     @property
     def compute_dtype(self):
@@ -54,36 +74,47 @@ def _dense_init(key, fin, fout):
 
 
 # (kind, *spec) rows build each arch; kinds:
-#   c  kh kw cin cout stride  — conv + ReLU
-#   cl kh kw cin cout stride  — conv, no ReLU (NiN's last 1x1)
-#   p  window stride          — max pool
-#   g                         — global average pool
-#   f  fin fout               — dense + ReLU
-#   fl fin fout               — dense, no ReLU (logits)
+#   c  kh kw cin cout stride pad — conv + ReLU (pad: int or "SAME")
+#   cl kh kw cin cout stride pad — conv, no ReLU (NiN's last 1x1)
+#   p  window stride             — max pool (ceil-mode in reference
+#                                  geometry; SAME in the gap variant)
+#   g                            — global average pool
+#   flat cin                     — flatten (fin computed from geometry)
+#   f  fin fout                  — dense + ReLU (fin -1 => from flatten)
+#   fl fin fout                  — dense, no ReLU (logits)
 def _rows(cfg: ConvNetConfig) -> Sequence[Tuple]:
     n = cfg.num_classes
+    ref = cfg.head == "flatten"
+
+    def pad(p):  # reference pads vs size-robust SAME
+        return p if ref else "SAME"
+
     if cfg.arch == "alex":
         return [
-            ("c", 11, 11, 3, 96, 4), ("p", 3, 2),
-            ("c", 5, 5, 96, 256, 1), ("p", 3, 2),
-            ("c", 3, 3, 256, 384, 1),
-            ("c", 3, 3, 384, 384, 1),
-            ("c", 3, 3, 384, 256, 1), ("p", 3, 2),
-            ("g",),
-            ("f", 256, 4096), ("f", 4096, 4096), ("fl", 4096, n),
+            # reference geometry: conv1 VALID stride 4 (227 -> 55)
+            ("c", 11, 11, 3, 96, 4, pad(0)), ("p", 3, 2),
+            ("c", 5, 5, 96, 256, 1, pad(2)), ("p", 3, 2),
+            ("c", 3, 3, 256, 384, 1, pad(1)),
+            ("c", 3, 3, 384, 384, 1, pad(1)),
+            ("c", 3, 3, 384, 256, 1, pad(1)), ("p", 3, 2),
+            ("flat", 256) if ref else ("g",),
+            # flatten: 256·6·6 = 9216 -> 4096 at the native 227 insize
+            ("f", -1 if ref else 256, 4096),
+            ("f", 4096, 4096), ("fl", 4096, n),
         ]
     if cfg.arch == "nin":
         return [
-            ("c", 11, 11, 3, 96, 4),
-            ("c", 1, 1, 96, 96, 1), ("c", 1, 1, 96, 96, 1), ("p", 3, 2),
-            ("c", 5, 5, 96, 256, 1),
-            ("c", 1, 1, 256, 256, 1), ("c", 1, 1, 256, 256, 1),
+            ("c", 11, 11, 3, 96, 4, pad(0)),
+            ("c", 1, 1, 96, 96, 1, 0), ("c", 1, 1, 96, 96, 1, 0),
             ("p", 3, 2),
-            ("c", 3, 3, 256, 384, 1),
-            ("c", 1, 1, 384, 384, 1), ("c", 1, 1, 384, 384, 1),
+            ("c", 5, 5, 96, 256, 1, pad(2)),
+            ("c", 1, 1, 256, 256, 1, 0), ("c", 1, 1, 256, 256, 1, 0),
             ("p", 3, 2),
-            ("c", 3, 3, 384, 1024, 1),
-            ("c", 1, 1, 1024, 1024, 1), ("cl", 1, 1, 1024, n, 1),
+            ("c", 3, 3, 256, 384, 1, pad(1)),
+            ("c", 1, 1, 384, 384, 1, 0), ("c", 1, 1, 384, 384, 1, 0),
+            ("p", 3, 2),
+            ("c", 3, 3, 384, 1024, 1, pad(1)),
+            ("c", 1, 1, 1024, 1024, 1, 0), ("cl", 1, 1, 1024, n, 1, 0),
             ("g",),
         ]
     # vgg16
@@ -91,26 +122,65 @@ def _rows(cfg: ConvNetConfig) -> Sequence[Tuple]:
     cin = 3
     for cout, reps in ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3)):
         for _ in range(reps):
-            rows.append(("c", 3, 3, cin, cout, 1))
+            rows.append(("c", 3, 3, cin, cout, 1, pad(1)))
             cin = cout
         rows.append(("p", 2, 2))
-    rows += [("g",), ("f", 512, 4096), ("f", 4096, 4096),
-             ("fl", 4096, n)]
-    return rows
+    head = [("flat", 512) if ref else ("g",),
+            # flatten: 512·7·7 = 25088 -> 4096 at the native 224 insize
+            ("f", -1 if ref else 512, 4096),
+            ("f", 4096, 4096), ("fl", 4096, n)]
+    return rows + head
+
+
+def _pool_out(size: int, k: int, stride: int, ceil_mode: bool) -> int:
+    if ceil_mode:  # Chainer cover_all=True
+        return max(-(-(size - k) // stride) + 1, 0)
+    return -(-size // stride)  # SAME
+
+
+def _conv_out(size: int, k: int, stride: int, pad) -> int:
+    if pad == "SAME":
+        return -(-size // stride)
+    return (size + 2 * pad - k) // stride + 1
+
+
+def _flatten_fin(cfg: ConvNetConfig) -> int:
+    """Spatial geometry simulation → flatten fan-in for this insize."""
+    size = cfg.insize
+    fin = None
+    ceil_mode = cfg.head == "flatten"
+    for row in _rows(cfg):
+        kind = row[0]
+        if kind in ("c", "cl"):
+            _, kh, _, _, _, stride, pad = row
+            size = _conv_out(size, kh, stride, pad)
+        elif kind == "p":
+            _, win, stride = row
+            size = _pool_out(size, win, stride, ceil_mode)
+        elif kind == "flat":
+            if size <= 0:
+                raise ValueError(
+                    f"image_size {cfg.insize} collapses to {size}px before "
+                    f"the {cfg.arch!r} flatten head — use the arch's native "
+                    f"size ({_NATIVE_SIZE[cfg.arch]}) or head='gap'")
+            fin = row[1] * size * size
+    return fin
 
 
 def init_convnet(key, cfg: ConvNetConfig):
+    flat_fin = _flatten_fin(cfg) if cfg.head == "flatten" else None
     params = []
     for row in _rows(cfg):
         kind = row[0]
         if kind in ("c", "cl"):
             key, sub = jax.random.split(key)
-            _, kh, kw, cin, cout, _ = row
+            _, kh, kw, cin, cout, _, _ = row
             params.append({"w": _conv_init(sub, kh, kw, cin, cout),
                            "b": jnp.zeros((cout,), jnp.float32)})
         elif kind in ("f", "fl"):
             key, sub = jax.random.split(key)
-            params.append(_dense_init(sub, row[1], row[2]))
+            fin = flat_fin if row[1] == -1 else row[1]
+            params.append(_dense_init(sub, fin, row[2]))
         else:
             params.append({})
     return params
@@ -123,20 +193,34 @@ def convnet_apply(cfg: ConvNetConfig, params, x):
     for row, p in zip(_rows(cfg), params):
         kind = row[0]
         if kind in ("c", "cl"):
-            _, _, _, _, _, stride = row
+            _, _, _, _, _, stride, pad = row
+            padding = pad if pad == "SAME" else [(pad, pad), (pad, pad)]
             h = lax.conv_general_dilated(
-                h, p["w"].astype(cd), (stride, stride), "SAME",
+                h, p["w"].astype(cd), (stride, stride), padding,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
             ) + p["b"].astype(cd)
             if kind == "c":
                 h = jax.nn.relu(h)
         elif kind == "p":
             _, win, stride = row
-            h = lax.reduce_window(
-                h, -jnp.inf, lax.max,
-                (1, win, win, 1), (1, stride, stride, 1), "SAME")
+            if cfg.head == "flatten":
+                # ceil-mode pooling (Chainer cover_all=True): pad the
+                # high edge just enough that every input row is covered
+                size = h.shape[1]
+                out = _pool_out(size, win, stride, True)
+                extra = max((out - 1) * stride + win - size, 0)
+                h = lax.reduce_window(
+                    h, -jnp.inf, lax.max,
+                    (1, win, win, 1), (1, stride, stride, 1),
+                    [(0, 0), (0, extra), (0, extra), (0, 0)])
+            else:
+                h = lax.reduce_window(
+                    h, -jnp.inf, lax.max,
+                    (1, win, win, 1), (1, stride, stride, 1), "SAME")
         elif kind == "g":
             h = jnp.mean(h, axis=(1, 2))
+        elif kind == "flat":
+            h = h.reshape(h.shape[0], -1)
         elif kind in ("f", "fl"):
             h = h.astype(jnp.float32) @ p["w"] + p["b"]
             if kind == "f":

@@ -33,9 +33,12 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from chainermn_tpu.ops.pallas_attention import flash_attention
+from chainermn_tpu.ops.pallas_attention import (
+    flash_attention,
+    flash_attention_supported,
+)
 from chainermn_tpu.parallel.expert import expert_parallel_moe
-from chainermn_tpu.parallel.pipeline import pipeline_apply
+from chainermn_tpu.parallel.pipeline import pipeline_apply, pipeline_train_1f1b
 from chainermn_tpu.parallel.ring_attention import (
     local_attention,
     ring_attention,
@@ -70,6 +73,7 @@ class TransformerConfig:
     n_experts: int = 8         # global expert count (moe=True)
     capacity_factor: float = 1.25
     num_microbatches: int = 1  # GPipe M (>1 only useful when pipe > 1)
+    pipeline_schedule: str = "gpipe"  # "gpipe" | "1f1b" (train step only)
     remat: bool = True
     dtype: str = "bfloat16"    # compute dtype (params stay fp32)
 
@@ -184,8 +188,14 @@ def _attention(cfg: TransformerConfig, h, blk):
     qkv = qkv.reshape(B, T, 3, Hl, cfg.d_head)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     if cfg.attention == "ring":
+        # flagship long-context path: ring schedule with the Pallas
+        # kernel as the per-pair compute whenever the local block shape
+        # fits the kernel (interpret mode keeps one config working on
+        # non-TPU backends); XLA einsum blocks otherwise
+        use_flash = flash_attention_supported(T, T)
         o = ring_attention(q, k, v, axis_name="seq", causal=True,
-                           remat=cfg.remat)
+                           remat=cfg.remat, use_flash=use_flash,
+                           interpret=jax.default_backend() != "tpu")
     elif cfg.attention == "ulysses":
         o = ulysses_attention(q, k, v, axis_name="seq", causal=True)
     elif cfg.attention == "local":
@@ -199,9 +209,14 @@ def _attention(cfg: TransformerConfig, h, blk):
                 'case (mesh seq axis is '
                 f'{lax.axis_size("seq")}); use attention="ring" to '
                 "shard the sequence")
-        o = flash_attention(
-            q, k, v, causal=True,
-            interpret=jax.default_backend() != "tpu")
+        if not flash_attention_supported(T, T):
+            # kernel contract: lengths must divide the (clamped) blocks —
+            # fall back to the XLA path instead of erroring at trace time
+            o = local_attention(q, k, v, causal=True)
+        else:
+            o = flash_attention(
+                q, k, v, causal=True,
+                interpret=jax.default_backend() != "tpu")
     else:
         raise ValueError(cfg.attention)
     o = row_parallel_dense(
@@ -241,17 +256,19 @@ def _block(cfg: TransformerConfig, h, blk):
 
 
 def _stage(cfg: TransformerConfig, stage_params, h):
-    """One pipeline stage = scan over its ``layers_per_stage`` blocks.
-    MoE aux losses inside a pipelined stage are dropped (the Switch
-    balancing term is a regulariser; returning side outputs through the
-    GPipe schedule would break the homogeneous-stage contract)."""
+    """One pipeline stage = scan over its ``layers_per_stage`` blocks,
+    returning ``(h, aux)`` — the summed MoE balancing loss of the
+    stage's layers rides the schedule via ``pipeline_apply(with_aux=
+    True)`` instead of being dropped."""
 
     def body(carry, blk):
-        out, _ = _block(cfg, carry, blk)
-        return out, None
+        h, aux = carry
+        out, a = _block(cfg, h, blk)
+        return (out, aux + a), None
 
-    h, _ = lax.scan(body, h, stage_params)
-    return h
+    aux0 = jnp.sum(h * 0, dtype=jnp.float32)
+    (h, aux), _ = lax.scan(body, (h, aux0), stage_params)
+    return h, aux
 
 
 def transformer_forward(cfg: TransformerConfig, params, tokens):
@@ -276,15 +293,15 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
 
     S = lax.axis_size("pipe")
     if S > 1 or cfg.num_microbatches > 1:
-        h = pipeline_apply(
+        h, aux = pipeline_apply(
             partial(_stage, cfg),
             params["blocks"],
             h,
             axis_name="pipe",
             num_microbatches=cfg.num_microbatches,
             remat=cfg.remat,
+            with_aux=True,
         )
-        aux = jnp.zeros((), jnp.float32)
     else:
         blocks = jax.tree.map(
             lambda a: jnp.squeeze(a, axis=0), params["blocks"])
@@ -329,6 +346,77 @@ def lm_loss(cfg: TransformerConfig, params, inputs, targets):
 _BATCH_SPEC = P(("data", "expert"), "seq")
 
 
+def _make_1f1b_grad(cfg: TransformerConfig):
+    """Build the 1F1B value-and-grad body (call inside shard_map).
+
+    Decomposition: embedding runs outside the schedule (its input grads
+    come back as the schedule's ``dx``); the transformer stack is the
+    pipelined stage function; final norm + weight-tied LM head + softmax
+    cross-entropy form the in-schedule ``loss_fn`` whose parameter
+    gradients (``ln_f`` and the head side of ``embed``) flow through the
+    schedule's ``loss_params`` path.
+    """
+    if cfg.moe:
+        raise ValueError(
+            "pipeline_schedule='1f1b' does not carry the Switch-MoE aux "
+            "loss through the schedule yet — use the GPipe schedule for "
+            "MoE configs")
+    cd = cfg.compute_dtype
+
+    def stage_fn(p, mb):
+        h, _ = _stage(cfg, p, mb)
+        return h
+
+    def grad_body(params, inputs, targets):
+        B, T = inputs.shape
+        r = lax.axis_index("seq")
+
+        def embed_fn(ep):
+            h = ep["embed"][inputs]
+            pos = lax.dynamic_slice_in_dim(ep["pos"], r * T, T, axis=0)
+            return (h + pos).astype(cd)
+
+        ep = {"embed": params["embed"], "pos": params["pos"]}
+        h, vjp_embed = jax.vjp(embed_fn, ep)
+
+        def loss_fn(lp, y, tgt):
+            hN = _rms_norm(y, lp["ln_f"])
+            logits = jnp.einsum(
+                "btd,vd->btv", hN.astype(jnp.float32), lp["embed"])
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, tgt[..., None], axis=-1).squeeze(-1)
+            return nll.mean()
+
+        lp = {"ln_f": params["ln_f"], "embed": params["embed"]}
+        loss, g_blocks, g_lp, dx = pipeline_train_1f1b(
+            stage_fn, loss_fn, params["blocks"], lp, h, targets,
+            axis_name="pipe", num_microbatches=cfg.num_microbatches)
+        (d_ep,) = vjp_embed(dx)
+
+        grads = {
+            # weight tying: embedding grads = lookup side + head side
+            "embed": d_ep["embed"] + g_lp["embed"],
+            "pos": d_ep["pos"],
+            "blocks": g_blocks,
+            "ln_f": g_lp["ln_f"],
+        }
+        # Normalisation: every parameter is REPLICATED over the
+        # data-like axes, so the shard_map transposes inside the manual
+        # vjp calls have already PSUMMED each gradient over
+        # (data, expert, seq) — the GPipe path folds the 1/N into the
+        # differentiated pmean; here the grads come back as global sums
+        # and need the explicit 1/N to become the global mean.
+        axes = ("data", "expert", "seq")
+        n = (lax.axis_size("data") * lax.axis_size("expert")
+             * lax.axis_size("seq"))
+        loss = lax.pmean(loss, axes)
+        grads = jax.tree.map(lambda g: g / n, grads)
+        return loss, grads
+
+    return grad_body
+
+
 def shard_params(mesh_cfg, cfg: TransformerConfig, params):
     """Place a host-initialised param pytree per :func:`param_specs`.
 
@@ -371,13 +459,29 @@ def make_train_step(mesh_cfg, cfg: TransformerConfig, optimizer):
     under plain jit where XLA propagates the grads' shardings through
     arbitrary optax state pytrees (which ``param_specs`` could not
     describe structurally).
+
+    With ``cfg.pipeline_schedule == "1f1b"`` the pipelined portion runs
+    the 1F1B schedule (:func:`...parallel.pipeline.pipeline_train_1f1b`)
+    — the loss moves INSIDE the schedule (final norm + tied head become
+    its ``loss_params``) so each micro-batch's backward starts as soon
+    as it clears the last stage, capping in-flight activations at O(S)
+    instead of GPipe's O(M).
     """
     specs = param_specs(cfg)
 
-    grad_fn = jax.shard_map(
-        lambda p, x, y: jax.value_and_grad(
+    if cfg.pipeline_schedule == "1f1b":
+        grad_body = _make_1f1b_grad(cfg)
+    elif cfg.pipeline_schedule == "gpipe":
+        grad_body = lambda p, x, y: jax.value_and_grad(
             lambda q: lax.pmean(
-                lm_loss(cfg, q, x, y), ("data", "expert", "seq")))(p),
+                lm_loss(cfg, q, x, y), ("data", "expert", "seq")))(p)
+    else:
+        raise ValueError(
+            f"pipeline_schedule must be gpipe|1f1b, "
+            f"got {cfg.pipeline_schedule!r}")
+
+    grad_fn = jax.shard_map(
+        grad_body,
         mesh=mesh_cfg.mesh,
         in_specs=(specs, _BATCH_SPEC, _BATCH_SPEC),
         out_specs=(P(), specs),

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["stack_stage_params", "pipeline_apply", "unstack_stage_params"]
+__all__ = ["stack_stage_params", "pipeline_apply", "pipeline_train_1f1b",
+           "unstack_stage_params"]
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2))
@@ -85,6 +86,7 @@ def pipeline_apply(
     axis_name: str = "pipe",
     num_microbatches: int,
     remat: bool = True,
+    with_aux: bool = False,
 ):
     """Run the GPipe schedule.  Call INSIDE ``shard_map`` over ``axis_name``.
 
@@ -101,10 +103,16 @@ def pipeline_apply(
         micro-batches big enough to fill the MXU.
       remat: rematerialise each stage application in backward (GPipe's
         memory trick: store only stage boundaries, recompute inside).
+      with_aux: ``stage_fn`` returns ``(mb, aux_scalar)``; per-microbatch
+        aux values from REAL ticks (not drain garbage) are summed over
+        stages and averaged over micro-batches, and the call returns
+        ``(out, aux)`` — how the Switch-MoE balancing loss survives
+        pipelining instead of being dropped.
 
     Returns the full batch output ``(B, ...)``, replicated over the pipe
     axis (masked psum from the last stage — so downstream loss code is
-    identical with and without pipelining).
+    identical with and without pipelining).  With ``with_aux``:
+    ``(output, aux)``.
     """
     S = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -119,35 +127,196 @@ def pipeline_apply(
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     mbs = x.reshape(M, B // M, *x.shape[1:])
 
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    raw_fn = stage_fn if with_aux else (
+        lambda p, mb: (stage_fn(p, mb), jnp.zeros((), jnp.float32)))
+    fn = jax.checkpoint(raw_fn) if remat else raw_fn
 
     up_perm = [(i, i + 1) for i in range(S - 1)]
 
     def tick(carry, t):
-        act, outputs = carry
+        act, outputs, aux_acc = carry
         # neighbour hand-off: device s receives device s-1's last output
         recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
         # stage 0 injects micro-batch t (clamped; ticks ≥ M push don't-care
         # values that drain past the last stage after the loop window)
         xt = mbs[jnp.minimum(t, M - 1)]
         inp = jnp.where(stage == 0, xt, recv)
-        out = fn(params, inp)
+        out, aux = fn(params, inp)
+        # stage s is working on micro-batch t-s during ticks s..s+M-1;
+        # fill/drain ticks push don't-care values whose aux must not count
+        active = (t >= stage) & (t - stage < M)
+        aux_acc = aux_acc + jnp.where(active, aux, 0.0)
         # last stage banks micro-batch t-(S-1) once the pipe is full
         idx = jnp.clip(t - (S - 1), 0, M - 1)
         updated = lax.dynamic_update_index_in_dim(outputs, out, idx, 0)
         outputs = jnp.where(t >= S - 1, updated, outputs)
-        return (out, outputs), None
+        return (out, outputs, aux_acc), None
 
     # initial carries are zeros that must carry the UNION of the input's
     # varying axes (data/seq/... under composition) plus the pipe axis —
     # deriving them from mbs inherits the vma, the multiply folds away
     act0 = lax.pcast(mbs[0] * 0, (axis_name,), to="varying")
     outs0 = lax.pcast(mbs * 0, (axis_name,), to="varying")
-    (_, outputs), _ = lax.scan(
-        tick, (act0, outs0), jnp.arange(M + S - 1))
+    aux0 = jnp.sum(act0 * 0, dtype=jnp.float32)
+    (_, outputs, aux_acc), _ = lax.scan(
+        tick, (act0, outs0, aux0), jnp.arange(M + S - 1))
 
     # broadcast the last stage's accumulator so downstream loss code is
     # identical with and without pipelining (grad-correct custom transpose;
     # also runs for S=1, where the free psum marks the result replicated)
     outputs = _replicate_from(outputs, axis_name, S - 1)
-    return outputs.reshape(B, *x.shape[1:])
+    out = outputs.reshape(B, *x.shape[1:])
+    if not with_aux:
+        return out
+    # total aux = sum over stages (psum) of each stage's M real ticks,
+    # averaged over micro-batches to match the unpipelined batch-mean
+    aux = lax.psum(aux_acc, axis_name) / M
+    return out, aux
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    loss_params,
+    x,
+    targets,
+    *,
+    axis_name: str = "pipe",
+    num_microbatches: int,
+):
+    """One-forward-one-backward (1F1B) pipelined training step.
+
+    Why a separate entry point: 1F1B's point is that each micro-batch's
+    backward starts as soon as its forward clears the last stage, capping
+    in-flight activations at ``O(S)`` instead of GPipe's ``O(M)``.  That
+    is only possible when the LOSS lives inside the schedule (the last
+    stage seeds cotangents itself) — with an outer loss, every forward
+    must finish first and the memory cap is lost.  So this function
+    computes loss AND gradients in one scheduled SPMD program, instead of
+    returning activations for an outer ``jax.grad``.
+
+    Schedule: ``M + 2(S-1)`` ticks, each with a forward slot and a
+    backward slot.  Stage ``s`` forwards micro-batch ``t − s`` and
+    backwards micro-batch ``t − (2S−2−s)`` (active-masked); in steady
+    state every stage alternates 1F/1B.  Stage inputs are stashed in a
+    ``2S−1``-slot ring buffer — the ``O(S)`` activation memory — and each
+    backward slot recomputes its stage forward via ``jax.vjp`` on the
+    stashed input (the remat trade GPipe makes too).  Bubble fraction
+    ``2(S−1)/(M+2(S−1))``, the same fill/drain cost as GPipe — the win is
+    memory, not bubbles (interleaved/looping schedules would shrink the
+    bubble; see README roadmap).
+
+    Args:
+      stage_fn: ``stage_fn(params, mb) -> mb`` (shape-preserving).
+      loss_fn: ``loss_fn(loss_params, y, tgt) -> scalar`` — applied to
+        the LAST stage's output per micro-batch (head + loss; its
+        parameter gradients flow too).
+      stage_params: this device's stage weights, leading axis 1 (as in
+        :func:`pipeline_apply`).
+      loss_params: pytree used by ``loss_fn`` (e.g. final norm + output
+        head), replicated over the mesh.
+      x: full local batch ``(B, ...)``; ``targets``: ``(B, ...)``.
+
+    Returns ``(loss, stage_grads, loss_grads, dx)`` — loss is the mean
+    over micro-batches (replicated); ``stage_grads`` matches
+    ``stage_params`` (this stage's shard, leading axis 1); ``loss_grads``
+    matches ``loss_params`` (replicated); ``dx`` is ``∂loss/∂x`` for the
+    layers feeding the pipeline (replicated).
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = num_microbatches
+    is_last = stage == S - 1
+
+    params = jax.tree.map(lambda a: jnp.squeeze(a, axis=0), stage_params)
+
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mbs = x.reshape(M, B // M, *x.shape[1:])
+    tgts = targets.reshape(M, B // M, *targets.shape[1:])
+
+    K = 2 * S - 1  # stash ring depth: max in-flight per stage is 2(S−1)+1
+    up_perm = [(i, i + 1) for i in range(S - 1)]
+    down_perm = [(i + 1, i) for i in range(S - 1)]
+
+    def masked_add(acc, new, active):
+        return jax.tree.map(
+            lambda a, n: a + jnp.where(active, n, jnp.zeros_like(n)),
+            acc, new)
+
+    def tick(carry, t):
+        act, ct, stash, gp, glp, dx_bank, loss_acc = carry
+
+        # ---- forward slot: stage s forwards micro-batch t − s -------- #
+        m_f = t - stage
+        fwd_active = (m_f >= 0) & (m_f < M)
+        recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
+        inp = jnp.where(stage == 0, mbs[jnp.clip(m_f, 0, M - 1)], recv)
+        y = stage_fn(params, inp)
+        stash = jnp.where(
+            fwd_active,
+            lax.dynamic_update_index_in_dim(stash, inp, m_f % K, 0),
+            stash)
+
+        # ---- backward slot: stage s backwards t − (2S−2−s) ----------- #
+        m_b = t - (2 * S - 2 - stage)
+        bwd_active = (m_b >= 0) & (m_b < M)
+        ct_recv = lax.ppermute(ct, axis_name, perm=down_perm) \
+            if S > 1 else ct
+        inp_b = stash[jnp.clip(m_b, 0, M - 1) % K]
+        tgt_b = tgts[jnp.clip(m_b, 0, M - 1)]
+
+        def composite(p, lp, xin):
+            yy = stage_fn(p, xin)
+            return yy, loss_fn(lp, yy, tgt_b)
+
+        (_, l_b), vjp = jax.vjp(composite, params, loss_params, inp_b)
+        # the last stage seeds its own cotangent from the in-schedule
+        # loss; earlier stages consume the downstream stage's dx
+        ct_y = jnp.where(is_last, jnp.zeros_like(ct_recv), ct_recv)
+        # + l_b*0: the cotangent must carry l_b's full varying-axes set
+        # (data/seq/... under composition), not just the pipe axis
+        ct_l = jnp.where(is_last, 1.0, 0.0).astype(l_b.dtype) + l_b * 0
+        dp, dlp, dx = vjp((ct_y, ct_l))
+
+        gp = masked_add(gp, dp, bwd_active)
+        # loss_params are REPLICATED, so the shard_map transpose has
+        # already psummed dlp over the pipe axis (every device sees the
+        # global value = the last stage's contribution, since only its
+        # ct_l is 1).  Bank it on the last stage only; the closing psum
+        # then counts it exactly once.
+        glp = masked_add(glp, dlp, bwd_active & is_last)
+        bank = bwd_active & (stage == 0)
+        dx_bank = jnp.where(
+            bank,
+            lax.dynamic_update_index_in_dim(
+                dx_bank, dx, jnp.clip(m_b, 0, M - 1), 0),
+            dx_bank)
+        loss_acc = loss_acc + jnp.where(
+            bwd_active & is_last, l_b, 0.0)
+
+        return (y, dx, stash, gp, glp, dx_bank, loss_acc), None
+
+    # zero carries derived from real tensors so they inherit the varying
+    # mesh axes (vma discipline, as in pipeline_apply)
+    mb0 = lax.pcast(mbs[0] * 0, (axis_name,), to="varying")
+    stash0 = jnp.broadcast_to(mb0, (K, *mb0.shape)) * 1
+    gp0 = jax.tree.map(lambda a: a * 0, params)
+    glp0 = jax.tree.map(
+        lambda a: lax.pcast(a * 0, (axis_name,), to="varying"), loss_params)
+    dx0 = lax.pcast(mbs * 0, (axis_name,), to="varying")
+    loss0 = jnp.sum(mb0 * 0, dtype=jnp.float32)
+
+    (_, _, _, gp, glp, dx_bank, loss_acc), _ = lax.scan(
+        tick, (mb0, mb0, stash0, gp0, glp0, dx0, loss0),
+        jnp.arange(M + 2 * (S - 1)))
+
+    # loss / loss-param grads / input grads live on single stages (last,
+    # last, first) with zeros elsewhere — psum replicates them exactly
+    loss = lax.psum(loss_acc, axis_name) / M
+    glp = jax.tree.map(lambda a: lax.psum(a, axis_name) / M, glp)
+    dx = lax.psum(dx_bank, axis_name).reshape(B, *x.shape[1:]) / M
+    gp = jax.tree.map(lambda a: a[None] / M, gp)  # restore stage axis
+    return loss, gp, glp, dx

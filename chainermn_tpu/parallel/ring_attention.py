@@ -49,6 +49,36 @@ def local_attention(q, k, v, *, causal: bool = False, q_offset=0,
     return jnp.einsum("bhts,bshd->bthd", p, v)
 
 
+def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset):
+    """XLA computation of one (Q block × K/V block) partial with its
+    log-sum-exp — semantics identical to
+    ``flash_attention(..., return_lse=True)`` including the fully-masked
+    convention (o=0, lse≈-1e30).  Used by the ring schedule on backends
+    where the Pallas interpreter cannot discharge seq-varying traced
+    SMEM scalars under shard_map's vma checking (jax interpreter bug);
+    on TPU the real kernel runs instead."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   kb.astype(jnp.float32)) * scale
+    allow = None
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(kb.shape[1])
+        allow = (qpos[:, None] >= kpos[None, :])[None, None]
+        s = jnp.where(allow, s, _NEG)
+    m = s.max(axis=-1)                                   # (B,H,T)
+    p = jnp.exp(s - m[..., None])
+    if allow is not None:
+        p = jnp.where(allow, p, 0.0)
+    l = p.sum(axis=-1)
+    safe = jnp.maximum(l, 1e-30)
+    o = jnp.einsum("bhts,bshd->bhtd", p,
+                   vb.astype(jnp.float32)) / safe[..., None]   # (B,H,T,D)
+    lse = m + jnp.log(safe)                              # (B,H,T)
+    return (o.transpose(0, 2, 1, 3).astype(q.dtype),
+            lse.transpose(0, 2, 1))                      # (B,T,H,D),(B,T,H)
+
+
 def ring_attention(q, k, v, *, axis_name: str = "seq",
                    causal: bool = False, remat: bool = True,
                    use_flash: bool = False, block_q: int = 256,
@@ -126,59 +156,61 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
                 interpret, S, r, ring):
     """Ring schedule with the Pallas kernel as the per-pair compute.
 
-    Under the causal ring each visiting pair is one of three STATIC
-    shapes — so no global offsets ever reach the kernel:
-
-    - step 0: the device's own block — ordinary causal flash;
-    - a block from an earlier ring position — FULL attention (every key
-      precedes every query);
-    - a block from a later position — fully masked: skipped via
-      ``lax.cond`` (the ring's built-in 2× causal FLOP saving).
+    Every visiting K/V block is attended with the SAME kernel call,
+    parameterised by the *global* block offsets (``q_offset = r·T``,
+    ``k_offset = src·T`` ride to the kernel in SMEM as traced scalars).
+    The kernel's own ``pl.when(needed)`` grid predicate then skips the
+    matmuls of every fully-future K block — so a visiting block from a
+    later ring position costs ~zero FLOPs and yields the neutral partial
+    ``(o=0, lse≈-1e30)``, preserving the ring's 2× causal saving without
+    any select-and-discard on the host side.
 
     Per-pair partials ``(o_i, lse_i)`` merge exactly in log-space:
     ``lse = logaddexp(lse, lse_i)``, ``o = o·e^{lse_prev−lse} +
     o_i·e^{lse_i−lse}``.  Autodiff differentiates the merge; the
-    kernel's custom VJP covers ``∂(o_i, lse_i)/∂(q, k, v)``."""
+    kernel's custom VJP covers ``∂(o_i, lse_i)/∂(q, k, v)``.
+
+    The ring itself is a ``lax.scan`` (compile time independent of ring
+    size); XLA overlaps each step's ppermute with the kernel math.
+    """
     from chainermn_tpu.ops.pallas_attention import flash_attention
 
-    def pair(qq, kb, vb, causal_pair):
-        return flash_attention(
-            qq, kb, vb, causal=causal_pair, block_q=block_q,
-            block_k=block_k, return_lse=True, interpret=interpret)
+    T = q.shape[1]
 
-    # step 0: self block
-    o, lse = pair(q, k, v, causal)
+    if interpret:
+        # the Pallas hlo-interpreter cannot discharge seq-varying traced
+        # SMEM scalars under shard_map's vma checking — run the
+        # semantically-identical XLA pair instead (the kernel itself is
+        # covered standalone by the ops tests; TPU runs the real kernel)
+        def pair(qq, kb, vb, k_off):
+            return _lse_attention_pair(
+                qq, kb, vb, causal=causal, q_offset=r * T, k_offset=k_off)
+    else:
+        def pair(qq, kb, vb, k_off):
+            return flash_attention(
+                qq, kb, vb, causal=causal, q_offset=r * T, k_offset=k_off,
+                block_q=block_q, block_k=block_k, return_lse=True,
+                interpret=False)
+
+    # step 0: self block (offsets equal → ordinary causal flash)
+    o, lse = pair(q, k, v, r * T)
     o = o.astype(jnp.float32)
     if S == 1:
         return o.astype(q.dtype)
 
-    def block_step(q, k_blk, v_blk, o, lse, i):
+    def block_step(carry, i):
+        k_blk, v_blk, o, lse = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm=ring)
         v_blk = lax.ppermute(v_blk, axis_name, perm=ring)
         src = (r - i) % S                                # block now held
-
-        o_i, lse_i = pair(q, k_blk, v_blk, False)
+        o_i, lse_i = pair(q, k_blk, v_blk, src * T)
         o_i = o_i.astype(jnp.float32)
-        if causal:
-            # only blocks from earlier ring positions contribute; later
-            # ones are fully masked → neutral merge elements.  (A select,
-            # not lax.cond: the pair's FLOPs are symmetric anyway on the
-            # ring's critical path, and pallas-under-cond trips the
-            # interpreter.)
-            keep = src < r
-            o_i = jnp.where(keep, o_i, 0.0)
-            lse_i = jnp.where(keep, lse_i, _NEG)
         lse_new = jnp.logaddexp(lse, lse_i)              # (B,T,H)
         w_old = jnp.exp(lse - lse_new)[..., None]
         w_new = jnp.exp(lse_i - lse_new)[..., None]
         o = o * w_old + o_i * w_new
-        return k_blk, v_blk, o, lse_new
+        return (k_blk, v_blk, o, lse_new), None
 
-    step = jax.checkpoint(block_step, static_argnums=(5,)) if remat \
-        else block_step
-    # python-unrolled ring (S is static): lax.scan around an interpreted
-    # pallas_call currently trips JAX's vma checking, and unrolling also
-    # lets XLA overlap each step's ppermute with the previous one's math
-    for i in range(1, S):
-        k, v, o, lse = step(q, k, v, o, lse, i)
+    step = jax.checkpoint(block_step) if remat else block_step
+    (k, v, o, lse), _ = lax.scan(step, (k, v, o, lse), jnp.arange(1, S))
     return o.astype(q.dtype)

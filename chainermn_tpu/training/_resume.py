@@ -1,0 +1,83 @@
+"""Full-trainer resume state — shared by the checkpointer and
+multi_node_snapshot.
+
+The reference serialized the whole trainer object graph through
+``chainer.serializers`` (SURVEY.md §3.5), so a resumed run continued its
+epoch, shuffle order, and log exactly.  The round-1 build saved only
+``{iteration, params, opt_state, model_state}`` — a resumed run silently
+restarted its epoch and lost its log history.  These helpers collect and
+restore the rest:
+
+- updater bookkeeping (``epoch_detail`` drives epoch triggers),
+- the training iterator's position/epoch/RNG (``state_dict`` protocol),
+- every trainer extension exposing ``state_dict``/``load_state_dict``
+  (LogReport history, custom extensions), keyed by extension name,
+- the wall-clock offset, so the logged timeline continues.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["collect_train_state", "restore_train_state"]
+
+
+def collect_train_state(updater, trainer=None) -> dict:
+    """Everything beyond (params, opt_state, model_state) a resume needs."""
+    extra: dict = {
+        "updater": {
+            "epoch_detail": float(getattr(updater, "epoch_detail", 0.0)),
+            "previous_epoch_detail": float(
+                getattr(updater, "previous_epoch_detail", 0.0)),
+        },
+    }
+    it = getattr(updater, "iterator", None)
+    if it is not None and hasattr(it, "state_dict"):
+        extra["iterator"] = it.state_dict()
+    if trainer is not None:
+        exts = {}
+        for entry in getattr(trainer, "_extensions", []):
+            sd = getattr(entry.ext, "state_dict", None)
+            if sd is not None:
+                exts[entry.name] = sd()
+        extra["trainer"] = {
+            "elapsed_time": float(getattr(trainer, "elapsed_time", 0.0)),
+            "extensions": exts,
+        }
+    return extra
+
+
+def restore_train_state(extra: Optional[dict], updater,
+                        trainer=None) -> None:
+    """Inverse of :func:`collect_train_state`; tolerates snapshots written
+    before a given piece of state existed (partial restores)."""
+    if not extra:
+        return
+    up = extra.get("updater", {})
+    if "epoch_detail" in up:
+        updater.epoch_detail = float(up["epoch_detail"])
+    if "previous_epoch_detail" in up:
+        updater.previous_epoch_detail = float(up["previous_epoch_detail"])
+    it = getattr(updater, "iterator", None)
+    if it is not None and hasattr(it, "load_state_dict") \
+            and "iterator" in extra:
+        saved = extra["iterator"]
+        order = saved.get("order")
+        ds = getattr(it, "dataset", None)
+        if (order is not None and ds is not None
+                and len(order) != len(ds)):
+            # resize-safe path (multi_node_snapshot at a different world
+            # size): the saved shuffle order indexes the WRITER's dataset
+            # shard — restoring it onto a differently-sized shard would
+            # read out of bounds / wrong examples.  Keep the fresh
+            # iterator (epoch restarts; params/opt state still resume).
+            pass
+        else:
+            it.load_state_dict(saved)
+    if trainer is not None and "trainer" in extra:
+        tr = extra["trainer"]
+        trainer.elapsed_time = float(tr.get("elapsed_time", 0.0))
+        saved = tr.get("extensions", {})
+        for entry in getattr(trainer, "_extensions", []):
+            if entry.name in saved and hasattr(entry.ext, "load_state_dict"):
+                entry.ext.load_state_dict(saved[entry.name])

@@ -67,7 +67,9 @@ class Trainer:
         return self.updater.iteration >= self._stop_period
 
     def run(self):
-        self._start = time.perf_counter()
+        # resume-aware clock: a restored elapsed_time offsets the start so
+        # the logged timeline continues instead of restarting at zero
+        self._start = time.perf_counter() - self.elapsed_time
         os.makedirs(self.out, exist_ok=True)
         # initialize-phase extensions (e.g. checkpointer.maybe_load ran
         # before run(); extensions with an initialize hook fire here)
@@ -117,6 +119,15 @@ class LogReport:
                 continue
             self._accum[k] = self._accum.get(k, 0.0) + f
         self._count += 1
+
+    def state_dict(self) -> dict:
+        return {"log": list(self.log), "accum": dict(self._accum),
+                "count": self._count}
+
+    def load_state_dict(self, st: dict) -> None:
+        self.log = [dict(e) for e in st["log"]]
+        self._accum = {k: float(v) for k, v in st["accum"].items()}
+        self._count = int(st["count"])
 
     def __call__(self, trainer):
         # average of every observation since the last fire

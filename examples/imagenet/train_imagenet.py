@@ -92,15 +92,18 @@ def main():
     resnet = args.arch.startswith("resnet")
     if args.tiny:
         image, classes, n = 32, 8, 512
+        # tiny smoke runs use the GAP head: the reference flatten heads
+        # need near-native input sizes (32px collapses to 0 spatial)
         cfg = (ResNetConfig(depth=50, num_classes=classes, width=8,
                             dtype="float32") if resnet
                else ConvNetConfig(arch=args.arch, num_classes=classes,
-                                  dtype="float32"))
+                                  dtype="float32", head="gap"))
     else:
         image, classes, n = 224, 1000, 50000
         cfg = (ResNetConfig(depth=int(args.arch[6:]), num_classes=classes)
                if resnet
-               else ConvNetConfig(arch=args.arch, num_classes=classes))
+               else ConvNetConfig(arch=args.arch, num_classes=classes,
+                                  image_size=image))
 
     from chainermn_tpu.datasets import SubDataset
 

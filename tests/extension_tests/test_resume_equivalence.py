@@ -1,0 +1,156 @@
+"""Kill-and-resume equivalence: a run interrupted mid-epoch and resumed
+from a checkpoint must produce EXACTLY the loss/log curve of an
+uninterrupted run — the reference's whole-trainer-serialization contract
+(chainermn/extensions/checkpoint.py + chainer.serializers; SURVEY §3.5).
+
+This is the acceptance test for resume completeness: iterator position,
+epoch bookkeeping, shuffle RNG, and LogReport history must all survive.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+from chainermn_tpu import (
+    SerialIterator,
+    StandardUpdater,
+    Trainer,
+    create_communicator,
+    create_multi_node_checkpointer,
+    create_multi_node_optimizer,
+)
+from chainermn_tpu.training import LogReport
+
+
+def _make_dataset(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = rng.randn(4).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    return [(x[i], y[i]) for i in range(n)]
+
+
+def _loss_fn(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _build(comm, tmpdir, seed=5):
+    data = _make_dataset()
+    it = SerialIterator(data, batch_size=16, shuffle=True, seed=seed)
+    opt = create_multi_node_optimizer(optax.sgd(0.05), comm)
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    up = StandardUpdater(it, opt, _loss_fn, params, comm)
+    trainer = Trainer(up, stop_trigger=(6, "epoch"), out=str(tmpdir / "out"))
+    log = LogReport(trigger=(1, "epoch"))
+    trainer.extend(log)
+    cp = create_multi_node_checkpointer(comm, str(tmpdir / "ckpt"))
+    # save every 3 iterations — NOT aligned with the 4-iteration epoch, so
+    # resumes land mid-epoch and mid-shuffle
+    trainer.extend(cp, trigger=(3, "iteration"))
+    return trainer, up, cp, log
+
+
+class TestResumeEquivalence:
+    @pytest.fixture()
+    def comm(self):
+        return create_communicator("tpu_xla")
+
+    def test_interrupted_equals_uninterrupted(self, comm, tmp_path):
+        # reference run: 6 epochs straight through
+        t_ref, up_ref, _, log_ref = _build(comm, tmp_path / "ref")
+        t_ref.run()
+        ref_curve = [(e["iteration"], e["main/loss"]) for e in log_ref.log]
+        ref_w = np.asarray(up_ref.params["w"])
+
+        # interrupted run: stop after epoch ~2.5 (iteration 10; last
+        # checkpoint fired at iteration 9 — mid-epoch, mid-shuffle)
+        t1, up1, cp1, _ = _build(comm, tmp_path / "killed")
+        t1._stop_period = 2.5
+        t1.run()
+        assert up1.iteration == 10
+
+        # resume in a FRESH trainer (new process simulation) and finish
+        t2, up2, cp2, log2 = _build(comm, tmp_path / "killed")
+        resumed = cp2.maybe_load(up2, t2)
+        assert resumed == 9
+        assert up2.iteration == 9
+        # iterator must resume mid-epoch, not restart it
+        assert 2.0 < up2.epoch_detail < 3.0
+        t2.run()
+
+        got_curve = [(e["iteration"], e["main/loss"]) for e in log2.log]
+        assert [i for i, _ in got_curve] == [i for i, _ in ref_curve]
+        np.testing.assert_allclose(
+            [l for _, l in got_curve], [l for _, l in ref_curve],
+            rtol=1e-6, atol=1e-7,
+            err_msg="resumed loss/log curve diverges from uninterrupted run")
+        np.testing.assert_allclose(
+            np.asarray(up2.params["w"]), ref_w, rtol=1e-6, atol=1e-7)
+
+    def test_resume_at_aligned_epoch_trigger(self, comm, tmp_path):
+        """Checkpoint trigger ALIGNED with the LogReport epoch trigger:
+        the checkpointer (lowest priority) must capture the POST-flush
+        LogReport, so no epoch's log entry is lost across resume."""
+        def build(root):
+            t, up, cp, log = _build(comm, root)
+            # re-extend checkpointer on the same tick as LogReport
+            t._extensions = [e for e in t._extensions
+                             if e.ext is not cp]
+            t.extend(cp, trigger=(4, "iteration"))  # 4 it == 1 epoch
+            return t, up, cp, log
+
+        t_ref, up_ref, _, log_ref = build(tmp_path / "ref")
+        t_ref.run()
+        ref_curve = [(e["iteration"], e["main/loss"]) for e in log_ref.log]
+
+        t1, _, _, _ = build(tmp_path / "killed")
+        t1._stop_period = 2.0  # stops exactly after the iteration-8 save
+        t1.run()
+
+        t2, up2, cp2, log2 = build(tmp_path / "killed")
+        assert cp2.maybe_load(up2, t2) == 8
+        # both epoch entries must already be in the restored log
+        assert [e["iteration"] for e in log2.log] == [4, 8]
+        t2.run()
+        got_curve = [(e["iteration"], e["main/loss"]) for e in log2.log]
+        assert [i for i, _ in got_curve] == [i for i, _ in ref_curve]
+        np.testing.assert_allclose(
+            [l for _, l in got_curve], [l for _, l in ref_curve],
+            rtol=1e-6, atol=1e-7)
+
+    def test_resize_mismatch_skips_iterator_restore(self, comm, tmp_path):
+        """A snapshot whose iterator order indexes a differently-sized
+        shard must NOT be restored onto the new iterator (resize-safe
+        multi_node_snapshot contract) — params still resume."""
+        from chainermn_tpu.training._resume import (
+            collect_train_state, restore_train_state)
+
+        t, up, _, _ = _build(comm, tmp_path)
+        state = collect_train_state(up, t)
+        # simulate a resume at a different world size: shard is half
+        t2, up2, _, _ = _build(comm, tmp_path / "resized")
+        up2.iterator.dataset = _make_dataset(32)
+        up2.iterator.reset()
+        before = up2.iterator.state_dict()
+        restore_train_state(state, up2, t2)
+        after = up2.iterator.state_dict()
+        assert len(after["order"]) == 32, "stale 64-entry order restored"
+        np.testing.assert_array_equal(after["order"], before["order"])
+
+    def test_orphan_shard_gc(self, comm, tmp_path):
+        """Stale shards from a dead run are swept on the next save."""
+        import os
+
+        t, up, cp, _ = _build(comm, tmp_path)
+        path = tmp_path / "ckpt"
+        path.mkdir(exist_ok=True)
+        # a pre-crash orphan: right name pattern, superseded iteration
+        orphan = path / f"snapshot_iter_1.{comm.inter_rank}"
+        orphan.write_bytes(b"stale")
+        t._stop_period = 1.0
+        t.run()  # fires the checkpointer at iteration 3
+        assert not orphan.exists(), "orphaned shard survived GC"
+        kept = [f for f in os.listdir(path) if f.startswith("snapshot")]
+        assert kept == [f"snapshot_iter_3.{comm.inter_rank}"]

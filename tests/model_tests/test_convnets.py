@@ -19,7 +19,8 @@ B, HW, C = 8, 32, 8
 
 @pytest.mark.parametrize("arch", ["alex", "nin", "vgg16"])
 def test_forward_shape(arch):
-    cfg = ConvNetConfig(arch=arch, num_classes=C, dtype="float32")
+    cfg = ConvNetConfig(arch=arch, num_classes=C, dtype="float32",
+                        head="gap")
     params = init_convnet(jax.random.PRNGKey(0), cfg)
     x = jnp.asarray(np.random.RandomState(0).randn(B, HW, HW, 3),
                     jnp.float32)
@@ -37,7 +38,8 @@ def test_unknown_arch_rejected():
 def test_dp_step_reduces_loss():
     import optax
 
-    cfg = ConvNetConfig(arch="nin", num_classes=4, dtype="float32")
+    cfg = ConvNetConfig(arch="nin", num_classes=4, dtype="float32",
+                        head="gap")
     params = init_convnet(jax.random.PRNGKey(0), cfg)
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(16, HW, HW, 3), jnp.float32)
@@ -65,3 +67,25 @@ def test_dp_step_reduces_loss():
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("arch,fin", [("alex", 9216), ("vgg16", 25088)])
+def test_reference_flatten_head_parity(arch, fin):
+    """head="flatten" at the native insize reproduces the reference FC
+    fan-ins (alex 256*6*6=9216 @227, vgg16 512*7*7=25088 @224) and a
+    consistent end-to-end shape (checked via eval_shape, no FLOPs)."""
+    cfg = ConvNetConfig(arch=arch, num_classes=C, dtype="float32")
+    params = init_convnet(jax.random.PRNGKey(0), cfg)
+    fc = [p for p in params if p and p["w"].ndim == 2][0]
+    assert fc["w"].shape == (fin, 4096)
+    out = jax.eval_shape(
+        lambda p, x: convnet_apply(cfg, p, x), params,
+        jax.ShapeDtypeStruct((2, cfg.insize, cfg.insize, 3), jnp.float32))
+    assert out.shape == (2, C)
+
+
+def test_flatten_head_rejects_collapsing_size():
+    with pytest.raises(ValueError, match="collapses"):
+        init_convnet(jax.random.PRNGKey(0),
+                     ConvNetConfig(arch="alex", num_classes=C,
+                                   image_size=32))

@@ -138,3 +138,47 @@ class TestMultiNodeOptimizer:
         np.testing.assert_allclose(np.asarray(w1), 0.0)  # first: zeros
         w2, state = f(w1, state, g2)
         np.testing.assert_allclose(np.asarray(w2)[0], [-1.0, -2.0])  # g1
+
+    def test_large_batch_recipe_composition(self, comm):
+        """BASELINE config 5 composition: warmup→decay LR schedule ×
+        double buffering × bf16 wire dtype.  Step t must apply
+        lr(t) × mean(grads at t−1) — the schedule advances with the
+        step counter while the gradient is one step stale."""
+        import sys, os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(__file__), "..", "..", "examples", "imagenet"))
+        from train_imagenet_large_batch import make_lr_schedule
+
+        sched = make_lr_schedule(base_lr=0.1, global_batch=1024,
+                                 warmup_epochs=1, total_epochs=3,
+                                 steps_per_epoch=4)
+        # linear scaling: peak lr = 0.1 * 1024/256 = 0.4, reached at step 4
+        np.testing.assert_allclose(float(sched(0)), 0.1, rtol=1e-6)
+        np.testing.assert_allclose(float(sched(4)), 0.4, rtol=1e-6)
+        assert float(sched(8)) < 0.4  # cosine decay after warmup
+
+        opt = create_multi_node_optimizer(
+            optax.sgd(sched), comm, double_buffering=True,
+            allreduce_grad_dtype=jnp.bfloat16)
+
+        def step(w, state, g):
+            u, state = opt.update(g, state, w)
+            return optax.apply_updates(w, u), state
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=comm.mesh, in_specs=(P(), P(), P(AX)),
+            out_specs=(P(), P())))
+        w = jnp.zeros(2)
+        state = opt.init(w)
+        # per-rank grads whose mean is [1, 2] (exercises the pmean too)
+        base = np.tile(np.array([[1.0, 2.0]], np.float32), (comm.size, 1))
+        scale = (np.arange(comm.size, dtype=np.float32)[:, None] + 0.5) * 2 \
+            / comm.size
+        g = base * scale  # mean over ranks == base[0]
+        w, state = f(w, state, g)
+        np.testing.assert_allclose(np.asarray(w), 0.0, atol=1e-7)  # stale 0
+        w, state = f(w, state, g)
+        # step 1 applies lr(1) × mean grad from step 0 (bf16 wire: ~1e-2)
+        lr1 = float(sched(1))
+        np.testing.assert_allclose(
+            np.asarray(w)[0], [-lr1 * 1.0, -lr1 * 2.0], rtol=2e-2)
