@@ -40,6 +40,7 @@ from chainermn_tpu.ops.pallas_attention import (
 from chainermn_tpu.parallel.expert import expert_parallel_moe
 from chainermn_tpu.parallel.pipeline import pipeline_apply, pipeline_train_1f1b
 from chainermn_tpu.parallel.ring_attention import (
+    _block_positions,
     local_attention,
     ring_attention,
 )
@@ -69,6 +70,10 @@ class TransformerConfig:
     n_layers: int = 4          # total; must divide by mesh pipe size
     max_seq: int = 2048
     attention: str = "ring"    # "ring" | "ulysses" | "local" | "flash"
+    seq_layout: str = "contiguous"  # "contiguous" | "zigzag" (ring only):
+    # zigzag = Striped-ring causal load balance; feed tokens permuted by
+    # parallel.ring_attention.zigzag_indices (targets through the same
+    # permutation) — position embeddings follow the layout automatically
     moe: bool = False          # Switch-MoE MLP in every block
     n_experts: int = 8         # global expert count (moe=True)
     capacity_factor: float = 1.25
@@ -193,11 +198,26 @@ def _attention(cfg: TransformerConfig, h, blk):
         # fits the kernel (interpret mode keeps one config working on
         # non-TPU backends); XLA einsum blocks otherwise
         use_flash = flash_attention_supported(T, T)
+        if cfg.seq_layout == "zigzag":
+            # each zigzag half-run must itself fit the kernel's blocks
+            use_flash = flash_attention_supported(T // 2, T // 2)
         o = ring_attention(q, k, v, axis_name="seq", causal=True,
                            remat=cfg.remat, use_flash=use_flash,
+                           layout=cfg.seq_layout,
                            interpret=jax.default_backend() != "tpu")
     elif cfg.attention == "ulysses":
-        o = ulysses_attention(q, k, v, axis_name="seq", causal=True)
+        # after the head<->seq exchange each device holds the FULL
+        # sequence for its head subset — the flash kernel slots straight
+        # in (static zero offsets), falling back to the XLA path when
+        # the full length doesn't fit the kernel's block contract
+        T_full = T * lax.axis_size("seq")
+        if flash_attention_supported(T_full, T_full):
+            fa = partial(flash_attention,
+                         interpret=jax.default_backend() != "tpu")
+            o = ulysses_attention(q, k, v, axis_name="seq", causal=True,
+                                  attn_fn=fa)
+        else:
+            o = ulysses_attention(q, k, v, axis_name="seq", causal=True)
     elif cfg.attention == "local":
         o = local_attention(q, k, v, causal=True)
     elif cfg.attention == "flash":
@@ -283,12 +303,21 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
     Returns ``(B_local, T_local, vocab)`` fp32 logits and the summed MoE
     aux loss (zero when ``moe=False`` or pipelined).
     """
+    if cfg.seq_layout == "zigzag" and cfg.attention != "ring":
+        raise ValueError(
+            'seq_layout="zigzag" is a ring-attention layout; '
+            f'attention={cfg.attention!r} expects contiguous shards')
     cd = cfg.compute_dtype
     B, T = tokens.shape
     r = lax.axis_index("seq")
 
     h = params["embed"][tokens]                        # (B, T, D) fp32
-    pos = lax.dynamic_slice_in_dim(params["pos"], r * T, T, axis=0)
+    if cfg.seq_layout == "zigzag":
+        # position rows follow the zigzag permutation of this shard
+        pos = params["pos"][
+            _block_positions(r, T, lax.axis_size("seq"), "zigzag")]
+    else:
+        pos = lax.dynamic_slice_in_dim(params["pos"], r * T, T, axis=0)
     h = (h + pos).astype(cd)
 
     S = lax.axis_size("pipe")

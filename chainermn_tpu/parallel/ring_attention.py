@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "local_attention"]
+__all__ = ["ring_attention", "local_attention", "zigzag_indices"]
 
 _NEG = -1e30  # finite mask value: keeps the online-softmax max well-defined
 
@@ -79,10 +79,58 @@ def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset):
             lse.transpose(0, 2, 1))                      # (B,T,H,D),(B,T,H)
 
 
+def zigzag_indices(S: int, T_global: int):
+    """Global-sequence permutation for the load-balanced causal layout.
+
+    Device ``r`` of an ``S``-ring holds chunks ``r`` and ``2S−1−r`` of the
+    ``2S``-chunk global sequence (Striped/zigzag ring attention): each
+    device then owns one "early" and one mirrored "late" chunk, so under
+    causal masking every (device, visiting block) pair carries ~half the
+    score matrix — the causal FLOP saving becomes a *wall-clock* saving
+    because no device idles while another computes a dense pair (the
+    contiguous layout's skipped-future blocks save FLOPs but the ring
+    still waits on its busiest device each step).
+
+    Returns an ``(S, T_global // S)`` int array: row ``r`` = the global
+    token indices device ``r`` holds, in local order.  Feed
+    ``x[..., zigzag_indices(S, T)[r], :]`` per device (or gather through
+    the flattened permutation before sharding) and pass
+    ``layout="zigzag"`` to :func:`ring_attention`.
+    """
+    import numpy as np
+
+    if T_global % (2 * S):
+        raise ValueError(
+            f"zigzag layout needs T ({T_global}) divisible by 2*S ({2*S})")
+    C = T_global // (2 * S)
+    rows = []
+    for rr in range(S):
+        rows.append(np.concatenate([
+            np.arange(rr * C, (rr + 1) * C),
+            np.arange((2 * S - 1 - rr) * C, (2 * S - rr) * C)]))
+    return np.stack(rows)
+
+
+def _block_offsets(rr, T, S, layout):
+    """Global offsets of the contiguous runs making up rank ``rr``'s
+    block: one T-run (contiguous) or two T/2-runs (zigzag)."""
+    if layout == "contiguous":
+        return [(0, T, rr * T)]
+    C = T // 2
+    return [(0, C, rr * C), (C, C, (2 * S - 1 - rr) * C)]
+
+
+def _block_positions(rr, T, S, layout):
+    parts = [off + jnp.arange(ln) for _, ln, off in
+             _block_offsets(rr, T, S, layout)]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
 def ring_attention(q, k, v, *, axis_name: str = "seq",
                    causal: bool = False, remat: bool = True,
                    use_flash: bool = False, block_q: int = 256,
-                   block_k: int = 512, interpret: bool = False):
+                   block_k: int = 512, interpret: bool = False,
+                   layout: str = "contiguous"):
     """Blockwise ring attention.  Call INSIDE ``shard_map`` over
     ``axis_name`` with Q/K/V sequence-sharded: ``(B, T_blk, H, D)`` each.
 
@@ -100,27 +148,37 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         ``flash_attention_supported(T_blk, T_blk, block_q, block_k)``.
       interpret: run the flash kernel in the Pallas interpreter
         (non-TPU backends).
+      layout: ``"contiguous"`` (device ``r`` holds tokens
+        ``[r·T, (r+1)·T)``) or ``"zigzag"`` (device ``r`` holds chunks
+        ``r`` and ``2S−1−r`` — see :func:`zigzag_indices`; balances the
+        causal workload across the ring so the 2× FLOP saving is also a
+        wall-clock saving).
 
     Returns ``(B, T_blk, H, D)`` — this device's attended block.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"layout {layout!r} not in (contiguous, zigzag)")
     S = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     scale = D ** -0.5
     ring = [(i, (i + 1) % S) for i in range(S)]
+    if layout == "zigzag" and T % 2:
+        raise ValueError(f"zigzag needs an even local length, got {T}")
 
     if use_flash:
         return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
                            remat=remat, block_q=block_q, block_k=block_k,
-                           interpret=interpret, S=S, r=r, ring=ring)
+                           interpret=interpret, S=S, r=r, ring=ring,
+                           layout=layout)
 
     def block_step(carry, i):
         k_blk, v_blk, num, den, m = carry
         src = (r - i) % S  # which block this device currently holds
         s = jnp.einsum("bthd,bshd->bhts", q, k_blk) * scale
         if causal:
-            qpos = r * T + jnp.arange(T)
-            kpos = src * T + jnp.arange(T)
+            qpos = _block_positions(r, T, S, layout)
+            kpos = _block_positions(src, T, S, layout)
             allow = qpos[:, None] >= kpos[None, :]
             s = jnp.where(allow[None, None], s, _NEG)
         # online softmax update (flash recurrence)
@@ -152,8 +210,16 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
     return out.transpose(0, 2, 1, 3)                     # (B,T,H,D)
 
 
+def _merge_lse(o, lse, o_i, lse_i):
+    """Exact log-space merge of two attention partials."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_new = jnp.exp(lse_i - lse_new)[..., None]
+    return o * w_old + o_i * w_new, lse_new
+
+
 def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
-                interpret, S, r, ring):
+                interpret, S, r, ring, layout="contiguous"):
     """Ring schedule with the Pallas kernel as the per-pair compute.
 
     Every visiting K/V block is attended with the SAME kernel call,
@@ -182,19 +248,42 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
         # SMEM scalars under shard_map's vma checking — run the
         # semantically-identical XLA pair instead (the kernel itself is
         # covered standalone by the ops tests; TPU runs the real kernel)
-        def pair(qq, kb, vb, k_off):
+        def pair(qq, kb, vb, q_off, k_off):
             return _lse_attention_pair(
-                qq, kb, vb, causal=causal, q_offset=r * T, k_offset=k_off)
+                qq, kb, vb, causal=causal, q_offset=q_off, k_offset=k_off)
     else:
-        def pair(qq, kb, vb, k_off):
+        def pair(qq, kb, vb, q_off, k_off):
             return flash_attention(
-                qq, kb, vb, causal=causal, q_offset=r * T, k_offset=k_off,
+                qq, kb, vb, causal=causal, q_offset=q_off, k_offset=k_off,
                 block_q=block_q, block_k=block_k, return_lse=True,
                 interpret=False)
 
-    # step 0: self block (offsets equal → ordinary causal flash)
-    o, lse = pair(q, k, v, r * T)
-    o = o.astype(jnp.float32)
+    def attend_block(k_blk, v_blk, src):
+        """Full local Q against the visiting block: one kernel call per
+        (contiguous Q run × contiguous K run) — 1 for the contiguous
+        layout, 4 for zigzag — merged exactly in log-space."""
+        k_runs = _block_offsets(src, T, S, layout)
+        outs = []
+        for q_start, q_len, q_off in _block_offsets(r, T, S, layout):
+            qq = lax.dynamic_slice_in_dim(q, q_start, q_len, axis=1)
+            o_h = lse_h = None
+            for k_start, k_len, k_off in k_runs:
+                kb = lax.dynamic_slice_in_dim(k_blk, k_start, k_len, 1)
+                vb = lax.dynamic_slice_in_dim(v_blk, k_start, k_len, 1)
+                o_i, lse_i = pair(qq, kb, vb, q_off, k_off)
+                o_i = o_i.astype(jnp.float32)
+                if o_h is None:
+                    o_h, lse_h = o_i, lse_i
+                else:
+                    o_h, lse_h = _merge_lse(o_h, lse_h, o_i, lse_i)
+            outs.append((o_h, lse_h))
+        if len(outs) == 1:
+            return outs[0]
+        return (jnp.concatenate([o for o, _ in outs], axis=1),
+                jnp.concatenate([l for _, l in outs], axis=1))
+
+    # step 0: self block
+    o, lse = attend_block(k, v, r)
     if S == 1:
         return o.astype(q.dtype)
 
@@ -203,13 +292,9 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
         k_blk = lax.ppermute(k_blk, axis_name, perm=ring)
         v_blk = lax.ppermute(v_blk, axis_name, perm=ring)
         src = (r - i) % S                                # block now held
-        o_i, lse_i = pair(q, k_blk, v_blk, src * T)
-        o_i = o_i.astype(jnp.float32)
-        lse_new = jnp.logaddexp(lse, lse_i)              # (B,T,H)
-        w_old = jnp.exp(lse - lse_new)[..., None]
-        w_new = jnp.exp(lse_i - lse_new)[..., None]
-        o = o * w_old + o_i * w_new
-        return (k_blk, v_blk, o, lse_new), None
+        o_i, lse_i = attend_block(k_blk, v_blk, src)
+        o, lse = _merge_lse(o, lse, o_i, lse_i)
+        return (k_blk, v_blk, o, lse), None
 
     step = jax.checkpoint(block_step) if remat else block_step
     (k, v, o, lse), _ = lax.scan(step, (k, v, o, lse), jnp.arange(1, S))

@@ -158,3 +158,34 @@ def test_flash_attention_matches_oracle():
     out = make_forward_fn(mc, cfg)(shard_params(mc, cfg, params), toks)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_ring_matches_oracle():
+    """seq_layout="zigzag": tokens fed through the zigzag permutation
+    must yield (after un-permuting) the same logits as the contiguous
+    oracle — position embeddings and causal masking follow the layout."""
+    from chainermn_tpu.parallel.ring_attention import zigzag_indices
+
+    S = 4
+    cfg = tiny_cfg(attention="ring", seq_layout="zigzag")
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    toks = tokens()[:, :T]
+    ref = oracle_logits(tiny_cfg(), params, toks)
+
+    perm = zigzag_indices(S, T).reshape(-1)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T)
+    mc = MeshConfig(seq=S, data=2)
+    out = make_forward_fn(mc, cfg)(
+        shard_params(mc, cfg, params), toks[:, perm])
+    np.testing.assert_allclose(
+        np.asarray(out)[:, inv], np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_requires_ring():
+    cfg = tiny_cfg(attention="ulysses", seq_layout="zigzag")
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    mc = MeshConfig(seq=4, data=2)
+    with pytest.raises(ValueError, match="zigzag"):
+        make_forward_fn(mc, cfg)(
+            shard_params(mc, cfg, params), tokens()[:, :T])

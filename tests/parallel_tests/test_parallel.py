@@ -411,3 +411,92 @@ class TestRingFlash:
         for a, b in zip(g_flash, g_xla):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
+
+
+class TestZigzagRing:
+    """Load-balanced (Striped/zigzag) causal ring layout: device r holds
+    chunks r and 2S-1-r; the result must equal dense attention gathered
+    through the same permutation, for both the einsum and kernel paths."""
+
+    def _global_qkv(self, B=2, Tg=64, H=2, D=16, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: jnp.asarray(
+            rng.randn(B, Tg, H, D).astype(np.float32) * 0.5)
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_matches_dense_oracle(self, use_flash):
+        from chainermn_tpu.parallel.ring_attention import (
+            local_attention, ring_attention, zigzag_indices)
+
+        S, Tg = 8, 64
+        q, k, v = self._global_qkv(Tg=Tg)
+        perm = zigzag_indices(S, Tg).reshape(-1)      # global -> zigzag
+        qz, kz, vz = (t[:, perm] for t in (q, k, v))
+
+        mc = MeshConfig(seq=S)
+        f = jax.jit(jax.shard_map(
+            lambda q, k, v: ring_attention(
+                q, k, v, axis_name="seq", causal=True, remat=False,
+                layout="zigzag", use_flash=use_flash, block_q=8,
+                block_k=8, interpret=True),
+            mesh=mc.mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq")))
+        out_z = np.asarray(f(qz, kz, vz))
+
+        ref = np.asarray(local_attention(q, k, v, causal=True))
+        # un-permute the zigzag output back to global order
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(Tg)
+        np.testing.assert_allclose(out_z[:, inv], ref,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_contiguous_ring(self):
+        from chainermn_tpu.parallel.ring_attention import (
+            ring_attention, zigzag_indices)
+
+        S, Tg = 4, 32
+        q, k, v = self._global_qkv(Tg=Tg, seed=3)
+        perm = zigzag_indices(S, Tg).reshape(-1)
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(Tg)
+        mc = MeshConfig(seq=S, data=2)
+
+        def make_grads(layout, qkv):
+            def loss(q, k, v):
+                o = ring_attention(q, k, v, axis_name="seq", causal=True,
+                                   remat=False, layout=layout)
+                return jax.lax.psum(jnp.sum(o * jnp.sin(o)), ("seq",))
+            g = jax.jit(jax.shard_map(
+                jax.grad(loss, argnums=(0, 1, 2)),
+                mesh=mc.mesh,
+                in_specs=(P(None, "seq"),) * 3,
+                out_specs=(P(None, "seq"),) * 3))(*qkv)
+            return [np.asarray(t) for t in g]
+
+        g_zig = make_grads("zigzag", (q[:, perm], k[:, perm], v[:, perm]))
+        g_ref = make_grads("contiguous", (q, k, v))
+        for a, b in zip(g_zig, g_ref):
+            np.testing.assert_allclose(a[:, inv], b, rtol=5e-4, atol=1e-5)
+
+    def test_bad_layout_rejected(self):
+        from chainermn_tpu.parallel.ring_attention import ring_attention
+
+        mc = MeshConfig(seq=2)
+        with pytest.raises(ValueError, match="layout"):
+            jax.jit(jax.shard_map(
+                lambda q: ring_attention(q, q, q, axis_name="seq",
+                                         layout="spiral"),
+                mesh=mc.mesh, in_specs=(P(None, "seq"),),
+                out_specs=P(None, "seq")))(
+                    np.zeros((1, 8, 1, 4), np.float32))
+
+    def test_zigzag_indices_cover(self):
+        from chainermn_tpu.parallel.ring_attention import zigzag_indices
+
+        idx = zigzag_indices(4, 64)
+        assert idx.shape == (4, 16)
+        assert sorted(idx.reshape(-1).tolist()) == list(range(64))
+        # device 0 holds the first and the LAST chunk (balance property)
+        assert idx[0, 0] == 0 and idx[0, -1] == 63
