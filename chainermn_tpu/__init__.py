@@ -42,6 +42,8 @@ from chainermn_tpu.training import (
     create_multi_node_evaluator,
     create_multi_node_optimizer,
     cross_replica_mean,
+    zero1_init,
+    zero1_optimizer,
 )
 
 __version__ = "0.1.0"
@@ -67,6 +69,8 @@ __all__ = [
     "add_global_except_hook",
     "create_multi_node_checkpointer",
     "cross_replica_mean",
+    "zero1_init",
+    "zero1_optimizer",
     "extensions",
     "links",
     "multi_node_snapshot",

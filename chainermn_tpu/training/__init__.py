@@ -9,6 +9,8 @@ from .evaluators import (
 from .optimizers import (
     create_multi_node_optimizer,
     cross_replica_mean,
+    zero1_init,
+    zero1_optimizer,
 )
 from .trainer import LogReport, PrintReport, Trainer, make_extension
 from .triggers import IntervalTrigger, get_trigger
@@ -28,4 +30,6 @@ __all__ = [
     "default_converter",
     "get_trigger",
     "make_extension",
+    "zero1_init",
+    "zero1_optimizer",
 ]
