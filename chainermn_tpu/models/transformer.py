@@ -41,6 +41,7 @@ from chainermn_tpu.parallel.expert import expert_parallel_moe
 from chainermn_tpu.parallel.pipeline import pipeline_apply, pipeline_train_1f1b
 from chainermn_tpu.parallel.ring_attention import (
     _block_positions,
+    broadcast_kv,
     local_attention,
     ring_attention,
 )
@@ -65,6 +66,7 @@ class TransformerConfig:
     vocab_size: int = 32000
     d_model: int = 512
     n_heads: int = 8
+    n_kv_heads: int = 0    # 0 => n_heads (MHA); fewer => GQA, 1 => MQA
     d_head: int = 64
     d_ff: int = 2048
     n_layers: int = 4          # total; must divide by mesh pipe size
@@ -86,6 +88,20 @@ class TransformerConfig:
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    def __post_init__(self):
+        if not 0 <= self.n_kv_heads <= self.n_heads:
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be in "
+                f"[0, n_heads={self.n_heads}] (0 means MHA)")
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_heads={self.n_heads} must be a multiple of "
+                f"n_kv_heads={self.kv_heads}")
+
 
 # --------------------------------------------------------------------- #
 # init
@@ -102,9 +118,15 @@ def _init_block(key, cfg: TransformerConfig):
     block = {
         "ln1": jnp.ones((D,), jnp.float32),
         "ln2": jnp.ones((D,), jnp.float32),
-        "wqkv": dense_init(ks[0], (D, 3, H, Dh), D),
         "wo": dense_init(ks[1], (H, Dh, D), H * Dh),
     }
+    if cfg.kv_heads == H:
+        block["wqkv"] = dense_init(ks[0], (D, 3, H, Dh), D)
+    else:
+        # GQA/MQA: Hkv shared K/V heads, each serving H/Hkv query heads
+        # (consecutive grouping: query head h reads kv head h//(H/Hkv))
+        block["wq"] = dense_init(ks[0], (D, H, Dh), D)
+        block["wkv"] = dense_init(ks[5], (D, 2, cfg.kv_heads, Dh), D)
     if cfg.moe:
         E = cfg.n_experts
         block["router"] = dense_init(ks[2], (D, E), D)
@@ -152,9 +174,13 @@ def param_specs(cfg: TransformerConfig):
     blk = {
         "ln1": P("pipe"),
         "ln2": P("pipe"),
-        "wqkv": P("pipe", None, None, None, "model", None),
         "wo": P("pipe", None, "model", None, None),
     }
+    if cfg.kv_heads == cfg.n_heads:
+        blk["wqkv"] = P("pipe", None, None, None, "model", None)
+    else:
+        blk["wq"] = P("pipe", None, None, "model", None)
+        blk["wkv"] = P("pipe", None, None, None, "model", None)
     if cfg.moe:
         blk["router"] = P("pipe")
         blk["w1"] = P("pipe", None, "expert", None, "model")
@@ -187,11 +213,31 @@ def _attention(cfg: TransformerConfig, h, blk):
     cd = cfg.compute_dtype
     x = _rms_norm(h, blk["ln1"])
     B, T, D = x.shape
-    Hl = blk["wqkv"].shape[2]          # local heads = H / model-axis size
-    qkv = column_parallel_dense(
-        x, blk["wqkv"].reshape(D, -1).astype(cd))
-    qkv = qkv.reshape(B, T, 3, Hl, cfg.d_head)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if "wqkv" in blk:
+        Hl = blk["wqkv"].shape[2]      # local heads = H / model-axis size
+        qkv = column_parallel_dense(
+            x, blk["wqkv"].reshape(D, -1).astype(cd))
+        qkv = qkv.reshape(B, T, 3, Hl, cfg.d_head)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    else:
+        # GQA/MQA: H/Hkv query heads share each K/V head.  K/V stay at
+        # their natural (shared) width all the way through the attention
+        # cores — the ring rotates and Ulysses exchanges Hkv-head blocks
+        # (ICI traffic shrinks by H/Hkv) and the grouped einsums read the
+        # shared heads in place.  Local (per model-rank) grouping equals
+        # global grouping because both H and Hkv shard over the same
+        # axis: global query head r·Hl+i reads kv head r·Hkvl + i//rep
+        # for rep = Hl/Hkvl = H/Hkv (mesh divisibility is validated at
+        # shard/jit build time by _check_mesh).
+        Hl = blk["wq"].shape[1]
+        Hkvl = blk["wkv"].shape[2]
+        q = column_parallel_dense(
+            x, blk["wq"].reshape(D, -1).astype(cd)
+        ).reshape(B, T, Hl, cfg.d_head)
+        kv = column_parallel_dense(
+            x, blk["wkv"].reshape(D, -1).astype(cd)
+        ).reshape(B, T, 2, Hkvl, cfg.d_head)
+        k, v = kv[:, :, 0], kv[:, :, 1]
     if cfg.attention == "ring":
         # flagship long-context path: ring schedule with the Pallas
         # kernel as the per-pair compute whenever the local block shape
@@ -232,8 +278,11 @@ def _attention(cfg: TransformerConfig, h, blk):
         if not flash_attention_supported(T, T):
             # kernel contract: lengths must divide the (clamped) blocks —
             # fall back to the XLA path instead of erroring at trace time
+            # (grouped-KV read in place; no broadcast)
             o = local_attention(q, k, v, causal=True)
         else:
+            # kernel wants matching head counts
+            k, v = broadcast_kv(k, v, q.shape[2] // k.shape[2])
             o = flash_attention(
                 q, k, v, causal=True,
                 interpret=jax.default_backend() != "tpu")
@@ -446,11 +495,34 @@ def _make_1f1b_grad(cfg: TransformerConfig):
     return grad_body
 
 
+def _check_mesh(mesh_cfg, cfg: TransformerConfig):
+    """Config↔mesh divisibility checks with actionable messages (instead
+    of opaque GSPMD placement errors deep inside jit)."""
+    mp = mesh_cfg.mesh.shape.get("model", 1)
+    sp = mesh_cfg.mesh.shape.get("seq", 1)
+    if cfg.n_heads % mp:
+        raise ValueError(
+            f"n_heads={cfg.n_heads} must be divisible by the model mesh "
+            f"axis ({mp})")
+    if cfg.kv_heads % mp:
+        raise ValueError(
+            f"n_kv_heads={cfg.kv_heads} must be divisible by the model "
+            f"mesh axis ({mp}); raise n_kv_heads or shrink the model "
+            "axis (shared kv heads shard over the same axis as query "
+            "heads)")
+    if cfg.attention == "ulysses" and sp > 1 and cfg.kv_heads % (mp * sp):
+        raise ValueError(
+            f"attention='ulysses' moves kv heads over the seq axis: "
+            f"n_kv_heads={cfg.kv_heads} must be divisible by "
+            f"model*seq ({mp}*{sp})")
+
+
 def shard_params(mesh_cfg, cfg: TransformerConfig, params):
     """Place a host-initialised param pytree per :func:`param_specs`.
 
     The reference's ``comm.bcast_data(model)`` moment: after this, every
     device holds exactly its shard (replicated leaves on all)."""
+    _check_mesh(mesh_cfg, cfg)
     return jax.tree.map(
         lambda a, s: jax.device_put(a, mesh_cfg.sharding(*s)),
         params, param_specs(cfg))
@@ -459,6 +531,8 @@ def shard_params(mesh_cfg, cfg: TransformerConfig, params):
 def make_forward_fn(mesh_cfg, cfg: TransformerConfig):
     """``fn(params, tokens) -> logits`` — jittable, shard_map'd over the
     full mesh.  Single-chip (all axes 1) and 5-axis runs share this path."""
+
+    _check_mesh(mesh_cfg, cfg)
 
     def fwd(params, tokens):
         logits, _ = transformer_forward(cfg, params, tokens)
@@ -496,6 +570,7 @@ def make_train_step(mesh_cfg, cfg: TransformerConfig, optimizer):
     as it clears the last stage, capping in-flight activations at O(S)
     instead of GPipe's O(M).
     """
+    _check_mesh(mesh_cfg, cfg)
     specs = param_specs(cfg)
 
     if cfg.pipeline_schedule == "1f1b":

@@ -29,24 +29,69 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "local_attention", "zigzag_indices"]
+__all__ = ["ring_attention", "local_attention", "zigzag_indices",
+           "broadcast_kv"]
 
 _NEG = -1e30  # finite mask value: keeps the online-softmax max well-defined
+
+
+def _group_rep(q_heads: int, kv_heads: int) -> int:
+    if q_heads % kv_heads:
+        raise ValueError(
+            f"query heads {q_heads} not a multiple of kv heads {kv_heads}")
+    return q_heads // kv_heads
+
+
+def broadcast_kv(k, v, rep: int):
+    """Broadcast shared K/V heads to query width for kernels that want
+    matching head counts.  The interleave convention (head ``g`` repeated
+    ``rep`` times consecutively) is THE grouping invariant — it must match
+    :func:`_qk_scores`'s ``h // rep`` mapping; keep every call site on
+    this helper."""
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
+def _qk_scores(q, k):
+    """``(B,T,H,D) × (B,S,G,D) -> (B,H,T,S)`` scores; when ``G < H``
+    (GQA/MQA) query head ``h`` reads kv head ``h // (H/G)`` via a grouped
+    einsum — the shared K is never materialised at query width."""
+    H, G = q.shape[2], k.shape[2]
+    if H == G:
+        return jnp.einsum("bthd,bshd->bhts", q, k)
+    R = _group_rep(H, G)
+    B, T, _, D = q.shape
+    s = jnp.einsum("btgrd,bsgd->bgrts", q.reshape(B, T, G, R, D), k)
+    return s.reshape(B, H, T, -1)
+
+
+def _pv_mix(p, v):
+    """``(B,H,T,S) × (B,S,G,D) -> (B,H,T,D)`` value mix, grouped when
+    ``G < H`` (the dual of :func:`_qk_scores`)."""
+    H, G = p.shape[1], v.shape[2]
+    if H == G:
+        return jnp.einsum("bhts,bshd->bhtd", p, v)
+    R = _group_rep(H, G)
+    B, _, T, S = p.shape
+    o = jnp.einsum("bgrts,bsgd->bgrtd", p.reshape(B, G, R, T, S), v)
+    return o.reshape(B, H, T, -1)
 
 
 def local_attention(q, k, v, *, causal: bool = False, q_offset=0,
                     k_offset=0):
     """Plain softmax attention on local blocks (the S=1 degenerate case and
-    the reference oracle for tests).  Shapes ``(B, T, H, D)``."""
+    the reference oracle for tests).  ``q: (B, T, H, D)``; ``k``/``v`` may
+    carry fewer (shared) heads ``(B, S, G, D)`` with ``G | H`` (GQA)."""
     scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    s = _qk_scores(q, k) * scale
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         allow = qpos[:, None] >= kpos[None, :]
         s = jnp.where(allow[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhts,bshd->bthd", p, v)
+    return _pv_mix(p, v).transpose(0, 2, 1, 3)
 
 
 def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset):
@@ -58,7 +103,7 @@ def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset):
     SMEM scalars under shard_map's vma checking (jax interpreter bug);
     on TPU the real kernel runs instead."""
     scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+    s = _qk_scores(q.astype(jnp.float32),
                    kb.astype(jnp.float32)) * scale
     allow = None
     if causal:
@@ -72,8 +117,7 @@ def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset):
         p = jnp.where(allow, p, 0.0)
     l = p.sum(axis=-1)
     safe = jnp.maximum(l, 1e-30)
-    o = jnp.einsum("bhts,bshd->bhtd", p,
-                   vb.astype(jnp.float32)) / safe[..., None]   # (B,H,T,D)
+    o = _pv_mix(p, vb.astype(jnp.float32)) / safe[..., None]   # (B,H,T,D)
     lse = m + jnp.log(safe)                              # (B,H,T)
     return (o.transpose(0, 2, 1, 3).astype(q.dtype),
             lse.transpose(0, 2, 1))                      # (B,T,H,D),(B,T,H)
@@ -155,12 +199,19 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         wall-clock saving).
 
     Returns ``(B, T_blk, H, D)`` — this device's attended block.
+
+    GQA/MQA: ``k``/``v`` may carry fewer (shared) heads than ``q``
+    (``G | H``).  The ring then rotates K/V at their natural ``G``-head
+    width — the ICI traffic and resident K/V memory shrink by ``H/G`` —
+    and the per-pair compute reads the shared heads through grouped
+    einsums (XLA path) or a local per-block broadcast (kernel path).
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"layout {layout!r} not in (contiguous, zigzag)")
     S = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     B, T, H, D = q.shape
+    _group_rep(H, k.shape[2])  # validate G | H before tracing the ring
     scale = D ** -0.5
     ring = [(i, (i + 1) % S) for i in range(S)]
     if layout == "zigzag" and T % 2:
@@ -175,7 +226,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
     def block_step(carry, i):
         k_blk, v_blk, num, den, m = carry
         src = (r - i) % S  # which block this device currently holds
-        s = jnp.einsum("bthd,bshd->bhts", q, k_blk) * scale
+        s = _qk_scores(q, k_blk) * scale
         if causal:
             qpos = _block_positions(r, T, S, layout)
             kpos = _block_positions(src, T, S, layout)
@@ -185,8 +236,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         m_new = jnp.maximum(m, s.max(axis=-1))           # (B,H,T)
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])                # (B,H,T,S)
-        num = num * alpha[..., None] + jnp.einsum(
-            "bhts,bshd->bhtd", p, v_blk)
+        num = num * alpha[..., None] + _pv_mix(p, v_blk)
         den = den * alpha + p.sum(axis=-1)
         # rotate K/V to the next device; XLA overlaps this with the math
         if S > 1:
@@ -242,6 +292,12 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
     from chainermn_tpu.ops.pallas_attention import flash_attention
 
     T = q.shape[1]
+    # GQA: the ring rotates K/V at shared-head width; the Pallas kernel
+    # wants matching head counts, so broadcast the *local visiting block*
+    # to query width at the kernel boundary (a per-block, post-ppermute
+    # expansion — the wire and the carry stay at G heads).  The XLA
+    # interpret pair reads shared heads directly via grouped einsums.
+    rep = _group_rep(q.shape[2], k.shape[2])
 
     if interpret:
         # the Pallas hlo-interpreter cannot discharge seq-varying traced
@@ -253,6 +309,7 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
                 qq, kb, vb, causal=causal, q_offset=q_off, k_offset=k_off)
     else:
         def pair(qq, kb, vb, q_off, k_off):
+            kb, vb = broadcast_kv(kb, vb, rep)
             return flash_attention(
                 qq, kb, vb, causal=causal, q_offset=q_off, k_offset=k_off,
                 block_q=block_q, block_k=block_k, return_lse=True,

@@ -27,7 +27,11 @@ from typing import Callable, Optional
 
 from jax import lax
 
-from chainermn_tpu.parallel.ring_attention import local_attention
+from chainermn_tpu.parallel.ring_attention import (
+    _group_rep,
+    broadcast_kv,
+    local_attention,
+)
 
 __all__ = ["ulysses_attention"]
 
@@ -42,19 +46,36 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
     tensors; defaults to :func:`local_attention` (swap in the pallas flash
     kernel for production).
 
+    GQA/MQA: ``k``/``v`` may carry fewer (shared) heads ``G`` with
+    ``S | G`` and ``G | H`` — the all-to-alls then move K/V at ``G``-head
+    width (the wire saving carries through), and the grouping lines up
+    locally because query and kv heads shard over the same axis: device
+    ``r`` holds query heads ``[r·H/S, (r+1)·H/S)`` whose shared heads are
+    exactly its ``[r·G/S, (r+1)·G/S)`` slice.  A custom ``attn_fn`` that
+    needs matching head counts gets K/V broadcast to query width *after*
+    the exchange (local); the default grouped path never materialises it.
+
     Returns ``(B, T/S, H, D)`` sequence-sharded, numerically identical to
     full attention (no online-softmax approximation anywhere).
     """
     S = lax.axis_size(axis_name)
+    rep = _group_rep(q.shape[2], k.shape[2])
     if S > 1:
         if q.shape[2] % S:
             raise ValueError(
                 f"heads {q.shape[2]} not divisible by seq-axis size {S}")
+        if k.shape[2] % S:
+            raise ValueError(
+                f"kv heads {k.shape[2]} not divisible by seq-axis size "
+                f"{S}: pick n_kv_heads a multiple of the seq mesh axis")
         # (B, T/S, H, D) → (B, T, H/S, D): scatter heads, gather sequence
         q, k, v = (
             lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
                            tiled=True)
             for t in (q, k, v))
+    if attn_fn is not None:
+        # local post-exchange broadcast for kernels wanting equal heads
+        k, v = broadcast_kv(k, v, rep)
     fn = attn_fn or local_attention
     out = fn(q, k, v, causal=causal)
     if S > 1:
