@@ -56,6 +56,8 @@ class KVObjectChannel:
         self._timeout_ms = timeout_ms
         self._send_seq: dict = {}
         self._recv_seq: dict = {}
+        self._ag_seq = 0
+        self._ag_frames: dict = {}  # seq -> own frame count (for lazy GC)
 
     @property
     def _client(self):
@@ -71,25 +73,85 @@ class KVObjectChannel:
     def _key(self, src: int, dst: int, seq: int, part: str) -> str:
         return f"{self._tag}/{src}.{dst}.{seq}/{part}"
 
-    def send(self, obj: Any, src: int, dst: int) -> None:
-        """Send ``obj`` on the (src, dst) lane; returns when published."""
+    def _publish(self, obj: Any, keyfn, what: str) -> int:
+        """Pickle + cap-check ``obj`` and write it as chunked frames with
+        the metadata key last (its presence implies every chunk is
+        readable).  ``keyfn(part)`` names the keys.  Returns the frame
+        count."""
         payload = pickle.dumps(obj)
         if len(payload) > MAX_OBJ_BYTES:
             raise DataSizeError(
-                f"send_obj payload is {len(payload)} bytes, over the "
-                f"{MAX_OBJ_BYTES}-byte p2p cap; scatter large data with "
-                "the chunked *_obj collectives or scatter_dataset instead")
+                f"{what} payload is {len(payload)} bytes, over the "
+                f"{MAX_OBJ_BYTES}-byte cap; move bulk data through the "
+                "array collectives or scatter_dataset instead")
         client = self._client
-        seq = self._send_seq.get((src, dst), 0)
-        self._send_seq[(src, dst)] = seq + 1
         nframes = max(1, -(-len(payload) // FRAME_BYTES))
         for k in range(nframes):
             client.key_value_set_bytes(
-                self._key(src, dst, seq, f"c{k}"),
+                keyfn(f"c{k}"),
                 payload[k * FRAME_BYTES : (k + 1) * FRAME_BYTES])
-        # metadata last: its presence implies every chunk is readable
-        client.key_value_set(
-            self._key(src, dst, seq, "meta"), f"{nframes},{len(payload)}")
+        client.key_value_set(keyfn("meta"), f"{nframes},{len(payload)}")
+        return nframes
+
+    def _collect(self, keyfn, what: str) -> Any:
+        """Blocking read of a message published by :meth:`_publish`."""
+        client = self._client
+        meta = client.blocking_key_value_get(
+            keyfn("meta"), self._timeout_ms)
+        nframes, total = (int(v) for v in meta.split(","))
+        buf = bytearray()
+        for k in range(nframes):
+            buf += client.blocking_key_value_get_bytes(
+                keyfn(f"c{k}"), self._timeout_ms)
+        if len(buf) != total:
+            raise RuntimeError(
+                f"{what} corruption: expected {total} bytes, "
+                f"reassembled {len(buf)}")
+        return pickle.loads(bytes(buf))
+
+    def send(self, obj: Any, src: int, dst: int) -> None:
+        """Send ``obj`` on the (src, dst) lane; returns when published."""
+        seq = self._send_seq.get((src, dst), 0)
+        self._send_seq[(src, dst)] = seq + 1
+        self._publish(
+            obj, lambda part: self._key(src, dst, seq, part), "send_obj")
+
+    def allgather(self, obj: Any, group, me: int):
+        """Group-scoped object allgather over the KV store.
+
+        ``group``: sorted process ids participating; ``me`` must be one of
+        them.  Returns the objects in ``group`` order.  This is the
+        collective path for *subgroup* communicators (``split``), where
+        the whole-world ``multihost_utils`` collectives would deadlock —
+        non-member processes never enter the call.
+
+        Key lifecycle (lazy GC): a process entering call ``s`` deletes its
+        own keys from call ``s−2``.  Safe because reading call ``s−1``'s
+        payloads — a precondition for any member reaching ``s`` — implies
+        every member finished its ``s−2`` collect before publishing
+        ``s−1``.
+        """
+        if me not in group:
+            raise ValueError(f"process {me} not in group {sorted(group)}")
+        client = self._client
+        s = self._ag_seq
+        self._ag_seq += 1
+        old = self._ag_frames.pop(s - 2, None)
+        if old is not None:
+            for k in range(old):
+                client.key_value_delete(self._key(me, -1, s - 2, f"gc{k}"))
+            client.key_value_delete(self._key(me, -1, s - 2, "gmeta"))
+
+        def keyfn(p):
+            return lambda part: self._key(
+                p, -1, s, "gmeta" if part == "meta" else "g" + part)
+
+        self._ag_frames[s] = self._publish(obj, keyfn(me), "allgather_obj")
+        return [
+            obj if p == me else self._collect(
+                keyfn(p), f"obj allgather from process {p}")
+            for p in sorted(group)
+        ]
 
     def recv(self, src: int, dst: int) -> Any:
         """Receive the next in-order object on the (src, dst) lane."""
@@ -100,16 +162,10 @@ class KVObjectChannel:
         # advance the lane only once the message is known to exist, so a
         # timed-out recv can be retried without desynchronising sequences
         self._recv_seq[(src, dst)] = seq + 1
-        nframes, total = (int(v) for v in meta.split(","))
-        buf = bytearray()
-        for k in range(nframes):
-            buf += client.blocking_key_value_get_bytes(
-                self._key(src, dst, seq, f"c{k}"), self._timeout_ms)
+        nframes = int(meta.split(",")[0])
+        obj = self._collect(
+            lambda part: self._key(src, dst, seq, part), "obj channel")
         for k in range(nframes):
             client.key_value_delete(self._key(src, dst, seq, f"c{k}"))
         client.key_value_delete(self._key(src, dst, seq, "meta"))
-        if len(buf) != total:
-            raise RuntimeError(
-                f"obj channel corruption: expected {total} bytes, "
-                f"reassembled {len(buf)}")
-        return pickle.loads(bytes(buf))
+        return obj

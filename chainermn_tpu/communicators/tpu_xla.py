@@ -39,6 +39,11 @@ _REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
 # the limit is host memory for the gather staging buffers).
 _OBJ_FRAME_BYTES = 64 * 1024 * 1024
 
+# per-process creation count of communicators with the same member-device
+# identity — disambiguates the KV namespace of re-created communicators
+# (SPMD-consistent: every process creates the same communicators in order)
+_INCARNATIONS: dict = {}
+
 
 class TpuXlaCommunicator(CommunicatorBase):
     """Collectives over a 1-D device mesh, lowered by XLA onto ICI/DCN."""
@@ -58,14 +63,29 @@ class TpuXlaCommunicator(CommunicatorBase):
         # logically-same communicator and (b) differ between distinct
         # communicators (split() children renumber ranks from 0, so key
         # collisions with the parent would cross-deliver messages).  The
-        # member device-id set is exactly that identity.
+        # member device-id set gives (b) across *concurrent* communicators;
+        # a per-ident incarnation counter gives it across *re-created* ones
+        # (a second split() with the same members would otherwise restart
+        # its sequence numbers on the first incarnation's still-live keys).
+        # The counter is SPMD-consistent because every process constructs
+        # the same communicators in the same order — the program-identity
+        # discipline the whole framework already assumes.
         import hashlib
 
         ident = hashlib.md5(
             ",".join(str(d.id) for d in self._devices).encode()
         ).hexdigest()[:10]
-        self._obj_channel = KVObjectChannel(tag=f"cmnobj-{axis_name}-{ident}")
+        inc = _INCARNATIONS.get(ident, 0)
+        _INCARNATIONS[ident] = inc + 1
+        self._obj_channel = KVObjectChannel(
+            tag=f"cmnobj-{axis_name}-{ident}-i{inc}")
         self._jit_cache: dict = {}  # per-instance (avoids lru_cache self leak)
+        # processes owning member devices, sorted: the obj-collective
+        # roster.  A split() child spanning fewer than all processes must
+        # NOT use the whole-world multihost collectives (non-members never
+        # enter the call -> deadlock) — it rides the KV group path.
+        self._member_procs = sorted(
+            {d.process_index for d in self._devices})
 
     # -- topology ------------------------------------------------------ #
 
@@ -284,9 +304,28 @@ class TpuXlaCommunicator(CommunicatorBase):
     def _root_process(self, root: int) -> int:
         return self._devices[root].process_index
 
+    @property
+    def _obj_local(self) -> bool:
+        """True when this communicator's devices live in one process —
+        obj collectives are then identities."""
+        return jax.process_count() == 1 or len(self._member_procs) == 1
+
+    @property
+    def _obj_subgroup(self) -> bool:
+        """True when members span >1 but not ALL processes (split child):
+        obj collectives must scope to the member roster."""
+        return 1 < len(self._member_procs) < jax.process_count()
+
+    def _my_group_index(self) -> int:
+        return self._member_procs.index(jax.process_index())
+
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
-        if jax.process_count() == 1:
+        if self._obj_local:
             return obj
+        if self._obj_subgroup:
+            objs = self._obj_channel.allgather(
+                obj, self._member_procs, jax.process_index())
+            return objs[self._member_procs.index(self._root_process(root))]
         from jax.experimental import multihost_utils
 
         is_src = self.inter_rank == self._root_process(root)
@@ -306,8 +345,11 @@ class TpuXlaCommunicator(CommunicatorBase):
         return pickle.loads(bytes(out))
 
     def allgather_obj(self, obj: Any) -> Sequence[Any]:
-        if jax.process_count() == 1:
+        if self._obj_local:
             return [obj]
+        if self._obj_subgroup:
+            return self._obj_channel.allgather(
+                obj, self._member_procs, jax.process_index())
         from jax.experimental import multihost_utils
 
         payload = pickle.dumps(obj)
@@ -340,10 +382,10 @@ class TpuXlaCommunicator(CommunicatorBase):
         return _tree_reduce(objs, op)
 
     def scatter_obj(self, objs, root: int = 0) -> Any:
-        if jax.process_count() == 1:
+        if self._obj_local:
             return objs[0] if objs else None
         all_lists = self.bcast_obj(objs, root)  # root = device rank
-        return all_lists[self.inter_rank]
+        return all_lists[self._my_group_index()]
 
     def send_obj(self, obj: Any, dest: int) -> None:
         """Point-to-point object send to device rank ``dest``.
@@ -391,10 +433,15 @@ class TpuXlaCommunicator(CommunicatorBase):
         return self._obj_channel.recv(src=source, dst=self.rank)
 
     def barrier(self) -> None:
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+        if self._obj_local:
+            return
+        if self._obj_subgroup:
+            self._obj_channel.allgather(
+                None, self._member_procs, jax.process_index())
+            return
+        from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices(f"{self._axis}_barrier")
+        multihost_utils.sync_global_devices(f"{self._axis}_barrier")
 
     # -- model/training helpers ----------------------------------------- #
 

@@ -1,7 +1,7 @@
-"""Classic ImageNet convnets — AlexNet, NiN, VGG-16 (reference:
-``examples/imagenet/models/{alex,nin,vgg}.py`` archs selectable via
-``--arch`` in ``train_imagenet.py``; unverified — mount empty, see
-SURVEY.md).
+"""Classic ImageNet convnets — AlexNet, NiN, VGG-16, GoogLeNet
+(reference: ``examples/imagenet/models/{alex,nin,vgg,googlenet}.py``
+archs selectable via ``--arch`` in ``train_imagenet.py``; unverified —
+mount empty, see SURVEY.md).
 
 Same TPU-first conventions as :mod:`chainermn_tpu.models.resnet`: NHWC,
 params fp32 / compute bf16, functional ``(params, x) -> logits``.  These
@@ -19,6 +19,14 @@ all-SAME padding and a global-average-pool head (256→4096 / 512→4096)
 that works at any input size (the ``--tiny`` smoke runs use it).  NiN is
 natively all-conv + GAP in the reference, so for NiN ``head`` only picks
 the geometry (reference pads + ceil pools vs all-SAME).
+
+GoogLeNet (Inception v1) carries the reference's two auxiliary
+classifiers (taps after 4a/4d; ``convnet_apply(..., with_aux=True)``
+returns ``(logits, aux_4a, aux_4d)``); LRN is dropped like AlexNet's,
+and the pre-FC dropout is omitted (pure-functional eval-parity —
+regularisation belongs to the training recipe here).  ``head`` picks
+reference geometry (ceil pools, 2048→1024 flattened aux heads at
+224px) vs size-robust GAP-aux variants.
 """
 
 from __future__ import annotations
@@ -32,13 +40,13 @@ from jax import lax
 
 __all__ = ["ConvNetConfig", "init_convnet", "convnet_apply"]
 
-_ARCHS = ("alex", "nin", "vgg16")
-_NATIVE_SIZE = {"alex": 227, "nin": 227, "vgg16": 224}
+_ARCHS = ("alex", "nin", "vgg16", "googlenet")
+_NATIVE_SIZE = {"alex": 227, "nin": 227, "vgg16": 224, "googlenet": 224}
 
 
 @dataclass(frozen=True)
 class ConvNetConfig:
-    arch: str = "alex"          # "alex" | "nin" | "vgg16"
+    arch: str = "alex"          # "alex" | "nin" | "vgg16" | "googlenet"
     num_classes: int = 1000
     dtype: str = "bfloat16"
     head: str = "flatten"       # "flatten" (reference parity) | "gap"
@@ -167,7 +175,145 @@ def _flatten_fin(cfg: ConvNetConfig) -> int:
     return fin
 
 
+# --------------------------------------------------------------------- #
+# GoogLeNet (Inception v1) — not expressible in the flat row DSL above
+# --------------------------------------------------------------------- #
+
+# (name, cin, b1, b3r, b3, b5r, b5, pool_proj); max-pool 3/2 precedes 4a
+# and 5a (the stem's own pools precede 3a).  Reference:
+# ``examples/imagenet/models/googlenet.py`` (unverified — mount empty).
+_INCEPTION = [
+    ("3a", 192, 64, 96, 128, 16, 32, 32),
+    ("3b", 256, 128, 128, 192, 32, 96, 64),
+    ("4a", 480, 192, 96, 208, 16, 48, 64),
+    ("4b", 512, 160, 112, 224, 24, 64, 64),
+    ("4c", 512, 128, 128, 256, 24, 64, 64),
+    ("4d", 512, 112, 144, 288, 32, 64, 64),
+    ("4e", 528, 256, 160, 320, 32, 128, 128),
+    ("5a", 832, 256, 160, 320, 32, 128, 128),
+    ("5b", 832, 384, 192, 384, 48, 128, 128),
+]
+_POOL_BEFORE = ("4a", "5a")
+_AUX_AFTER = ("4a", "4d")   # the two auxiliary classifier taps
+
+
+def _conv_p(key, kh, kw, cin, cout):
+    return {"w": _conv_init(key, kh, kw, cin, cout),
+            "b": jnp.zeros((cout,), jnp.float32)}
+
+
+def _googlenet_init(key, cfg: ConvNetConfig):
+    if cfg.head == "flatten" and cfg.insize != 224:
+        # the aux heads' 2048-wide flatten assumes the 14px 4a/4d taps of
+        # a 224px input — fail at init like the other archs' "collapses"
+        # check, not with a matmul shape error at trace time
+        raise ValueError(
+            f"googlenet reference geometry (head='flatten') is fixed at "
+            f"224px; got image_size={cfg.insize} — use head='gap' for "
+            "other input sizes")
+    ks = iter(jax.random.split(key, 80))
+    params = {
+        "stem": [
+            _conv_p(next(ks), 7, 7, 3, 64),      # conv1 7x7/2
+            _conv_p(next(ks), 1, 1, 64, 64),     # conv2 reduce
+            _conv_p(next(ks), 3, 3, 64, 192),    # conv2
+        ],
+        "inc": {},
+        "fc": _dense_init(next(ks), 1024, cfg.num_classes),
+    }
+    for name, cin, b1, b3r, b3, b5r, b5, pp in _INCEPTION:
+        params["inc"][name] = {
+            "b1": _conv_p(next(ks), 1, 1, cin, b1),
+            "b3r": _conv_p(next(ks), 1, 1, cin, b3r),
+            "b3": _conv_p(next(ks), 3, 3, b3r, b3),
+            "b5r": _conv_p(next(ks), 1, 1, cin, b5r),
+            "b5": _conv_p(next(ks), 5, 5, b5r, b5),
+            "pp": _conv_p(next(ks), 1, 1, cin, pp),
+        }
+    for tap, cin in zip(_AUX_AFTER, (512, 528)):
+        fin = 128 * 4 * 4 if cfg.head == "flatten" else 128
+        params[f"aux_{tap}"] = {
+            "conv": _conv_p(next(ks), 1, 1, cin, 128),
+            "fc1": _dense_init(next(ks), fin, 1024),
+            "fc2": _dense_init(next(ks), 1024, cfg.num_classes),
+        }
+    return params
+
+
+def _googlenet_apply(cfg: ConvNetConfig, params, x, with_aux: bool):
+    cd = cfg.compute_dtype
+    ceil = cfg.head == "flatten"
+
+    def conv(p, h, stride=1, pad="SAME"):
+        padding = pad if pad == "SAME" else [(pad, pad), (pad, pad)]
+        return jax.nn.relu(lax.conv_general_dilated(
+            h, p["w"].astype(cd), (stride, stride), padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + p["b"].astype(cd))
+
+    def maxpool(h, win=3, stride=2):
+        if ceil:  # Chainer cover_all=True geometry
+            size = h.shape[1]
+            out = _pool_out(size, win, stride, True)
+            extra = max((out - 1) * stride + win - size, 0)
+            return lax.reduce_window(
+                h, -jnp.inf, lax.max, (1, win, win, 1),
+                (1, stride, stride, 1),
+                [(0, 0), (0, extra), (0, extra), (0, 0)])
+        return lax.reduce_window(
+            h, -jnp.inf, lax.max, (1, win, win, 1),
+            (1, stride, stride, 1), "SAME")
+
+    def inception(p, h):
+        pool = maxpool(h, 3, 1) if not ceil else lax.reduce_window(
+            h, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 1, 1, 1),
+            [(0, 0), (1, 1), (1, 1), (0, 0)])
+        return jnp.concatenate([
+            conv(p["b1"], h),
+            conv(p["b3"], conv(p["b3r"], h)),
+            conv(p["b5"], conv(p["b5r"], h)),
+            conv(p["pp"], pool),
+        ], axis=-1)
+
+    def aux_head(p, h):
+        if ceil:
+            # reference geometry: 5x5/3 VALID average pool (14 -> 4),
+            # 1x1 conv, flatten 4·4·128 = 2048
+            a = lax.reduce_window(
+                h, 0.0, lax.add, (1, 5, 5, 1), (1, 3, 3, 1), "VALID"
+            ) / 25.0
+            a = conv(p["conv"], a)
+            a = a.reshape(a.shape[0], -1)
+        else:   # size-robust: 1x1 conv then GAP
+            a = jnp.mean(conv(p["conv"], h), axis=(1, 2))
+        a = jax.nn.relu(a.astype(jnp.float32) @ p["fc1"]["w"]
+                        + p["fc1"]["b"])
+        return a @ p["fc2"]["w"] + p["fc2"]["b"]
+
+    h = x.astype(cd)
+    h = conv(params["stem"][0], h, stride=2, pad=3)
+    h = maxpool(h)
+    h = conv(params["stem"][1], h, pad=0)
+    h = conv(params["stem"][2], h, pad=1)
+    h = maxpool(h)
+    aux_logits = []
+    for row in _INCEPTION:
+        name = row[0]
+        if name in _POOL_BEFORE:
+            h = maxpool(h)
+        h = inception(params["inc"][name], h)
+        if with_aux and name in _AUX_AFTER:
+            aux_logits.append(aux_head(params[f"aux_{name}"], h))
+    h = jnp.mean(h, axis=(1, 2))                       # GAP -> (B, 1024)
+    logits = h.astype(jnp.float32) @ params["fc"]["w"] + params["fc"]["b"]
+    if with_aux:
+        return logits, *aux_logits
+    return logits
+
+
 def init_convnet(key, cfg: ConvNetConfig):
+    if cfg.arch == "googlenet":
+        return _googlenet_init(key, cfg)
     flat_fin = _flatten_fin(cfg) if cfg.head == "flatten" else None
     params = []
     for row in _rows(cfg):
@@ -186,8 +332,18 @@ def init_convnet(key, cfg: ConvNetConfig):
     return params
 
 
-def convnet_apply(cfg: ConvNetConfig, params, x):
-    """``(B, H, W, 3)`` images → ``(B, num_classes)`` fp32 logits."""
+def convnet_apply(cfg: ConvNetConfig, params, x, with_aux: bool = False):
+    """``(B, H, W, 3)`` images → ``(B, num_classes)`` fp32 logits.
+
+    ``with_aux=True`` (GoogLeNet only) additionally returns the two
+    auxiliary-classifier logits ``(logits, aux_4a, aux_4d)`` — train with
+    ``main + 0.3·(aux_4a + aux_4d)`` per the Inception recipe."""
+    if cfg.arch == "googlenet":
+        return _googlenet_apply(cfg, params, x, with_aux)
+    if with_aux:
+        raise ValueError(
+            f"with_aux: arch {cfg.arch!r} has no auxiliary classifiers "
+            "(googlenet only)")
     cd = cfg.compute_dtype
     h = x.astype(cd)
     for row, p in zip(_rows(cfg), params):

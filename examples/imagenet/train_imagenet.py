@@ -53,7 +53,7 @@ def main():
     p.add_argument("--communicator", default="tpu_xla")
     p.add_argument("--arch", default="resnet50",
                    choices=["resnet50", "resnet101", "resnet152",
-                            "alex", "nin", "vgg16"],
+                            "alex", "nin", "vgg16", "googlenet"],
                    help="model architecture (reference --arch parity)")
     p.add_argument("--batchsize", type=int, default=256,
                    help="global batch size")
@@ -125,8 +125,18 @@ def main():
     else:
         params, state = init_convnet(jax.random.PRNGKey(0), cfg), None
 
-        def loss_fn(params, x, y):
-            return softmax_cross_entropy(convnet_apply(cfg, params, x), y)
+        if args.arch == "googlenet":
+            # Inception recipe: main + 0.3·(aux_4a + aux_4d)
+            def loss_fn(params, x, y):
+                logits, a1, a2 = convnet_apply(
+                    cfg, params, x, with_aux=True)
+                return (softmax_cross_entropy(logits, y)
+                        + 0.3 * (softmax_cross_entropy(a1, y)
+                                 + softmax_cross_entropy(a2, y)))
+        else:
+            def loss_fn(params, x, y):
+                return softmax_cross_entropy(
+                    convnet_apply(cfg, params, x), y)
 
     opt = cmn.create_multi_node_optimizer(
         optax.sgd(args.lr, momentum=0.9), comm,

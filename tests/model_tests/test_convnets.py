@@ -17,7 +17,7 @@ from chainermn_tpu.parallel import MeshConfig
 B, HW, C = 8, 32, 8
 
 
-@pytest.mark.parametrize("arch", ["alex", "nin", "vgg16"])
+@pytest.mark.parametrize("arch", ["alex", "nin", "vgg16", "googlenet"])
 def test_forward_shape(arch):
     cfg = ConvNetConfig(arch=arch, num_classes=C, dtype="float32",
                         head="gap")
@@ -84,8 +84,44 @@ def test_reference_flatten_head_parity(arch, fin):
     assert out.shape == (2, C)
 
 
+def test_googlenet_aux_heads():
+    """Reference geometry at 224px: aux taps flatten 4·4·128=2048, all
+    three logit sets have class shape (checked via eval_shape); with_aux
+    on other archs raises."""
+    cfg = ConvNetConfig(arch="googlenet", num_classes=C, dtype="float32")
+    params = init_convnet(jax.random.PRNGKey(0), cfg)
+    assert params["aux_4a"]["fc1"]["w"].shape == (2048, 1024)
+    assert params["fc"]["w"].shape == (1024, C)
+    outs = jax.eval_shape(
+        lambda p, x: convnet_apply(cfg, p, x, with_aux=True), params,
+        jax.ShapeDtypeStruct((2, 224, 224, 3), jnp.float32))
+    assert [o.shape for o in outs] == [(2, C)] * 3
+
+    with pytest.raises(ValueError, match="with_aux"):
+        convnet_apply(ConvNetConfig(arch="alex"), [], None, with_aux=True)
+
+
+def test_googlenet_gap_aux_small_input():
+    """Size-robust head: aux classifiers GAP (fc1 128->1024) and run at
+    32px with finite values."""
+    cfg = ConvNetConfig(arch="googlenet", num_classes=C, dtype="float32",
+                        head="gap")
+    params = init_convnet(jax.random.PRNGKey(0), cfg)
+    assert params["aux_4a"]["fc1"]["w"].shape == (128, 1024)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, HW, HW, 3),
+                    jnp.float32)
+    logits, a1, a2 = convnet_apply(cfg, params, x, with_aux=True)
+    for o in (logits, a1, a2):
+        assert o.shape == (2, C)
+        assert np.isfinite(np.asarray(o)).all()
+
+
 def test_flatten_head_rejects_collapsing_size():
     with pytest.raises(ValueError, match="collapses"):
         init_convnet(jax.random.PRNGKey(0),
                      ConvNetConfig(arch="alex", num_classes=C,
                                    image_size=32))
+    with pytest.raises(ValueError, match="224"):
+        init_convnet(jax.random.PRNGKey(0),
+                     ConvNetConfig(arch="googlenet", num_classes=C,
+                                   image_size=112))

@@ -246,6 +246,145 @@ def scenario_observation_aggregator(comm):
     assert abs(tr.observation["loss"] - expect) < 1e-9, tr.observation
 
 
+def scenario_split(comm):
+    """MPI_Comm_split analogue across processes: even/odd device split
+    produces working sub-communicators whose obj collectives stay inside
+    the split (the reference's split tests, SURVEY.md §4).  Run with ≥4
+    processes so each subgroup spans >1 process — the whole-world
+    multihost collectives would deadlock there; the KV group path must
+    carry them."""
+    ws = comm.size
+    colors = np.arange(ws) % 2
+    sub = comm.split(colors, np.arange(ws))
+    expect = [i for i in range(ws) if i % 2 == comm.rank % 2]
+    assert sub.size == len(expect), (sub.size, expect)
+    # sub-communicator topology: my rank within my color group
+    assert sub.rank == expect.index(comm.rank)
+    # obj collectives scope to the subgroup (distinct KV lanes per split)
+    vals = sub.allgather_obj(comm.rank)
+    assert vals == expect, (vals, expect)
+    # subgroup bcast: root is the subgroup's OWN rank 0 (global device
+    # rank expect[0]); both halves broadcast concurrently without
+    # cross-talk or deadlock
+    got = sub.bcast_obj(f"from{comm.rank}" if sub.rank == 0 else None,
+                        root=0)
+    assert got == f"from{expect[0]}", got
+    # repeated rounds: the lazy-GC key lifecycle must keep lanes ordered
+    for round_no in range(3):
+        red = sub.allreduce_obj({"r": float(comm.rank), "n": 1}, op="sum")
+        assert red == {"r": float(sum(expect)), "n": len(expect)}, red
+        sub.barrier()
+    # re-created communicator with the SAME member set: the incarnation
+    # counter must give it a fresh KV namespace (seq numbers restart at 0
+    # and must not read the first incarnation's still-live keys)
+    sub2 = comm.split(colors, np.arange(ws))
+    vals2 = sub2.allgather_obj(("fresh", comm.rank))
+    assert vals2 == [("fresh", p) for p in expect], vals2
+
+
+def scenario_snapshot(comm):
+    """multi_node_snapshot across real processes: writer rank persists
+    one logical snapshot, the barrier protects readers, and
+    load_snapshot restores it on EVERY process."""
+    from chainermn_tpu import multi_node_snapshot
+    from chainermn_tpu.extensions.snapshot import load_snapshot
+
+    class FakeUpdater:
+        def __init__(self):
+            self.iteration = 7
+            self.params = {"w": np.full(2, 3.25)}
+            self.opt_state = {"m": np.ones(2)}
+            self.state = None
+
+    class FakeTrainer:
+        def __init__(self, out):
+            self.updater = FakeUpdater()
+            self.out = out
+            self.observation = {}
+
+    out = comm.bcast_obj(
+        tempfile.mkdtemp(prefix="cmn_snap_") if comm.inter_rank == 0
+        else None, root=0)
+    snap = multi_node_snapshot(comm)
+    snap(FakeTrainer(out))          # writer writes snapshot_iter_7
+    fresh = FakeTrainer(out)
+    fresh.updater.iteration = 0
+    fresh.updater.params = {"w": np.zeros(2)}
+    it = load_snapshot(fresh.updater,
+                       os.path.join(out, "snapshot_iter_7"), fresh)
+    assert it == 7, it
+    np.testing.assert_allclose(fresh.updater.params["w"], 3.25)
+    comm.barrier()
+
+
+def scenario_allreduce_persistent(comm):
+    """BN-running-stats averaging across processes (the reference's
+    AllreducePersistentValues)."""
+    from chainermn_tpu.extensions import AllreducePersistentValues
+
+    class FakeUpdater:
+        def __init__(self, r):
+            self.params = {"persistent": {"bn_mean": np.full(3, float(r))}}
+
+    class FakeTrainer:
+        def __init__(self, r):
+            self.updater = FakeUpdater(r)
+
+    tr = FakeTrainer(comm.inter_rank)
+    AllreducePersistentValues(comm)(tr)
+    ws = comm.inter_size
+    np.testing.assert_allclose(
+        tr.updater.params["persistent"]["bn_mean"],
+        sum(range(ws)) / ws)
+
+
+def scenario_dp_train(comm):
+    """End-to-end: a jitted DP train step over the PROCESS-SPANNING mesh
+    — per-process batches, pmean'd grads, params provably in sync (the
+    reference's whole raison d'être, §3.1, across real processes)."""
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu import create_multi_node_optimizer
+
+    ws = comm.size
+    rng = np.random.RandomState(0)              # same on every process
+    w_true = rng.randn(4, 2).astype(np.float32)
+    xs = rng.randn(ws, 32, 4).astype(np.float32)
+    ys = np.einsum("rbi,ij->rbj", xs, w_true)
+
+    params = {"w": jnp.zeros((4, 2))}
+    opt = create_multi_node_optimizer(optax.sgd(0.2), comm)
+    state = jax.jit(opt.init)(params)
+
+    def step(p, s, x, y):
+        x, y = x[0], y[0]
+        loss, g = jax.value_and_grad(
+            lambda q: jnp.mean((x @ q["w"] - y) ** 2))(p)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, jax.lax.pmean(
+            loss, comm.axis_name)
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=comm.mesh,
+        in_specs=(P(), P(), P(comm.axis_name), P(comm.axis_name)),
+        out_specs=(P(), P(), P())))
+    # global batch sharded over the world: this process feeds its shard
+    sh = jax.sharding.NamedSharding(comm.mesh, P(comm.axis_name))
+    gx = jax.device_put(jnp.asarray(xs), sh)
+    gy = jax.device_put(jnp.asarray(ys), sh)
+    losses = []
+    for _ in range(60):
+        params, state, loss = f(params, state, gx, gy)
+        losses.append(float(jax.block_until_ready(loss)))
+    assert losses[-1] < 1e-2, losses[-1]
+    # every process must hold identical params
+    w_all = comm.allgather_obj(np.asarray(params["w"]).tolist())
+    for other in w_all[1:]:
+        assert other == w_all[0], "params diverged across processes"
+
+
 SCENARIOS = {
     name[len("scenario_"):]: fn
     for name, fn in list(globals().items())

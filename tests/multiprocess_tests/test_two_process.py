@@ -33,3 +33,17 @@ class TestTwoProcess:
 
     def test_observation_aggregator(self, mp_run):
         mp_run("observation_aggregator")
+
+    def test_split(self, mp_run):
+        # 4 processes: each even/odd subgroup spans 2 processes, forcing
+        # the KV group collectives (whole-world ones would deadlock)
+        mp_run("split", nprocs=4)
+
+    def test_snapshot(self, mp_run):
+        mp_run("snapshot")
+
+    def test_allreduce_persistent(self, mp_run):
+        mp_run("allreduce_persistent")
+
+    def test_dp_train_step(self, mp_run):
+        mp_run("dp_train")
