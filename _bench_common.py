@@ -1,0 +1,78 @@
+"""Shared plumbing for the root-level benchmark scripts.
+
+Both ``bench.py`` (ResNet-50 images/s) and ``bench_transformer.py``
+(LM tokens/s) need the same two pieces:
+
+- the per-chip peak bf16 FLOP/s table (MFU denominator), and
+- the hermetic child-process runner: the TPU backend on this host can
+  hang inside ``jax.devices()``, so measurements run in a child under a
+  hard timeout with bounded retries, and a failure still prints the ONE
+  required JSON line with an ``error`` field instead of an external
+  rc=124 and no record.
+"""
+
+import json
+import os
+import subprocess
+
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public
+# specs).  Unknown kinds report mfu=null.
+PEAK_FLOPS = [
+    ("v6", 918e12),       # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e reports as "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops(device_kind: str):
+    dk = device_kind.lower()
+    for key, peak in PEAK_FLOPS:
+        if key in dk:
+            return peak
+    return None
+
+
+def pin_platform(platform: str) -> None:
+    """Pin the child's JAX platform before any backend init."""
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
+def run_child_with_retries(cmd, cwd, timeouts, metric, unit) -> int:
+    """Run ``cmd`` under per-attempt timeouts until one prints a
+    ``BENCH_RESULT`` line; always print exactly one JSON line."""
+    errors = []
+    for attempt, budget in enumerate(timeouts):
+        try:
+            proc = subprocess.run(
+                cmd, timeout=budget, capture_output=True, text=True,
+                cwd=cwd)
+        except subprocess.TimeoutExpired:
+            errors.append(
+                f"attempt {attempt + 1}: timed out after {budget}s "
+                "(TPU backend init hang is the known failure mode here)")
+            continue
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("BENCH_RESULT "):
+                print(line[len("BENCH_RESULT "):])
+                return 0
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        errors.append(
+            f"attempt {attempt + 1}: rc={proc.returncode}, "
+            f"last output: {' | '.join(tail[-3:]) if tail else '<none>'}")
+    print(json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": unit,
+        "vs_baseline": None,
+        "error": "; ".join(errors)[-1800:],
+    }))
+    return 0

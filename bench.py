@@ -18,38 +18,19 @@ instead of an external rc=124 with no JSON at all.
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
+
+from _bench_common import peak_flops, pin_platform, run_child_with_retries
 
 BASELINE_IMG_S_PER_CHIP = 125.0
 METRIC = "resnet50_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
-# Used for the MFU denominator; unknown kinds report mfu=null.
-_PEAK_FLOPS = [
-    ("v6", 918e12),       # Trillium
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),  # v5e reports as "TPU v5 lite"
-    ("v5e", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
 # ResNet-50 @ 224x224: ~4.09e9 MACs forward per image => 8.18e9 FLOPs;
 # a train step (fwd + bwd ~= 2x fwd) is ~3x forward.  Fallback when the
 # compiled executable's own cost analysis is unavailable.
 _ANALYTIC_TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.089e9
-
-
-def _peak_flops(device_kind: str):
-    dk = device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in dk:
-            return peak
-    return None
 
 
 def make_step(mc, cfg, opt):
@@ -124,10 +105,11 @@ def run(batch=256, image=224, warmup=3, iters=10):
 
     for _ in range(warmup):
         params, state, opt_state, loss = step(params, state, opt_state, x, y)
-    # sync via host transfer: on the experimental axon platform
-    # block_until_ready() returns before execution finishes, so timing
-    # must anchor on a device->host copy of a value from the last step
-    float(loss)
+    if warmup:
+        # sync via host transfer: on the experimental axon platform
+        # block_until_ready() returns before execution finishes, so
+        # timing must anchor on a device->host copy from the last step
+        float(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -138,7 +120,7 @@ def run(batch=256, image=224, warmup=3, iters=10):
     img_s = batch * iters / dt
     step_ms = dt / iters * 1e3
     kind = jax.devices()[0].device_kind
-    peak = _peak_flops(kind)
+    peak = peak_flops(kind)
     mfu = (flops_per_step * iters / dt / peak) if peak else None
     return {
         "metric": METRIC,
@@ -154,11 +136,7 @@ def run(batch=256, image=224, warmup=3, iters=10):
 
 
 def _child_main(args):
-    if args.platform:
-        os.environ["JAX_PLATFORMS"] = args.platform
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
+    pin_platform(args.platform)
     result = run(batch=args.batch, image=args.image,
                  warmup=args.warmup, iters=args.iters)
     print("BENCH_RESULT " + json.dumps(result))
@@ -173,34 +151,8 @@ def _parent_main(args):
            "--warmup", str(args.warmup), "--iters", str(args.iters)]
     if args.platform:
         cmd += ["--platform", args.platform]
-
-    errors = []
-    for attempt, budget in enumerate(args.timeouts):
-        try:
-            proc = subprocess.run(
-                cmd, timeout=budget, capture_output=True, text=True,
-                cwd=os.path.dirname(here))
-        except subprocess.TimeoutExpired:
-            errors.append(
-                f"attempt {attempt + 1}: timed out after {budget}s "
-                "(TPU backend init hang is the known failure mode here)")
-            continue
-        for line in reversed(proc.stdout.splitlines()):
-            if line.startswith("BENCH_RESULT "):
-                print(line[len("BENCH_RESULT "):])
-                return 0
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        errors.append(
-            f"attempt {attempt + 1}: rc={proc.returncode}, "
-            f"last output: {' | '.join(tail[-3:]) if tail else '<none>'}")
-    print(json.dumps({
-        "metric": METRIC,
-        "value": None,
-        "unit": UNIT,
-        "vs_baseline": None,
-        "error": "; ".join(errors)[-1800:],
-    }))
-    return 0
+    return run_child_with_retries(
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
 
 
 def _parse_args(argv):

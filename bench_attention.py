@@ -15,9 +15,10 @@ init on this host can hang).
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
+
+from _bench_common import pin_platform, run_child_with_retries
 
 METRIC = "flash_attention_8k_speedup_vs_xla"
 UNIT = "x"
@@ -76,11 +77,7 @@ def main(argv):
     args = p.parse_args(argv)
 
     if args.child:
-        if args.platform:
-            os.environ["JAX_PLATFORMS"] = args.platform
-            import jax
-
-            jax.config.update("jax_platforms", args.platform)
+        pin_platform(args.platform)
         print("BENCH_RESULT " + json.dumps(
             run(batch=args.batch, seq=args.seq, iters=args.iters)))
         return 0
@@ -90,25 +87,8 @@ def main(argv):
            "--batch", str(args.batch), "--iters", str(args.iters)]
     if args.platform:
         cmd += ["--platform", args.platform]
-    errors = []
-    for attempt, budget in enumerate(args.timeouts):
-        try:
-            proc = subprocess.run(cmd, timeout=budget, capture_output=True,
-                                  text=True, cwd=os.path.dirname(here))
-        except subprocess.TimeoutExpired:
-            errors.append(f"attempt {attempt + 1}: timed out after {budget}s")
-            continue
-        for line in reversed(proc.stdout.splitlines()):
-            if line.startswith("BENCH_RESULT "):
-                print(line[len("BENCH_RESULT "):])
-                return 0
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        errors.append(f"attempt {attempt + 1}: rc={proc.returncode}, "
-                      f"{' | '.join(tail[-3:]) if tail else '<none>'}")
-    print(json.dumps({"metric": METRIC, "value": None, "unit": UNIT,
-                      "vs_baseline": None,
-                      "error": "; ".join(errors)[-1800:]}))
-    return 0
+    return run_child_with_retries(
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
 
 
 if __name__ == "__main__":

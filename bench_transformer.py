@@ -16,34 +16,18 @@ timeout/retry pattern as bench.py (the TPU backend init can hang).
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
+
+from _bench_common import peak_flops, pin_platform, run_child_with_retries
 
 METRIC = "transformer_train_tokens_per_sec_per_chip"
 UNIT = "tokens/sec/chip"
 
-_PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
-
-def _peak_flops(device_kind: str):
-    dk = device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in dk:
-            return peak
-    return None
-
 
 def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
-        n_kv_heads=0, warmup=3, iters=10, attention="flash"):
+        n_kv_heads=0, warmup=3, iters=10, attention="flash",
+        remat_policy="full"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -62,7 +46,7 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         # remat: the production setting — without it this 335M config's
         # activations alone overflow a 16G-HBM chip (20.3G requested).
         # MFU still counts model FLOPs (6PT), not the recompute.
-        remat=True,
+        remat=True, remat_policy=remat_policy,
     )
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
     params = shard_params(
@@ -85,7 +69,9 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
 
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, x, y)
-    float(loss)  # device->host sync (axon quirk: block_until_ready lies)
+    if warmup:
+        # device->host sync (axon quirk: block_until_ready lies)
+        float(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -95,7 +81,7 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
 
     tok_s = tokens_per_step * iters / dt
     kind = jax.devices()[0].device_kind
-    peak = _peak_flops(kind)
+    peak = peak_flops(kind)
     mfu = (flops_per_step * iters / dt / peak) if peak else None
     return {
         "metric": METRIC,
@@ -109,20 +95,18 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         "n_params": int(n_params),
         "attention": attention,
         "n_kv_heads": n_kv_heads,
+        "remat_policy": remat_policy,
         "loss": round(float(loss), 3),
     }
 
 
 def _child_main(args):
-    if args.platform:
-        os.environ["JAX_PLATFORMS"] = args.platform
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
+    pin_platform(args.platform)
     result = run(batch=args.batch, seq=args.seq, d_model=args.d_model,
                  n_layers=args.n_layers, n_heads=args.n_heads,
                  n_kv_heads=args.n_kv_heads, warmup=args.warmup,
-                 iters=args.iters, attention=args.attention)
+                 iters=args.iters, attention=args.attention,
+                 remat_policy=args.remat_policy)
     print("BENCH_RESULT " + json.dumps(result))
 
 
@@ -135,33 +119,12 @@ def _parent_main(args):
            "--n-heads", str(args.n_heads),
            "--n-kv-heads", str(args.n_kv_heads),
            "--warmup", str(args.warmup), "--iters", str(args.iters),
-           "--attention", args.attention]
+           "--attention", args.attention,
+           "--remat-policy", args.remat_policy]
     if args.platform:
         cmd += ["--platform", args.platform]
-
-    errors = []
-    for attempt, budget in enumerate(args.timeouts):
-        try:
-            proc = subprocess.run(
-                cmd, timeout=budget, capture_output=True, text=True,
-                cwd=os.path.dirname(here))
-        except subprocess.TimeoutExpired:
-            errors.append(f"attempt {attempt + 1}: timed out after "
-                          f"{budget}s")
-            continue
-        for line in reversed(proc.stdout.splitlines()):
-            if line.startswith("BENCH_RESULT "):
-                print(line[len("BENCH_RESULT "):])
-                return 0
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        errors.append(
-            f"attempt {attempt + 1}: rc={proc.returncode}, "
-            f"last output: {' | '.join(tail[-3:]) if tail else '<none>'}")
-    print(json.dumps({
-        "metric": METRIC, "value": None, "unit": UNIT,
-        "vs_baseline": None, "error": "; ".join(errors)[-1800:],
-    }))
-    return 0
+    return run_child_with_retries(
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
 
 
 def _parse_args(argv):
@@ -177,6 +140,8 @@ def _parse_args(argv):
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--attention", default="flash",
                    choices=["flash", "local", "ring", "ulysses"])
+    p.add_argument("--remat-policy", default="full",
+                   choices=["full", "dots"])
     p.add_argument("--platform", default=None)
     p.add_argument("--timeouts", type=int, nargs="+", default=[480, 420])
     return p.parse_args(argv)

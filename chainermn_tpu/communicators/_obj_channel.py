@@ -93,11 +93,14 @@ class KVObjectChannel:
         client.key_value_set(keyfn("meta"), f"{nframes},{len(payload)}")
         return nframes
 
-    def _collect(self, keyfn, what: str) -> Any:
-        """Blocking read of a message published by :meth:`_publish`."""
+    def _collect(self, keyfn, what: str, meta: str = None) -> Any:
+        """Blocking read of a message published by :meth:`_publish`.
+        Pass ``meta`` when the caller already fetched the metadata key
+        (recv's retry-safe existence check) to save a KV round-trip."""
         client = self._client
-        meta = client.blocking_key_value_get(
-            keyfn("meta"), self._timeout_ms)
+        if meta is None:
+            meta = client.blocking_key_value_get(
+                keyfn("meta"), self._timeout_ms)
         nframes, total = (int(v) for v in meta.split(","))
         buf = bytearray()
         for k in range(nframes):
@@ -164,7 +167,8 @@ class KVObjectChannel:
         self._recv_seq[(src, dst)] = seq + 1
         nframes = int(meta.split(",")[0])
         obj = self._collect(
-            lambda part: self._key(src, dst, seq, part), "obj channel")
+            lambda part: self._key(src, dst, seq, part), "obj channel",
+            meta=meta)
         for k in range(nframes):
             client.key_value_delete(self._key(src, dst, seq, f"c{k}"))
         client.key_value_delete(self._key(src, dst, seq, "meta"))

@@ -323,9 +323,15 @@ class TpuXlaCommunicator(CommunicatorBase):
         if self._obj_local:
             return obj
         if self._obj_subgroup:
+            # only the root's payload matters: non-roots contribute None
+            # so the KV store carries ONE copy (and a non-root's large
+            # local object can't trip the size cap, matching the
+            # whole-world path's source-only pickling)
+            root_proc = self._root_process(root)
             objs = self._obj_channel.allgather(
-                obj, self._member_procs, jax.process_index())
-            return objs[self._member_procs.index(self._root_process(root))]
+                obj if jax.process_index() == root_proc else None,
+                self._member_procs, jax.process_index())
+            return objs[self._member_procs.index(root_proc)]
         from jax.experimental import multihost_utils
 
         is_src = self.inter_rank == self._root_process(root)

@@ -38,7 +38,11 @@ from chainermn_tpu.ops.pallas_attention import (
     flash_attention_supported,
 )
 from chainermn_tpu.parallel.expert import expert_parallel_moe
-from chainermn_tpu.parallel.pipeline import pipeline_apply, pipeline_train_1f1b
+from chainermn_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_train_1f1b,
+    pipeline_train_interleaved,
+)
 from chainermn_tpu.parallel.ring_attention import (
     _block_positions,
     broadcast_kv,
@@ -80,8 +84,15 @@ class TransformerConfig:
     n_experts: int = 8         # global expert count (moe=True)
     capacity_factor: float = 1.25
     num_microbatches: int = 1  # GPipe M (>1 only useful when pipe > 1)
-    pipeline_schedule: str = "gpipe"  # "gpipe" | "1f1b" (train step only)
+    pipeline_schedule: str = "gpipe"  # "gpipe" | "1f1b" | "interleaved"
+    virtual_pipe: int = 1      # V model chunks per pipe device (Megatron
+    # interleaved schedule: bubble ÷~V for V× activation stash + ring
+    # traffic); >1 requires pipeline_schedule="interleaved"
     remat: bool = True
+    remat_policy: str = "full"  # "full" | "dots": with "dots" the block
+    # checkpoint saves matmul outputs (jax dots_with_no_batch_dims_saveable)
+    # and recomputes only the cheap elementwise/norm ops — most of full
+    # remat's memory saving at a fraction of its ~33% recompute cost
     dtype: str = "bfloat16"    # compute dtype (params stay fp32)
 
     @property
@@ -92,7 +103,28 @@ class TransformerConfig:
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
 
+    @property
+    def checkpoint_fn(self):
+        """The configured ``jax.checkpoint`` wrapper (identity when
+        ``remat=False``)."""
+        if not self.remat:
+            return lambda f: f
+        if self.remat_policy == "dots":
+            return partial(
+                jax.checkpoint,
+                policy=jax.checkpoint_policies.
+                dots_with_no_batch_dims_saveable)
+        return jax.checkpoint
+
     def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy {self.remat_policy!r} not in (full, dots)")
+        if self.virtual_pipe > 1 and self.pipeline_schedule != "interleaved":
+            raise ValueError(
+                f"virtual_pipe={self.virtual_pipe} needs "
+                'pipeline_schedule="interleaved" (got '
+                f"{self.pipeline_schedule!r})")
         if not 0 <= self.n_kv_heads <= self.n_heads:
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must be in "
@@ -139,20 +171,32 @@ def _init_block(key, cfg: TransformerConfig):
 
 
 def init_transformer(key, cfg: TransformerConfig, pipe_size: int = 1):
-    """Parameter pytree.  Blocks are stacked ``(pipe_size, L/pipe, ...)`` —
-    the leading axis shards over ``pipe``, the second is scanned locally."""
-    if cfg.n_layers % pipe_size:
+    """Parameter pytree.  Blocks are stacked ``(pipe_size, L/pipe, ...)``
+    — the leading axis shards over ``pipe``, the second is scanned
+    locally.  With ``virtual_pipe = V > 1`` the block stack is
+    ``(pipe_size, V, L/(pipe·V), ...)``: chunk ``c`` of device ``s`` is
+    virtual stage ``g = c·pipe + s`` holding the ``g``-th layer slice
+    (Megatron interleaved assignment)."""
+    V = cfg.virtual_pipe
+    if cfg.n_layers % (pipe_size * V):
         raise ValueError(
-            f"{cfg.n_layers} layers not divisible by pipe={pipe_size}")
+            f"{cfg.n_layers} layers not divisible by "
+            f"pipe·virtual_pipe = {pipe_size}·{V}")
     k_emb, k_pos, k_blocks = jax.random.split(key, 3)
     blocks = [
         _init_block(k, cfg)
         for k in jax.random.split(k_blocks, cfg.n_layers)
     ]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
-    lps = cfg.n_layers // pipe_size
-    stacked = jax.tree.map(
-        lambda a: a.reshape(pipe_size, lps, *a.shape[1:]), stacked)
+    if V > 1:
+        lpc = cfg.n_layers // (pipe_size * V)  # layers per chunk
+        stacked = jax.tree.map(
+            lambda a: a.reshape(V, pipe_size, lpc, *a.shape[1:])
+            .swapaxes(0, 1), stacked)
+    else:
+        lps = cfg.n_layers // pipe_size
+        stacked = jax.tree.map(
+            lambda a: a.reshape(pipe_size, lps, *a.shape[1:]), stacked)
     D = cfg.d_model
     return {
         "embed": jax.random.normal(
@@ -188,6 +232,10 @@ def param_specs(cfg: TransformerConfig):
     else:
         blk["w1"] = P("pipe", None, None, "model")
         blk["w2"] = P("pipe", None, "model", None)
+    if cfg.virtual_pipe > 1:
+        # blocks carry an extra local chunk axis after pipe: (pipe, V,
+        # layers_per_chunk, ...) — replicate over it, shift the rest
+        blk = {k: P(v[0], None, *v[1:]) for k, v in blk.items()}
     return {
         "embed": P(),
         "pos": P(),
@@ -370,7 +418,27 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
     h = (h + pos).astype(cd)
 
     S = lax.axis_size("pipe")
-    if S > 1 or cfg.num_microbatches > 1:
+    if cfg.virtual_pipe > 1:
+        # forward-only traversal of the V chunk rings: chunk c of every
+        # device runs as one GPipe pass; the next chunk's pass consumes
+        # its output (virtual stage order g = c·S + s is preserved).
+        # The interleaved schedule proper only matters when backward
+        # timing is involved — make_train_step uses it.
+        aux = jnp.zeros((), jnp.float32)
+        for c in range(cfg.virtual_pipe):
+            chunk = jax.tree.map(lambda a: a[:, c], params["blocks"])
+            h, a = pipeline_apply(
+                partial(_stage, cfg),
+                chunk,
+                h,
+                axis_name="pipe",
+                num_microbatches=cfg.num_microbatches,
+                remat=cfg.remat,
+                with_aux=True,
+                checkpoint_fn=cfg.checkpoint_fn,
+            )
+            aux = aux + a
+    elif S > 1 or cfg.num_microbatches > 1:
         h, aux = pipeline_apply(
             partial(_stage, cfg),
             params["blocks"],
@@ -379,6 +447,7 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
             num_microbatches=cfg.num_microbatches,
             remat=cfg.remat,
             with_aux=True,
+            checkpoint_fn=cfg.checkpoint_fn,
         )
     else:
         blocks = jax.tree.map(
@@ -386,8 +455,7 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
 
         def body(carry, blk):
             h, aux = carry
-            fn = jax.checkpoint(partial(_block, cfg)) if cfg.remat \
-                else partial(_block, cfg)
+            fn = cfg.checkpoint_fn(partial(_block, cfg))
             h, a = fn(h, blk)
             return (h, aux + a), None
 
@@ -467,9 +535,15 @@ def _make_1f1b_grad(cfg: TransformerConfig):
             return nll.mean()
 
         lp = {"ln_f": params["ln_f"], "embed": params["embed"]}
-        loss, g_blocks, g_lp, dx = pipeline_train_1f1b(
-            stage_fn, loss_fn, params["blocks"], lp, h, targets,
-            axis_name="pipe", num_microbatches=cfg.num_microbatches)
+        if cfg.pipeline_schedule == "interleaved":
+            loss, g_blocks, g_lp, dx = pipeline_train_interleaved(
+                stage_fn, loss_fn, params["blocks"], lp, h, targets,
+                axis_name="pipe", num_microbatches=cfg.num_microbatches,
+                num_chunks=cfg.virtual_pipe)
+        else:
+            loss, g_blocks, g_lp, dx = pipeline_train_1f1b(
+                stage_fn, loss_fn, params["blocks"], lp, h, targets,
+                axis_name="pipe", num_microbatches=cfg.num_microbatches)
         (d_ep,) = vjp_embed(dx)
 
         grads = {
@@ -573,7 +647,7 @@ def make_train_step(mesh_cfg, cfg: TransformerConfig, optimizer):
     _check_mesh(mesh_cfg, cfg)
     specs = param_specs(cfg)
 
-    if cfg.pipeline_schedule == "1f1b":
+    if cfg.pipeline_schedule in ("1f1b", "interleaved"):
         grad_body = _make_1f1b_grad(cfg)
     elif cfg.pipeline_schedule == "gpipe":
         grad_body = lambda p, x, y: jax.value_and_grad(
@@ -581,7 +655,7 @@ def make_train_step(mesh_cfg, cfg: TransformerConfig, optimizer):
                 lm_loss(cfg, q, x, y), ("data", "expert", "seq")))(p)
     else:
         raise ValueError(
-            f"pipeline_schedule must be gpipe|1f1b, "
+            f"pipeline_schedule must be gpipe|1f1b|interleaved, "
             f"got {cfg.pipeline_schedule!r}")
 
     grad_fn = jax.shard_map(

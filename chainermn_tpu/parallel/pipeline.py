@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["stack_stage_params", "pipeline_apply", "pipeline_train_1f1b",
-           "unstack_stage_params"]
+           "pipeline_train_interleaved", "unstack_stage_params"]
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2))
@@ -87,6 +87,7 @@ def pipeline_apply(
     num_microbatches: int,
     remat: bool = True,
     with_aux: bool = False,
+    checkpoint_fn: Callable = None,
 ):
     """Run the GPipe schedule.  Call INSIDE ``shard_map`` over ``axis_name``.
 
@@ -103,6 +104,8 @@ def pipeline_apply(
         micro-batches big enough to fill the MXU.
       remat: rematerialise each stage application in backward (GPipe's
         memory trick: store only stage boundaries, recompute inside).
+      checkpoint_fn: override the remat wrapper (e.g. a policied
+        ``jax.checkpoint`` saving matmul outputs); ignores ``remat``.
       with_aux: ``stage_fn`` returns ``(mb, aux_scalar)``; per-microbatch
         aux values from REAL ticks (not drain garbage) are summed over
         stages and averaged over micro-batches, and the call returns
@@ -129,7 +132,9 @@ def pipeline_apply(
 
     raw_fn = stage_fn if with_aux else (
         lambda p, mb: (stage_fn(p, mb), jnp.zeros((), jnp.float32)))
-    fn = jax.checkpoint(raw_fn) if remat else raw_fn
+    if checkpoint_fn is None:
+        checkpoint_fn = jax.checkpoint if remat else (lambda f: f)
+    fn = checkpoint_fn(raw_fn)
 
     up_perm = [(i, i + 1) for i in range(S - 1)]
 
@@ -319,4 +324,235 @@ def pipeline_train_1f1b(
     glp = jax.tree.map(lambda a: lax.psum(a, axis_name) / M, glp)
     dx = lax.psum(dx_bank, axis_name).reshape(B, *x.shape[1:]) / M
     gp = jax.tree.map(lambda a: a[None] / M, gp)  # restore stage axis
+    return loss, gp, glp, dx
+
+
+# --------------------------------------------------------------------- #
+# Interleaved 1F1B (virtual pipeline stages)
+# --------------------------------------------------------------------- #
+
+
+def _interleaved_tables(S: int, V: int, M: int):
+    """Static tick tables for the interleaved 1F1B schedule.
+
+    Device ``s`` holds ``V`` model chunks; virtual stage ``g = c·S + s``
+    is chunk ``c`` on device ``s``.  Per Megatron's schedule, device
+    ``s``'s forward slot ``k`` handles micro-batch
+    ``(k // (S·V))·S + k % S`` of chunk ``(k % (S·V)) // S``; backward
+    slots mirror it with chunks reversed, delayed by the warmup
+    ``(S−s−1)·2 + (V−1)·S``.  Staggering device ``s``'s slot sequence by
+    ``s`` ticks makes EVERY data dependency (chain, ring wrap, and the
+    last virtual stage's same-tick loss seed) exactly one ring hop one
+    tick earlier — verified by assertion below, so a schedule bug fails
+    loudly at trace time instead of silently mis-wiring activations.
+
+    Returns ``(T, f_act, f_m, f_c, b_act, b_m, b_c, K)`` — tick count,
+    ``(S, T)`` activity/micro-batch/chunk tables, and the stash ring
+    depth (exact max in-flight per chunk, so ``m % K`` slots never
+    collide).
+    """
+    import numpy as np
+
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs micro-batches ({M}) divisible "
+            f"by the pipe axis ({S})")
+    SV, MV = S * V, M * V
+    T = 2 * (S - 1) + (V - 1) * S + MV
+    f_act = np.zeros((S, T), bool)
+    b_act = np.zeros((S, T), bool)
+    f_m = np.zeros((S, T), np.int32)
+    f_c = np.zeros((S, T), np.int32)
+    b_m = np.zeros((S, T), np.int32)
+    b_c = np.zeros((S, T), np.int32)
+    for s in range(S):
+        w = (S - s - 1) * 2 + (V - 1) * S
+        for t in range(T):
+            k = t - s
+            if 0 <= k < MV:
+                p = k % SV
+                f_act[s, t] = True
+                f_m[s, t] = (k // SV) * S + p % S
+                f_c[s, t] = p // S
+            j = t - s - w
+            if 0 <= j < MV:
+                p = j % SV
+                b_act[s, t] = True
+                b_m[s, t] = (j // SV) * S + p % S
+                b_c[s, t] = V - 1 - p // S
+
+    # self-verify every dependency = one ring hop, one tick earlier
+    # (explicit raise, not assert: the fail-loudly promise must survive
+    # python -O)
+    def _dep(cond, what, s, t):
+        if not cond:
+            raise RuntimeError(
+                f"interleaved schedule: {what} dependency broken at "
+                f"device {s} tick {t} (S={S} V={V} M={M})")
+
+    for s in range(S):
+        for t in range(T):
+            if f_act[s, t] and not (s == 0 and f_c[s, t] == 0):
+                ps, pc = (s - 1) % S, f_c[s, t] - (1 if s == 0 else 0)
+                _dep(f_act[ps, t - 1] and f_m[ps, t - 1] == f_m[s, t]
+                     and f_c[ps, t - 1] == pc, "forward", s, t)
+            if b_act[s, t] and not (s == S - 1 and b_c[s, t] == V - 1):
+                ns = (s + 1) % S
+                nc = b_c[s, t] + (1 if s == S - 1 else 0)
+                _dep(b_act[ns, t - 1] and b_m[ns, t - 1] == b_m[s, t]
+                     and b_c[ns, t - 1] == nc, "backward", s, t)
+            if b_act[s, t] and s == S - 1 and b_c[s, t] == V - 1:
+                # loss seed: forward of the same (m, chunk) this tick or
+                # earlier on this device
+                m = b_m[s, t]
+                _dep(any(f_act[s, tt] and f_m[s, tt] == m
+                         and f_c[s, tt] == V - 1
+                         for tt in range(t + 1)), "loss-seed", s, t)
+
+    # exact stash requirement: max concurrent (t_fwd..t_bwd) intervals
+    # per (device, chunk); in-flight micro-batches are consecutive, so a
+    # ring of that depth indexed by m % K cannot collide
+    K = 1
+    for s in range(S):
+        for c in range(V):
+            events = []
+            for t in range(T):
+                if f_act[s, t] and f_c[s, t] == c:
+                    events.append((t, 1))
+                if b_act[s, t] and b_c[s, t] == c:
+                    events.append((t + 1, -1))
+            live = peak = 0
+            for t, d in sorted(events):
+                live += d
+                peak = max(peak, live)
+            K = max(K, peak)
+    return T, f_act, f_m, f_c, b_act, b_m, b_c, K
+
+
+def pipeline_train_interleaved(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    loss_params,
+    x,
+    targets,
+    *,
+    axis_name: str = "pipe",
+    num_microbatches: int,
+    num_chunks: int,
+):
+    """Interleaved 1F1B (Megatron virtual pipeline stages), one SPMD scan.
+
+    Each device holds ``num_chunks`` (V) model chunks instead of one
+    contiguous stage; micro-batches traverse the ``S·V`` virtual stages
+    by looping the ring ``V`` times.  The fill/drain bubble shrinks from
+    ``2(S−1)`` model-ticks to ``(2(S−1) + (V−1)S)/V`` — the interleaving
+    trade: ~``V``× less bubble for ``V``× the activation stash and ring
+    traffic.  ``V = 1`` reduces exactly to :func:`pipeline_train_1f1b`'s
+    schedule.
+
+    Args:
+      stage_fn: ``stage_fn(chunk_params, mb) -> mb`` — ONE chunk's
+        computation (shape-preserving).
+      loss_fn: ``loss_fn(loss_params, y, tgt) -> scalar`` on the LAST
+        virtual stage's output.
+      stage_params: this device's chunk weights with leading axes
+        ``(1, V, ...)`` — axis 0 is the sharded pipe axis, axis 1 the
+        local chunk axis (global virtual stage ``g = c·S + s``; pack
+        with ``blocks.reshape(V, S, ...).swapaxes(0, 1)`` so chunk ``c``
+        of device ``s`` holds the right layer slice).
+      x / targets: full local batch ``(B, ...)``.
+
+    Returns ``(loss, stage_grads, loss_grads, dx)`` with the same
+    conventions as :func:`pipeline_train_1f1b`.
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M, V = num_microbatches, num_chunks
+    is_last_dev = stage == S - 1
+
+    params = jax.tree.map(lambda a: jnp.squeeze(a, axis=0), stage_params)
+    pv = jax.tree.leaves(params)[0].shape[0]
+    if pv != V:
+        raise ValueError(
+            f"stage_params chunk axis is {pv}, expected num_chunks={V}")
+
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mbs = x.reshape(M, B // M, *x.shape[1:])
+    tgts = targets.reshape(M, B // M, *targets.shape[1:])
+
+    T, f_act, f_m, f_c, b_act, b_m, b_c, K = _interleaved_tables(
+        int(S), V, M)
+    tbl = [jnp.asarray(a) for a in (f_act, f_m, f_c, b_act, b_m, b_c)]
+    up_perm = [(i, (i + 1) % S) for i in range(S)]
+    down_perm = [((i + 1) % S, i) for i in range(S)]
+
+    def chunk_params(c):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            params)
+
+    def tick(carry, t):
+        act, ct, stash, gp, glp, dx_bank, loss_acc = carry
+        fa, fm, fc, ba, bm, bc = (a[stage, t] for a in tbl)
+
+        # ---- forward slot ------------------------------------------- #
+        recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
+        inject = (stage == 0) & (fc == 0)
+        inp = jnp.where(inject, mbs[fm], recv)
+        y = stage_fn(chunk_params(fc), inp)
+        stash = jnp.where(
+            fa,
+            lax.dynamic_update_index_in_dim(
+                stash, inp[None], fc * K + fm % K, 0),
+            stash)
+
+        # ---- backward slot ------------------------------------------ #
+        ct_recv = lax.ppermute(ct, axis_name, perm=down_perm) \
+            if S > 1 else ct
+        inp_b = stash[bc * K + bm % K]
+        tgt_b = tgts[bm]
+        seed = is_last_dev & (bc == V - 1)
+
+        def composite(p, lp, xin):
+            yy = stage_fn(p, xin)
+            return yy, loss_fn(lp, yy, tgt_b)
+
+        (_, l_b), vjp = jax.vjp(
+            composite, chunk_params(bc), loss_params, inp_b)
+        ct_y = jnp.where(seed, jnp.zeros_like(ct_recv), ct_recv)
+        ct_l = jnp.where(seed, 1.0, 0.0).astype(l_b.dtype) + l_b * 0
+        dpc, dlp, dx = vjp((ct_y, ct_l))
+
+        gp = jax.tree.map(
+            lambda G, d: G.at[bc].add(
+                jnp.where(ba, d, jnp.zeros_like(d))), gp, dpc)
+        glp = jax.tree.map(
+            lambda G, d: G + jnp.where(ba & seed, d, jnp.zeros_like(d)),
+            glp, dlp)
+        bank = ba & (stage == 0) & (bc == 0)
+        dx_bank = jnp.where(
+            bank,
+            lax.dynamic_update_index_in_dim(dx_bank, dx, bm, 0),
+            dx_bank)
+        loss_acc = loss_acc + jnp.where(ba & seed, l_b, 0.0)
+        return (y, dx, stash, gp, glp, dx_bank, loss_acc), None
+
+    mb0 = lax.pcast(mbs[0] * 0, (axis_name,), to="varying")
+    stash0 = jnp.broadcast_to(mb0, (V * K, *mb0.shape)) * 1
+    gp0 = jax.tree.map(lambda a: a * 0, params)
+    glp0 = jax.tree.map(
+        lambda a: lax.pcast(a * 0, (axis_name,), to="varying"), loss_params)
+    dx0 = lax.pcast(mbs * 0, (axis_name,), to="varying")
+    loss0 = jnp.sum(mb0 * 0, dtype=jnp.float32)
+
+    (_, _, _, gp, glp, dx_bank, loss_acc), _ = lax.scan(
+        tick, (mb0, mb0, stash0, gp0, glp0, dx0, loss0), jnp.arange(T))
+
+    loss = lax.psum(loss_acc, axis_name) / M
+    glp = jax.tree.map(lambda a: lax.psum(a, axis_name) / M, glp)
+    dx = lax.psum(dx_bank, axis_name).reshape(B, *x.shape[1:]) / M
+    gp = jax.tree.map(lambda a: a[None] / M, gp)  # restore pipe axis
     return loss, gp, glp, dx

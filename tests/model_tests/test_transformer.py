@@ -72,6 +72,41 @@ def test_forward_matches_oracle(axes):
         np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+def test_remat_policy_grad_equivalence():
+    """remat_policy='dots' must be a pure scheduling choice: grads equal
+    the remat='full' and remat=False paths bit-for-bit (fp32)."""
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.models.transformer import lm_loss, param_specs
+
+    toks = tokens()
+    x, y = toks[:, :T], toks[:, 1:]
+    batch_spec = P(("data", "expert"), "seq")
+    one = MeshConfig(data=1, devices=jax.devices()[:1])
+    grads = {}
+    for name, kw in (("none", dict(remat=False)),
+                     ("full", dict(remat=True)),
+                     ("dots", dict(remat=True, remat_policy="dots"))):
+        cfg = tiny_cfg(**kw)
+        params = init_transformer(jax.random.PRNGKey(0), cfg)
+        specs = param_specs(cfg)
+        grad_fn = jax.jit(jax.shard_map(
+            lambda p, xx, yy: jax.grad(
+                lambda q: lm_loss(cfg, q, xx, yy))(p),
+            mesh=one.mesh,
+            in_specs=(specs, batch_spec, batch_spec),
+            out_specs=specs))
+        grads[name] = grad_fn(params, x, y)
+    for name in ("full", "dots"):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6),
+            grads["none"], grads[name])
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        tiny_cfg(remat_policy="everything")
+
+
 def test_ulysses_matches_oracle():
     cfg = tiny_cfg(attention="ulysses")
     params = init_transformer(jax.random.PRNGKey(0), cfg)
