@@ -1,0 +1,217 @@
+"""Autoregressive decoding with a KV cache for the flagship transformer.
+
+Beyond-reference breadth: the reference's only generation path was the
+seq2seq example's greedy LSTM translate loop (reference:
+``examples/seq2seq/seq2seq.py`` ``translate``, unverified — mount empty,
+see SURVEY.md).  This is the transformer equivalent, TPU-first:
+
+- ONE jitted program: prefill + generate is a single ``lax.scan`` over
+  time steps (no per-token Python dispatch, static shapes throughout —
+  the token buffer and cache are ``max_len``-sized from the start);
+- the KV cache is stored at the model's **shared-head width** (GQA/MQA:
+  ``n_kv_heads``, not ``n_heads``) — exactly the H/Hkv memory saving
+  that motivates GQA at inference; the grouped-einsum attention cores
+  (:func:`...ring_attention._qk_scores`) read it in place;
+- composes with DP (batch over ``data``) and TP (heads over ``model``)
+  meshes; the decode step is seq-length-1 so SP/PP are out of scope
+  (``seq``/``pipe`` axes must be 1 — raise early, not mid-trace).
+
+Greedy (``temperature=0``) or temperature sampling.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.parallel.ring_attention import _pv_mix, _qk_scores
+from chainermn_tpu.parallel.tensor import (
+    column_parallel_dense,
+    row_parallel_dense,
+)
+
+from .transformer import TransformerConfig, _rms_norm, param_specs
+
+__all__ = ["make_generate_fn"]
+
+_NEG = -1e30
+
+
+def _vary(x, *axes):
+    """Mark ``x`` varying over ``axes`` (no-op for already-varying) —
+    block params are pipe-sharded even at pipe size 1, so everything they
+    touch must carry the pipe axis in its vma type."""
+    need = tuple(a for a in axes if a not in jax.typeof(x).vma)
+    return lax.pcast(x, need, to="varying") if need else x
+
+
+def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
+    """One block for ONE new token.  ``h``: (B, 1, D); ``ck``/``cv``:
+    (B, max_len, Hkv_local, Dh) this layer's cache; ``pos``: scalar
+    position of the new token.  Returns (h, ck, cv)."""
+    cd = cfg.compute_dtype
+    x = _rms_norm(h, blk["ln1"])
+    B, _, D = x.shape
+    if "wqkv" in blk:
+        Hl = blk["wqkv"].shape[2]
+        qkv = column_parallel_dense(x, blk["wqkv"].reshape(D, -1).astype(cd))
+        qkv = qkv.reshape(B, 1, 3, Hl, cfg.d_head)
+        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    else:
+        Hl = blk["wq"].shape[1]
+        Hkvl = blk["wkv"].shape[2]
+        q = column_parallel_dense(
+            x, blk["wq"].reshape(D, -1).astype(cd)
+        ).reshape(B, 1, Hl, cfg.d_head)
+        kv = column_parallel_dense(
+            x, blk["wkv"].reshape(D, -1).astype(cd)
+        ).reshape(B, 1, 2, Hkvl, cfg.d_head)
+        k_new, v_new = kv[:, :, 0], kv[:, :, 1]
+    ck = lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
+                                  (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
+                                  (0, pos, 0, 0))
+    # grouped attention of the 1-token query against the whole cache,
+    # masked to positions <= pos (static max_len shape)
+    s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
+    allow = jnp.arange(ck.shape[1]) <= pos                # (max_len,)
+    s = jnp.where(allow[None, None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)   # (B,1,Hl,Dh)
+    h = h + row_parallel_dense(
+        o.reshape(B, 1, -1), blk["wo"].reshape(-1, D).astype(cd))
+
+    x = _rms_norm(h, blk["ln2"])
+    if cfg.moe:
+        # per-token Switch routing (same experts; tiny per-step batches
+        # may clip at capacity — acceptable at decode time)
+        from chainermn_tpu.parallel.expert import expert_parallel_moe
+
+        def expert_fn(pp, tokens):
+            y = jax.nn.relu(column_parallel_dense(tokens, pp["w1"]))
+            return row_parallel_dense(y, pp["w2"])
+
+        out, _ = expert_parallel_moe(
+            x.reshape(B, D),
+            blk["router"].astype(cd),
+            {"w1": blk["w1"].astype(cd), "w2": blk["w2"].astype(cd)},
+            expert_fn,
+            axis_name="expert",
+            capacity_factor=cfg.capacity_factor,
+        )
+        h = h + out.reshape(B, 1, D)
+    else:
+        y = jax.nn.relu(column_parallel_dense(x, blk["w1"].astype(cd)))
+        h = h + row_parallel_dense(y, blk["w2"].astype(cd))
+    return h, ck, cv
+
+
+def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
+    """Next-token logits for ``tok`` (B,) at position ``pos``; updates
+    the (L, B, max_len, Hkv_local, Dh) cache pair."""
+    cd = cfg.compute_dtype
+    h = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cd)
+    h = _vary(h, "pipe")
+    caches = tuple(jax.tree.map(lambda c: _vary(c, "pipe"), caches))
+    blocks = jax.tree.map(lambda a: jnp.squeeze(a, 0), params["blocks"])
+    if cfg.virtual_pipe > 1:
+        # merge (V, layers_per_chunk) into one L axis; at pipe=1 the
+        # virtual-stage order IS the layer order, so this is exact
+        blocks = jax.tree.map(
+            lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]),
+            blocks)
+
+    def layer(h, xs):
+        blk, ck, cv = xs
+        h, ck, cv = _decode_block(cfg, h, blk, ck, cv, pos)
+        return h, (ck, cv)
+
+    h, (ck, cv) = lax.scan(layer, h, (blocks, *caches))
+    h = _rms_norm(h, params["ln_f"])
+    logits = jnp.einsum(
+        "btd,vd->btv", h.astype(jnp.float32), params["embed"])[:, 0]
+    # close the pipe axis (size 1 in decode): free re-replication that
+    # lets the token buffer stay (data, expert)-varying only
+    return lax.psum(logits, "pipe"), (ck, cv)
+
+
+def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
+                     max_len: int = 0, temperature: float = 0.0):
+    """Build ``generate(params, prompt, key=None) -> (B, max_len)``.
+
+    ``prompt``: (B, P) int32, left-aligned (no padding support — equal
+    prompt lengths, the same contract as the reference's translate
+    batches); generation fills positions P..max_len-1.  Greedy when
+    ``temperature == 0``, else temperature sampling (``key`` required).
+    """
+    for ax in ("seq", "pipe"):
+        if mesh_cfg.mesh.shape.get(ax, 1) != 1:
+            raise ValueError(
+                f"decoding runs length-1 steps: the {ax!r} mesh axis "
+                f"({mesh_cfg.mesh.shape[ax]}) must be 1 (shard batch "
+                "over data and heads over model instead)")
+    max_len = max_len or cfg.max_seq
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"max_len {max_len} exceeds cfg.max_seq {cfg.max_seq}")
+
+    specs = param_specs(cfg)
+    batch_spec = P(("data", "expert"))
+
+    def body(params, prompt, key):
+        # decorrelate sampling across batch shards (same key on every
+        # device would draw identical noise for different examples)
+        key = jax.random.fold_in(
+            key, lax.axis_index("data") * lax.axis_size("expert")
+            + lax.axis_index("expert"))
+        B, Plen = prompt.shape
+        L = cfg.n_layers
+        Hkvl = cfg.kv_heads // mesh_cfg.mesh.shape.get("model", 1)
+        cache = tuple(
+            _vary(jnp.zeros((L, B, max_len, Hkvl, cfg.d_head),
+                            cfg.compute_dtype),
+                  "pipe", "data", "expert", "model")
+            for _ in range(2))
+        buf = jnp.zeros((B, max_len), jnp.int32)
+        buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+        def step(carry, t):
+            buf, caches, key = carry
+            logits, caches = _decode_step(
+                cfg, params, caches, buf[:, t], t)
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, logits / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # keep prompt tokens; write generated ones past the prompt
+            # (scan range is [0, max_len-1), so t+1 is always in bounds)
+            keep = t + 1 < Plen
+            cur = lax.dynamic_slice(buf, (0, t + 1), (B, 1))[:, 0]
+            val = jnp.where(keep, cur, nxt.astype(jnp.int32))
+            buf = lax.dynamic_update_slice(buf, val[:, None], (0, t + 1))
+            return (buf, caches, key), None
+
+        (buf, _, _), _ = lax.scan(
+            step, (buf, cache, key), jnp.arange(max_len - 1))
+        return buf
+
+    fn = jax.jit(jax.shard_map(
+        body,
+        mesh=mesh_cfg.mesh,
+        in_specs=(specs, batch_spec, P()),
+        out_specs=batch_spec,
+    ))
+
+    def generate(params, prompt, key=None):
+        if temperature > 0.0 and key is None:
+            raise ValueError("temperature sampling needs a PRNG key")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return fn(params, prompt, key)
+
+    return generate

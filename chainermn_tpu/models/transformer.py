@@ -120,6 +120,9 @@ class TransformerConfig:
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy {self.remat_policy!r} not in (full, dots)")
+        if self.virtual_pipe < 1:
+            raise ValueError(
+                f"virtual_pipe={self.virtual_pipe} must be >= 1")
         if self.virtual_pipe > 1 and self.pipeline_schedule != "interleaved":
             raise ValueError(
                 f"virtual_pipe={self.virtual_pipe} needs "
@@ -504,7 +507,8 @@ def _make_1f1b_grad(cfg: TransformerConfig):
     """
     if cfg.moe:
         raise ValueError(
-            "pipeline_schedule='1f1b' does not carry the Switch-MoE aux "
+            f"pipeline_schedule={cfg.pipeline_schedule!r} does not carry "
+            "the Switch-MoE aux "
             "loss through the schedule yet — use the GPipe schedule for "
             "MoE configs")
     cd = cfg.compute_dtype
