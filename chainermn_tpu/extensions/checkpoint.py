@@ -53,11 +53,14 @@ class MultiNodeCheckpointer:
     # the lowest priority for the same reason).
     priority = 30
 
-    def __init__(self, comm, path: str, name: str = "snapshot"):
+    def __init__(self, comm, path: str, name: str = "snapshot",
+                 async_write: bool = False):
         self.comm = comm
         self.path = path
         self.name = name
+        self.async_write = async_write
         self._saved_iterations: Set[int] = set()
+        self._pending = None  # (thread, iteration, error_box)
 
     # ------------------------------------------------------------------ #
     # inventory
@@ -101,11 +104,73 @@ class MultiNodeCheckpointer:
         if getattr(updater, "state", None) is not None:
             state["model_state"] = updater.state
         fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
+        if self.async_write:
+            self._save_async(os.path.join(self.path, fn), state, it)
+            return
         save_state(os.path.join(self.path, fn), state)
         self._saved_iterations.add(it)
         # all shards of this iteration exist before older sets are GC'd
         self.comm.barrier()
         self._cleanup(keep=it)
+
+    # ------------------------------------------------------------------ #
+    # async write path
+    # ------------------------------------------------------------------ #
+
+    def _save_async(self, path: str, state, it: int) -> None:
+        """Overlap the file write with training (orbax-style, own
+        implementation).  Ordering:
+
+        1. join the previous write, then barrier + GC — every process
+           reaching save(N+1) has finished writing set N, so N is
+           globally complete and older sets are safe to reap;
+        2. ``jax.device_get`` the state NOW, on the main thread: the
+           donated train step reuses the current params' device buffers
+           on the next step, so the copy cannot be deferred to the
+           writer thread (collectives also stay main-thread-only —
+           the thread touches nothing but host memory and the disk);
+        3. hand the host pytree to a writer thread and return.
+        """
+        import threading
+
+        import jax
+        import numpy as np
+
+        self._join_pending(barrier_and_gc=True)
+        # device_get returns host-numpy leaves BY IDENTITY (no copy), so
+        # a leaf the training loop mutates in place would be pickled
+        # mid-mutation by the writer thread — snapshot real copies
+        host_state = jax.tree.map(np.array, jax.device_get(state))
+        box = {}
+
+        def write():
+            try:
+                save_state(path, host_state)
+            except BaseException as e:  # surfaced at the next join
+                box["error"] = e
+
+        th = threading.Thread(
+            target=write, name=f"ckpt-write-{it}", daemon=True)
+        th.start()
+        self._pending = (th, it, box)
+
+    def _join_pending(self, barrier_and_gc: bool) -> None:
+        """Wait for the in-flight write (if any); re-raise its error.
+        With ``barrier_and_gc`` the joined iteration is then agreed
+        complete across processes and older sets are reaped."""
+        if self._pending is None:
+            return
+        th, it, box = self._pending
+        self._pending = None
+        th.join()
+        if "error" in box:
+            raise RuntimeError(
+                f"async checkpoint write of iteration {it} failed"
+            ) from box["error"]
+        self._saved_iterations.add(it)
+        if barrier_and_gc:
+            self.comm.barrier()
+            self._cleanup(keep=it)
 
     def _cleanup(self, keep: int) -> None:
         """Remove every superseded shard of THIS rank — including orphans
@@ -136,6 +201,7 @@ class MultiNodeCheckpointer:
         (fresh start — the reference's behaviour on first launch).
         """
         from chainermn_tpu.training._resume import restore_train_state
+        self._join_pending(barrier_and_gc=True)
         common = self._common_iterations()
         if not common:
             return None
@@ -161,10 +227,20 @@ class MultiNodeCheckpointer:
         return it
 
     def finalize(self, trainer=None) -> None:
+        self._join_pending(barrier_and_gc=True)
         self.comm.barrier()
 
 
-def create_multi_node_checkpointer(comm, path: str,
-                                   name: str = "snapshot") -> MultiNodeCheckpointer:
-    """Factory with the reference's exact name and signature shape."""
-    return MultiNodeCheckpointer(comm, path, name)
+def create_multi_node_checkpointer(
+    comm, path: str, name: str = "snapshot",
+    async_write: bool = False,
+) -> MultiNodeCheckpointer:
+    """Factory with the reference's exact name and signature shape.
+
+    ``async_write=True`` overlaps snapshot file writes with training
+    (the device→host copy stays synchronous; pickling + disk IO move to
+    a writer thread, joined at the next save/resume/finalize).  Beyond
+    the reference, which blocked the training loop for the full write.
+    """
+    return MultiNodeCheckpointer(comm, path, name,
+                                 async_write=async_write)

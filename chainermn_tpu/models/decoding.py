@@ -34,11 +34,11 @@ from chainermn_tpu.parallel.tensor import (
     row_parallel_dense,
 )
 
-from .transformer import TransformerConfig, _rms_norm, param_specs
+from chainermn_tpu.parallel.ring_attention import _NEG
+
+from .transformer import TransformerConfig, _check_mesh, _rms_norm, param_specs
 
 __all__ = ["make_generate_fn"]
-
-_NEG = -1e30
 
 
 def _vary(x, *axes):
@@ -148,6 +148,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     batches); generation fills positions P..max_len-1.  Greedy when
     ``temperature == 0``, else temperature sampling (``key`` required).
     """
+    _check_mesh(mesh_cfg, cfg)   # head/kv divisibility, clear errors
     for ax in ("seq", "pipe"):
         if mesh_cfg.mesh.shape.get(ax, 1) != 1:
             raise ValueError(

@@ -132,6 +132,79 @@ class TestCheckpointer:
         with pytest.raises(RuntimeError, match="world size"):
             loader.maybe_load(FakeUpdater())
 
+    def test_async_save_resume_roundtrip(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), async_write=True)
+        up = FakeUpdater(seed=1)
+        up.iteration = 42
+        cp.save(up)
+        # mutate AFTER save returns: the async copy must have snapshotted
+        up.params = {"w": up.params["w"] * 0, "b": up.params["b"]}
+        cp.finalize()
+
+        fresh = FakeUpdater(seed=2)
+        resumed = create_multi_node_checkpointer(
+            comm, str(tmp_path)).maybe_load(fresh)
+        assert resumed == 42
+        np.testing.assert_array_equal(
+            fresh.params["w"], FakeUpdater(seed=1).params["w"])
+
+    def test_async_snapshot_isolated_from_inplace_mutation(
+            self, comm, tmp_path):
+        """Host-numpy state mutated IN PLACE right after save() must not
+        leak into the written snapshot (device_get aliases numpy leaves;
+        the async path must copy)."""
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), async_write=True)
+        up = FakeUpdater()
+        up.params = {"w": np.full(3, 1.0)}   # host numpy: aliasing risk
+        up.opt_state = {"m": np.zeros(3)}
+        up.iteration = 8
+        cp.save(up)
+        up.params["w"] *= 999.0              # in-place, post-save
+        cp.finalize()
+        fresh = FakeUpdater()
+        assert create_multi_node_checkpointer(
+            comm, str(tmp_path)).maybe_load(fresh) == 8
+        np.testing.assert_allclose(fresh.params["w"], 1.0)
+
+    def test_async_gc_on_next_save(self, comm, tmp_path):
+        """Joining at save N+1 agrees set N complete and reaps older."""
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), async_write=True)
+        up = FakeUpdater()
+        for it in (10, 20, 30):
+            up.iteration = it
+            cp.save(up)
+        cp.finalize()
+        files = sorted(os.listdir(tmp_path))
+        assert files == ["snapshot_iter_30.0"], files
+
+    def test_async_resume_joins_pending(self, comm, tmp_path):
+        """maybe_load right after an async save must see that save."""
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), async_write=True)
+        up = FakeUpdater(seed=4)
+        up.iteration = 7
+        cp.save(up)
+        fresh = FakeUpdater(seed=5)
+        assert cp.maybe_load(fresh) == 7
+        np.testing.assert_array_equal(fresh.params["w"], up.params["w"])
+
+    def test_async_write_error_surfaces(self, comm, tmp_path):
+        # a regular FILE where the snapshot directory should be makes the
+        # writer thread's makedirs fail (permission tricks don't work for
+        # root); the error must surface at the next join
+        blocked = tmp_path / "blocked"
+        blocked.write_text("not a directory")
+        cp = create_multi_node_checkpointer(
+            comm, str(blocked), async_write=True)
+        up = FakeUpdater()
+        up.iteration = 1
+        cp.save(up)
+        with pytest.raises(RuntimeError, match="async checkpoint"):
+            cp.finalize()
+
     def test_trainer_extension_protocol(self, comm, tmp_path):
         cp = create_multi_node_checkpointer(comm, str(tmp_path))
         up = FakeUpdater()

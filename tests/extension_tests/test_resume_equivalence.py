@@ -36,7 +36,7 @@ def _loss_fn(params, x, y):
     return jnp.mean((pred - y) ** 2)
 
 
-def _build(comm, tmpdir, seed=5):
+def _build(comm, tmpdir, seed=5, async_write=False):
     data = _make_dataset()
     it = SerialIterator(data, batch_size=16, shuffle=True, seed=seed)
     opt = create_multi_node_optimizer(optax.sgd(0.05), comm)
@@ -45,7 +45,8 @@ def _build(comm, tmpdir, seed=5):
     trainer = Trainer(up, stop_trigger=(6, "epoch"), out=str(tmpdir / "out"))
     log = LogReport(trigger=(1, "epoch"))
     trainer.extend(log)
-    cp = create_multi_node_checkpointer(comm, str(tmpdir / "ckpt"))
+    cp = create_multi_node_checkpointer(comm, str(tmpdir / "ckpt"),
+                                        async_write=async_write)
     # save every 3 iterations — NOT aligned with the 4-iteration epoch, so
     # resumes land mid-epoch and mid-shuffle
     trainer.extend(cp, trigger=(3, "iteration"))
@@ -57,7 +58,10 @@ class TestResumeEquivalence:
     def comm(self):
         return create_communicator("tpu_xla")
 
-    def test_interrupted_equals_uninterrupted(self, comm, tmp_path):
+    @pytest.mark.parametrize("async_write", [False, True],
+                             ids=["sync", "async"])
+    def test_interrupted_equals_uninterrupted(self, comm, tmp_path,
+                                              async_write):
         # reference run: 6 epochs straight through
         t_ref, up_ref, _, log_ref = _build(comm, tmp_path / "ref")
         t_ref.run()
@@ -66,10 +70,12 @@ class TestResumeEquivalence:
 
         # interrupted run: stop after epoch ~2.5 (iteration 10; last
         # checkpoint fired at iteration 9 — mid-epoch, mid-shuffle)
-        t1, up1, cp1, _ = _build(comm, tmp_path / "killed")
+        t1, up1, cp1, _ = _build(comm, tmp_path / "killed",
+                                 async_write=async_write)
         t1._stop_period = 2.5
         t1.run()
         assert up1.iteration == 10
+        cp1.finalize()   # flush the in-flight async write, if any
 
         # resume in a FRESH trainer (new process simulation) and finish
         t2, up2, cp2, log2 = _build(comm, tmp_path / "killed")

@@ -192,6 +192,40 @@ def scenario_checkpoint(comm):
     comm.barrier()
 
 
+def scenario_checkpoint_async(comm):
+    """Async checkpointer across real processes: overlapped writes, the
+    join-then-barrier GC ordering, and resume agreement."""
+    from chainermn_tpu import create_multi_node_checkpointer
+
+    class FakeUpdater:
+        def __init__(self):
+            self.iteration = 0
+            self.params = {"w": np.zeros(3)}
+            self.opt_state = {"m": np.zeros(3)}
+            self.state = None
+
+    path = comm.bcast_obj(
+        tempfile.mkdtemp(prefix="cmn_ackpt_") if comm.inter_rank == 0
+        else None, root=0)
+    cp = create_multi_node_checkpointer(comm, path, async_write=True)
+    up = FakeUpdater()
+    for it in (5, 10, 15):
+        up.iteration = it
+        up.params = {"w": np.full(3, float(it))}
+        cp.save(up)
+    cp.finalize()
+    comm.barrier()
+    # GC: only the newest complete set remains on every process
+    mine = sorted(fn for fn in os.listdir(path)
+                  if fn.endswith(f".{comm.inter_rank}"))
+    assert mine == ["snapshot_iter_15." + str(comm.inter_rank)], mine
+    fresh = FakeUpdater()
+    cp2 = create_multi_node_checkpointer(comm, path)
+    assert cp2.maybe_load(fresh) == 15
+    np.testing.assert_allclose(fresh.params["w"], 15.0)
+    comm.barrier()
+
+
 def scenario_evaluator(comm):
     from chainermn_tpu import create_multi_node_evaluator
 

@@ -25,6 +25,9 @@ class TestTwoProcess:
     def test_checkpoint_agreement_resume(self, mp_run):
         mp_run("checkpoint")
 
+    def test_checkpoint_async(self, mp_run):
+        mp_run("checkpoint_async")
+
     def test_evaluator_averaging(self, mp_run):
         mp_run("evaluator")
 
