@@ -149,8 +149,12 @@ class MultiNodeCheckpointer:
             except BaseException as e:  # surfaced at the next join
                 box["error"] = e
 
-        th = threading.Thread(
-            target=write, name=f"ckpt-write-{it}", daemon=True)
+        # NON-daemonic: an uncaught exception unwinding the interpreter
+        # must still let the in-flight write complete (save_state's
+        # tmp+rename keeps a killed write from tearing the file, but a
+        # daemon thread would silently LOSE the snapshot save() already
+        # reported as taken)
+        th = threading.Thread(target=write, name=f"ckpt-write-{it}")
         th.start()
         self._pending = (th, it, box)
 
@@ -227,8 +231,16 @@ class MultiNodeCheckpointer:
         return it
 
     def finalize(self, trainer=None) -> None:
-        self._join_pending(barrier_and_gc=True)
-        self.comm.barrier()
+        import sys
+
+        # during crash unwind (Trainer.run's finally) peers may already
+        # be dead: join the write for durability but skip the
+        # cross-process barrier/GC — a collective here would deadlock
+        # exactly when the except hook should be aborting the job
+        crashing = sys.exc_info()[0] is not None
+        self._join_pending(barrier_and_gc=not crashing)
+        if not crashing:
+            self.comm.barrier()
 
 
 def create_multi_node_checkpointer(

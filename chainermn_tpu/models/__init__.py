@@ -3,7 +3,7 @@ ResNet-50, seq2seq NMT) re-built TPU-first, plus the flagship transformer
 exercising every parallelism axis."""
 
 from .convnets import ConvNetConfig, convnet_apply, init_convnet
-from .decoding import make_generate_fn
+from .decoding import make_beam_search_fn, make_generate_fn
 from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
 from .resnet import ResNetConfig, init_resnet, resnet_apply
 from .seq2seq import (
@@ -37,6 +37,7 @@ __all__ = [
     "accuracy",
     "init_mlp",
     "init_transformer",
+    "make_beam_search_fn",
     "make_forward_fn",
     "make_generate_fn",
     "make_train_step",

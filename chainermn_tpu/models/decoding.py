@@ -28,17 +28,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from chainermn_tpu.parallel.ring_attention import _pv_mix, _qk_scores
+from chainermn_tpu.parallel.ring_attention import _NEG, _pv_mix, _qk_scores
 from chainermn_tpu.parallel.tensor import (
     column_parallel_dense,
     row_parallel_dense,
 )
 
-from chainermn_tpu.parallel.ring_attention import _NEG
-
 from .transformer import TransformerConfig, _check_mesh, _rms_norm, param_specs
 
-__all__ = ["make_generate_fn"]
+__all__ = ["make_generate_fn", "make_beam_search_fn"]
 
 
 def _vary(x, *axes):
@@ -139,15 +137,9 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     return lax.psum(logits, "pipe"), (ck, cv)
 
 
-def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
-                     max_len: int = 0, temperature: float = 0.0):
-    """Build ``generate(params, prompt, key=None) -> (B, max_len)``.
-
-    ``prompt``: (B, P) int32, left-aligned (no padding support — equal
-    prompt lengths, the same contract as the reference's translate
-    batches); generation fills positions P..max_len-1.  Greedy when
-    ``temperature == 0``, else temperature sampling (``key`` required).
-    """
+def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
+    """Shared validation for the decode factories; returns the resolved
+    ``(max_len, kv_heads_local)``."""
     _check_mesh(mesh_cfg, cfg)   # head/kv divisibility, clear errors
     for ax in ("seq", "pipe"):
         if mesh_cfg.mesh.shape.get(ax, 1) != 1:
@@ -159,7 +151,30 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     if max_len > cfg.max_seq:
         raise ValueError(
             f"max_len {max_len} exceeds cfg.max_seq {cfg.max_seq}")
+    return max_len, cfg.kv_heads // mesh_cfg.mesh.shape.get("model", 1)
 
+
+def _make_cache(cfg: TransformerConfig, rows: int, max_len: int,
+                kv_heads_local: int):
+    """Zero KV cache pair ``(L, rows, max_len, Hkv_local, Dh)``, typed
+    varying over every mesh axis its contents will carry."""
+    return tuple(
+        _vary(jnp.zeros((cfg.n_layers, rows, max_len, kv_heads_local,
+                         cfg.d_head), cfg.compute_dtype),
+              "pipe", "data", "expert", "model")
+        for _ in range(2))
+
+
+def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
+                     max_len: int = 0, temperature: float = 0.0):
+    """Build ``generate(params, prompt, key=None) -> (B, max_len)``.
+
+    ``prompt``: (B, P) int32, left-aligned (no padding support — equal
+    prompt lengths, the same contract as the reference's translate
+    batches); generation fills positions P..max_len-1.  Greedy when
+    ``temperature == 0``, else temperature sampling (``key`` required).
+    """
+    max_len, kv_heads_local = _decode_preamble(mesh_cfg, cfg, max_len)
     specs = param_specs(cfg)
     batch_spec = P(("data", "expert"))
 
@@ -170,13 +185,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
             key, lax.axis_index("data") * lax.axis_size("expert")
             + lax.axis_index("expert"))
         B, Plen = prompt.shape
-        L = cfg.n_layers
-        Hkvl = cfg.kv_heads // mesh_cfg.mesh.shape.get("model", 1)
-        cache = tuple(
-            _vary(jnp.zeros((L, B, max_len, Hkvl, cfg.d_head),
-                            cfg.compute_dtype),
-                  "pipe", "data", "expert", "model")
-            for _ in range(2))
+        cache = _make_cache(cfg, B, max_len, kv_heads_local)
         buf = jnp.zeros((B, max_len), jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
@@ -216,3 +225,131 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
         return fn(params, prompt, key)
 
     return generate
+
+
+def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
+                        beam_size: int, max_len: int = 0,
+                        eos_id: int = -1, length_penalty: float = 0.0):
+    """Build ``beam_search(params, prompt) -> (tokens, scores)``.
+
+    Jittable beam search over the KV-cached decoder (the reference's
+    ``translate`` was greedy-only): ``K = beam_size`` hypotheses per
+    batch element advance in lockstep; each step expands every live
+    beam by the full vocab, keeps the global top-K by cumulative
+    log-probability, and reorders the KV cache by beam origin (a local
+    gather — beams live on the same device as their batch element, so
+    DP/TP meshes compose exactly as in :func:`make_generate_fn`).
+
+    ``eos_id >= 0`` freezes hypotheses that emit it (score kept, padded
+    with ``eos_id``).  ``length_penalty`` α applies GNMT normalisation
+    ``score / ((5+len)/6)^α`` for the final ranking.
+
+    Returns ``tokens`` (B, K, max_len) sorted best-first and ``scores``
+    (B, K) (length-normalised when α > 0).
+    """
+    _check_mesh(mesh_cfg, cfg)
+    for ax in ("seq", "pipe"):
+        if mesh_cfg.mesh.shape.get(ax, 1) != 1:
+            raise ValueError(
+                f"beam search runs length-1 steps: the {ax!r} mesh axis "
+                f"({mesh_cfg.mesh.shape[ax]}) must be 1")
+    if beam_size < 1:
+        raise ValueError(f"beam_size {beam_size} must be >= 1")
+    max_len, kv_heads_local = _decode_preamble(mesh_cfg, cfg, max_len)
+    K = beam_size
+
+    specs = param_specs(cfg)
+    batch_spec = P(("data", "expert"))
+
+    def body(params, prompt):
+        B, Plen = prompt.shape
+        # -- prefill at width B (the K beams are identical inside the
+        # prompt — no reason to pay K× its FLOPs or reorder gathers) --
+        cache_b = _make_cache(cfg, B, max_len, kv_heads_local)
+
+        def prefill(caches, t):
+            _, caches = _decode_step(cfg, params, caches, prompt[:, t], t)
+            return caches, None
+
+        cache_b, _ = lax.scan(prefill, cache_b, jnp.arange(Plen - 1))
+        # tile to beam width: flat row b·K + k holds batch b's beam k
+        cache = tuple(jnp.repeat(c, K, axis=1) for c in cache_b)
+
+        buf = jnp.zeros((B, K, max_len), jnp.int32)
+        buf = lax.dynamic_update_slice(
+            buf, jnp.broadcast_to(prompt[:, None], (B, K, Plen)),
+            (0, 0, 0))
+        # beam 0 carries the prompt; duplicates start dead so the first
+        # expansion draws K distinct continuations from beam 0
+        scores = _vary(
+            jnp.broadcast_to(
+                jnp.where(jnp.arange(K) == 0, 0.0, _NEG)[None],
+                (B, K)) * 1.0,
+            "data", "expert")
+        finished = _vary(jnp.zeros((B, K), bool), "data", "expert")
+
+        def step(carry, t):
+            buf, scores, finished, caches = carry
+            logits, caches = _decode_step(
+                cfg, params, caches, buf.reshape(B * K, max_len)[:, t], t)
+            logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, -1)
+            V = logp.shape[-1]
+            # finished beams propose exactly one candidate (their score,
+            # continuing with eos/pad); live beams propose the vocab
+            pad_tok = jnp.int32(max(eos_id, 0))
+            cand = jnp.where(
+                finished[..., None], _NEG, logp) + scores[..., None]
+            keep_score = jnp.where(finished, scores, _NEG)
+            # candidate matrix (B, K, V+1): last column = "stay finished"
+            cand = jnp.concatenate([cand, keep_score[..., None]], -1)
+            flat = cand.reshape(B, K * (V + 1))
+            top_scores, top_idx = lax.top_k(flat, K)
+            origin = top_idx // (V + 1)                     # (B, K)
+            token = top_idx % (V + 1)
+            stay = token == V
+            token = jnp.where(stay, pad_tok, token).astype(jnp.int32)
+
+            # finished-ness follows the reorder, then eos/stay extend it
+            new_finished = (
+                jnp.take_along_axis(finished, origin, axis=1)
+                | stay | (jnp.asarray(eos_id >= 0) & (token == eos_id)))
+
+            # reorder histories + caches by beam origin (per batch row)
+            buf = jnp.take_along_axis(buf, origin[..., None], axis=1)
+            buf = lax.dynamic_update_slice(
+                buf, token[..., None], (0, 0, t + 1))
+            flat_origin = (
+                jnp.arange(B)[:, None] * K + origin).reshape(-1)
+            caches = tuple(
+                jnp.take(c, flat_origin, axis=1) for c in caches)
+            return (buf, top_scores, new_finished, caches), None
+
+        # beam phase starts at the LAST prompt position (its logits seed
+        # the first expansion); scan range [Plen-1, max_len-1)
+        (buf, scores, finished, _), _ = lax.scan(
+            step, (buf, scores, finished, cache),
+            jnp.arange(Plen - 1, max_len - 1))
+
+        if length_penalty > 0.0:
+            # generated length per beam (position of first eos, if any)
+            gen = buf[:, :, Plen:]
+            if eos_id >= 0:
+                is_eos = gen == eos_id
+                first = jnp.where(
+                    is_eos.any(-1), is_eos.argmax(-1), gen.shape[-1])
+            else:
+                first = jnp.full(gen.shape[:2], gen.shape[-1])
+            norm = ((5.0 + first.astype(jnp.float32)) / 6.0) \
+                ** length_penalty
+            scores = scores / jnp.maximum(norm, 1e-6)
+        order = jnp.argsort(-scores, axis=1)
+        buf = jnp.take_along_axis(buf, order[..., None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        return buf, scores
+
+    return jax.jit(jax.shard_map(
+        body,
+        mesh=mesh_cfg.mesh,
+        in_specs=(specs, batch_spec),
+        out_specs=(batch_spec, batch_spec),
+    ))

@@ -77,24 +77,29 @@ class Trainer:
             init = getattr(e.ext, "initialize", None)
             if init:
                 init(self)
-        while not self._done():
-            self.updater.update()
-            self.observation = dict(self.updater.observation)
-            self.elapsed_time = time.perf_counter() - self._start
+        try:
+            while not self._done():
+                self.updater.update()
+                self.observation = dict(self.updater.observation)
+                self.elapsed_time = time.perf_counter() - self._start
+                for e in self._extensions:
+                    # extensions with an ``observe`` hook see EVERY
+                    # iteration's observation (LogReport interval
+                    # averaging); ``__call__`` still fires on the trigger
+                    obs_hook = getattr(e.ext, "observe", None)
+                    if obs_hook:
+                        obs_hook(self)
+                for e in self._extensions:
+                    if e.trigger(self):
+                        e.ext(self)
+        finally:
+            # finalize even when update() raises: an in-flight async
+            # checkpoint write must not be lost to the crash it exists
+            # to protect against
             for e in self._extensions:
-                # extensions with an ``observe`` hook see EVERY iteration's
-                # observation (LogReport interval averaging); ``__call__``
-                # still fires only on the trigger
-                obs_hook = getattr(e.ext, "observe", None)
-                if obs_hook:
-                    obs_hook(self)
-            for e in self._extensions:
-                if e.trigger(self):
-                    e.ext(self)
-        for e in self._extensions:
-            fin = getattr(e.ext, "finalize", None)
-            if fin:
-                fin(self)
+                fin = getattr(e.ext, "finalize", None)
+                if fin:
+                    fin(self)
 
 
 class LogReport:

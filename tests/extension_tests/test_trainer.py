@@ -125,3 +125,40 @@ class TestEndToEnd:
         trainer = cmn.Trainer(updater, (8, "iteration"), out=str(tmp_path))
         trainer.run()
         assert updater.iteration == 8
+
+    def test_finalize_runs_when_update_raises(self, comm, tmp_path):
+        """A crash mid-loop must still finalize extensions — an in-flight
+        async checkpoint write would otherwise be lost with the process
+        (and the checkpointer must skip its barrier during unwind)."""
+        train = toy_problem(64)
+        it = cmn.SerialIterator(train, 16)
+        params = init_mlp(jax.random.PRNGKey(0), [16, 4])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        updater = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+        trainer = cmn.Trainer(updater, (20, "iteration"),
+                              out=str(tmp_path))
+        cp = cmn.create_multi_node_checkpointer(
+            comm, str(tmp_path / "ckpt"), async_write=True)
+        trainer.extend(cp, trigger=(2, "iteration"))
+
+        real_update = updater.update
+
+        def exploding_update():
+            if updater.iteration >= 3:
+                raise RuntimeError("simulated mid-training crash")
+            real_update()
+
+        updater.update = exploding_update
+        with pytest.raises(RuntimeError, match="simulated"):
+            trainer.run()
+        # the iteration-2 async write survived the crash
+        fresh = cmn.StandardUpdater(
+            cmn.SerialIterator(train, 16), opt, loss_fn,
+            init_mlp(jax.random.PRNGKey(1), [16, 4]), comm)
+        resumed = cmn.create_multi_node_checkpointer(
+            comm, str(tmp_path / "ckpt")).maybe_load(fresh)
+        assert resumed == 2
