@@ -14,6 +14,7 @@ from .seq2seq import (
 )
 from .transformer import (
     TransformerConfig,
+    apply_rope,
     init_transformer,
     make_forward_fn,
     make_train_step,
@@ -29,6 +30,7 @@ __all__ = [
     "init_convnet",
     "Seq2seqConfig",
     "TransformerConfig",
+    "apply_rope",
     "init_seq2seq",
     "seq2seq_loss",
     "seq2seq_translate",

@@ -34,7 +34,13 @@ from chainermn_tpu.parallel.tensor import (
     row_parallel_dense,
 )
 
-from .transformer import TransformerConfig, _check_mesh, _rms_norm, param_specs
+from .transformer import (
+    TransformerConfig,
+    _check_mesh,
+    _rms_norm,
+    apply_rope,
+    param_specs,
+)
 
 __all__ = ["make_generate_fn", "make_beam_search_fn"]
 
@@ -69,6 +75,10 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
             x, blk["wkv"].reshape(D, -1).astype(cd)
         ).reshape(B, 1, 2, Hkvl, cfg.d_head)
         k_new, v_new = kv[:, :, 0], kv[:, :, 1]
+    if cfg.pos_embedding == "rope":
+        p1 = jnp.full((1,), pos)
+        q = apply_rope(q, p1, cfg.rope_theta)
+        k_new = apply_rope(k_new, p1, cfg.rope_theta)
     ck = lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
                                   (0, pos, 0, 0))
     cv = lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
@@ -112,7 +122,10 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     """Next-token logits for ``tok`` (B,) at position ``pos``; updates
     the (L, B, max_len, Hkv_local, Dh) cache pair."""
     cd = cfg.compute_dtype
-    h = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cd)
+    h = params["embed"][tok]
+    if cfg.pos_embedding == "learned":
+        h = h + params["pos"][pos]
+    h = h[:, None, :].astype(cd)
     h = _vary(h, "pipe")
     caches = tuple(jax.tree.map(lambda c: _vary(c, "pipe"), caches))
     blocks = jax.tree.map(lambda a: jnp.squeeze(a, 0), params["blocks"])

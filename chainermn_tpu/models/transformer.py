@@ -57,6 +57,7 @@ from chainermn_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "TransformerConfig",
+    "apply_rope",
     "init_transformer",
     "transformer_forward",
     "param_specs",
@@ -76,6 +77,12 @@ class TransformerConfig:
     n_layers: int = 4          # total; must divide by mesh pipe size
     max_seq: int = 2048
     attention: str = "ring"    # "ring" | "ulysses" | "local" | "flash"
+    pos_embedding: str = "learned"  # "learned" (absolute table, the
+    # "pos" param) | "rope" (rotary on q/k per block — no position
+    # parameters; the long-context default: relative by construction,
+    # composes with ring/zigzag sharding because each shard rotates by
+    # its own global positions before any K/V movement)
+    rope_theta: float = 10000.0
     seq_layout: str = "contiguous"  # "contiguous" | "zigzag" (ring only):
     # zigzag = Striped-ring causal load balance; feed tokens permuted by
     # parallel.ring_attention.zigzag_indices (targets through the same
@@ -117,6 +124,13 @@ class TransformerConfig:
         return jax.checkpoint
 
     def __post_init__(self):
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding {self.pos_embedding!r} not in "
+                "(learned, rope)")
+        if self.pos_embedding == "rope" and self.d_head % 2:
+            raise ValueError(
+                f"rope needs an even d_head, got {self.d_head}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy {self.remat_policy!r} not in (full, dots)")
@@ -201,14 +215,16 @@ def init_transformer(key, cfg: TransformerConfig, pipe_size: int = 1):
         stacked = jax.tree.map(
             lambda a: a.reshape(pipe_size, lps, *a.shape[1:]), stacked)
     D = cfg.d_model
-    return {
+    params = {
         "embed": jax.random.normal(
             k_emb, (cfg.vocab_size, D), jnp.float32) * 0.02,
-        "pos": jax.random.normal(
-            k_pos, (cfg.max_seq, D), jnp.float32) * 0.02,
         "blocks": stacked,
         "ln_f": jnp.ones((D,), jnp.float32),
     }
+    if cfg.pos_embedding == "learned":
+        params["pos"] = jax.random.normal(
+            k_pos, (cfg.max_seq, D), jnp.float32) * 0.02
+    return params
 
 
 def param_specs(cfg: TransformerConfig):
@@ -239,12 +255,14 @@ def param_specs(cfg: TransformerConfig):
         # blocks carry an extra local chunk axis after pipe: (pipe, V,
         # layers_per_chunk, ...) — replicate over it, shift the rest
         blk = {k: P(v[0], None, *v[1:]) for k, v in blk.items()}
-    return {
+    specs = {
         "embed": P(),
-        "pos": P(),
         "blocks": blk,
         "ln_f": P(),
     }
+    if cfg.pos_embedding == "learned":
+        specs["pos"] = P()
+    return specs
 
 
 # --------------------------------------------------------------------- #
@@ -256,6 +274,28 @@ def _rms_norm(x, scale):
     x32 = x.astype(jnp.float32)
     r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
     return (x32 * r * scale).astype(x.dtype)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary embedding (rotate-half convention) on ``x`` (..., T, H, D)
+    at absolute ``positions`` (T,).  Rotations are absolute per token but
+    the QK dot depends only on position DIFFERENCES — so sharded callers
+    (ring shards, zigzag layouts, KV caches) just pass each token's own
+    global position and relative attention falls out, with no position
+    parameters to learn or extend.
+
+    The trig tables are (T, d_head/2) — negligible next to the T² score
+    matrix, so they are recomputed per call (the layer-invariant parts
+    are XLA CSE-hoistable) instead of threading a cache through every
+    stage signature."""
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None]  # (T, half)
+    cos = jnp.cos(ang)[:, None].astype(x.dtype)    # (T, 1, half)
+    sin = jnp.sin(ang)[:, None].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
 def _attention(cfg: TransformerConfig, h, blk):
@@ -289,6 +329,15 @@ def _attention(cfg: TransformerConfig, h, blk):
             x, blk["wkv"].reshape(D, -1).astype(cd)
         ).reshape(B, T, 2, Hkvl, cfg.d_head)
         k, v = kv[:, :, 0], kv[:, :, 1]
+    if cfg.pos_embedding == "rope":
+        # rotate by each local token's GLOBAL position BEFORE any ring
+        # rotation / Ulysses exchange — relative attention then holds
+        # across shard boundaries by construction
+        pos = _block_positions(
+            lax.axis_index("seq"), T, lax.axis_size("seq"),
+            cfg.seq_layout if cfg.attention == "ring" else "contiguous")
+        q = apply_rope(q, pos, cfg.rope_theta)
+        k = apply_rope(k, pos, cfg.rope_theta)
     if cfg.attention == "ring":
         # flagship long-context path: ring schedule with the Pallas
         # kernel as the per-pair compute whenever the local block shape
@@ -412,13 +461,16 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
     r = lax.axis_index("seq")
 
     h = params["embed"][tokens]                        # (B, T, D) fp32
-    if cfg.seq_layout == "zigzag":
+    if cfg.pos_embedding == "rope":
+        h = h.astype(cd)          # rotations happen inside attention
+    elif cfg.seq_layout == "zigzag":
         # position rows follow the zigzag permutation of this shard
-        pos = params["pos"][
+        h = (h + params["pos"][
             _block_positions(r, T, lax.axis_size("seq"), "zigzag")]
+        ).astype(cd)
     else:
-        pos = lax.dynamic_slice_in_dim(params["pos"], r * T, T, axis=0)
-    h = (h + pos).astype(cd)
+        h = (h + lax.dynamic_slice_in_dim(
+            params["pos"], r * T, T, axis=0)).astype(cd)
 
     S = lax.axis_size("pipe")
     if cfg.virtual_pipe > 1:
@@ -523,10 +575,14 @@ def _make_1f1b_grad(cfg: TransformerConfig):
 
         def embed_fn(ep):
             h = ep["embed"][inputs]
+            if cfg.pos_embedding == "rope":
+                return h.astype(cd)
             pos = lax.dynamic_slice_in_dim(ep["pos"], r * T, T, axis=0)
             return (h + pos).astype(cd)
 
-        ep = {"embed": params["embed"], "pos": params["pos"]}
+        ep = {"embed": params["embed"]}
+        if cfg.pos_embedding == "learned":
+            ep["pos"] = params["pos"]
         h, vjp_embed = jax.vjp(embed_fn, ep)
 
         def loss_fn(lp, y, tgt):
@@ -553,10 +609,11 @@ def _make_1f1b_grad(cfg: TransformerConfig):
         grads = {
             # weight tying: embedding grads = lookup side + head side
             "embed": d_ep["embed"] + g_lp["embed"],
-            "pos": d_ep["pos"],
             "blocks": g_blocks,
             "ln_f": g_lp["ln_f"],
         }
+        if cfg.pos_embedding == "learned":
+            grads["pos"] = d_ep["pos"]
         # Normalisation: every parameter is REPLICATED over the
         # data-like axes, so the shard_map transposes inside the manual
         # vjp calls have already PSUMMED each gradient over
