@@ -24,7 +24,7 @@ no threads.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -76,6 +76,77 @@ def cross_replica_mean(axis_name: str, dtype=None) -> optax.GradientTransformati
             return jax.lax.pmean(g, axis_name)
 
         return jax.tree.map(reduce_one, grads), state
+
+    return optax.GradientTransformation(init, update)
+
+
+class AccumState(NamedTuple):
+    step: jnp.ndarray          # micro-step counter (same on all members)
+    acc: optax.Updates         # running SUM of incoming (reduced) grads
+    inner: Any
+
+
+def _grad_accumulation(
+    inner: optax.GradientTransformation, every: int,
+    axis_name: Optional[str] = None,
+) -> optax.GradientTransformation:
+    """Gradient accumulation around ``inner``: parameters move every
+    ``every`` calls with the mean of the accumulated grads.
+
+    Not ``optax.MultiSteps``: its internal ``lax.cond`` branches return
+    the incoming-typed updates on emit ticks but zeros typed from a
+    fresh ``eval_shape`` on skip ticks, which shard_map's varying-axes
+    typing rejects.  Here both branches type their outputs from the SAME
+    values (``zeros_like`` of the accumulated mean / the untouched
+    state), so the cond stays well-typed in every vma regime.  The
+    factory feeds this transform already-reduced grads (post-pmean, or
+    zero1 shards), so the accumulator is replication-typed (or
+    shard-width); the value of accumulation is the ``every``×-larger
+    global batch under fixed HBM — the cross-replica collectives still
+    run per micro-step.
+    """
+
+    def init(params):
+        return AccumState(
+            jnp.zeros((), jnp.int32),
+            jax.tree.map(jnp.zeros_like, params),
+            inner.init(params),
+        )
+
+    def update(grads, state, params=None):
+        acc = jax.tree.map(lambda a, g: a + g, state.acc, grads)
+        # the emit predicate must be replication-typed for the lax.cond
+        # (a varying pred would force every output varying): the counter
+        # is identical on all members by construction, but a world-
+        # stacked zero1 carry types it varying — a scalar pmean restores
+        # the invariant typing at negligible cost
+        step = state.step
+        if axis_name is not None:
+            try:
+                vma = jax.typeof(step).vma
+            except AttributeError:  # pragma: no cover - older jax
+                vma = ()
+            if axis_name in vma:
+                # the counter is identical on every member; pmax is an
+                # EXACT int32 way to restore the replication typing the
+                # cond predicate needs (a float pmean would lose integer
+                # precision past 2**24 micro-steps)
+                step = jax.lax.pmax(step, axis_name)
+        emit = (step + 1) % every == 0
+        mean = jax.tree.map(lambda a: a / every, acc)
+
+        def do(mean, acc, inner_state):
+            upd, new_inner = inner.update(mean, inner_state, params)
+            return upd, jax.tree.map(jnp.zeros_like, acc), new_inner
+
+        def skip(mean, acc, inner_state):
+            # zeros typed from the SAME value the do branch feeds inner
+            # (dtype and vma both match updates = inner.update(mean, ...))
+            return jax.tree.map(jnp.zeros_like, mean), acc, inner_state
+
+        upd, acc, new_inner = jax.lax.cond(
+            emit, do, skip, mean, acc, state.inner)
+        return upd, AccumState(state.step + 1, acc, new_inner)
 
     return optax.GradientTransformation(init, update)
 
@@ -257,6 +328,7 @@ def create_multi_node_optimizer(
     comm=None,
     double_buffering: bool = False,
     zero1: bool = False,
+    accum_steps: int = 1,
     axis_name: Optional[str] = None,
     allreduce_grad_dtype=None,
 ) -> optax.GradientTransformation:
@@ -273,18 +345,29 @@ def create_multi_node_optimizer(
         (:func:`zero1_optimizer`); replaces the pmean with a
         reduce-scatter/all-gather pair.  With ``double_buffering`` the
         stale-grad stash is also sharded (1/N memory).
+      accum_steps: gradient accumulation — parameters update every
+        ``accum_steps`` calls with the mean of the accumulated grads
+        (global batch = ``world × local_batch × accum_steps``; the
+        large-batch recipe's missing piece when HBM caps the per-step
+        batch).  The accumulator sits after the cross-replica reduction,
+        so it holds *reduced* (replication-typed) grads — carryable with
+        plain replicated out_specs in every regime — and, under zero1,
+        1/world-width shards.  Double buffering composes at the emit
+        level (staleness counts real updates, not micro-steps).
       allreduce_grad_dtype: wire dtype for the mean (bf16 recommended).
     """
     ax = axis_name or (comm.axis_name if comm is not None else None)
     if ax is None:
         raise ValueError("need comm or axis_name")
-    if zero1:
-        inner = actual_optimizer
-        if double_buffering:
-            inner = optax.chain(_double_buffer(), inner)
-        return zero1_optimizer(inner, ax, wire_dtype=allreduce_grad_dtype)
-    chain = [cross_replica_mean(ax, allreduce_grad_dtype)]
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps {accum_steps} must be >= 1")
+    inner = actual_optimizer
     if double_buffering:
-        chain.append(_double_buffer())
-    chain.append(actual_optimizer)
-    return optax.chain(*chain)
+        inner = optax.chain(_double_buffer(), inner)
+    if accum_steps > 1:
+        inner = _grad_accumulation(inner, accum_steps, axis_name=ax)
+    if zero1:
+        # accumulation INSIDE zero1: the accumulator holds 1/N shards
+        return zero1_optimizer(inner, ax, wire_dtype=allreduce_grad_dtype)
+    return optax.chain(
+        cross_replica_mean(ax, allreduce_grad_dtype), inner)

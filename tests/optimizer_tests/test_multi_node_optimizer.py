@@ -182,3 +182,70 @@ class TestMultiNodeOptimizer:
         lr1 = float(sched(1))
         np.testing.assert_allclose(
             np.asarray(w)[0], [-lr1 * 1.0, -lr1 * 2.0], rtol=2e-2)
+
+
+class TestGradientAccumulation:
+    def _step_fn(self, comm, opt, zero1):
+        """zero1: world-stacked state carry (zero1_init contract);
+        plain: replicated state exactly like StandardUpdater passes it."""
+        if zero1:
+            def body(params, state, grads):
+                g = jax.tree.map(lambda a: a[0], grads)
+                state = jax.tree.map(lambda a: a[0], state)
+                updates, state = opt.update(g, state, params)
+                state = jax.tree.map(lambda a: a[None], state)
+                return optax.apply_updates(params, updates), state
+
+            return jax.jit(jax.shard_map(
+                body, mesh=comm.mesh,
+                in_specs=(P(), P(AX), P(AX)), out_specs=(P(), P(AX))))
+
+        def body(params, state, grads):
+            g = jax.tree.map(lambda a: a[0], grads)
+            updates, state = opt.update(g, state, params)
+            return optax.apply_updates(params, updates), state
+
+        return jax.jit(jax.shard_map(
+            body, mesh=comm.mesh,
+            in_specs=(P(), P(), P(AX)), out_specs=(P(), P())))
+
+    def _init(self, comm, opt, params, zero1):
+        from chainermn_tpu.training.optimizers import zero1_init
+
+        if zero1:
+            return zero1_init(opt, params, comm.mesh, AX)
+        return jax.jit(opt.init)(params)
+
+    @pytest.mark.parametrize("zero1", [False, True])
+    @pytest.mark.parametrize("inner", ["sgd", "adam"])
+    def test_two_micro_steps_equal_one_big(self, comm, zero1, inner):
+        make = {"sgd": lambda: optax.sgd(0.5),
+                "adam": lambda: optax.adam(1e-2)}[inner]
+        n = comm.size
+        params = {"w": jnp.ones(6)}
+        rng = np.random.RandomState(0)
+        g1 = {"w": jnp.asarray(rng.randn(n, 6), jnp.float32)}
+        g2 = {"w": jnp.asarray(rng.randn(n, 6), jnp.float32)}
+
+        opt = create_multi_node_optimizer(
+            make(), comm, accum_steps=2, zero1=zero1)
+        state = self._init(comm, opt, params, zero1)
+        step = self._step_fn(comm, opt, zero1)
+        p_mid, state = step(params, state, g1)
+        # non-final micro-step: parameters must NOT move
+        np.testing.assert_array_equal(np.asarray(p_mid["w"]),
+                                      np.asarray(params["w"]))
+        p_acc, _ = step(p_mid, state, g2)
+
+        ref_opt = create_multi_node_optimizer(make(), comm, zero1=zero1)
+        ref_state = self._init(comm, ref_opt, params, zero1)
+        g_big = {"w": (g1["w"] + g2["w"]) / 2.0}
+        p_ref, _ = self._step_fn(comm, ref_opt, zero1)(params, ref_state, g_big)
+        np.testing.assert_allclose(
+            np.asarray(p_acc["w"]), np.asarray(p_ref["w"]),
+            rtol=1e-5, atol=1e-6)
+
+    def test_invalid_accum_steps(self, comm):
+        with pytest.raises(ValueError, match="accum_steps"):
+            create_multi_node_optimizer(optax.sgd(0.1), comm,
+                                        accum_steps=0)
