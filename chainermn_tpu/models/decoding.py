@@ -86,7 +86,10 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
     # grouped attention of the 1-token query against the whole cache,
     # masked to positions <= pos (static max_len shape)
     s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
-    allow = jnp.arange(ck.shape[1]) <= pos                # (max_len,)
+    kpos = jnp.arange(ck.shape[1])
+    allow = kpos <= pos                                   # (max_len,)
+    if cfg.attention_window:
+        allow &= (pos - kpos) < cfg.attention_window
     s = jnp.where(allow[None, None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)   # (B,1,Hl,Dh)

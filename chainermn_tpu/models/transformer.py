@@ -77,6 +77,10 @@ class TransformerConfig:
     n_layers: int = 4          # total; must divide by mesh pipe size
     max_seq: int = 2048
     attention: str = "ring"    # "ring" | "ulysses" | "local" | "flash"
+    attention_window: int = 0  # 0 => full causal; W>0 => sliding causal
+    # window (token t attends to (t-W, t]): Mistral-style local
+    # attention; the flash kernel and the ring schedule skip fully
+    # out-of-window blocks, so long-context FLOPs scale with W not T
     pos_embedding: str = "learned"  # "learned" (absolute table, the
     # "pos" param) | "rope" (rotary on q/k per block — no position
     # parameters; the long-context default: relative by construction,
@@ -124,6 +128,9 @@ class TransformerConfig:
         return jax.checkpoint
 
     def __post_init__(self):
+        if self.attention_window < 0:
+            raise ValueError(
+                f"attention_window {self.attention_window} must be >= 0")
         if self.pos_embedding not in ("learned", "rope"):
             raise ValueError(
                 f"pos_embedding {self.pos_embedding!r} not in "
@@ -348,6 +355,7 @@ def _attention(cfg: TransformerConfig, h, blk):
             # each zigzag half-run must itself fit the kernel's blocks
             use_flash = flash_attention_supported(T // 2, T // 2)
         o = ring_attention(q, k, v, axis_name="seq", causal=True,
+                           window=cfg.attention_window or None,
                            remat=cfg.remat, use_flash=use_flash,
                            layout=cfg.seq_layout,
                            interpret=jax.default_backend() != "tpu")
@@ -361,11 +369,14 @@ def _attention(cfg: TransformerConfig, h, blk):
             fa = partial(flash_attention,
                          interpret=jax.default_backend() != "tpu")
             o = ulysses_attention(q, k, v, axis_name="seq", causal=True,
+                                  window=cfg.attention_window or None,
                                   attn_fn=fa)
         else:
-            o = ulysses_attention(q, k, v, axis_name="seq", causal=True)
+            o = ulysses_attention(q, k, v, axis_name="seq", causal=True,
+                                  window=cfg.attention_window or None)
     elif cfg.attention == "local":
-        o = local_attention(q, k, v, causal=True)
+        o = local_attention(q, k, v, causal=True,
+                            window=cfg.attention_window or None)
     elif cfg.attention == "flash":
         # Pallas kernel (TPU); non-TPU backends run the same kernel
         # through the Pallas interpreter so one config works everywhere.
@@ -379,12 +390,14 @@ def _attention(cfg: TransformerConfig, h, blk):
             # kernel contract: lengths must divide the (clamped) blocks —
             # fall back to the XLA path instead of erroring at trace time
             # (grouped-KV read in place; no broadcast)
-            o = local_attention(q, k, v, causal=True)
+            o = local_attention(q, k, v, causal=True,
+                                window=cfg.attention_window or None)
         else:
             # kernel wants matching head counts
             k, v = broadcast_kv(k, v, q.shape[2] // k.shape[2])
             o = flash_attention(
                 q, k, v, causal=True,
+                window=cfg.attention_window or None,
                 interpret=jax.default_backend() != "tpu")
     else:
         raise ValueError(cfg.attention)

@@ -62,7 +62,7 @@ def _positions(off, base, count):
 
 
 def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, l_ref, m_ref, *, scale, causal):
+                acc_ref, l_ref, m_ref, *, scale, causal, window):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     Bq, D = q_ref.shape[1:]
@@ -81,6 +81,10 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # under shard_map (jax bug); pl.when(cond) routes discharge safely.
     needed = (j >= 0) if not causal else (
         q_off + (i + 1) * Bq - 1 >= k_off + j * Bk)
+    if window is not None:
+        # also skip K blocks entirely BEFORE the window of every q row
+        needed &= (k_off + (j + 1) * Bk - 1
+                   >= q_off + i * Bq - (window - 1))
 
     @pl.when(needed)
     def _():
@@ -93,6 +97,8 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             qpos = _positions(q_off, i * Bq, Bq)
             kpos = _positions(k_off, j * Bk, Bk)
             allow = qpos[:, None] >= kpos[None, :]
+            if window is not None:
+                allow &= (qpos[:, None] - kpos[None, :]) < window
             s = jnp.where(allow, s, _NEG)
         m = m_ref[:, 0]
         l = l_ref[:, 0]
@@ -122,19 +128,22 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 # --------------------------------------------------------------------- #
 
 
-def _recompute_p(q, kb, scale, lse, causal, q_off, k_off, i, j, Bq, Bk):
+def _recompute_p(q, kb, scale, lse, causal, window, q_off, k_off, i, j,
+                 Bq, Bk):
     s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
     if causal:
         qpos = _positions(q_off, i * Bq, Bq)
         kpos = _positions(k_off, j * Bk, Bk)
         allow = qpos[:, None] >= kpos[None, :]
+        if window is not None:
+            allow &= (qpos[:, None] - kpos[None, :]) < window
         s = jnp.where(allow, s, _NEG)
         return jnp.where(allow, jnp.exp(s - lse[:, None]), 0.0)
     return jnp.exp(s - lse[:, None])
 
 
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_acc, *, scale, causal):
+               dq_ref, dq_acc, *, scale, causal, window):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     Bq, D = q_ref.shape[1:]
@@ -150,6 +159,9 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # under shard_map (jax bug); pl.when(cond) routes discharge safely.
     needed = (j >= 0) if not causal else (
         q_off + (i + 1) * Bq - 1 >= k_off + j * Bk)
+    if window is not None:
+        needed &= (k_off + (j + 1) * Bk - 1
+                   >= q_off + i * Bq - (window - 1))
 
     @pl.when(needed)
     def _():
@@ -159,7 +171,7 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][:, 0]
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
-        p = _recompute_p(q, kb, scale, lse, causal, q_off, k_off,
+        p = _recompute_p(q, kb, scale, lse, causal, window, q_off, k_off,
                          i, j, Bq, Bk)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -171,7 +183,7 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal):
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, window):
     j, i = pl.program_id(1), pl.program_id(2)   # k block outer, q inner
     nq = pl.num_programs(2)
     Bk, D = k_ref.shape[1:]
@@ -188,6 +200,9 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # under shard_map (jax bug); pl.when(cond) routes discharge safely.
     needed = (j >= 0) if not causal else (
         q_off + (i + 1) * Bq - 1 >= k_off + j * Bk)
+    if window is not None:
+        needed &= (k_off + (j + 1) * Bk - 1
+                   >= q_off + i * Bq - (window - 1))
 
     @pl.when(needed)
     def _():
@@ -197,7 +212,7 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
-        p = _recompute_p(q, kb, scale, lse, causal, q_off, k_off,
+        p = _recompute_p(q, kb, scale, lse, causal, window, q_off, k_off,
                          i, j, Bq, Bk)                   # (Bq, Bk)
         dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
@@ -242,11 +257,13 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
 
 
-def _fwd(q3, k3, v3, offs, scale, causal, block_q, block_k, interpret):
+def _fwd(q3, k3, v3, offs, scale, causal, window, block_q, block_k,
+         interpret):
     BH, Tq, D = q3.shape
     Tk = k3.shape[1]
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          window=window),
         grid=(BH, Tq // block_q, Tk // block_k),
         in_specs=[_smem_spec(), _q_spec(block_q, D), _k_spec(block_k, D),
                   _k_spec(block_k, D)],
@@ -266,20 +283,22 @@ def _fwd(q3, k3, v3, offs, scale, causal, block_q, block_k, interpret):
     return o, lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q3, k3, v3, offs, scale, causal, block_q, block_k, interpret):
-    return _fwd(q3, k3, v3, offs, scale, causal, block_q, block_k,
-                interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, offs, scale, causal, window, block_q, block_k,
+           interpret):
+    return _fwd(q3, k3, v3, offs, scale, causal, window, block_q,
+                block_k, interpret)
 
 
-def _flash_fwd(q3, k3, v3, offs, scale, causal, block_q, block_k,
+def _flash_fwd(q3, k3, v3, offs, scale, causal, window, block_q, block_k,
                interpret):
-    o, lse = _fwd(q3, k3, v3, offs, scale, causal, block_q, block_k,
-                  interpret)
+    o, lse = _fwd(q3, k3, v3, offs, scale, causal, window, block_q,
+                  block_k, interpret)
     return (o, lse), (q3, k3, v3, offs, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, cts):
+def _flash_bwd(scale, causal, window, block_q, block_k, interpret, res,
+               cts):
     q3, k3, v3, offs, o, lse = res
     do, dlse = cts
     BH, Tq, D = q3.shape
@@ -293,7 +312,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, cts):
     lse3 = jnp.broadcast_to(lse[..., None], lse.shape + (_LANE,))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          window=window),
         grid=(BH, Tq // block_q, Tk // block_k),
         in_specs=[
             _smem_spec(),
@@ -313,7 +333,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, cts):
     qkvec_spec = pl.BlockSpec(
         (1, block_q, _LANE), lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          window=window),
         grid=(BH, Tk // block_k, Tq // block_q),
         in_specs=[
             _smem_spec(),
@@ -348,7 +369,8 @@ def flash_attention_supported(T_q: int, T_k: int, block_q: int = 256,
             and bq % 8 == 0 and bk % 8 == 0)
 
 
-def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
+def flash_attention(q, k, v, *, causal: bool = False, window=None,
+                    q_offset=0,
                     k_offset=0, block_q: int = 256, block_k: int = 512,
                     return_lse: bool = False, interpret: bool = False):
     """Flash attention over ``(B, T, H, D)`` tensors.
@@ -359,7 +381,8 @@ def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
     positions exactly like
     :func:`...parallel.ring_attention.local_attention`, with one
     deliberate divergence: a query row whose ENTIRE K range is masked
-    (possible only when ``k_offset > q_offset``) returns **zeros** and an
+    (when ``k_offset > q_offset``, or with ``window`` when the K range
+    lies entirely before the row's window) returns **zeros** and an
     lse of ≈``-1e30``, where the XLA oracle returns the meaningless
     uniform-softmax mean of V.  Zeros/-inf are the correct identities for
     callers that combine per-shard partials via lse.
@@ -369,6 +392,11 @@ def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding causal "
+                         "window attention)")
+    if window is not None and window < 1:
+        raise ValueError(f"window {window} must be >= 1")
     if not flash_attention_supported(Tq, Tk, block_q, block_k):
         raise ValueError(
             f"sequence lengths ({Tq}, {Tk}) unsupported for blocks "
@@ -381,6 +409,7 @@ def flash_attention(q, k, v, *, causal: bool = False, q_offset=0,
                    jnp.asarray(k_offset, jnp.int32)]))
     to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
     o, lse = _flash(to3(q), to3(k), to3(v), offs, D ** -0.5, causal,
+                    None if window is None else int(window),
                     block_q, block_k, interpret)
     o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     if return_lse:

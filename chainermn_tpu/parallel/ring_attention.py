@@ -78,23 +78,30 @@ def _pv_mix(p, v):
     return o.reshape(B, H, T, -1)
 
 
-def local_attention(q, k, v, *, causal: bool = False, q_offset=0,
-                    k_offset=0):
+def local_attention(q, k, v, *, causal: bool = False, window=None,
+                    q_offset=0, k_offset=0):
     """Plain softmax attention on local blocks (the S=1 degenerate case and
     the reference oracle for tests).  ``q: (B, T, H, D)``; ``k``/``v`` may
-    carry fewer (shared) heads ``(B, S, G, D)`` with ``G | H`` (GQA)."""
+    carry fewer (shared) heads ``(B, S, G, D)`` with ``G | H`` (GQA).
+    ``window``: sliding causal window — token t attends to
+    ``(t-window, t]`` (requires ``causal``)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     scale = q.shape[-1] ** -0.5
     s = _qk_scores(q, k) * scale
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         allow = qpos[:, None] >= kpos[None, :]
+        if window is not None:
+            allow &= (qpos[:, None] - kpos[None, :]) < window
         s = jnp.where(allow[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return _pv_mix(p, v).transpose(0, 2, 1, 3)
 
 
-def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset):
+def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset,
+                        window=None):
     """XLA computation of one (Q block × K/V block) partial with its
     log-sum-exp — semantics identical to
     ``flash_attention(..., return_lse=True)`` including the fully-masked
@@ -109,7 +116,10 @@ def _lse_attention_pair(q, kb, vb, *, causal, q_offset, k_offset):
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(kb.shape[1])
-        allow = (qpos[:, None] >= kpos[None, :])[None, None]
+        allow = qpos[:, None] >= kpos[None, :]
+        if window is not None:
+            allow &= (qpos[:, None] - kpos[None, :]) < window
+        allow = allow[None, None]
         s = jnp.where(allow, s, _NEG)
     m = s.max(axis=-1)                                   # (B,H,T)
     p = jnp.exp(s - m[..., None])
@@ -171,7 +181,7 @@ def _block_positions(rr, T, S, layout):
 
 
 def ring_attention(q, k, v, *, axis_name: str = "seq",
-                   causal: bool = False, remat: bool = True,
+                   causal: bool = False, window=None, remat: bool = True,
                    use_flash: bool = False, block_q: int = 256,
                    block_k: int = 512, interpret: bool = False,
                    layout: str = "contiguous"):
@@ -208,6 +218,8 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"layout {layout!r} not in (contiguous, zigzag)")
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     S = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     B, T, H, D = q.shape
@@ -217,11 +229,21 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
     if layout == "zigzag" and T % 2:
         raise ValueError(f"zigzag needs an even local length, got {T}")
 
+    # windowed contiguous causal rings: visiting blocks more than
+    # ceil(W/T) positions behind are entirely out-of-window, and blocks
+    # ahead are entirely future — truncate the ring statically instead
+    # of rotating and masking S-1 times (zigzag keeps all steps: each
+    # device also holds a mirrored late chunk whose window reaches far)
+    n_steps = S
+    if window is not None and causal and layout == "contiguous":
+        n_steps = min(S, -(-window // T) + 1)
+
     if use_flash:
         return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
+                           window=window,
                            remat=remat, block_q=block_q, block_k=block_k,
                            interpret=interpret, S=S, r=r, ring=ring,
-                           layout=layout)
+                           layout=layout, n_steps=n_steps)
 
     def block_step(carry, i):
         k_blk, v_blk, num, den, m = carry
@@ -231,6 +253,8 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
             qpos = _block_positions(r, T, S, layout)
             kpos = _block_positions(src, T, S, layout)
             allow = qpos[:, None] >= kpos[None, :]
+            if window is not None:
+                allow &= (qpos[:, None] - kpos[None, :]) < window
             s = jnp.where(allow[None, None], s, _NEG)
         # online softmax update (flash recurrence)
         m_new = jnp.maximum(m, s.max(axis=-1))           # (B,H,T)
@@ -255,7 +279,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
     den0 = zq[..., 0]                                    # (B,H,T)
     m0 = den0 + jnp.asarray(_NEG, q.dtype)
     (k, v, num, den, m), _ = lax.scan(
-        step, (k, v, num0, den0, m0), jnp.arange(S))
+        step, (k, v, num0, den0, m0), jnp.arange(n_steps))
     out = num / den[..., None]                           # (B,H,T,D)
     return out.transpose(0, 2, 1, 3)                     # (B,T,H,D)
 
@@ -268,8 +292,9 @@ def _merge_lse(o, lse, o_i, lse_i):
     return o * w_old + o_i * w_new, lse_new
 
 
-def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
-                interpret, S, r, ring, layout="contiguous"):
+def _ring_flash(q, k, v, *, axis_name, causal, window, remat, block_q,
+                block_k, interpret, S, r, ring, layout="contiguous",
+                n_steps=None):
     """Ring schedule with the Pallas kernel as the per-pair compute.
 
     Every visiting K/V block is attended with the SAME kernel call,
@@ -306,12 +331,14 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
         # covered standalone by the ops tests; TPU runs the real kernel)
         def pair(qq, kb, vb, q_off, k_off):
             return _lse_attention_pair(
-                qq, kb, vb, causal=causal, q_offset=q_off, k_offset=k_off)
+                qq, kb, vb, causal=causal, window=window,
+                q_offset=q_off, k_offset=k_off)
     else:
         def pair(qq, kb, vb, q_off, k_off):
             kb, vb = broadcast_kv(kb, vb, rep)
             return flash_attention(
-                qq, kb, vb, causal=causal, q_offset=q_off, k_offset=k_off,
+                qq, kb, vb, causal=causal, window=window,
+                q_offset=q_off, k_offset=k_off,
                 block_q=block_q, block_k=block_k, return_lse=True,
                 interpret=False)
 
@@ -339,9 +366,11 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
         return (jnp.concatenate([o for o, _ in outs], axis=1),
                 jnp.concatenate([l for _, l in outs], axis=1))
 
+    if n_steps is None:
+        n_steps = S
     # step 0: self block
     o, lse = attend_block(k, v, r)
-    if S == 1:
+    if n_steps == 1:
         return o.astype(q.dtype)
 
     def block_step(carry, i):
@@ -354,5 +383,6 @@ def _ring_flash(q, k, v, *, axis_name, causal, remat, block_q, block_k,
         return (k_blk, v_blk, o, lse), None
 
     step = jax.checkpoint(block_step) if remat else block_step
-    (k, v, o, lse), _ = lax.scan(step, (k, v, o, lse), jnp.arange(1, S))
+    (k, v, o, lse), _ = lax.scan(
+        step, (k, v, o, lse), jnp.arange(1, n_steps))
     return o.astype(q.dtype)

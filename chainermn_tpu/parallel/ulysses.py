@@ -37,7 +37,7 @@ __all__ = ["ulysses_attention"]
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = "seq",
-                      causal: bool = False,
+                      causal: bool = False, window=None,
                       attn_fn: Optional[Callable] = None):
     """Sequence-parallel exact attention.  Call INSIDE ``shard_map`` over
     ``axis_name`` with Q/K/V sequence-sharded ``(B, T/S, H, D)``.
@@ -77,7 +77,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
         # local post-exchange broadcast for kernels wanting equal heads
         k, v = broadcast_kv(k, v, rep)
     fn = attn_fn or local_attention
-    out = fn(q, k, v, causal=causal)
+    out = fn(q, k, v, causal=causal, window=window) if window is not None \
+        else fn(q, k, v, causal=causal)
     if S > 1:
         # inverse exchange: scatter sequence, gather heads
         out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
