@@ -1,0 +1,115 @@
+"""KV-cache decode throughput benchmark: generated tokens/sec.
+
+Measures greedy generation on the flagship transformer (GQA + RoPE —
+the inference-lean configuration) on one chip.  No reference number
+exists (the reference's generation path was a greedy LSTM loop), so
+``vs_baseline`` is tokens/sec divided by 500 — an order-of-magnitude
+yardstick for a ~300M-param bf16 decoder on one chip, not an upstream
+measurement.  Same hermetic child-process pattern as bench.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from _bench_common import pin_platform, run_child_with_retries
+
+METRIC = "transformer_greedy_decode_tokens_per_sec"
+UNIT = "tokens/sec"
+_YARDSTICK = 500.0
+
+
+def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
+        n_heads=16, n_kv_heads=4, warmup=1, iters=2):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models import (
+        TransformerConfig, init_transformer, make_generate_fn,
+        shard_params,
+    )
+    from chainermn_tpu.parallel import MeshConfig
+
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_heads=n_heads,
+        n_kv_heads=n_kv_heads, d_head=d_model // n_heads,
+        d_ff=4 * d_model, n_layers=n_layers, max_seq=max_len,
+        attention="local", pos_embedding="rope", dtype="bfloat16",
+        remat=False,
+    )
+    mc = MeshConfig(data=1, devices=jax.devices()[:1])
+    params = shard_params(
+        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+    gen = make_generate_fn(mc, cfg, max_len=max_len)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch, prompt_len)), jnp.int32)
+
+    for _ in range(warmup):
+        out = gen(params, prompt)
+    if warmup:
+        int(np.asarray(out)[0, -1])  # device->host sync (axon quirk)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = gen(params, prompt)
+    int(np.asarray(out)[0, -1])
+    dt = time.perf_counter() - t0
+
+    new_tokens = (max_len - prompt_len) * batch
+    tok_s = new_tokens * iters / dt
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    return {
+        "metric": METRIC,
+        "value": round(tok_s, 1),
+        "unit": UNIT,
+        # per-SEQUENCE rate vs the yardstick (batch-independent, matching
+        # the recorded BENCH_MEASURED entries)
+        "vs_baseline": round(tok_s / batch / _YARDSTICK, 3),
+        "tokens_per_sec_per_seq": round(tok_s / batch, 1),
+        "device_kind": jax.devices()[0].device_kind,
+        "batch": batch, "max_len": max_len,
+        "n_params": int(n_params),
+        "n_kv_heads": n_kv_heads,
+    }
+
+
+def main(argv):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--child", action="store_true")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--n-layers", type=int, default=8)
+    p.add_argument("--d-model", type=int, default=1024)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--iters", type=int, default=2)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--timeouts", type=int, nargs="+",
+                   default=[900, 600])  # the 511-step decode scan compiles slowly
+    args = p.parse_args(argv)
+
+    if args.child:
+        pin_platform(args.platform)
+        print("BENCH_RESULT " + json.dumps(run(
+            batch=args.batch, max_len=args.max_len,
+            n_layers=args.n_layers, d_model=args.d_model,
+            warmup=args.warmup, iters=args.iters)))
+        return 0
+
+    here = os.path.abspath(__file__)
+    cmd = [sys.executable, here, "--child",
+           "--batch", str(args.batch), "--max-len", str(args.max_len),
+           "--n-layers", str(args.n_layers),
+           "--d-model", str(args.d_model),
+           "--warmup", str(args.warmup), "--iters", str(args.iters)]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    return run_child_with_retries(
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

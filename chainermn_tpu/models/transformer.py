@@ -309,6 +309,7 @@ def _attention(cfg: TransformerConfig, h, blk):
     """Pre-LN attention: column-parallel QKV (heads sharded over ``model``),
     seq-parallel core (ring/Ulysses over ``seq``), row-parallel output."""
     cd = cfg.compute_dtype
+    win = cfg.attention_window or None
     x = _rms_norm(h, blk["ln1"])
     B, T, D = x.shape
     if "wqkv" in blk:
@@ -355,7 +356,7 @@ def _attention(cfg: TransformerConfig, h, blk):
             # each zigzag half-run must itself fit the kernel's blocks
             use_flash = flash_attention_supported(T // 2, T // 2)
         o = ring_attention(q, k, v, axis_name="seq", causal=True,
-                           window=cfg.attention_window or None,
+                           window=win,
                            remat=cfg.remat, use_flash=use_flash,
                            layout=cfg.seq_layout,
                            interpret=jax.default_backend() != "tpu")
@@ -369,14 +370,14 @@ def _attention(cfg: TransformerConfig, h, blk):
             fa = partial(flash_attention,
                          interpret=jax.default_backend() != "tpu")
             o = ulysses_attention(q, k, v, axis_name="seq", causal=True,
-                                  window=cfg.attention_window or None,
+                                  window=win,
                                   attn_fn=fa)
         else:
             o = ulysses_attention(q, k, v, axis_name="seq", causal=True,
-                                  window=cfg.attention_window or None)
+                                  window=win)
     elif cfg.attention == "local":
         o = local_attention(q, k, v, causal=True,
-                            window=cfg.attention_window or None)
+                            window=win)
     elif cfg.attention == "flash":
         # Pallas kernel (TPU); non-TPU backends run the same kernel
         # through the Pallas interpreter so one config works everywhere.
@@ -391,13 +392,13 @@ def _attention(cfg: TransformerConfig, h, blk):
             # fall back to the XLA path instead of erroring at trace time
             # (grouped-KV read in place; no broadcast)
             o = local_attention(q, k, v, causal=True,
-                                window=cfg.attention_window or None)
+                                window=win)
         else:
             # kernel wants matching head counts
             k, v = broadcast_kv(k, v, q.shape[2] // k.shape[2])
             o = flash_attention(
                 q, k, v, causal=True,
-                window=cfg.attention_window or None,
+                window=win,
                 interpret=jax.default_backend() != "tpu")
     else:
         raise ValueError(cfg.attention)

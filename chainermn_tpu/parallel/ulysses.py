@@ -42,9 +42,10 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
     """Sequence-parallel exact attention.  Call INSIDE ``shard_map`` over
     ``axis_name`` with Q/K/V sequence-sharded ``(B, T/S, H, D)``.
 
-    ``attn_fn(q, k, v, causal=...)`` runs on full-sequence, head-sharded
-    tensors; defaults to :func:`local_attention` (swap in the pallas flash
-    kernel for production).
+    ``attn_fn(q, k, v, causal=..., window=...)`` runs on full-sequence,
+    head-sharded tensors; defaults to :func:`local_attention` (swap in
+    the pallas flash kernel for production — any ``attn_fn`` must accept
+    the ``window`` keyword, if only to reject it).
 
     GQA/MQA: ``k``/``v`` may carry fewer (shared) heads ``G`` with
     ``S | G`` and ``G | H`` — the all-to-alls then move K/V at ``G``-head
@@ -77,8 +78,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
         # local post-exchange broadcast for kernels wanting equal heads
         k, v = broadcast_kv(k, v, rep)
     fn = attn_fn or local_attention
-    out = fn(q, k, v, causal=causal, window=window) if window is not None \
-        else fn(q, k, v, causal=causal)
+    out = fn(q, k, v, causal=causal, window=window)
     if S > 1:
         # inverse exchange: scatter sequence, gather heads
         out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,

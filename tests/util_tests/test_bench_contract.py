@@ -1,0 +1,79 @@
+"""The driver-gate contract for every bench script: print exactly ONE
+JSON line with {"metric", "value", "unit", "vs_baseline"} — measured
+values on success, value=null + an "error" diagnosis on failure — and
+exit 0 either way.  A bench that crashes without JSON wastes an entire
+round (round 1's BENCH_r01.json was a stack trace)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _run(script, args, timeout=600):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("TPU_", "LIBTPU", "PJRT_", "JAX_", "XLA_")):
+            env.pop(k)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, script), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=_ROOT,
+        env=env)
+    return proc
+
+
+def _assert_contract(proc, expect_value):
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    if expect_value:
+        assert rec["value"] is not None and rec["value"] > 0, rec
+    else:
+        assert rec["value"] is None and "error" in rec, rec
+    return rec
+
+
+def test_bench_resnet_success_contract():
+    rec = _assert_contract(
+        _run("bench.py", ["--platform", "cpu", "--batch", "4",
+                          "--image", "32", "--warmup", "1",
+                          "--iters", "2", "--timeouts", "420"]),
+        expect_value=True)
+    assert rec["unit"] == "images/sec/chip"
+
+
+def test_bench_failure_still_prints_json():
+    # an unknown platform makes the child crash fast; the parent must
+    # still emit the one-line diagnosis and exit 0
+    rec = _assert_contract(
+        _run("bench.py", ["--platform", "definitely-not-a-backend",
+                          "--timeouts", "120"]),
+        expect_value=False)
+    assert "attempt" in rec["error"]
+
+
+@pytest.mark.parametrize("script,args,unit", [
+    ("bench_transformer.py",
+     ["--batch", "2", "--seq", "32", "--d-model", "32", "--n-layers", "1",
+      "--n-heads", "2", "--warmup", "0", "--iters", "1",
+      "--attention", "local"], "tokens/sec/chip"),
+    ("bench_decode.py",
+     ["--batch", "2", "--max-len", "32", "--n-layers", "1",
+      "--d-model", "64", "--warmup", "0", "--iters", "1"], "tokens/sec"),
+    ("bench_attention.py",
+     ["--seq", "64", "--batch", "1", "--iters", "1"], "x"),
+], ids=["transformer", "decode", "attention"])
+def test_other_benches_contract(script, args, unit):
+    rec = _assert_contract(
+        _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
+        expect_value=True)
+    assert rec["unit"] == unit
