@@ -3,9 +3,10 @@
 Measures greedy generation on the flagship transformer (GQA + RoPE —
 the inference-lean configuration) on one chip.  No reference number
 exists (the reference's generation path was a greedy LSTM loop), so
-``vs_baseline`` is tokens/sec divided by 500 — an order-of-magnitude
-yardstick for a ~300M-param bf16 decoder on one chip, not an upstream
-measurement.  Same hermetic child-process pattern as bench.py.
+``vs_baseline`` is per-SEQUENCE tokens/sec divided by 500 — an
+order-of-magnitude, batch-independent yardstick for a ~300M-param bf16
+decoder on one chip, not an upstream measurement (``value`` stays the
+batch-aggregate rate).  Same hermetic child-process pattern as bench.py.
 """
 
 import argparse

@@ -14,7 +14,7 @@ from .optimizers import (
 )
 from .trainer import LogReport, PrintReport, Trainer, make_extension
 from .triggers import IntervalTrigger, get_trigger
-from .updater import StandardUpdater, default_converter
+from .updater import StandardUpdater, default_converter, fuse_steps
 
 __all__ = [
     "Evaluator",
@@ -28,6 +28,7 @@ __all__ = [
     "create_multi_node_optimizer",
     "cross_replica_mean",
     "default_converter",
+    "fuse_steps",
     "get_trigger",
     "make_extension",
     "zero1_init",

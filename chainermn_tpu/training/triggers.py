@@ -10,13 +10,25 @@ class IntervalTrigger:
             raise ValueError(f"unit must be epoch|iteration, got {unit!r}")
         self.period = period
         self.unit = unit
-        self._last_fired_count = -1
+        # iteration unit uses CROSSING semantics (like the epoch branch):
+        # with fused update windows (steps_per_execution > 1) iteration
+        # advances by k per update, so ``it % period == 0`` would skip any
+        # trigger point falling inside a window.
+        self._seen_iteration = None
+        self._seen_fire = False
 
     def __call__(self, trainer) -> bool:
         if self.unit == "iteration":
             it = trainer.updater.iteration
-            fire = it > 0 and it % self.period == 0
-            return fire
+            if it == self._seen_iteration:
+                # idempotent within one iteration (an extension entry may
+                # probe its trigger more than once per loop turn)
+                return self._seen_fire
+            prev = self._seen_iteration or 0
+            self._seen_iteration = it
+            self._seen_fire = it > 0 and \
+                int(it / self.period) > int(prev / self.period)
+            return self._seen_fire
         # epoch unit: fire when an epoch boundary was crossed this iteration
         prev = trainer.updater.previous_epoch_detail
         cur = trainer.updater.epoch_detail

@@ -1,0 +1,155 @@
+"""fuse_steps / steps_per_execution — fused multi-step windows must be
+numerically identical to the plain one-dispatch-per-step loop (the fusion
+is a latency optimisation, never a semantics change)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models import init_mlp, mlp_apply, softmax_cross_entropy
+from chainermn_tpu.training import fuse_steps
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla")
+
+
+def _toy_step():
+    opt = optax.sgd(0.1, momentum=0.9)
+
+    def step(carry, x, y):
+        params, opt_state = carry
+
+        def loss_fn(p):
+            return jnp.mean((mlp_apply(p, x).squeeze(-1) - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    params = init_mlp(jax.random.PRNGKey(0), [4, 8, 1])
+    return step, (params, opt.init(params))
+
+
+class TestFuseSteps:
+    def test_fixed_batch_matches_loop(self):
+        step, carry = _toy_step()
+        x = jnp.asarray(np.random.RandomState(0).randn(16, 4), jnp.float32)
+        y = jnp.asarray(np.random.RandomState(1).randn(16), jnp.float32)
+
+        loop_carry, losses = carry, []
+        for _ in range(5):
+            loop_carry, l = step(loop_carry, x, y)
+            losses.append(l)
+
+        fused = jax.jit(fuse_steps(step, 5))
+        fused_carry, fused_losses = fused(carry, x, y)
+
+        assert fused_losses.shape == (5,)
+        np.testing.assert_allclose(
+            np.asarray(fused_losses), np.asarray(jnp.stack(losses)),
+            rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5),
+            fused_carry, loop_carry)
+
+    def test_scan_batches_matches_loop(self):
+        step, carry = _toy_step()
+        rng = np.random.RandomState(2)
+        xs = jnp.asarray(rng.randn(4, 16, 4), jnp.float32)
+        ys = jnp.asarray(rng.randn(4, 16), jnp.float32)
+
+        loop_carry = carry
+        for i in range(4):
+            loop_carry, _ = step(loop_carry, xs[i], ys[i])
+
+        fused = jax.jit(fuse_steps(step, 4, scan_batches=True))
+        fused_carry, fused_losses = fused(carry, xs, ys)
+
+        assert fused_losses.shape == (4,)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5),
+            fused_carry, loop_carry)
+
+
+def _dataset(n=96, dim=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32), np.int32(i % classes))
+            for i in range(n)]
+
+
+def _make_updater(comm, steps_per_execution, repeat=True, n=96,
+                  batch_size=16):
+    it = cmn.SerialIterator(_dataset(n=n), batch_size, repeat=repeat,
+                            shuffle=True, seed=7)
+    params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    return cmn.StandardUpdater(
+        it, opt, loss_fn, params, comm,
+        steps_per_execution=steps_per_execution)
+
+
+class TestStepsPerExecution:
+    def test_identical_to_unfused(self, comm):
+        plain = _make_updater(comm, 1)
+        fused = _make_updater(comm, 3)
+
+        for _ in range(6):
+            plain.update()
+        for _ in range(2):
+            fused.update()
+
+        assert plain.iteration == fused.iteration == 6
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            plain.params, fused.params)
+
+    def test_window_mean_loss_observed(self, comm):
+        fused = _make_updater(comm, 3)
+        fused.update()
+        assert float(fused.observation["main/loss"]) > 0
+        assert fused.iteration == 3
+
+    def test_ragged_tail_batch(self, comm):
+        # 40 examples / batch 16 -> batches of 16, 16, 8: the ragged tail
+        # cannot stack into the window and must still be consumed.
+        upd = _make_updater(comm, 4, repeat=False, n=40)
+        upd.update()
+        assert upd.iteration == 3
+        with pytest.raises(StopIteration):
+            upd.update()
+
+    def test_iteration_trigger_crossing_inside_window(self, comm):
+        # window of 3, trigger every 5 iterations: the trigger points 5,
+        # 25, ... fall INSIDE fused windows (iteration jumps 3->6,
+        # 24->27) and must still fire via crossing semantics.
+        upd = _make_updater(comm, 3)
+        trainer = cmn.Trainer(upd, (5, "epoch"))
+        fired = []
+
+        @cmn.training.make_extension(trigger=(5, "iteration"))
+        def probe(tr):
+            fired.append(tr.updater.iteration)
+
+        trainer.extend(probe)
+        trainer.run()
+        # 30 iterations in windows of 3 -> crossings of 5 at 6,12,15,21,
+        # 27,30 (one fire per crossed multiple of 5)
+        assert fired == [6, 12, 15, 21, 27, 30]
+
+    def test_trainer_stop_trigger_with_fused_window(self, comm):
+        # 96/16 = 6 batches/epoch; window 3 divides it: 2 updates/epoch.
+        upd = _make_updater(comm, 3)
+        trainer = cmn.Trainer(upd, (2, "epoch"))
+        trainer.run()
+        assert upd.iteration == 12
+        assert upd.epoch == 2
