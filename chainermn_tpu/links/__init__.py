@@ -6,6 +6,9 @@
 - :mod:`chainermn_tpu.links.multi_node_chain_list` — declarative cross-rank
   model graph (reference: ``chainermn/links/multi_node_chain_list.py``,
   ``MultiNodeChainList``).
+- :mod:`chainermn_tpu.links.n_step_rnn` — stacked RNN split across ranks
+  by layer (reference: ``chainermn/links/n_step_rnn.py``,
+  ``create_multi_node_n_step_rnn``).
 
 The high-throughput pipeline-parallel path (homogeneous stacked stages,
 micro-batching, stage-sharded parameters) lives in
@@ -19,10 +22,12 @@ from chainermn_tpu.links.batch_normalization import (
     multi_node_batch_normalization,
 )
 from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+from chainermn_tpu.links.n_step_rnn import create_multi_node_n_step_rnn
 
 __all__ = [
     "BatchNormState",
     "MultiNodeChainList",
+    "create_multi_node_n_step_rnn",
     "init_batch_norm",
     "multi_node_batch_normalization",
 ]

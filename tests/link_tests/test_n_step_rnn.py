@@ -1,0 +1,140 @@
+"""create_multi_node_n_step_rnn — the layer-split multi-rank RNN must
+match a sequential (single-"rank") run of the same stack exactly, in
+forward and backward, and masked pad steps must carry state through."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.communicators._mesh_utils import make_world_mesh
+from chainermn_tpu.links import create_multi_node_n_step_rnn
+from chainermn_tpu.links.n_step_rnn import _stage_apply
+
+AX = "world"
+B, T, D_IN, D_H = 4, 6, 5, 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_world_mesh(axis_name=AX)
+
+
+def smap(mesh, fn, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+
+
+def _data(seed=0, ragged=False):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(B, T, D_IN).astype(np.float32)
+    if ragged:
+        lens = rng.randint(2, T + 1, size=B)
+        mask = (np.arange(T)[None, :] < lens[:, None]).astype(np.float32)
+        xs = xs * mask[:, :, None]
+    else:
+        mask = np.ones((B, T), np.float32)
+    return jnp.asarray(xs), jnp.asarray(mask)
+
+
+def _oracle(params_list, xs, mask, cell):
+    """Sequential run: concatenate every stage's layers into one stack."""
+    layers = [p for stage in params_list for p in stage]
+    return _stage_apply(layers, xs, mask, cell)
+
+
+@pytest.mark.parametrize("cell", ["lstm", "gru", "tanh"])
+@pytest.mark.parametrize("ragged", [False, True])
+def test_forward_matches_sequential(mesh, cell, ragged):
+    chain = create_multi_node_n_step_rnn(
+        4, D_IN, D_H, n_stages=4, cell=cell, axis_name=AX)
+    params = chain.init(jax.random.PRNGKey(0))
+    xs, mask = _data(ragged=ragged)
+
+    ys, hy, cy = smap(
+        mesh, lambda x, m: chain.apply(params, (x, m)),
+        in_specs=(P(), P()), out_specs=P())(xs, mask)
+    o_ys, o_hy, o_cy = _oracle(params, xs, mask, cell)
+
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(o_ys),
+                               rtol=1e-5, atol=1e-6)
+    # chain returns the LAST stage's (1-layer) final states
+    np.testing.assert_allclose(np.asarray(hy), np.asarray(o_hy[-1:]),
+                               rtol=1e-5, atol=1e-6)
+    if cell == "lstm":
+        np.testing.assert_allclose(np.asarray(cy), np.asarray(o_cy[-1:]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_backward_matches_sequential(mesh):
+    chain = create_multi_node_n_step_rnn(
+        4, D_IN, D_H, n_stages=2, cell="lstm", axis_name=AX)
+    params = chain.init(jax.random.PRNGKey(1))
+    xs, mask = _data(seed=3, ragged=True)
+
+    def dist_loss(params, x, m):
+        ys, _, _ = chain.apply(params, (x, m))
+        return jnp.sum(ys ** 2)
+
+    def dist_grads(params, x, m):
+        g = jax.grad(dist_loss)(params, x, m)
+        return chain.reduce_grads(g)
+
+    g_dist = smap(mesh, dist_grads, in_specs=(P(), P(), P()),
+                  out_specs=P())(params, xs, mask)
+
+    def seq_loss(params):
+        ys, _, _ = _oracle(params, xs, mask, "lstm")
+        return jnp.sum(ys ** 2)
+
+    g_seq = jax.grad(seq_loss)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g_dist, g_seq)
+
+
+def test_mask_carries_state_through_pads(mesh):
+    """Final states of a padded sequence == final states of its truncated
+    dense version (the ragged-NStepLSTM contract)."""
+    chain = create_multi_node_n_step_rnn(
+        2, D_IN, D_H, n_stages=2, cell="lstm", axis_name=AX)
+    params = chain.init(jax.random.PRNGKey(2))
+
+    rng = np.random.RandomState(5)
+    t_real = 3
+    xs_short = rng.randn(B, t_real, D_IN).astype(np.float32)
+    xs_pad = np.concatenate(
+        [xs_short, rng.randn(B, T - t_real, D_IN).astype(np.float32)],
+        axis=1)
+    mask_pad = np.concatenate(
+        [np.ones((B, t_real), np.float32),
+         np.zeros((B, T - t_real), np.float32)], axis=1)
+
+    run = smap(mesh, lambda x, m: chain.apply(params, (x, m)),
+               in_specs=(P(), P()), out_specs=P())
+    _, hy_pad, cy_pad = run(jnp.asarray(xs_pad), jnp.asarray(mask_pad))
+    _, hy_short, cy_short = run(
+        jnp.asarray(xs_short), jnp.ones((B, t_real), jnp.float32))
+
+    np.testing.assert_allclose(np.asarray(hy_pad), np.asarray(hy_short),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cy_pad), np.asarray(cy_short),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_uneven_layer_split():
+    chain = create_multi_node_n_step_rnn(5, D_IN, D_H, n_stages=3)
+    params = chain.init(jax.random.PRNGKey(0))
+    assert [len(p) for p in params] == [2, 2, 1]
+    # first layer consumes d_in, all others d_hidden
+    assert params[0][0]["w"].shape == (D_IN, 4 * D_H)
+    assert params[0][1]["w"].shape == (D_H, 4 * D_H)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="cell"):
+        create_multi_node_n_step_rnn(2, 4, 4, 2, cell="conv")
+    with pytest.raises(ValueError, match="n_stages"):
+        create_multi_node_n_step_rnn(2, 4, 4, 3)
