@@ -12,6 +12,8 @@
   state, e.g. BN running stats, across processes.
 - :func:`add_global_except_hook` — uncaught exception on any process kills
   the whole job instead of deadlocking the collective.
+- :class:`PreemptionCheckpointer` — checkpoint + clean stop on the TPU
+  preemption SIGTERM notice (beyond reference; see module docstring).
 """
 
 from chainermn_tpu.extensions.allreduce_persistent import (
@@ -27,12 +29,14 @@ from chainermn_tpu.extensions.global_except_hook import (
 from chainermn_tpu.extensions.observation_aggregator import (
     ObservationAggregator,
 )
+from chainermn_tpu.extensions.preemption import PreemptionCheckpointer
 from chainermn_tpu.extensions.snapshot import multi_node_snapshot
 
 __all__ = [
     "AllreducePersistentValues",
     "MultiNodeCheckpointer",
     "ObservationAggregator",
+    "PreemptionCheckpointer",
     "add_global_except_hook",
     "create_multi_node_checkpointer",
     "multi_node_snapshot",

@@ -1,0 +1,129 @@
+"""Preemption-aware checkpointing — save-and-stop on SIGTERM.
+
+Beyond-reference subsystem (SURVEY §5 "failure detection": the reference
+had none — fault tolerance was checkpoint + full restart, and a
+preempted rank simply died, losing everything since the last periodic
+snapshot).  On TPU this matters more, not less: preemptible/spot TPU
+slices receive a SIGTERM grace notice (~30 s) before reclamation, so a
+job that checkpoints *on* the notice loses zero work instead of up to
+one checkpoint interval.
+
+Design:
+
+- a signal handler (installed on the MAIN thread; Python delivers
+  signals between bytecodes, so it can fire mid-``update``) only sets a
+  flag — all real work happens at the next iteration boundary, where
+  the train state is consistent;
+- the decision to save is made COLLECTIVELY: one host gets the signal
+  first (or only — single-host preemption of a multi-host job), so the
+  flag is OR-reduced across processes via the object collectives before
+  acting.  Every process then checkpoints the same iteration and the
+  restored run is bitwise-consistent with a normal resume;
+- after the save the trainer is stopped cleanly (``trainer.stop()``),
+  letting ``finalize`` hooks (async checkpoint writer joins, log flush)
+  run — no ``os._exit`` races with an in-flight shard write.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Optional, Sequence
+
+__all__ = ["PreemptionCheckpointer"]
+
+
+class PreemptionCheckpointer:
+    """Trainer extension: checkpoint + clean stop when a preemption
+    signal arrives anywhere in the job.
+
+    Args:
+      checkpointer: a ``MultiNodeCheckpointer`` (its ``save`` is reused,
+        so shard naming / GC / resume agreement are identical to
+        periodic snapshots — ``maybe_load`` on restart just works).
+      comm: communicator used for the cross-process flag OR-reduce;
+        ``None`` (or single-process) skips the collective.
+      signals: signal numbers to trap (default ``SIGTERM``, the TPU/GCE
+        preemption notice).  Previous handlers are chained, not
+        replaced, and restored on ``finalize``.
+      check_interval: poll the cross-process flag every N iterations
+        (raise it if host-side object collectives are expensive in a
+        very large job; the grace window is seconds, so 1 is right for
+        nearly everyone).
+    """
+
+    trigger = (1, "iteration")
+    # runs LAST on its tick: if a periodic snapshot and the preemption
+    # save land on the same iteration, the log writers flush first so the
+    # saved LogReport history is complete (same reason the checkpointer
+    # itself has low priority)
+    priority = 20
+
+    def __init__(self, checkpointer, comm=None,
+                 signals: Sequence[int] = (signal.SIGTERM,),
+                 check_interval: int = 1):
+        self.checkpointer = checkpointer
+        self.comm = comm
+        self.signaled = False
+        self._signals = tuple(signals)
+        self._prev_handlers = {}
+        self._check_interval = max(int(check_interval), 1)
+        self._calls = 0
+        self._installed = False
+
+    # -- signal plumbing ------------------------------------------------
+    def _handler(self, signum, frame):
+        self.signaled = True
+        prev = self._prev_handlers.get(signum)
+        if callable(prev) and prev not in (
+                signal.SIG_IGN, signal.SIG_DFL, self._handler):
+            prev(signum, frame)
+
+    def _install(self):
+        if self._installed:
+            return
+        for s in self._signals:
+            self._prev_handlers[s] = signal.signal(s, self._handler)
+        self._installed = True
+
+    def _uninstall(self):
+        if not self._installed:
+            return
+        for s, prev in self._prev_handlers.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, TypeError):  # non-main thread / None
+                pass
+        self._prev_handlers.clear()
+        self._installed = False
+
+    # -- trainer extension protocol ------------------------------------
+    def initialize(self, trainer):
+        self._install()
+
+    def _global_flag(self) -> bool:
+        comm = self.comm
+        if comm is None or getattr(comm, "inter_size", 1) <= 1:
+            return self.signaled
+        flags = comm.allgather_obj(bool(self.signaled))
+        return any(flags)
+
+    def __call__(self, trainer):
+        self._calls += 1
+        # Gate on the SHARED cadence only: every process must make the
+        # same enter/skip decision for the allgather below, or a
+        # signaled rank's collective would pair with an unsignaled
+        # rank's next-cadence call and they would checkpoint different
+        # iterations.  (A signaled process therefore waits until the
+        # next cadence tick — with the default interval of 1, none.)
+        if self._calls % self._check_interval:
+            return
+        if not self._global_flag():
+            return
+        it = trainer.updater.iteration
+        self.checkpointer.save(trainer.updater, trainer)
+        trainer.stop(
+            f"preemption signal received; checkpoint saved at "
+            f"iteration {it}")
+
+    def finalize(self, trainer=None):
+        self._uninstall()

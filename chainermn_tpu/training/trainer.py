@@ -49,6 +49,14 @@ class Trainer:
         self.observation = {}
         self.elapsed_time = 0.0
         self._start = None
+        self._stop_requested = False
+        self.stop_reason = None
+
+    def stop(self, reason: str = None):
+        """Request a clean stop: the loop exits after the current
+        iteration's extensions run (used by preemption handling)."""
+        self._stop_requested = True
+        self.stop_reason = reason
 
     def extend(self, extension, trigger=None, name=None, priority=None):
         trig = trigger if trigger is not None else getattr(
@@ -62,6 +70,8 @@ class Trainer:
         return self
 
     def _done(self) -> bool:
+        if self._stop_requested:
+            return True
         if self._stop_unit == "epoch":
             return self.updater.epoch_detail >= self._stop_period
         return self.updater.iteration >= self._stop_period
@@ -77,6 +87,9 @@ class Trainer:
             init = getattr(e.ext, "initialize", None)
             if init:
                 init(self)
+            trig_init = getattr(e.trigger, "initialize", None)
+            if trig_init:
+                trig_init(self)
         try:
             while not self._done():
                 self.updater.update()

@@ -17,6 +17,15 @@ class IntervalTrigger:
         self._seen_iteration = None
         self._seen_fire = False
 
+    def initialize(self, trainer) -> None:
+        """Called by ``Trainer.run`` before the loop: seed the crossing
+        state from the CURRENT iteration, so a resumed run (iteration
+        restored to e.g. 100 by ``maybe_load``) does not see a phantom
+        0→101 crossing and fire every iteration-unit trigger once
+        immediately after resume."""
+        if self._seen_iteration is None and self.unit == "iteration":
+            self._seen_iteration = trainer.updater.iteration
+
     def __call__(self, trainer) -> bool:
         if self.unit == "iteration":
             it = trainer.updater.iteration

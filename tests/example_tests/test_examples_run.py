@@ -45,8 +45,13 @@ def _run_example(relpath, args, timeout=420):
       "--arch", "googlenet"]),
     ("examples/imagenet/train_imagenet_large_batch.py",
      ["--tiny", "--epoch", "1", "--batchsize", "64"]),
+    ("examples/imagenet/train_imagenet_large_batch.py",
+     ["--tiny", "--epoch", "1", "--batchsize", "64",
+      "--optimizer", "lars", "--steps-per-execution", "2",
+      "--resumable"]),
 ], ids=["mnist-dp", "mnist-mp", "seq2seq", "imagenet-resnet",
-        "imagenet-googlenet", "imagenet-large-batch"])
+        "imagenet-googlenet", "imagenet-large-batch",
+        "imagenet-large-batch-lars"])
 def test_example_runs(relpath, args, tmp_path):
     out = []
     if "--out" not in args and "model_parallel" not in relpath:

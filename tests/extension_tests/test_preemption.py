@@ -1,0 +1,158 @@
+"""PreemptionCheckpointer — a preemption signal must checkpoint at the
+next iteration boundary, stop the trainer cleanly, and leave a snapshot a
+fresh run's ``maybe_load`` resumes from."""
+
+import os
+import signal
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.extensions import (
+    PreemptionCheckpointer,
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.models import init_mlp, mlp_apply, softmax_cross_entropy
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla")
+
+
+def _dataset(n=64, dim=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32), np.int32(i % classes))
+            for i in range(n)]
+
+
+def _make_trainer(comm, out, epochs=50):
+    it = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=3)
+    params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    upd = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+    return cmn.Trainer(upd, (epochs, "epoch"), out=str(out))
+
+
+class TestPreemption:
+    def test_signal_checkpoints_and_stops(self, comm, tmp_path):
+        trainer = _make_trainer(comm, tmp_path)
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        pre = PreemptionCheckpointer(cp, comm, signals=(signal.SIGUSR1,))
+        trainer.extend(pre)
+
+        @cmn.training.make_extension(trigger=(1, "iteration"), priority=999)
+        def fake_preemption(tr):
+            if tr.updater.iteration == 4:
+                os.kill(os.getpid(), signal.SIGUSR1)
+
+        trainer.extend(fake_preemption)
+        trainer.run()
+
+        # stopped long before the 50-epoch stop trigger, right after the
+        # signal's iteration boundary
+        assert trainer.updater.iteration == 4
+        assert "preemption" in trainer.stop_reason
+        assert pre.signaled
+
+        # the snapshot is a normal checkpoint: a fresh job resumes from it
+        trainer2 = _make_trainer(comm, tmp_path)
+        cp2 = create_multi_node_checkpointer(comm, str(tmp_path))
+        assert cp2.maybe_load(trainer2.updater, trainer2) == 4
+        assert trainer2.updater.iteration == 4
+
+    def test_no_signal_no_interference(self, comm, tmp_path):
+        trainer = _make_trainer(comm, tmp_path, epochs=2)
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        pre = PreemptionCheckpointer(cp, comm, signals=(signal.SIGUSR1,))
+        trainer.extend(pre)
+        trainer.run()
+        assert trainer.updater.iteration == 8  # 64/16 * 2 epochs
+        assert trainer.stop_reason is None
+        assert not os.listdir(tmp_path) or not [
+            f for f in os.listdir(tmp_path) if "snapshot" in f]
+
+    def test_check_interval_defers_to_shared_cadence(self, comm, tmp_path):
+        # check_interval=3: the collective flag check runs only on calls
+        # 3, 6, ... — a signal at iteration 1 acts at iteration 3, so in
+        # a multi-process job every rank enters the allgather on the
+        # same call and checkpoints the same iteration.
+        trainer = _make_trainer(comm, tmp_path)
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        pre = PreemptionCheckpointer(cp, comm, signals=(signal.SIGUSR1,),
+                                     check_interval=3)
+        trainer.extend(pre)
+
+        @cmn.training.make_extension(trigger=(1, "iteration"), priority=999)
+        def fake_preemption(tr):
+            if tr.updater.iteration == 1:
+                os.kill(os.getpid(), signal.SIGUSR1)
+
+        trainer.extend(fake_preemption)
+        trainer.run()
+        assert trainer.updater.iteration == 3
+        assert cp._common_iterations() == [3]
+
+    def test_no_spurious_trigger_fire_after_resume(self, comm, tmp_path):
+        # (period=100, 'iteration') with a run resumed at iteration 4:
+        # the next iterations (5, 6, ...) must NOT fire the trigger (the
+        # crossing state is seeded from the restored iteration, not 0).
+        trainer = _make_trainer(comm, tmp_path, epochs=1)
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        pre = PreemptionCheckpointer(cp, comm, signals=(signal.SIGUSR1,))
+        trainer.extend(pre)
+
+        @cmn.training.make_extension(trigger=(1, "iteration"), priority=999)
+        def fake_preemption(tr):
+            if tr.updater.iteration == 2:
+                os.kill(os.getpid(), signal.SIGUSR1)
+
+        trainer.extend(fake_preemption)
+        trainer.run()
+        assert trainer.updater.iteration == 2
+
+        trainer2 = _make_trainer(comm, tmp_path, epochs=1)
+        cp2 = create_multi_node_checkpointer(comm, str(tmp_path))
+        assert cp2.maybe_load(trainer2.updater, trainer2) == 2
+        fired = []
+
+        @cmn.training.make_extension(trigger=(100, "iteration"))
+        def probe(tr):
+            fired.append(tr.updater.iteration)
+
+        trainer2.extend(probe)
+        trainer2.run()  # finishes the epoch: iterations 3, 4
+        assert trainer2.updater.iteration == 4
+        assert fired == []
+
+    def test_handler_chained_and_restored(self, comm, tmp_path):
+        hits = []
+        prev = signal.signal(signal.SIGUSR2, lambda s, f: hits.append(s))
+        try:
+            trainer = _make_trainer(comm, tmp_path)
+            cp = create_multi_node_checkpointer(comm, str(tmp_path))
+            pre = PreemptionCheckpointer(cp, comm,
+                                         signals=(signal.SIGUSR2,))
+
+            @cmn.training.make_extension(trigger=(1, "iteration"),
+                                         priority=999)
+            def fake_preemption(tr):
+                if tr.updater.iteration == 2:
+                    os.kill(os.getpid(), signal.SIGUSR2)
+
+            trainer.extend(pre)
+            trainer.extend(fake_preemption)
+            trainer.run()
+            # the pre-existing handler was chained, not replaced
+            assert hits == [signal.SIGUSR2]
+            # finalize (ran in trainer.run) restored it
+            assert signal.getsignal(signal.SIGUSR2) is not pre._handler
+        finally:
+            signal.signal(signal.SIGUSR2, prev)
