@@ -35,6 +35,7 @@ Design (flash-attention v2 schedule, TPU-shaped):
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -359,19 +360,44 @@ def _flash_bwd(scale, causal, window, block_q, block_k, interpret, res,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention_supported(T_q: int, T_k: int, block_q: int = 256,
-                              block_k: int = 512) -> bool:
+def _fit_block(T: int, want: int) -> Optional[int]:
+    """Pick the block size for a length-``T`` axis given requested size
+    ``want``; ``None`` means "not worth the kernel — fall back to XLA".
+
+    - ``T`` must be sublane-aligned (multiple of 8, the fp32 min tile);
+    - ``T <= want``: the whole axis is one block;
+    - otherwise: the largest power-of-two block <= ``want`` that tiles
+      ``T``, searched no lower than ``min(want, 128)`` — blocks below
+      ~128 rows leave the MXU mostly idle, at which point the XLA
+      fallback is faster than a degenerate kernel launch (so e.g.
+      T=1032, 8-aligned but only tileable by 8, reports unsupported).
+    """
+    if T % 8:
+        return None
+    want = min(want, T)
+    if T <= want:
+        return T
+    b = 1 << (want.bit_length() - 1)   # round down to a power of two
+    floor = min(128, b)                # honor explicitly-small requests
+    while b >= floor:
+        if T % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def flash_attention_supported(T_q: int, T_k: int, block_q: int = 1024,
+                              block_k: int = 1024) -> bool:
     """Shapes the kernel handles (callers fall back to XLA otherwise):
-    lengths divisible by their (clamped) blocks, blocks sublane-aligned
-    (multiples of 8 — the fp32 min tile)."""
-    bq, bk = min(block_q, T_q), min(block_k, T_k)
-    return (T_q % bq == 0 and T_k % bk == 0
-            and bq % 8 == 0 and bk % 8 == 0)
+    8-aligned lengths that are either a single block or tileable by a
+    power-of-two block no smaller than 128 (see :func:`_fit_block`)."""
+    return (_fit_block(T_q, block_q) is not None
+            and _fit_block(T_k, block_k) is not None)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, window=None,
                     q_offset=0,
-                    k_offset=0, block_q: int = 256, block_k: int = 512,
+                    k_offset=0, block_q: int = 1024, block_k: int = 1024,
                     return_lse: bool = False, interpret: bool = False):
     """Flash attention over ``(B, T, H, D)`` tensors.
 
@@ -397,13 +423,15 @@ def flash_attention(q, k, v, *, causal: bool = False, window=None,
                          "window attention)")
     if window is not None and window < 1:
         raise ValueError(f"window {window} must be >= 1")
-    if not flash_attention_supported(Tq, Tk, block_q, block_k):
+    bq, bk = _fit_block(Tq, block_q), _fit_block(Tk, block_k)
+    if bq is None or bk is None:
         raise ValueError(
-            f"sequence lengths ({Tq}, {Tk}) unsupported for blocks "
-            f"({block_q}, {block_k}) — use flash_attention_supported() "
-            "and fall back to local_attention")
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tk)
+            f"sequence lengths ({Tq}, {Tk}) unsupported: lengths must be "
+            "multiples of 8 and either fit in one block or be tileable "
+            "by a power-of-two block >= 128 — gate on "
+            "flash_attention_supported() and fall back to "
+            "local_attention")
+    block_q, block_k = bq, bk
     offs = jnp.asarray(
         jnp.stack([jnp.asarray(q_offset, jnp.int32),
                    jnp.asarray(k_offset, jnp.int32)]))

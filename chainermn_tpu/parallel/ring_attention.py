@@ -182,8 +182,8 @@ def _block_positions(rr, T, S, layout):
 
 def ring_attention(q, k, v, *, axis_name: str = "seq",
                    causal: bool = False, window=None, remat: bool = True,
-                   use_flash: bool = False, block_q: int = 256,
-                   block_k: int = 512, interpret: bool = False,
+                   use_flash: bool = False, block_q: int = 1024,
+                   block_k: int = 1024, interpret: bool = False,
                    layout: str = "contiguous"):
     """Blockwise ring attention.  Call INSIDE ``shard_map`` over
     ``axis_name`` with Q/K/V sequence-sharded: ``(B, T_blk, H, D)`` each.

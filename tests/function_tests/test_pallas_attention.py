@@ -89,6 +89,27 @@ def test_supported_predicate():
         flash_attention(q[:, :33], k, v, interpret=True)
 
 
+def test_fit_block():
+    from chainermn_tpu.ops.pallas_attention import _fit_block
+
+    assert _fit_block(8192, 1024) == 1024
+    assert _fit_block(2048, 1024) == 1024
+    # non-power-of-two requests round down, not collapse to 8 rows
+    assert _fit_block(8192, 1000) == 512
+    # non-power-of-two lengths shrink the block until it tiles
+    assert _fit_block(1536, 1024) == 512
+    assert _fit_block(384, 128) == 128
+    # whole-axis single block for short sequences
+    assert _fit_block(1000, 1024) == 1000
+    assert _fit_block(64, 1024) == 64
+    # explicit small requests are honored below the 128 floor
+    assert _fit_block(64, 32) == 32
+    # 8-aligned but only tileable by degenerate blocks -> XLA fallback
+    assert _fit_block(1032, 1024) is None
+    # not sublane-aligned
+    assert _fit_block(100, 1024) is None
+
+
 def test_fully_masked_rows_zero_partial_rows_exact():
     """k_offset ahead of q_offset: rows with some valid K must match the
     oracle exactly; rows with NO valid K return zeros (documented
