@@ -44,9 +44,12 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         d_ff=4 * d_model, n_layers=n_layers, max_seq=seq,
         attention=attention, dtype="bfloat16",
         # remat: the production setting — without it this 335M config's
-        # activations alone overflow a 16G-HBM chip (20.3G requested).
-        # MFU still counts model FLOPs (6PT), not the recompute.
-        remat=True, remat_policy=remat_policy,
+        # activations alone overflow a 16G-HBM chip (20.3G requested) at
+        # the default batch; --remat-policy none turns it off for
+        # smaller batches.  MFU still counts model FLOPs (6PT), not the
+        # recompute.
+        remat=remat_policy != "none",
+        remat_policy=remat_policy if remat_policy != "none" else "full",
     )
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
     params = shard_params(
@@ -141,7 +144,7 @@ def _parse_args(argv):
     p.add_argument("--attention", default="flash",
                    choices=["flash", "local", "ring", "ulysses"])
     p.add_argument("--remat-policy", default="full",
-                   choices=["full", "dots"])
+                   choices=["full", "dots", "none"])
     p.add_argument("--platform", default=None)
     p.add_argument("--timeouts", type=int, nargs="+", default=[480, 420])
     return p.parse_args(argv)

@@ -367,9 +367,10 @@ def _fit_block(T: int, want: int) -> Optional[int]:
     - ``T`` must be sublane-aligned (multiple of 8, the fp32 min tile);
     - ``T <= want``: the whole axis is one block;
     - otherwise: the largest power-of-two block <= ``want`` that tiles
-      ``T``, searched no lower than ``min(want, 128)`` — blocks below
-      ~128 rows leave the MXU mostly idle, at which point the XLA
-      fallback is faster than a degenerate kernel launch (so e.g.
+      ``T``.  The search floor is 128 — or ``want`` rounded down to a
+      power of two, when the caller explicitly requests smaller blocks —
+      because blocks below ~128 rows leave the MXU mostly idle, at which
+      point the XLA fallback beats a degenerate kernel launch (so e.g.
       T=1032, 8-aligned but only tileable by 8, reports unsupported).
     """
     if T % 8:

@@ -71,7 +71,11 @@ def test_bench_failure_still_prints_json():
       "--d-model", "64", "--warmup", "0", "--iters", "1"], "tokens/sec"),
     ("bench_attention.py",
      ["--seq", "64", "--batch", "1", "--iters", "1"], "x"),
-], ids=["transformer", "decode", "attention"])
+    ("bench_seq2seq.py",
+     ["--batch", "8", "--vocab", "64", "--units", "16", "--max-src", "8",
+      "--max-tgt", "8", "--warmup", "0", "--iters", "1",
+      "--steps-per-call", "2"], "tokens/sec"),
+], ids=["transformer", "decode", "attention", "seq2seq"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
