@@ -23,6 +23,7 @@ from chainermn_tpu.extensions.checkpoint import (
     MultiNodeCheckpointer,
     create_multi_node_checkpointer,
 )
+from chainermn_tpu.extensions.fail_on_non_number import FailOnNonNumber
 from chainermn_tpu.extensions.global_except_hook import (
     add_global_except_hook,
 )
@@ -34,6 +35,7 @@ from chainermn_tpu.extensions.snapshot import multi_node_snapshot
 
 __all__ = [
     "AllreducePersistentValues",
+    "FailOnNonNumber",
     "MultiNodeCheckpointer",
     "ObservationAggregator",
     "PreemptionCheckpointer",

@@ -1,0 +1,47 @@
+"""Fail fast on non-finite training loss.
+
+Parity with the ``chainer.training.extensions.FailOnNonNumber`` guard the
+reference's users attached to distributed trainers: a NaN/Inf loss on ANY
+process raises immediately instead of training garbage for hours (and in
+the distributed case, instead of letting one diverged process drift from
+the others).  Combined with :func:`add_global_except_hook`, the raise
+tears down the whole job — the reference's crash-don't-deadlock model.
+
+Runs as an ``observe`` hook, so EVERY iteration is checked regardless of
+the extension's trigger; the device→host transfer this forces is one
+scalar that the trainer loop reads for logging anyway.
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["FailOnNonNumber"]
+
+
+class FailOnNonNumber:
+    """Raise ``RuntimeError`` when a watched observation goes non-finite.
+
+    Args:
+      keys: observation entries to watch (default: ``main/loss``).
+    """
+
+    priority = 400  # before log writers: fail the iteration that broke
+
+    def __init__(self, keys=("main/loss",)):
+        self.keys = tuple(keys)
+
+    def observe(self, trainer):
+        for key in self.keys:
+            val = trainer.observation.get(key)
+            if val is None:
+                continue
+            val = float(val)
+            if not math.isfinite(val):
+                raise RuntimeError(
+                    f"non-finite {key} ({val}) at iteration "
+                    f"{trainer.updater.iteration} — stopping before the "
+                    "divergence trains further")
+
+    def __call__(self, trainer):  # trigger path: same check
+        self.observe(trainer)

@@ -27,9 +27,15 @@ supplies all of them, designed for the TPU mesh from the start:
 from chainermn_tpu.parallel.mesh import MeshConfig
 from chainermn_tpu.parallel.pipeline import (
     pipeline_apply,
+    pipeline_train_1f1b,
+    pipeline_train_interleaved,
     stack_stage_params,
 )
-from chainermn_tpu.parallel.ring_attention import ring_attention
+from chainermn_tpu.parallel.ring_attention import (
+    local_attention,
+    ring_attention,
+    zigzag_indices,
+)
 from chainermn_tpu.parallel.tensor import (
     column_parallel_dense,
     row_parallel_dense,
@@ -41,9 +47,13 @@ __all__ = [
     "MeshConfig",
     "column_parallel_dense",
     "expert_parallel_moe",
+    "local_attention",
     "pipeline_apply",
+    "pipeline_train_1f1b",
+    "pipeline_train_interleaved",
     "ring_attention",
     "row_parallel_dense",
     "stack_stage_params",
     "ulysses_attention",
+    "zigzag_indices",
 ]

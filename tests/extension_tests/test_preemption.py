@@ -41,6 +41,34 @@ def _make_trainer(comm, out, epochs=50):
     return cmn.Trainer(upd, (epochs, "epoch"), out=str(out))
 
 
+class TestFailOnNonNumber:
+    def test_raises_on_nan_loss(self, comm, tmp_path):
+        from chainermn_tpu.extensions import FailOnNonNumber
+
+        it = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=3)
+        params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+        # absurd LR: diverges to NaN within a few iterations
+        opt = cmn.create_multi_node_optimizer(optax.sgd(1e9), comm)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        upd = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+        trainer = cmn.Trainer(upd, (50, "epoch"), out=str(tmp_path))
+        trainer.extend(FailOnNonNumber())
+        with pytest.raises(RuntimeError, match="non-finite"):
+            trainer.run()
+        assert trainer.updater.iteration < 50 * 4
+
+    def test_quiet_on_healthy_run(self, comm, tmp_path):
+        from chainermn_tpu.extensions import FailOnNonNumber
+
+        trainer = _make_trainer(comm, tmp_path, epochs=1)
+        trainer.extend(FailOnNonNumber())
+        trainer.run()
+        assert trainer.updater.iteration == 4
+
+
 class TestPreemption:
     def test_signal_checkpoints_and_stops(self, comm, tmp_path):
         trainer = _make_trainer(comm, tmp_path)
