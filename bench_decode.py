@@ -23,7 +23,7 @@ _YARDSTICK = 500.0
 
 
 def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
-        n_heads=16, n_kv_heads=4, warmup=1, iters=2):
+        n_heads=16, n_kv_heads=4, warmup=1, iters=2, int8=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -42,9 +42,14 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
         remat=False,
     )
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
-    params = shard_params(
-        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
-    gen = make_generate_fn(mc, cfg, max_len=max_len)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    if int8:
+        from chainermn_tpu.models import quantize_params_int8
+
+        params = quantize_params_int8(cfg, params)
+    params = shard_params(mc, cfg, params)
+    gen = make_generate_fn(mc, cfg, max_len=max_len, quantized=int8)
     prompt = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size,
                                          (batch, prompt_len)), jnp.int32)
@@ -62,7 +67,6 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
 
     new_tokens = (max_len - prompt_len) * batch
     tok_s = new_tokens * iters / dt
-    n_params = sum(p.size for p in jax.tree.leaves(params))
     return {
         "metric": METRIC,
         "value": round(tok_s, 1),
@@ -75,6 +79,7 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
         "batch": batch, "max_len": max_len,
         "n_params": int(n_params),
         "n_kv_heads": n_kv_heads,
+        "int8": int8,
     }
 
 
@@ -85,6 +90,8 @@ def main(argv):
     p.add_argument("--max-len", type=int, default=512)
     p.add_argument("--n-layers", type=int, default=8)
     p.add_argument("--d-model", type=int, default=1024)
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 decode (quantize_params_int8)")
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--iters", type=int, default=2)
     p.add_argument("--platform", default=None)
@@ -97,7 +104,7 @@ def main(argv):
         print("BENCH_RESULT " + json.dumps(run(
             batch=args.batch, max_len=args.max_len,
             n_layers=args.n_layers, d_model=args.d_model,
-            warmup=args.warmup, iters=args.iters)))
+            warmup=args.warmup, iters=args.iters, int8=args.int8)))
         return 0
 
     here = os.path.abspath(__file__)
@@ -105,7 +112,8 @@ def main(argv):
            "--batch", str(args.batch), "--max-len", str(args.max_len),
            "--n-layers", str(args.n_layers),
            "--d-model", str(args.d_model),
-           "--warmup", str(args.warmup), "--iters", str(args.iters)]
+           "--warmup", str(args.warmup), "--iters", str(args.iters)] \
+        + (["--int8"] if args.int8 else [])
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(

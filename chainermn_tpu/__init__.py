@@ -9,7 +9,8 @@ single-controller SPMD model: collectives lower to XLA ops over the ICI/DCN
 mesh instead of MPI/NCCL calls.
 """
 
-from chainermn_tpu import extensions, links, ops, utils
+from chainermn_tpu import (extensions, links, models, ops,
+                           parallel, utils)
 from chainermn_tpu.extensions import (
     add_global_except_hook,
     create_multi_node_checkpointer,
@@ -74,7 +75,9 @@ __all__ = [
     "extensions",
     "links",
     "multi_node_snapshot",
+    "models",
     "ops",
+    "parallel",
     "utils",
     "scatter_dataset",
     "scatter_index",

@@ -4,6 +4,7 @@ exercising every parallelism axis."""
 
 from .convnets import ConvNetConfig, convnet_apply, init_convnet
 from .decoding import make_beam_search_fn, make_generate_fn
+from .quantization import quantize_params_int8
 from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
 from .resnet import ResNetConfig, init_resnet, resnet_apply
 from .seq2seq import (
@@ -45,6 +46,7 @@ __all__ = [
     "make_train_step",
     "mlp_apply",
     "param_specs",
+    "quantize_params_int8",
     "shard_params",
     "softmax_cross_entropy",
     "transformer_forward",

@@ -53,6 +53,28 @@ def _vary(x, *axes):
     return lax.pcast(x, need, to="varying") if need else x
 
 
+def _dense_q(dense, x, blk, name, cd):
+    """``dense(x, blk[name])`` with optional weight-only int8: the int8
+    tensor is only touched by a ``convert`` (which XLA fuses into the
+    dot's operand load — the HBM read stays int8-sized) and the
+    per-output-channel scale is applied to the dot OUTPUT (exact for
+    scales constant along the contraction)."""
+    from .quantization import _BASE
+
+    w = blk[name]
+    # contraction layout comes from the one declaration in
+    # quantization._BASE: axis-0 contraction reshapes to (in, out),
+    # leading-axes contraction (wo) to (..., out)
+    flat_in = _BASE[name][1] == (0,)
+    w2d = w.reshape(w.shape[0], -1) if flat_in else \
+        w.reshape(-1, w.shape[-1])
+    y = dense(x, w2d.astype(cd))
+    scale = blk.get(name + "_scale")
+    if scale is not None:
+        y = y * scale.reshape(-1).astype(cd)
+    return y
+
+
 def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
     """One block for ONE new token.  ``h``: (B, 1, D); ``ck``/``cv``:
     (B, max_len, Hkv_local, Dh) this layer's cache; ``pos``: scalar
@@ -62,18 +84,16 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
     B, _, D = x.shape
     if "wqkv" in blk:
         Hl = blk["wqkv"].shape[2]
-        qkv = column_parallel_dense(x, blk["wqkv"].reshape(D, -1).astype(cd))
+        qkv = _dense_q(column_parallel_dense, x, blk, "wqkv", cd)
         qkv = qkv.reshape(B, 1, 3, Hl, cfg.d_head)
         q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     else:
         Hl = blk["wq"].shape[1]
         Hkvl = blk["wkv"].shape[2]
-        q = column_parallel_dense(
-            x, blk["wq"].reshape(D, -1).astype(cd)
-        ).reshape(B, 1, Hl, cfg.d_head)
-        kv = column_parallel_dense(
-            x, blk["wkv"].reshape(D, -1).astype(cd)
-        ).reshape(B, 1, 2, Hkvl, cfg.d_head)
+        q = _dense_q(column_parallel_dense, x, blk, "wq", cd
+                     ).reshape(B, 1, Hl, cfg.d_head)
+        kv = _dense_q(column_parallel_dense, x, blk, "wkv", cd
+                      ).reshape(B, 1, 2, Hkvl, cfg.d_head)
         k_new, v_new = kv[:, :, 0], kv[:, :, 1]
     if cfg.pos_embedding == "rope":
         p1 = jnp.full((1,), pos)
@@ -93,8 +113,8 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
     s = jnp.where(allow[None, None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)   # (B,1,Hl,Dh)
-    h = h + row_parallel_dense(
-        o.reshape(B, 1, -1), blk["wo"].reshape(-1, D).astype(cd))
+    h = h + _dense_q(row_parallel_dense, o.reshape(B, 1, -1),
+                     blk, "wo", cd)
 
     x = _rms_norm(h, blk["ln2"])
     if cfg.moe:
@@ -116,8 +136,8 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
         )
         h = h + out.reshape(B, 1, D)
     else:
-        y = jax.nn.relu(column_parallel_dense(x, blk["w1"].astype(cd)))
-        h = h + row_parallel_dense(y, blk["w2"].astype(cd))
+        y = jax.nn.relu(_dense_q(column_parallel_dense, x, blk, "w1", cd))
+        h = h + _dense_q(row_parallel_dense, y, blk, "w2", cd)
     return h, ck, cv
 
 
@@ -125,9 +145,13 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     """Next-token logits for ``tok`` (B,) at position ``pos``; updates
     the (L, B, max_len, Hkv_local, Dh) cache pair."""
     cd = cfg.compute_dtype
-    h = params["embed"][tok]
+    h = params["embed"][tok].astype(cd)
+    emb_scale = params.get("embed_scale")
+    if emb_scale is not None:
+        # int8 embedding rows: dequantize the gathered rows only
+        h = h * emb_scale[tok][:, None].astype(cd)
     if cfg.pos_embedding == "learned":
-        h = h + params["pos"][pos]
+        h = h + params["pos"][pos].astype(cd)
     h = h[:, None, :].astype(cd)
     h = _vary(h, "pipe")
     caches = tuple(jax.tree.map(lambda c: _vary(c, "pipe"), caches))
@@ -147,7 +171,11 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     h, (ck, cv) = lax.scan(layer, h, (blocks, *caches))
     h = _rms_norm(h, params["ln_f"])
     logits = jnp.einsum(
-        "btd,vd->btv", h.astype(jnp.float32), params["embed"])[:, 0]
+        "btd,vd->btv", h.astype(jnp.float32),
+        params["embed"].astype(jnp.float32))[:, 0]
+    if emb_scale is not None:
+        # per-vocab-row scale applies to the logits output channel
+        logits = logits * emb_scale[None, :]
     # close the pipe axis (size 1 in decode): free re-replication that
     # lets the token buffer stay (data, expert)-varying only
     return lax.psum(logits, "pipe"), (ck, cv)
@@ -182,16 +210,20 @@ def _make_cache(cfg: TransformerConfig, rows: int, max_len: int,
 
 
 def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
-                     max_len: int = 0, temperature: float = 0.0):
+                     max_len: int = 0, temperature: float = 0.0,
+                     quantized: bool = False):
     """Build ``generate(params, prompt, key=None) -> (B, max_len)``.
 
     ``prompt``: (B, P) int32, left-aligned (no padding support — equal
     prompt lengths, the same contract as the reference's translate
     batches); generation fills positions P..max_len-1.  Greedy when
     ``temperature == 0``, else temperature sampling (``key`` required).
+    ``quantized=True`` expects int8 weight-only params from
+    :func:`...quantization.quantize_params_int8` (≈half the HBM traffic
+    per token).
     """
     max_len, kv_heads_local = _decode_preamble(mesh_cfg, cfg, max_len)
-    specs = param_specs(cfg)
+    specs = param_specs(cfg, quantized=quantized)
     batch_spec = P(("data", "expert"))
 
     def body(params, prompt, key):
@@ -245,7 +277,8 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
 
 def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
                         beam_size: int, max_len: int = 0,
-                        eos_id: int = -1, length_penalty: float = 0.0):
+                        eos_id: int = -1, length_penalty: float = 0.0,
+                        quantized: bool = False):
     """Build ``beam_search(params, prompt) -> (tokens, scores)``.
 
     Jittable beam search over the KV-cached decoder (the reference's
@@ -274,7 +307,7 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
     max_len, kv_heads_local = _decode_preamble(mesh_cfg, cfg, max_len)
     K = beam_size
 
-    specs = param_specs(cfg)
+    specs = param_specs(cfg, quantized=quantized)
     batch_spec = P(("data", "expert"))
 
     def body(params, prompt):

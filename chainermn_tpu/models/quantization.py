@@ -1,0 +1,92 @@
+"""Weight-only int8 quantization for KV-cache decoding.
+
+Beyond-reference (the reference predates quantized inference).  Decode
+is HBM-bandwidth-bound: every generated token re-reads every weight, so
+halving the bytes ≈ halves the step time.  The scheme is the standard
+weight-only recipe:
+
+- **int8 storage, bf16 compute**: weights are stored as ``int8`` with a
+  per-output-channel fp32 scale (absmax / 127 over the contraction
+  axes).  Inside the decode step the only op touching the int8 tensor
+  is a ``convert`` — XLA fuses it into the dot's operand load, so the
+  HBM traffic is the int8 bytes — and the scale is applied to the dot
+  OUTPUT (mathematically identical for per-output-channel scales, and
+  it keeps the weight operand a pure convert so the fusion holds);
+- activations, KV cache, norms, and the learned positional table stay
+  in bf16/fp32 — weight bytes dominate decode traffic;
+- the embedding quantizes per ROW (vocab entry), which serves both its
+  uses: the token gather dequantizes the gathered rows, and the logits
+  matmul (contraction over d_model) applies the scale per vocab output.
+
+Quantize OUTSIDE shard_map / jit, on the full (host or replicated)
+parameters; shard the result with :func:`...transformer.shard_params`
+(it auto-detects the quantized structure).  Training is out of scope —
+this is an inference-path transform (``make_generate_fn(...,
+quantized=True)`` / ``make_beam_search_fn(..., quantized=True)``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_params_int8"]
+
+# base (per-layer, prefix-free) layouts: rank and contraction axes of
+# each quantizable block weight — see transformer._init_block
+_BASE = {
+    "wqkv": (4, (0,)),   # (D, 3, H, Dh)   contracts D
+    "wq":   (3, (0,)),   # (D, H, Dh)
+    "wkv":  (4, (0,)),   # (D, 2, Hkv, Dh)
+    "wo":   (3, (0, 1)),  # (H, Dh, D)     contracts H·Dh
+    "w1":   (2, (0,)),   # (D, F)
+    "w2":   (2, (0,)),   # (F, D)
+}
+
+
+def _quantize_leaf(w, axes):
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=axes).astype(jnp.float32)
+
+
+def quantize_params_int8(cfg, params):
+    """Return a decode-ready pytree: block/embedding weights as int8
+    plus ``<name>_scale`` fp32 leaves; everything else passes through.
+
+    MoE experts are not quantized (per-expert tiny matmuls at decode
+    time are routing-bound, not weight-bound) — ``cfg.moe`` raises.
+    """
+    if cfg.moe:
+        raise NotImplementedError(
+            "int8 decode does not cover MoE expert weights")
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name, (base_rank, base_axes) in _BASE.items():
+        if name not in blocks:
+            continue
+        w = blocks[name]
+        prefix = w.ndim - base_rank   # (pipe, L) or (pipe, V, L)
+        q, scale = _quantize_leaf(
+            w, tuple(prefix + a for a in base_axes))
+        blocks[name] = q
+        blocks[name + "_scale"] = scale
+    out["blocks"] = blocks
+    q, scale = _quantize_leaf(params["embed"], (1,))  # per vocab row
+    out["embed"] = q
+    out["embed_scale"] = scale
+    return out
+
+
+def scale_spec(weight_spec, base_rank, base_axes, leaf_ndim):
+    """PartitionSpec for a scale leaf: the weight's spec with the
+    contraction axes removed (scales are computed over the full global
+    contraction, so they never shard along it)."""
+    from jax.sharding import PartitionSpec as P
+
+    entries = tuple(weight_spec) + (None,) * (
+        leaf_ndim - len(tuple(weight_spec)))
+    prefix = leaf_ndim - base_rank
+    drop = {prefix + a for a in base_axes}
+    return P(*(e for i, e in enumerate(entries) if i not in drop))

@@ -234,12 +234,15 @@ def init_transformer(key, cfg: TransformerConfig, pipe_size: int = 1):
     return params
 
 
-def param_specs(cfg: TransformerConfig):
+def param_specs(cfg: TransformerConfig, quantized: bool = False):
     """PartitionSpec pytree matching :func:`init_transformer`'s output.
 
     TP shards head/ff dims over ``model``, EP shards experts over
     ``expert``, PP shards the stage axis over ``pipe``; embeddings and
-    norms replicate.
+    norms replicate.  With ``quantized=True`` the tree additionally
+    carries ``<name>_scale`` specs matching
+    :func:`...quantization.quantize_params_int8`'s output (the weight's
+    spec with its contraction axes dropped).
     """
     blk = {
         "ln1": P("pipe"),
@@ -262,11 +265,21 @@ def param_specs(cfg: TransformerConfig):
         # blocks carry an extra local chunk axis after pipe: (pipe, V,
         # layers_per_chunk, ...) — replicate over it, shift the rest
         blk = {k: P(v[0], None, *v[1:]) for k, v in blk.items()}
+    if quantized:
+        from .quantization import _BASE, scale_spec
+
+        prefix = 2 + (1 if cfg.virtual_pipe > 1 else 0)
+        for name, (base_rank, base_axes) in _BASE.items():
+            if name in blk and name not in ("router",):
+                blk[name + "_scale"] = scale_spec(
+                    blk[name], base_rank, base_axes, prefix + base_rank)
     specs = {
         "embed": P(),
         "blocks": blk,
         "ln_f": P(),
     }
+    if quantized:
+        specs["embed_scale"] = P()
     if cfg.pos_embedding == "learned":
         specs["pos"] = P()
     return specs
@@ -670,11 +683,12 @@ def shard_params(mesh_cfg, cfg: TransformerConfig, params):
     """Place a host-initialised param pytree per :func:`param_specs`.
 
     The reference's ``comm.bcast_data(model)`` moment: after this, every
-    device holds exactly its shard (replicated leaves on all)."""
+    device holds exactly its shard (replicated leaves on all).  Handles
+    both plain and int8-quantized (``quantize_params_int8``) trees."""
     _check_mesh(mesh_cfg, cfg)
     return jax.tree.map(
         lambda a, s: jax.device_put(a, mesh_cfg.sharding(*s)),
-        params, param_specs(cfg))
+        params, param_specs(cfg, quantized="embed_scale" in params))
 
 
 def make_forward_fn(mesh_cfg, cfg: TransformerConfig):
