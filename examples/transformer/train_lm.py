@@ -9,7 +9,10 @@ Synthetic data with learnable structure (an affine next-token rule
 plus noise) so the loss measurably falls within a smoke run — the same
 role the reference's synthetic/MNIST data played.
 
-Examples (virtual 8-device pod):
+Examples (virtual 8-device pod — export the fake-device flag first):
+
+    export JAX_PLATFORMS=cpu
+    export XLA_FLAGS=--xla_force_host_platform_device_count=8
 
     # DP only
     python train_lm.py --platform cpu --mesh data=8 --steps 30
@@ -141,11 +144,23 @@ def main():
               f"{args.steps}")
         return None
 
+    # zigzag layout contract: the model expects tokens permuted by
+    # zigzag_indices (device r holds chunks r and 2S-1-r, balancing the
+    # causal ring); inputs AND targets permute identically, so the
+    # next-token alignment is preserved
+    perm = None
+    if args.seq_layout == "zigzag":
+        from chainermn_tpu.parallel import zigzag_indices
+
+        perm = zigzag_indices(axes.get("seq", 1), args.seq).reshape(-1)
+
     first = last = None
     t0 = time.perf_counter()
     for i, (x, y) in enumerate(
             make_batches(args.vocab, args.batchsize, args.seq,
                          args.steps - start, seed=start)):
+        if perm is not None:
+            x, y = x[:, perm], y[:, perm]
         params, opt_state, loss = step(
             params, opt_state, jnp.asarray(x), jnp.asarray(y))
         loss = float(loss)
@@ -161,7 +176,6 @@ def main():
         # never persist a diverged state — a resume would train from it
         raise SystemExit("non-finite loss")
     if ckpt_file:
-        os.makedirs(args.checkpoint, exist_ok=True)
         save_state(ckpt_file, {
             "params": jax.tree.map(np.asarray, params),
             "opt": jax.tree.map(np.asarray, opt_state),

@@ -146,6 +146,39 @@ class TestStepsPerExecution:
         # 27,30 (one fire per crossed multiple of 5)
         assert fired == [6, 12, 15, 21, 27, 30]
 
+    def test_stateful_model_identical_to_unfused(self, comm):
+        # BN running stats thread through the fused scan exactly as
+        # through per-step dispatches (the `state is not None` path)
+        from chainermn_tpu.links import (init_batch_norm,
+                                         multi_node_batch_normalization)
+
+        bn_params, bn_state = init_batch_norm(6)
+        w = jax.random.normal(jax.random.PRNGKey(1), (6, 3))
+
+        def make(steps_per_execution):
+            it = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=7)
+            opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+            def loss_fn(p, state, x, y):
+                h, new_state = multi_node_batch_normalization(
+                    p["bn"], state, x, axis_name=comm.axis_name)
+                return softmax_cross_entropy(h @ p["w"], y), new_state
+
+            return cmn.StandardUpdater(
+                it, opt, loss_fn, {"bn": bn_params, "w": w}, comm,
+                state=bn_state, steps_per_execution=steps_per_execution)
+
+        plain, fused = make(1), make(3)
+        for _ in range(6):
+            plain.update()
+        for _ in range(2):
+            fused.update()
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            (plain.params, plain.state), (fused.params, fused.state))
+        assert int(plain.state.n) == int(fused.state.n) == 6
+
     def test_trainer_stop_trigger_with_fused_window(self, comm):
         # 96/16 = 6 batches/epoch; window 3 divides it: 2 updates/epoch.
         upd = _make_updater(comm, 3)
