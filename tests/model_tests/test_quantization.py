@@ -57,35 +57,44 @@ def test_quantize_error_bound():
                                   params["blocks"]["ln1"])
 
 
-@pytest.mark.parametrize("gqa", [False, True], ids=["mha", "gqa"])
-def test_quantized_logits_close(gqa):
-    cfg = tiny_cfg(n_kv_heads=2 if gqa else 0)
-    params = init_transformer(jax.random.PRNGKey(1), cfg)
-    qparams = quantize_params_int8(cfg, params)
+def _decode_logits(cfg, params, toks, steps, quantized):
+    """Teacher-forced cached decode of ``steps`` positions on a
+    single-device mesh, with plain or quantized param specs."""
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
-    toks = prompt(2, 4)
 
-    def make_body(quantized):
-        def body(params, toks):
-            caches = _make_cache(cfg, B, T, cfg.kv_heads)
-            outs = []
-            for t in range(4):
-                logits, caches = _decode_step(
-                    cfg, params, caches, toks[:, t], t)
-                outs.append(logits)
-            return jnp.stack(outs, 1)
-        return jax.jit(jax.shard_map(
-            body, mesh=mc.mesh,
-            in_specs=(param_specs(cfg, quantized=quantized),
-                      P(("data", "expert"))),
-            out_specs=P(("data", "expert"))))
+    def body(params, toks):
+        caches = _make_cache(cfg, B, T, cfg.kv_heads)
+        outs = []
+        for t in range(steps):
+            logits, caches = _decode_step(
+                cfg, params, caches, toks[:, t], t)
+            outs.append(logits)
+        return jnp.stack(outs, 1)
 
-    ref = make_body(False)(shard_params(mc, cfg, params), toks)
-    out = make_body(True)(shard_params(mc, cfg, qparams), toks)
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mc.mesh,
+        in_specs=(param_specs(cfg, quantized=quantized),
+                  P(("data", "expert"))),
+        out_specs=P(("data", "expert"))))
+    return fn(shard_params(mc, cfg, params), toks)
+
+
+def _assert_quantized_tracks_fp(cfg, seed, steps):
+    params = init_transformer(jax.random.PRNGKey(seed), cfg)
+    qparams = quantize_params_int8(cfg, params)
+    toks = prompt(seed, steps)
+    ref = _decode_logits(cfg, params, toks, steps, False)
+    out = _decode_logits(cfg, qparams, toks, steps, True)
     # int8 per-channel weight error ~0.4%/layer; logits track within a
     # few percent of the logit RANGE on this tiny random model
     scale = float(jnp.max(jnp.abs(ref)))
     assert float(jnp.max(jnp.abs(out - ref))) < 0.05 * scale
+
+
+@pytest.mark.parametrize("gqa", [False, True], ids=["mha", "gqa"])
+def test_quantized_logits_close(gqa):
+    _assert_quantized_tracks_fp(tiny_cfg(n_kv_heads=2 if gqa else 0),
+                                seed=1, steps=4)
 
 
 @pytest.mark.parametrize("axes", [dict(data=1), dict(data=4, model=2)],
@@ -119,6 +128,14 @@ def test_quantized_beam_search_runs():
     # scores sorted best-first
     s = np.asarray(scores)
     assert (np.diff(s, axis=1) <= 1e-6).all()
+
+
+def test_quantized_windowed_decode_logits_close():
+    """int8 composes with sliding-window causal decode (the window mask
+    lives in the attention path, orthogonal to weight storage)."""
+    _assert_quantized_tracks_fp(
+        tiny_cfg(n_kv_heads=2, attention_window=4, pos_embedding="rope"),
+        seed=7, steps=6)
 
 
 def test_moe_not_supported():

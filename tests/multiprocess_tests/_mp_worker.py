@@ -419,6 +419,59 @@ def scenario_dp_train(comm):
         assert other == w_all[0], "params diverged across processes"
 
 
+def scenario_preemption(comm):
+    """The preemption flag is OR-reduced COLLECTIVELY: only process 0
+    'receives' the signal, yet every process must checkpoint the same
+    iteration and stop — exercising the ``inter_size > 1`` branch of
+    ``PreemptionCheckpointer._global_flag`` with real processes."""
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.extensions import (
+        PreemptionCheckpointer,
+        create_multi_node_checkpointer,
+    )
+    from chainermn_tpu.models import init_mlp, mlp_apply, \
+        softmax_cross_entropy
+
+    # every process must agree on the directory (rank 0 decides)
+    path = comm.bcast_obj(
+        tempfile.mkdtemp(prefix="preempt_")
+        if comm.inter_rank == 0 else None, root=0)
+
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4).astype(np.float32), np.int32(i % 2))
+            for i in range(64)]
+    it = cmn.SerialIterator(data, 16, shuffle=True, seed=1)
+    params = init_mlp(jax.random.PRNGKey(0), [4, 8, 2])
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    upd = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+    trainer = cmn.Trainer(upd, (50, "epoch"), out=path)
+    cp = create_multi_node_checkpointer(comm, path)
+    pre = PreemptionCheckpointer(cp, comm, signals=())
+    trainer.extend(pre)
+
+    @cmn.training.make_extension(trigger=(1, "iteration"), priority=999)
+    def fake_signal(tr):
+        # ONLY process 0 sees the signal; the others learn of it
+        # through the collective flag reduce
+        if comm.inter_rank == 0 and tr.updater.iteration == 3:
+            pre.signaled = True
+
+    trainer.extend(fake_signal)
+    trainer.run()
+
+    assert upd.iteration == 3, upd.iteration
+    assert "preemption" in (trainer.stop_reason or ""), trainer.stop_reason
+    # all processes agreed on the checkpointed iteration
+    iters = comm.allgather_obj(cp._common_iterations())
+    assert all(x == [3] for x in iters), iters
+
+
 SCENARIOS = {
     name[len("scenario_"):]: fn
     for name, fn in list(globals().items())

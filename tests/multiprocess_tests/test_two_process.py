@@ -50,3 +50,6 @@ class TestTwoProcess:
 
     def test_dp_train_step(self, mp_run):
         mp_run("dp_train")
+
+    def test_preemption_collective_flag(self, mp_run):
+        mp_run("preemption")
