@@ -27,7 +27,7 @@ Design:
 from __future__ import annotations
 
 import signal
-from typing import Optional, Sequence
+from typing import Sequence
 
 __all__ = ["PreemptionCheckpointer"]
 

@@ -23,8 +23,6 @@ Cells: LSTM / GRU / tanh-RNN (the reference wrapped the matching
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import lax

@@ -21,8 +21,6 @@ Greedy (``temperature=0``) or temperature sampling.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import lax

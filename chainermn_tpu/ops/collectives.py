@@ -21,7 +21,6 @@ code the mesh axis *is* the communicator.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

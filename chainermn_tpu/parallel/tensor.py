@@ -21,7 +21,6 @@ tests verify numerics against.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax.numpy as jnp
 from jax import lax
