@@ -28,6 +28,7 @@ from chainermn_tpu.datasets import (
     create_empty_dataset,
     scatter_dataset,
     scatter_index,
+    shuffle_data_blocks,
 )
 from chainermn_tpu.iterators import (
     SerialIterator,
@@ -81,4 +82,5 @@ __all__ = [
     "utils",
     "scatter_dataset",
     "scatter_index",
+    "shuffle_data_blocks",
 ]

@@ -152,6 +152,20 @@ class CommunicatorBase(abc.ABC):
     def scatter_obj(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any: ...
 
     @abc.abstractmethod
+    def alltoall_obj(self, objs: Sequence[Any]) -> Sequence[Any]:
+        """Per-process object exchange: ``objs[j]`` is delivered to the
+        communicator's j-th member process; returns the objects received
+        from every member (same order).  Control-plane only — the data
+        plane belongs in :func:`chainermn_tpu.ops.alltoall`.
+
+        Contract: all ``*_obj`` collectives share ONE member order —
+        ascending process index — so ``allgather_obj`` row ``j`` and
+        ``alltoall_obj`` slot ``j`` always refer to the same process
+        (``shuffle_data_blocks`` and topology discovery rely on this).
+        """
+        ...
+
+    @abc.abstractmethod
     def send_obj(self, obj: Any, dest: int) -> None: ...
 
     @abc.abstractmethod

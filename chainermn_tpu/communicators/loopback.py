@@ -82,6 +82,14 @@ class LoopbackCommunicator(CommunicatorBase):
     def scatter_obj(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
         return objs[0] if objs else None
 
+    def alltoall_obj(self, objs: Sequence[Any]) -> Sequence[Any]:
+        if len(objs) != 1:
+            raise ValueError(
+                f"alltoall_obj expects 1 send object at size 1, got "
+                f"{len(objs)}")
+        # round-trip through pickle to keep loopback faithful to transport
+        return [pickle.loads(pickle.dumps(o)) for o in objs]
+
     def send_obj(self, obj: Any, dest: int) -> None:
         # round-trip through pickle to keep loopback faithful to transport
         self._queue.append(pickle.dumps(obj))

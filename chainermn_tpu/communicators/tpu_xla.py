@@ -393,6 +393,37 @@ class TpuXlaCommunicator(CommunicatorBase):
         all_lists = self.bcast_obj(objs, root)  # root = device rank
         return all_lists[self._my_group_index()]
 
+    def alltoall_obj(self, objs) -> Sequence[Any]:
+        """Per-process object exchange over PAIRWISE p2p lanes.
+
+        Staggered rounds (offset d: send to me+d, recv from me−d), one
+        payload in flight per process per round — each process's wire
+        traffic and memory stay O(its own send+recv volume), never the
+        whole exchange (the property ``shuffle_data_blocks`` relies on
+        for datasets too large to gather anywhere)."""
+        n = 1 if self._obj_local else len(self._member_procs)
+        if len(objs) != n:
+            raise ValueError(
+                f"alltoall_obj expects {n} send objects (one per member "
+                f"process), got {len(objs)}")
+        if self._obj_local:
+            # pickle round-trip keeps single-process behaviour faithful
+            # to the real transport (unpicklables fail here, not on a pod)
+            return [pickle.loads(pickle.dumps(o)) for o in objs]
+        me = self._my_group_index()
+        # object p2p addresses controllers: each member process's first
+        # device rank
+        ctrl = [self._controller_rank(p) for p in self._member_procs]
+        out: list = [None] * n
+        out[me] = pickle.loads(pickle.dumps(objs[me]))
+        for d in range(1, n):
+            dst, src = (me + d) % n, (me - d) % n
+            self._obj_channel.send(objs[dst], src=self.rank,
+                                   dst=ctrl[dst])
+            out[src] = self._obj_channel.recv(src=ctrl[src],
+                                              dst=self.rank)
+        return out
+
     def send_obj(self, obj: Any, dest: int) -> None:
         """Point-to-point object send to device rank ``dest``.
 
@@ -415,14 +446,19 @@ class TpuXlaCommunicator(CommunicatorBase):
         self._check_controller_rank(dest, "send_obj dest")
         self._obj_channel.send(obj, src=self.rank, dst=dest)
 
+    def _controller_rank(self, proc: int) -> int:
+        """The device rank object p2p addresses for process ``proc``:
+        its first-owned rank in the shared device order."""
+        return next(i for i, d in enumerate(self._devices)
+                    if d.process_index == proc)
+
     def _check_controller_rank(self, r: int, what: str) -> None:
         """Object p2p endpoints are *controllers* (one per process), not
         devices: the remote peer only ever receives as its own first-owned
         rank, so any other device rank would publish an unreceivable
         message."""
         proc = self._root_process(r)
-        controller = next(
-            i for i, d in enumerate(self._devices) if d.process_index == proc)
+        controller = self._controller_rank(proc)
         if r != controller:
             raise ValueError(
                 f"{what}={r} is device rank {r} of process {proc}, but "

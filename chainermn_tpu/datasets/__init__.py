@@ -23,6 +23,7 @@ __all__ = [
     "scatter_dataset",
     "scatter_index",
     "create_empty_dataset",
+    "shuffle_data_blocks",
     "SubDataset",
     "EmptyDataset",
 ]
@@ -126,3 +127,48 @@ class EmptyDataset:
 
 def create_empty_dataset(dataset) -> EmptyDataset:
     return EmptyDataset(len(dataset))
+
+
+def shuffle_data_blocks(comm, local_block: Sequence, seed: int = 0):
+    """Globally shuffle examples already distributed as per-process
+    blocks (reference: ``chainermn/datasets/shuffle_datablock.py``,
+    ``shuffle_data_blocks``; unverified — mount empty, see SURVEY.md).
+
+    For datasets too large to load on one process (where
+    :func:`scatter_dataset` would need everything on the root): each
+    process reads its own block, then this exchanges examples so every
+    process ends with a near-equal-size, *globally* shuffled subset —
+    e.g. blocks read from sorted/per-class files become IID shards.
+
+    The exchange rides ``comm.alltoall_obj`` (control-plane transport):
+    a shared ``seed`` gives every process the same global permutation;
+    each example's permuted position picks its destination from a
+    balanced contiguous split, and receivers re-order by position so
+    the result is exactly the permuted concatenation of all blocks.
+
+    Returns this process's shuffled block (a list).
+    """
+    # row order of allgather_obj defines the member order; carry each
+    # process's (order-defining) rank so sizes line up with it
+    rows = comm.allgather_obj((comm.inter_rank, len(local_block)))
+    sizes = [n for _, n in rows]
+    me = [r for r, _ in rows].index(comm.inter_rank)
+    total = sum(sizes)
+    n_members = len(rows)
+
+    rng = np.random.RandomState(seed)        # identical on all processes
+    inv = np.empty(total, np.int64)
+    inv[rng.permutation(total)] = np.arange(total)
+    bounds = [total * j // n_members for j in range(n_members + 1)]
+
+    offset = sum(sizes[:me])
+    send = [[] for _ in range(n_members)]
+    for i, example in enumerate(local_block):
+        pos = int(inv[offset + i])
+        dest = np.searchsorted(bounds, pos, side="right") - 1
+        send[dest].append((pos, example))
+
+    received = comm.alltoall_obj(send)
+    merged = sorted(
+        (item for row in received for item in row), key=lambda t: t[0])
+    return [example for _, example in merged]

@@ -71,3 +71,30 @@ class TestEmptyDataset:
         assert e[41] == ()
         with pytest.raises(IndexError):
             e[42]
+
+
+class TestShuffleDataBlocks:
+    def test_single_process_is_global_permutation(self, comm):
+        from chainermn_tpu.datasets import shuffle_data_blocks
+
+        block = list(range(20))
+        out = shuffle_data_blocks(comm, block, seed=3)
+        assert sorted(out) == block
+        assert out != block  # actually shuffled
+
+    def test_deterministic_in_seed(self, comm):
+        from chainermn_tpu.datasets import shuffle_data_blocks
+
+        a = shuffle_data_blocks(comm, list(range(16)), seed=1)
+        b = shuffle_data_blocks(comm, list(range(16)), seed=1)
+        c = shuffle_data_blocks(comm, list(range(16)), seed=2)
+        assert a == b
+        assert a != c
+
+    def test_loopback(self):
+        import chainermn_tpu as cmn
+        from chainermn_tpu.datasets import shuffle_data_blocks
+
+        comm = cmn.create_communicator("loopback")
+        out = shuffle_data_blocks(comm, list(range(12)), seed=0)
+        assert sorted(out) == list(range(12))

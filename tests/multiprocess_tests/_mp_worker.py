@@ -419,6 +419,34 @@ def scenario_dp_train(comm):
         assert other == w_all[0], "params diverged across processes"
 
 
+def scenario_shuffle_datablock(comm):
+    """Cross-process block shuffle: unequal per-process blocks come out
+    globally shuffled, balanced, and complete — the examples really move
+    between processes (each block starts disjoint)."""
+    from chainermn_tpu.datasets import shuffle_data_blocks
+
+    r, n = comm.inter_rank, comm.inter_size
+    # disjoint, unequal blocks: proc r holds r*100 .. r*100 + (10 - 2r)
+    sizes = [10 - 2 * j for j in range(n)]
+    block = list(range(r * 100, r * 100 + sizes[r]))
+    out = shuffle_data_blocks(comm, block, seed=5)
+
+    gathered = comm.allgather_obj(out)
+    merged = sorted(x for row in gathered for x in row)
+    expected = sorted(
+        x for j in range(n) for x in range(j * 100, j * 100 + sizes[j]))
+    assert merged == expected, merged
+    # balanced: near-equal split of the total
+    total = sum(sizes)
+    assert {len(row) for row in gathered} <= {total // n, -(-total // n)}, \
+        [len(x) for x in gathered]
+    # actually mixed across processes: each output spans several blocks
+    assert len({x // 100 for x in out}) > 1, out
+    # alltoall_obj round-trip sanity on its own
+    back = comm.alltoall_obj([f"{r}->{j}" for j in range(comm.inter_size)])
+    assert back == [f"{j}->{r}" for j in range(comm.inter_size)], back
+
+
 def scenario_preemption(comm):
     """The preemption flag is OR-reduced COLLECTIVELY: only process 0
     'receives' the signal, yet every process must checkpoint the same

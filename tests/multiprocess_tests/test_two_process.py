@@ -53,3 +53,10 @@ class TestTwoProcess:
 
     def test_preemption_collective_flag(self, mp_run):
         mp_run("preemption")
+
+    def test_shuffle_datablock(self, mp_run):
+        mp_run("shuffle_datablock")
+
+    def test_shuffle_datablock_four_process(self, mp_run):
+        # n>2 exercises the staggered pairwise exchange rounds
+        mp_run("shuffle_datablock", nprocs=4)
