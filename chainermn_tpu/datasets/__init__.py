@@ -162,11 +162,11 @@ def shuffle_data_blocks(comm, local_block: Sequence, seed: int = 0):
     bounds = [total * j // n_members for j in range(n_members + 1)]
 
     offset = sum(sizes[:me])
+    my_pos = inv[offset : offset + len(local_block)]
+    dests = np.searchsorted(bounds, my_pos, side="right") - 1
     send = [[] for _ in range(n_members)]
     for i, example in enumerate(local_block):
-        pos = int(inv[offset + i])
-        dest = np.searchsorted(bounds, pos, side="right") - 1
-        send[dest].append((pos, example))
+        send[int(dests[i])].append((int(my_pos[i]), example))
 
     received = comm.alltoall_obj(send)
     merged = sorted(

@@ -121,13 +121,25 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
         from chainermn_tpu.parallel.expert import expert_parallel_moe
 
         def expert_fn(pp, tokens):
-            y = jax.nn.relu(column_parallel_dense(tokens, pp["w1"]))
-            return row_parallel_dense(y, pp["w2"])
+            # weights may be int8 (leading expert axis vmaps away, so
+            # per-expert scales arrive as plain per-channel vectors)
+            y = column_parallel_dense(tokens, pp["w1"].astype(cd))
+            if "w1_scale" in pp:
+                y = y * pp["w1_scale"].astype(cd)
+            y = jax.nn.relu(y)
+            out = row_parallel_dense(y, pp["w2"].astype(cd))
+            if "w2_scale" in pp:
+                out = out * pp["w2_scale"].astype(cd)
+            return out
 
+        expert_params = {"w1": blk["w1"], "w2": blk["w2"]}
+        for s in ("w1_scale", "w2_scale"):
+            if s in blk:
+                expert_params[s] = blk[s]
         out, _ = expert_parallel_moe(
             x.reshape(B, D),
             blk["router"].astype(cd),
-            {"w1": blk["w1"].astype(cd), "w2": blk["w2"].astype(cd)},
+            expert_params,
             expert_fn,
             axis_name="expert",
             capacity_factor=cfg.capacity_factor,

@@ -43,6 +43,20 @@ _BASE = {
     "w2":   (2, (0,)),   # (F, D)
 }
 
+# MoE expert stacks carry a leading expert axis; scales are then
+# per-expert-per-output-channel.  The router's WEIGHTS stay fp — it is
+# tiny and feeds an argmax, so adding weight noise there would flip
+# routing for nothing (its inputs still carry upstream quantization
+# noise; near-tied experts can flip regardless)
+_MOE_OVERRIDE = {
+    "w1": (3, (1,)),     # (E, D, F)  contracts D
+    "w2": (3, (1,)),     # (E, F, D)  contracts F
+}
+
+
+def base_layout(moe: bool):
+    return {**_BASE, **_MOE_OVERRIDE} if moe else _BASE
+
 
 def _quantize_leaf(w, axes):
     amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
@@ -54,16 +68,11 @@ def _quantize_leaf(w, axes):
 def quantize_params_int8(cfg, params):
     """Return a decode-ready pytree: block/embedding weights as int8
     plus ``<name>_scale`` fp32 leaves; everything else passes through.
-
-    MoE experts are not quantized (per-expert tiny matmuls at decode
-    time are routing-bound, not weight-bound) — ``cfg.moe`` raises.
+    MoE expert stacks quantize per expert (the router stays fp32).
     """
-    if cfg.moe:
-        raise NotImplementedError(
-            "int8 decode does not cover MoE expert weights")
     out = dict(params)
     blocks = dict(params["blocks"])
-    for name, (base_rank, base_axes) in _BASE.items():
+    for name, (base_rank, base_axes) in base_layout(cfg.moe).items():
         if name not in blocks:
             continue
         w = blocks[name]

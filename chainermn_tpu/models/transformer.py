@@ -266,10 +266,10 @@ def param_specs(cfg: TransformerConfig, quantized: bool = False):
         # layers_per_chunk, ...) — replicate over it, shift the rest
         blk = {k: P(v[0], None, *v[1:]) for k, v in blk.items()}
     if quantized:
-        from .quantization import _BASE, scale_spec
+        from .quantization import base_layout, scale_spec
 
         prefix = 2 + (1 if cfg.virtual_pipe > 1 else 0)
-        for name, (base_rank, base_axes) in _BASE.items():
+        for name, (base_rank, base_axes) in base_layout(cfg.moe).items():
             if name in blk and name not in ("router",):
                 blk[name + "_scale"] = scale_spec(
                     blk[name], base_rank, base_axes, prefix + base_rank)
