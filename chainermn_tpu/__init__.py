@@ -10,7 +10,7 @@ mesh instead of MPI/NCCL calls.
 """
 
 from chainermn_tpu import (extensions, links, models, ops,
-                           parallel, utils)
+                           parallel, testing, utils)
 from chainermn_tpu.extensions import (
     add_global_except_hook,
     create_multi_node_checkpointer,
@@ -83,4 +83,5 @@ __all__ = [
     "scatter_dataset",
     "scatter_index",
     "shuffle_data_blocks",
+    "testing",
 ]

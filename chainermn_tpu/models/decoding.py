@@ -57,13 +57,16 @@ def _dense_q(dense, x, blk, name, cd):
     dot's operand load — the HBM read stays int8-sized) and the
     per-output-channel scale is applied to the dot OUTPUT (exact for
     scales constant along the contraction)."""
-    from .quantization import _BASE
+    from .quantization import _MOE_OVERRIDE, base_layout
 
     w = blk[name]
-    # contraction layout comes from the one declaration in
-    # quantization._BASE: axis-0 contraction reshapes to (in, out),
-    # leading-axes contraction (wo) to (..., out)
-    flat_in = _BASE[name][1] == (0,)
+    # contraction layout comes from quantization's declaration: axis-0
+    # contraction reshapes to (in, out), leading-axes contraction (wo)
+    # to (..., out).  MoE-overridden names never reach this path (they
+    # flow through expert_fn) — keep it that way.
+    assert name not in _MOE_OVERRIDE or w.ndim == 2, \
+        f"{name}: MoE-layout weight routed through _dense_q"
+    flat_in = base_layout(False)[name][1] == (0,)
     w2d = w.reshape(w.shape[0], -1) if flat_in else \
         w.reshape(-1, w.shape[-1])
     y = dense(x, w2d.astype(cd))
@@ -132,10 +135,9 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
                 out = out * pp["w2_scale"].astype(cd)
             return out
 
-        expert_params = {"w1": blk["w1"], "w2": blk["w2"]}
-        for s in ("w1_scale", "w2_scale"):
-            if s in blk:
-                expert_params[s] = blk[s]
+        expert_params = {
+            k: blk[k]
+            for k in ("w1", "w2", "w1_scale", "w2_scale") if k in blk}
         out, _ = expert_parallel_moe(
             x.reshape(B, D),
             blk["router"].astype(cd),

@@ -1,0 +1,142 @@
+"""Testing helpers — the distributed-test tooling the reference made its
+users assemble by hand (SURVEY §4: ChainerMN tests ran under a real
+``mpiexec -n 2`` and simply skipped when the world was too small; there
+was no fake cluster).  JAX can fake both halves, and this module
+packages the two tricks this repo's own suite runs on:
+
+- :func:`ensure_virtual_pod` — an N-device virtual CPU "pod" in ONE
+  process (every collective/sharding/pipeline schedule runs for real);
+- :func:`run_multiprocess` — real multi-process JAX clusters on
+  localhost, the TPU-native ``mpiexec -n N`` for the code paths that
+  only exist across processes (object transport, checkpoint agreement,
+  preemption flag reduce).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+__all__ = ["ensure_virtual_pod", "run_multiprocess", "free_port"]
+
+
+def ensure_virtual_pod(n_devices: int = 8) -> None:
+    """Pin this process's JAX to an ``n_devices`` virtual CPU pod.
+
+    MUST run before the first backend use (the first ``jax.devices()``
+    locks the platform) — call it at the top of a test conftest or
+    script entry point.  Idempotent if the pod is already configured;
+    raises if the backend was already initialised differently (too late
+    to change) or ends up with fewer devices.
+
+    Both layers are set because env vars alone are too late when a
+    sitecustomize imports jax at interpreter start (the trap this
+    repo's round-1 driver gates fell into): ``XLA_FLAGS`` is read at
+    backend init, and ``jax.config`` overrides any platform plugin
+    registered at import time.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.device_count() < n_devices:
+        raise RuntimeError(
+            f"virtual pod has {jax.device_count()} devices, wanted "
+            f"{n_devices} — ensure_virtual_pod must run before the "
+            "first JAX backend use (jax.devices() locks the platform "
+            "and XLA_FLAGS)")
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (for the cluster coordinator)."""
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_multiprocess(
+    worker: str,
+    args: Sequence[str] = (),
+    *,
+    nprocs: int = 2,
+    timeout: float = 180,
+    pythonpath: Optional[str] = None,
+):
+    """Run ``worker`` (a Python file) as an ``nprocs``-process JAX CPU
+    cluster on localhost — the ``mpiexec -n N`` replacement for tests.
+
+    Each worker process receives
+    ``<worker> <coordinator_addr> <nprocs> <process_id> *args`` and
+    should begin with::
+
+        import chainermn_tpu, sys
+        addr, n, i = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+        chainermn_tpu.init_distributed(
+            coordinator_address=addr, num_processes=n, process_id=i)
+        comm = chainermn_tpu.create_communicator("tpu_xla")
+
+    The environment is scrubbed of TPU-plugin/JAX/XLA settings and each
+    worker is pinned to one CPU device through BOTH layers (env var +
+    a ``jax.config`` bootstrap before the worker's code runs — env vars
+    alone lose when a sitecustomize imports jax at interpreter start).
+    Returns the list of captured outputs; raises ``RuntimeError`` with
+    every worker's output on any non-zero exit or on timeout (the usual
+    symptom of a cross-process collective deadlock).
+    """
+    addr = f"localhost:{free_port()}"
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("TPU_", "LIBTPU", "PJRT_", "JAX_", "XLA_")):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    if pythonpath:
+        env["PYTHONPATH"] = (
+            pythonpath + os.pathsep + env.get("PYTHONPATH", ""))
+
+    bootstrap = (
+        "import sys, runpy, jax; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        "sys.argv = sys.argv[1:]; "
+        "runpy.run_path(sys.argv[0], run_name='__main__')"
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", bootstrap, worker, addr, str(nprocs),
+             str(i), *map(str, args)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        for i in range(nprocs)
+    ]
+    outputs, codes = [], []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outputs.append(out)
+            codes.append(p.returncode)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            out, _ = p.communicate()
+            outputs.append(out)
+        raise RuntimeError(
+            f"multiprocess worker timed out after {timeout}s (likely a "
+            "cross-process collective deadlock)\n"
+            + "\n---\n".join(outputs)) from None
+    if any(codes):
+        raise RuntimeError(
+            "multiprocess workers failed:\n" + "\n".join(
+                f"--- worker {i} rc={codes[i]} ---\n{outputs[i]}"
+                for i in range(nprocs)))
+    return outputs
