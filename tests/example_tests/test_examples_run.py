@@ -68,6 +68,31 @@ def test_example_runs(relpath, args, tmp_path):
     _run_example(relpath, args + out)
 
 
+@pytest.mark.parametrize("extra", [
+    [], ["--beam", "3", "--int8"],
+    ["--mesh", "data=4,model=2", "--n-kv-heads", "2",
+     "--pos-embedding", "rope", "--temperature", "0.8"],
+], ids=["greedy", "beam-int8", "tp-sampling"])
+def test_generate_example(extra):
+    out = _run_example("examples/transformer/generate.py",
+                       ["--max-len", "16"] + extra)
+    if "--beam" in extra:
+        assert "beam 0" in out and "beam 2" in out
+    else:
+        assert "generated:" in out
+
+
+def test_train_then_generate_roundtrip(tmp_path):
+    ck = str(tmp_path / "ck")
+    _run_example("examples/transformer/train_lm.py",
+                 ["--mesh", "data=8", "--steps", "10",
+                  "--checkpoint", ck])
+    out = _run_example("examples/transformer/generate.py",
+                       ["--checkpoint", ck, "--vocab", "128",
+                        "--max-len", "16"])
+    assert "loaded" in out and "generated:" in out
+
+
 def test_train_lm_checkpoint_resume(tmp_path):
     """--checkpoint writes a resumable state; a second run restores it."""
     args = ["--mesh", "data=8", "--steps", "10",
