@@ -96,6 +96,30 @@ class TestPreemption:
         assert cp2.maybe_load(trainer2.updater, trainer2) == 4
         assert trainer2.updater.iteration == 4
 
+    def test_async_writer_joined_before_exit(self, comm, tmp_path):
+        """With async_write=True the preemption save overlaps the (now
+        ending) loop; trainer.run's finalize must join the writer so the
+        shard is complete on disk when the process exits."""
+        trainer = _make_trainer(comm, tmp_path)
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), async_write=True)
+        pre = PreemptionCheckpointer(cp, comm, signals=(signal.SIGUSR1,))
+        trainer.extend(cp, trigger=(10**6, "iteration"))  # periodic: never
+        trainer.extend(pre)
+
+        @cmn.training.make_extension(trigger=(1, "iteration"), priority=999)
+        def fake_preemption(tr):
+            if tr.updater.iteration == 2:
+                os.kill(os.getpid(), signal.SIGUSR1)
+
+        trainer.extend(fake_preemption)
+        trainer.run()
+        assert trainer.updater.iteration == 2
+        # the shard must be fully written and loadable NOW
+        cp2 = create_multi_node_checkpointer(comm, str(tmp_path))
+        trainer2 = _make_trainer(comm, tmp_path)
+        assert cp2.maybe_load(trainer2.updater, trainer2) == 2
+
     def test_no_signal_no_interference(self, comm, tmp_path):
         trainer = _make_trainer(comm, tmp_path, epochs=2)
         cp = create_multi_node_checkpointer(comm, str(tmp_path))
