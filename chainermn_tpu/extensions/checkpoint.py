@@ -139,8 +139,14 @@ class MultiNodeCheckpointer:
         self._join_pending(barrier_and_gc=True)
         # device_get returns host-numpy leaves BY IDENTITY (no copy), so
         # a leaf the training loop mutates in place would be pickled
-        # mid-mutation by the writer thread — snapshot real copies
-        host_state = jax.tree.map(np.array, jax.device_get(state))
+        # mid-mutation by the writer thread — snapshot real copies.
+        # _host_view first: process-spanning leaves (ZeRO-1 state) need
+        # a COLLECTIVE gather, which must run here on the main thread
+        # (every process calls save on the same tick), never the writer
+        from chainermn_tpu.utils.serialization import _host_view
+
+        host_state = jax.tree.map(
+            np.array, jax.device_get(jax.tree.map(_host_view, state)))
         box = {}
 
         def write():

@@ -41,6 +41,15 @@ class _MultiNodeSnapshot:
         }
         if getattr(trainer.updater, "state", None) is not None:
             state["model_state"] = trainer.updater.state
+        # host-gather on ALL processes first: process-spanning leaves
+        # (ZeRO-1 optimizer state) gather collectively, and a
+        # writer-only save_state would deadlock the non-writers in the
+        # barrier below
+        import jax
+
+        from chainermn_tpu.utils.serialization import _host_view
+
+        state = jax.tree.map(_host_view, state)
         if self.comm.inter_rank == self.writer_rank:
             path = os.path.join(
                 trainer.out,

@@ -24,13 +24,14 @@ no threads.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 
 __all__ = [
+    "Zero1Transformation",
     "cross_replica_mean",
     "create_multi_node_optimizer",
     "zero1_optimizer",
@@ -213,6 +214,17 @@ def _leaf_shard(leaf, idx, n: int):
     return jax.lax.dynamic_slice(flat, (idx * s,), (s,))
 
 
+class Zero1Transformation(NamedTuple):
+    """An ``optax.GradientTransformation`` (structurally) whose distinct
+    TYPE marks the ZeRO-1 state layout, so consumers that must carry the
+    state differently (``StandardUpdater``: world-stacked, sharded over
+    the data axis) can detect it instead of asking the user to repeat a
+    ``zero1=True`` flag that could silently disagree."""
+
+    init: Callable
+    update: Callable
+
+
 def zero1_optimizer(
     inner: optax.GradientTransformation,
     axis_name: str,
@@ -287,7 +299,7 @@ def zero1_optimizer(
 
         return jax.tree.map(gather, upd_shards, grads), state
 
-    return optax.GradientTransformation(init, update)
+    return Zero1Transformation(init, update)
 
 
 def zero1_init(tx, params, mesh, axis_name: str):

@@ -8,7 +8,9 @@ fuse and overlap the collective with compute (what pure_nccl needed streams
 and double-buffer threads for).
 
 The global batch enters sharded over the communicator's mesh axis; params
-and optimiser state stay replicated; the ``multi-node optimizer``'s
+stay replicated; optimiser state is replicated too, EXCEPT under ZeRO-1
+(detected from the transformation type), where it is carried
+world-stacked and sharded over the axis; the ``multi-node optimizer``'s
 ``cross_replica_mean`` supplies the ``pmean``.
 """
 
@@ -100,6 +102,11 @@ class StandardUpdater:
         stacks them, and runs the whole window on device, amortising
         per-dispatch latency.  ``iteration`` advances by the window
         size; ``main/loss`` reports the window mean.
+    ZeRO-1 optimizers (``create_multi_node_optimizer(..., zero1=True)``)
+    are detected from the transformation's type: their state is
+    initialised per-shard via ``zero1_init`` and carried WORLD-STACKED
+    (leading axis = mesh member) across steps, sharded over the data
+    axis instead of replicated.
     """
 
     def __init__(
@@ -127,7 +134,14 @@ class StandardUpdater:
         # first-update weight broadcast of the reference, done at init
         self.params = comm.bcast_data(params)
         self.state = None if state is None else comm.bcast_data(state)
-        self.opt_state = optimizer.init(self.params)
+        from .optimizers import Zero1Transformation, zero1_init
+
+        self.zero1 = isinstance(optimizer, Zero1Transformation)
+        if self.zero1:
+            self.opt_state = zero1_init(
+                optimizer, self.params, comm.mesh, comm.axis_name)
+        else:
+            self.opt_state = optimizer.init(self.params)
 
         self.iteration = 0
         self.epoch_detail = 0.0
@@ -151,9 +165,15 @@ class StandardUpdater:
         optimizer, loss_fn = self.optimizer, self.loss_fn
 
         stateful = self.state is not None
+        zero1 = self.zero1
 
         def step(carry, *batch):
             params, state, opt_state = carry
+            if zero1:
+                # world-stacked ZeRO state: this member's shard arrives
+                # with a leading length-1 member axis — peel it for the
+                # update, restack for the carry (zero1_init convention)
+                opt_state = jax.tree.map(lambda s: s[0], opt_state)
 
             def global_loss(p):
                 # pmean INSIDE the differentiated function: with replicated
@@ -170,6 +190,8 @@ class StandardUpdater:
                 global_loss, has_aux=True)(params)
             updates, new_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if zero1:
+                new_state = jax.tree.map(lambda s: s[None], new_state)
             # loss is already the global mean (ObservationAggregator
             # semantics for the train loss come for free inside the step)
             return (new_params, new_model_state, new_state), loss
@@ -178,13 +200,16 @@ class StandardUpdater:
             step, n_steps, scan_batches=True)
         # batch specs: the fused window's leading n_steps axis is a scan
         # axis, not a sharded one — only the per-example axis splits.
+        # ZeRO-1 state is world-stacked: its leading member axis shards
+        # over the data axis (each member holds its own 1/N slice).
+        opt_spec = P(ax) if self.zero1 else P()
         fn = jax.jit(
             jax.shard_map(
                 fused,
                 mesh=self.comm.mesh,
-                in_specs=((P(), P(), P()),) + (P(*(
+                in_specs=((P(), P(), opt_spec),) + (P(*(
                     (None, ax) if n_steps > 1 else (ax,))),) * n_batch_args,
-                out_specs=((P(), P(), P()), P()),
+                out_specs=((P(), P(), opt_spec), P()),
             ),
             donate_argnums=(0,),
         )

@@ -21,9 +21,32 @@ import numpy as np
 __all__ = ["save_state", "load_state"]
 
 
+def _host_view(x):
+    """Host copy of a leaf.  A multi-process-sharded array (e.g. ZeRO-1
+    optimizer state over a process-spanning mesh) is not fully
+    addressable, so ``device_get`` would raise — gather it to its full
+    global value first.  COLLECTIVE for such leaves: every process must
+    reach this save on the same tick (true for the checkpointer and
+    snapshot extensions, which trigger in lockstep).
+
+    Trade-off, chosen for correctness + simplicity: the gather is a
+    transient full-state materialisation per process and each per-rank
+    shard file then holds the complete state (N× disk for N processes).
+    Saving only the addressable shards and reassembling on load would
+    restore 1/N files, at the cost of a resume protocol that must pair
+    shard files with mesh positions — a future optimisation, noted here
+    so nobody mistakes the current layout for it."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return x
+
+
 def save_state(path: str, pytree) -> None:
     """Atomically write ``pytree`` (arrays / numeric scalars) to ``path``."""
-    leaves, treedef = jax.tree.flatten(jax.device_get(pytree))
+    leaves, treedef = jax.tree.flatten(
+        jax.device_get(jax.tree.map(_host_view, pytree)))
     payload = {f"leaf_{i:05d}": np.asarray(v) for i, v in enumerate(leaves)}
     # npz keeps only stock numpy dtypes; ml_dtypes leaves (bfloat16, fp8)
     # come back as raw void records — record true dtypes to view-cast back.

@@ -447,6 +447,85 @@ def scenario_shuffle_datablock(comm):
     assert back == [f"{j}->{r}" for j in range(comm.inter_size)], back
 
 
+def scenario_zero1_checkpoint(comm):
+    """ZeRO-1 over a PROCESS-SPANNING mesh: the optimizer state is not
+    fully addressable by either process, so checkpointing exercises the
+    gather-on-save path; resume must agree across processes."""
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.models import init_mlp, mlp_apply, \
+        softmax_cross_entropy
+
+    path = comm.bcast_obj(
+        tempfile.mkdtemp(prefix="zero1ck_")
+        if comm.inter_rank == 0 else None, root=0)
+
+    def make_updater():
+        rng = np.random.RandomState(0)          # same data on all procs
+        data = [(rng.randn(4).astype(np.float32), np.int32(i % 2))
+                for i in range(64)]
+        it = cmn.SerialIterator(data, 16, shuffle=True, seed=1)
+        params = init_mlp(jax.random.PRNGKey(0), [4, 8, 2])
+        opt = cmn.create_multi_node_optimizer(
+            optax.adam(5e-2), comm, zero1=True)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        return cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+
+    upd = make_updater()
+    assert upd.zero1
+    # state spans both processes' devices
+    leaf = jax.tree.leaves(upd.opt_state)[0]
+    assert not leaf.is_fully_addressable
+    for _ in range(3):
+        upd.update()
+
+    cp = create_multi_node_checkpointer(comm, path)
+    cp.save(upd)
+
+    upd2 = make_updater()
+    loaded = create_multi_node_checkpointer(comm, path)
+    assert loaded.maybe_load(upd2) == 3
+    # params agree across processes and match the saved run
+    w = comm.allgather_obj(
+        np.asarray(jax.tree.leaves(upd2.params)[0]).tolist())
+    assert w[0] == w[-1]
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(upd2.params)[0]),
+        np.asarray(jax.tree.leaves(upd.params)[0]), rtol=1e-6)
+    # the restored run continues without error
+    upd2.update()
+
+    # async writer path: device pull + collective gather happen on the
+    # main thread before the writer thread starts — must not crash or
+    # deadlock on the process-spanning state
+    cp_async = create_multi_node_checkpointer(
+        comm, path, name="async", async_write=True)
+    cp_async.save(upd2)
+    cp_async.finalize()
+    assert cp_async._common_iterations() == [4]
+
+    # writer-only snapshot: ALL ranks join the collective gather before
+    # rank 0 writes (a writer-only gather would deadlock the barrier)
+    from chainermn_tpu.extensions import multi_node_snapshot
+
+    class _Tr:
+        updater = upd2
+        out = path
+        observation = {}
+
+    multi_node_snapshot(comm)(_Tr())
+    import os
+
+    assert os.path.exists(os.path.join(path, "snapshot_iter_4")) \
+        or comm.inter_rank != 0
+
+
 def scenario_preemption(comm):
     """The preemption flag is OR-reduced COLLECTIVELY: only process 0
     'receives' the signal, yet every process must checkpoint the same

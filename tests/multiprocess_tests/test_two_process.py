@@ -54,6 +54,9 @@ class TestTwoProcess:
     def test_preemption_collective_flag(self, mp_run):
         mp_run("preemption")
 
+    def test_zero1_checkpoint(self, mp_run):
+        mp_run("zero1_checkpoint")
+
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
 

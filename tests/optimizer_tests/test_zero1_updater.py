@@ -1,0 +1,75 @@
+"""StandardUpdater(zero1=True) — ZeRO-1 sharded optimizer state driven
+by the stock trainer loop must be numerically identical to the
+replicated-state path (sharding is an implementation detail) and must
+compose with fused windows."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models import init_mlp, mlp_apply, softmax_cross_entropy
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla")
+
+
+def _dataset(n=96, dim=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32), np.int32(i % classes))
+            for i in range(n)]
+
+
+def _make(comm, zero1, steps_per_execution=1):
+    it = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=7)
+    params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+    opt = cmn.create_multi_node_optimizer(
+        optax.adam(5e-2), comm, zero1=zero1)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    # no flag on the updater: ZeRO-1 is detected from the optimizer type
+    return cmn.StandardUpdater(
+        it, opt, loss_fn, params, comm,
+        steps_per_execution=steps_per_execution)
+
+
+def test_zero1_matches_replicated(comm):
+    plain = _make(comm, zero1=False)
+    z1 = _make(comm, zero1=True)
+    for _ in range(8):
+        plain.update()
+        z1.update()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+        plain.params, z1.params)
+    # the state really is sharded: world-stacked leading member axis
+    mu = jax.tree.leaves(z1.opt_state)
+    n = comm.size
+    assert any(m.ndim >= 1 and m.shape[0] == n for m in mu)
+
+
+def test_zero1_with_fused_windows(comm):
+    ref = _make(comm, zero1=True)
+    fused = _make(comm, zero1=True, steps_per_execution=3)
+    for _ in range(6):
+        ref.update()
+    for _ in range(2):
+        fused.update()
+    assert ref.iteration == fused.iteration == 6
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+        ref.params, fused.params)
+
+
+def test_zero1_converges_in_trainer(comm):
+    upd = _make(comm, zero1=True)
+    trainer = cmn.Trainer(upd, (4, "epoch"))
+    trainer.run()
+    assert float(upd.observation["main/loss"]) < 1.0
