@@ -44,6 +44,7 @@ from chainermn_tpu.training import (
     create_multi_node_evaluator,
     create_multi_node_optimizer,
     cross_replica_mean,
+    shard_opt_state,
     zero1_init,
     zero1_optimizer,
 )
@@ -71,6 +72,7 @@ __all__ = [
     "add_global_except_hook",
     "create_multi_node_checkpointer",
     "cross_replica_mean",
+    "shard_opt_state",
     "zero1_init",
     "zero1_optimizer",
     "extensions",

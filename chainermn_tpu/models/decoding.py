@@ -197,6 +197,12 @@ def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
     """Shared validation for the decode factories; returns the resolved
     ``(max_len, kv_heads_local)``."""
     _check_mesh(mesh_cfg, cfg)   # head/kv divisibility, clear errors
+    if cfg.fsdp:
+        raise ValueError(
+            "fsdp is a training-path layout (per-layer just-in-time "
+            "weight gathers would land a collective on every generated "
+            "token); decode with dataclasses.replace(cfg, fsdp=False, "
+            "fsdp_wire_dtype='') and re-place the params")
     for ax in ("seq", "pipe"):
         if mesh_cfg.mesh.shape.get(ax, 1) != 1:
             raise ValueError(

@@ -99,6 +99,20 @@ class TransformerConfig:
     virtual_pipe: int = 1      # V model chunks per pipe device (Megatron
     # interleaved schedule: bubble ÷~V for V× activation stash + ring
     # traffic); >1 requires pipeline_schedule="interleaved"
+    fsdp: bool = False         # ZeRO-3 / FSDP: shard the d_model dim of
+    # every block matrix over ``data`` at rest; each scanned layer
+    # all-gathers its weights just-in-time inside the block, and the
+    # gather's AD transpose is a reduce-scatter, so gradients and
+    # optimiser state land shard-width too — the BLOCK matrices' params
+    # + grads + moments cost 1/N_data per device.  The embedding table
+    # and norm scales stay replicated (depth scales the block stack,
+    # not the embed).  Training-path feature; decoding expects
+    # replicated/TP layouts (gathering per generated token would put a
+    # collective on the per-token critical path).
+    fsdp_wire_dtype: str = ""  # "" => gather/reduce-scatter in the
+    # param dtype (fp32 — bit-comparable with fsdp=False); "bfloat16"
+    # halves the per-layer gather + grad reduce-scatter wire bytes (the
+    # allreduce_grad_dtype analogue for the FSDP path)
     remat: bool = True
     remat_policy: str = "full"  # "full" | "dots": with "dots" the block
     # checkpoint saves matmul outputs (jax dots_with_no_batch_dims_saveable)
@@ -157,6 +171,19 @@ class TransformerConfig:
             raise ValueError(
                 f"n_heads={self.n_heads} must be a multiple of "
                 f"n_kv_heads={self.kv_heads}")
+        if self.fsdp_wire_dtype:
+            try:
+                ok = jnp.issubdtype(
+                    jnp.dtype(self.fsdp_wire_dtype), jnp.floating)
+            except TypeError:
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"fsdp_wire_dtype {self.fsdp_wire_dtype!r} must "
+                    "name a floating dtype (weights/grads travel in "
+                    "it; an integer cast would zero them)")
+        if self.fsdp_wire_dtype and not self.fsdp:
+            raise ValueError("fsdp_wire_dtype is set but fsdp=False")
 
 
 # --------------------------------------------------------------------- #
@@ -234,6 +261,41 @@ def init_transformer(key, cfg: TransformerConfig, pipe_size: int = 1):
     return params
 
 
+def _fsdp_dims(cfg: TransformerConfig):
+    """Leaf → axis (into the BASE per-layer shapes, i.e. after scan has
+    stripped the pipe/chunk/layer prefixes) that FSDP shards over
+    ``data``.  One rule everywhere: **the d_model dim** — it exists in
+    every matrix leaf and is never claimed by TP (``model`` shards
+    head/ff dims) or EP (``expert`` shards the expert dim), so the two
+    shardings compose without collisions.  Norm scales are omitted."""
+    dims = {"wo": 2}
+    if cfg.kv_heads == cfg.n_heads:
+        dims["wqkv"] = 0
+    else:
+        dims["wq"] = 0
+        dims["wkv"] = 0
+    if cfg.moe:
+        dims.update({"router": 0, "w1": 1, "w2": 2})
+    else:
+        dims.update({"w1": 0, "w2": 1})
+    return dims
+
+
+def _fsdp_gather(cfg: TransformerConfig, blk):
+    """All-gather one layer's FSDP-sharded leaves along ``data`` (call
+    inside the block, i.e. once per layer per use).  AD transposes each
+    gather into a ``psum_scatter``, which IS ZeRO's gradient
+    reduce-scatter — no hand-written backward."""
+    wd = jnp.dtype(cfg.fsdp_wire_dtype) if cfg.fsdp_wire_dtype else None
+    out = dict(blk)
+    for name, dim in _fsdp_dims(cfg).items():
+        leaf = blk[name]
+        if wd is not None and leaf.dtype != wd:
+            leaf = leaf.astype(wd)
+        out[name] = lax.all_gather(leaf, "data", axis=dim, tiled=True)
+    return out
+
+
 def param_specs(cfg: TransformerConfig, quantized: bool = False):
     """PartitionSpec pytree matching :func:`init_transformer`'s output.
 
@@ -265,6 +327,18 @@ def param_specs(cfg: TransformerConfig, quantized: bool = False):
         # blocks carry an extra local chunk axis after pipe: (pipe, V,
         # layers_per_chunk, ...) — replicate over it, shift the rest
         blk = {k: P(v[0], None, *v[1:]) for k, v in blk.items()}
+    if cfg.fsdp and not quantized:
+        # ZeRO-3 at-rest layout: "data" lands on each matrix's d_model
+        # dim (see _fsdp_dims).  Skipped for quantized (decode) trees —
+        # decoding wants resident weights, not per-token gathers.
+        prefix = 2 + (1 if cfg.virtual_pipe > 1 else 0)
+        for name, dim in _fsdp_dims(cfg).items():
+            full = list(blk[name])
+            idx = prefix + dim
+            full += [None] * (idx + 1 - len(full))
+            assert full[idx] is None, (name, full)
+            full[idx] = "data"
+            blk[name] = P(*full)
     if quantized:
         from .quantization import base_layout, scale_spec
 
@@ -447,6 +521,8 @@ def _mlp(cfg: TransformerConfig, h, blk):
 
 
 def _block(cfg: TransformerConfig, h, blk):
+    if cfg.fsdp:
+        blk = _fsdp_gather(cfg, blk)
     h = _attention(cfg, h, blk)
     return _mlp(cfg, h, blk)
 
@@ -677,6 +753,12 @@ def _check_mesh(mesh_cfg, cfg: TransformerConfig):
             f"attention='ulysses' moves kv heads over the seq axis: "
             f"n_kv_heads={cfg.kv_heads} must be divisible by "
             f"model*seq ({mp}*{sp})")
+    dp = mesh_cfg.mesh.shape.get("data", 1)
+    if cfg.fsdp and cfg.d_model % dp:
+        raise ValueError(
+            f"fsdp shards every matrix's d_model dim over the data "
+            f"axis: d_model={cfg.d_model} must be divisible by the "
+            f"data mesh axis ({dp})")
 
 
 def shard_params(mesh_cfg, cfg: TransformerConfig, params):

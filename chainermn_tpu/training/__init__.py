@@ -9,6 +9,7 @@ from .evaluators import (
 from .optimizers import (
     create_multi_node_optimizer,
     cross_replica_mean,
+    shard_opt_state,
     zero1_init,
     zero1_optimizer,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "fuse_steps",
     "get_trigger",
     "make_extension",
+    "shard_opt_state",
     "zero1_init",
     "zero1_optimizer",
 ]

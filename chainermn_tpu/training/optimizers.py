@@ -34,6 +34,7 @@ __all__ = [
     "Zero1Transformation",
     "cross_replica_mean",
     "create_multi_node_optimizer",
+    "shard_opt_state",
     "zero1_optimizer",
     "zero1_init",
     "DoubleBufferState",
@@ -300,6 +301,63 @@ def zero1_optimizer(
         return jax.tree.map(gather, upd_shards, grads), state
 
     return Zero1Transformation(init, update)
+
+
+def shard_opt_state(optimizer, params):
+    """Initialise ``optimizer``'s state with the PARAMS' shardings.
+
+    ``jax.jit(optimizer.init)(params)`` silently replicates the state:
+    ``zeros_like`` has no data dependence on its input, so XLA's
+    sharding propagation never reaches the moment buffers — under an
+    FSDP/ZeRO-3 param layout that re-materialises ``2·P`` of replicated
+    Adam state and forfeits the sharding's memory win (and forces a
+    reshard on the first update).  This helper pins ``out_shardings``
+    instead: each state leaf whose shape matches a param leaf gets that
+    param's sharding (elementwise optimiser state mirrors the param
+    tree leaf-for-leaf), scalars and unmatched leaves replicate.
+
+    Works for any placed param pytree (transformer, ResNet, custom);
+    falls back to plain ``jit(init)`` for uncommitted host arrays.
+
+    Matching: optax's params-shaped state (``mu``/``nu``/trace/...)
+    mirrors the param tree structurally, so each state leaf's tree path
+    *ends with* some param leaf's full path (``mu.blocks.w1`` ↔
+    ``blocks.w1``) — longest matching path suffix with an equal shape
+    wins; scalars and unmatched leaves replicate.  No shape-only
+    fallback: two same-shape params can carry different shardings
+    (fsdp w1/w2 with d_ff == d_model), and guessing would pin a
+    transposed layout that costs a hidden reshard every update —
+    replicated is the safe default for state a path can't identify.
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    from jax.tree_util import tree_flatten_with_path
+
+    p_paths, _ = tree_flatten_with_path(params)
+    by_path, mesh = {}, None
+    for path, p in p_paths:
+        sh = getattr(p, "sharding", None)
+        if sh is None or not hasattr(sh, "mesh"):
+            continue
+        mesh = mesh if mesh is not None else sh.mesh
+        by_path[tuple(str(k) for k in path)] = (p.shape, sh)
+    if mesh is None:
+        return jax.jit(optimizer.init)(params)
+    replicated = NamedSharding(mesh, P())
+    shapes = jax.eval_shape(optimizer.init, params)
+    s_paths, treedef = tree_flatten_with_path(shapes)
+
+    def pick(path, sd):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):          # longest suffix first
+            hit = by_path.get(keys[start:])
+            if hit is not None and hit[0] == sd.shape:
+                return hit[1]
+        return replicated
+
+    out_shardings = treedef.unflatten(
+        [pick(path, sd) for path, sd in s_paths])
+    return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
 
 
 def zero1_init(tx, params, mesh, axis_name: str):

@@ -75,6 +75,10 @@ def main():
     p.add_argument("--moe", action="store_true")
     p.add_argument("--seq-layout", default="contiguous",
                    choices=["contiguous", "zigzag"])
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3/FSDP: shard params+grads+optimiser "
+                        "state over the data axis (d_model must divide "
+                        "by it); weights all-gather per layer")
     p.add_argument("--vocab", type=int, default=128)
     p.add_argument("--d-model", type=int, default=64)
     p.add_argument("--n-heads", type=int, default=4)
@@ -104,6 +108,7 @@ def main():
         shard_params,
     )
     from chainermn_tpu.parallel import MeshConfig
+    from chainermn_tpu.training import shard_opt_state
     from chainermn_tpu.utils.serialization import load_state, save_state
 
     axes = parse_mesh(args.mesh)
@@ -122,12 +127,15 @@ def main():
         moe=args.moe, n_experts=max(2 * axes.get("expert", 1), 2),
         num_microbatches=2 if pipe > 1 else 1,
         pipeline_schedule=args.schedule, virtual_pipe=V,
+        fsdp=args.fsdp,
         dtype="float32", remat=False,
     )
     params = shard_params(
         mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, pipe))
     opt = optax.adamw(args.lr)
-    opt_state = jax.jit(opt.init)(params)
+    # pins the state's shardings to the params' (with --fsdp the Adam
+    # moments land shard-width; plain jit(init) would replicate them)
+    opt_state = shard_opt_state(opt, params)
     step = make_train_step(mc, cfg, opt)
 
     start = 0
@@ -135,8 +143,18 @@ def main():
                  if args.checkpoint else None)
     if ckpt_file and os.path.exists(ckpt_file):
         saved = load_state(ckpt_file)
-        params = jax.tree.map(jnp.asarray, saved["params"])
-        opt_state = jax.tree.map(jnp.asarray, saved["opt"])
+        # re-place on the mesh: device_put against the freshly built
+        # (correctly sharded) state, NOT bare jnp.asarray — with --fsdp
+        # that would re-materialise params AND both Adam moments
+        # replicated, forfeiting exactly the residency the flag buys
+        def replace_like(saved_tree, like_tree):
+            return jax.tree.map(
+                lambda saved_leaf, like: jax.device_put(
+                    jnp.asarray(saved_leaf), like.sharding),
+                saved_tree, like_tree)
+
+        params = replace_like(saved["params"], params)
+        opt_state = replace_like(saved["opt"], opt_state)
         start = int(saved["step"])
         print(f"resumed at step {start}")
     if start >= args.steps:
