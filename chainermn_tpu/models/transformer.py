@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.ops.pallas_attention import (
@@ -38,6 +39,7 @@ from chainermn_tpu.ops.pallas_attention import (
     flash_attention_supported,
 )
 from chainermn_tpu.parallel.expert import expert_parallel_moe
+from chainermn_tpu.parallel.fsdp import fsdp_gather
 from chainermn_tpu.parallel.pipeline import (
     pipeline_apply,
     pipeline_train_1f1b,
@@ -135,10 +137,16 @@ class TransformerConfig:
         if not self.remat:
             return lambda f: f
         if self.remat_policy == "dots":
+            # matmul outputs AND the attention-core output: the flash
+            # kernel is a custom call, invisible to the dots policy, so
+            # without the named save the whole fwd kernel re-runs in
+            # backward (~9% of the step at 2k context, measured)
+            cp = jax.checkpoint_policies
             return partial(
                 jax.checkpoint,
-                policy=jax.checkpoint_policies.
-                dots_with_no_batch_dims_saveable)
+                policy=cp.save_from_both_policies(
+                    cp.dots_with_no_batch_dims_saveable,
+                    cp.save_only_these_names("attn_out")))
         return jax.checkpoint
 
     def __post_init__(self):
@@ -285,15 +293,12 @@ def _fsdp_gather(cfg: TransformerConfig, blk):
     """All-gather one layer's FSDP-sharded leaves along ``data`` (call
     inside the block, i.e. once per layer per use).  AD transposes each
     gather into a ``psum_scatter``, which IS ZeRO's gradient
-    reduce-scatter — no hand-written backward."""
-    wd = jnp.dtype(cfg.fsdp_wire_dtype) if cfg.fsdp_wire_dtype else None
-    out = dict(blk)
-    for name, dim in _fsdp_dims(cfg).items():
-        leaf = blk[name]
-        if wd is not None and leaf.dtype != wd:
-            leaf = leaf.astype(wd)
-        out[name] = lax.all_gather(leaf, "data", axis=dim, tiled=True)
-    return out
+    reduce-scatter — no hand-written backward.  Mechanics live in
+    :func:`...parallel.fsdp.fsdp_gather`; this only binds the
+    transformer's dim map (norm scales get ``None`` → pass through)."""
+    dims = _fsdp_dims(cfg)
+    return fsdp_gather(blk, {k: dims.get(k) for k in blk},
+                       "data", cfg.fsdp_wire_dtype or None)
 
 
 def param_specs(cfg: TransformerConfig, quantized: bool = False):
@@ -368,6 +373,55 @@ def _rms_norm(x, scale):
     x32 = x.astype(jnp.float32)
     r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
     return (x32 * r * scale).astype(x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _lm_head(cd, h, embed):
+    """Weight-tied LM head: compute-dtype operands on the MXU, fp32
+    accumulation and fp32 logits (stable softmax).  With ``cd=bf16``
+    this runs the single biggest matmul of the step at the MXU's native
+    rate instead of ~1/4 of it — naively ``h.fp32 @ embed.fp32`` makes
+    the head (and, worse, its TWO transposed gradient matmuls) fp32."""
+    return jnp.einsum("btd,vd->btv", h.astype(cd), embed.astype(cd),
+                      preferred_element_type=jnp.float32)
+
+
+def _lm_head_fwd(cd, h, embed):
+    return _lm_head(cd, h, embed), (h, embed)
+
+
+def _lm_head_bwd(cd, res, g):
+    # the logit cotangent is (softmax - onehot)/N — unit-scale, safe in
+    # bf16 — so both grad matmuls ride the MXU too; accumulation stays
+    # fp32 and grads leave at their primal dtypes (embed's is fp32)
+    h, embed = res
+    gl = g.astype(cd)
+    dh = jnp.einsum("btv,vd->btd", gl, embed.astype(cd),
+                    preferred_element_type=jnp.float32).astype(h.dtype)
+    dw = jnp.einsum("btv,btd->vd", gl, h.astype(cd),
+                    preferred_element_type=jnp.float32).astype(embed.dtype)
+    # embed is replicated over every mesh axis; its true cotangent is
+    # the SUM of the per-member partials, which the standard einsum
+    # transpose would emit as shard_map's automatic psum.  custom_vjp
+    # hides that linearity from the vma checker, so reduce explicitly
+    # over whatever axes the local partial is varying on (size-1 axes
+    # and the single-device oracle fold to identity).  No silent
+    # fallback: on a jax too old for vma typing the reduction CANNOT be
+    # reconstructed here, and skipping it would mean unreduced embed
+    # grads — fail instead.
+    try:
+        vma = tuple(jax.typeof(dw).vma)
+    except AttributeError:  # pragma: no cover - older jax: no vma typing
+        raise RuntimeError(
+            "_lm_head needs jax.typeof(...).vma (shard_map varying-axes "
+            "typing) to place the embed-gradient psum; this jax version "
+            "does not expose it") from None
+    if vma:
+        dw = lax.psum(dw, vma)
+    return dh, dw
+
+
+_lm_head.defvjp(_lm_head_fwd, _lm_head_bwd)
 
 
 def apply_rope(x, positions, theta: float = 10000.0):
@@ -489,6 +543,10 @@ def _attention(cfg: TransformerConfig, h, blk):
                 interpret=jax.default_backend() != "tpu")
     else:
         raise ValueError(cfg.attention)
+    # named for the "dots" remat policy: saving the attention-core
+    # output keeps the (expensive, custom-call) kernel out of backward
+    # recompute while the cheap elementwise neighbourhood still remats
+    o = checkpoint_name(o, "attn_out")
     o = row_parallel_dense(
         o.reshape(B, T, -1), blk["wo"].reshape(-1, D).astype(cd))
     return h + o
@@ -628,9 +686,9 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
         aux = lax.psum(aux, "pipe")
 
     h = _rms_norm(h, params["ln_f"])
-    # weight-tied head; fp32 logits for a stable softmax
-    logits = jnp.einsum(
-        "btd,vd->btv", h.astype(jnp.float32), params["embed"])
+    # weight-tied head; fp32 logits for a stable softmax, compute-dtype
+    # matmul operands (see _lm_head)
+    logits = _lm_head(cfg.compute_dtype, h, params["embed"])
     return logits, aux
 
 
@@ -690,8 +748,7 @@ def _make_1f1b_grad(cfg: TransformerConfig):
 
         def loss_fn(lp, y, tgt):
             hN = _rms_norm(y, lp["ln_f"])
-            logits = jnp.einsum(
-                "btd,vd->btv", hN.astype(jnp.float32), lp["embed"])
+            logits = _lm_head(cd, hN, lp["embed"])
             logp = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(
                 logp, tgt[..., None], axis=-1).squeeze(-1)

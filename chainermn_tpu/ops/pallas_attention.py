@@ -89,10 +89,15 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(needed)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        # dots take the refs' NATIVE dtype (bf16 in production) with
+        # fp32 accumulation — casting operands to fp32 first would run
+        # every matmul at the MXU's fp32 rate, ~4x slower (measured:
+        # the whole train-step attention share dropped ~2x when these
+        # casts were removed); softmax statistics stay fp32 throughout
+        q = q_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
         allow = None
         if causal:
             qpos = _positions(q_off, i * Bq, Bq)
@@ -112,7 +117,7 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             # block's V rows into the output
             p = jnp.where(allow, p, 0.0)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
-            p, vb, preferred_element_type=jnp.float32)
+            p.astype(vb.dtype), vb, preferred_element_type=jnp.float32)
         l_ref[...] = _bcast(l * alpha + p.sum(axis=-1))
         m_ref[...] = _bcast(m_new)
 
@@ -166,17 +171,21 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype (bf16) dot operands, fp32 accumulation — see the
+        # forward kernel's note; ds is cast back to the wire dtype for
+        # the MXU (the standard flash-v2 backward numerics)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
+        kb = k_ref[0]
+        vb = v_ref[0]
         p = _recompute_p(q, kb, scale, lse, causal, window, q_off, k_off,
                          i, j, Bq, Bk)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dq_acc[...] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+        dq_acc[...] += jnp.dot(ds.astype(kb.dtype), kb,
+                               preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _():
@@ -207,18 +216,22 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _():
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype (bf16) dot operands, fp32 accumulation — see the
+        # forward kernel's note; p/ds cast to the wire dtype for the MXU
+        kb = k_ref[0]
+        vb = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
         p = _recompute_p(q, kb, scale, lse, causal, window, q_off, k_off,
                          i, j, Bq, Bk)                   # (Bq, Bk)
-        dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(p.astype(do.dtype).T, do,
+                               preferred_element_type=jnp.float32)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dk_acc[...] += jnp.dot(ds.astype(q.dtype).T, q,
+                               preferred_element_type=jnp.float32)
 
     @pl.when(i == nq - 1)
     def _():

@@ -42,11 +42,15 @@ from chainermn_tpu.parallel.tensor import (
 )
 from chainermn_tpu.parallel.ulysses import ulysses_attention
 from chainermn_tpu.parallel.expert import expert_parallel_moe
+from chainermn_tpu.parallel.fsdp import fsdp_dims, fsdp_gather, fsdp_specs
 
 __all__ = [
     "MeshConfig",
     "column_parallel_dense",
     "expert_parallel_moe",
+    "fsdp_dims",
+    "fsdp_gather",
+    "fsdp_specs",
     "local_attention",
     "pipeline_apply",
     "pipeline_train_1f1b",

@@ -1,0 +1,113 @@
+"""Generic FSDP / ZeRO-3 utilities for ARBITRARY parameter pytrees.
+
+Beyond-reference (the reference replicated parameters on every rank, as
+every DP framework of its era did).  The flagship transformer has its
+own purpose-built layout (``TransformerConfig(fsdp=True)`` — one
+d_model-dim rule, see ``models/transformer._fsdp_dims``); this module is
+the same mechanics for *user* models driven through shard_map:
+
+- :func:`fsdp_dims` picks, per leaf, which axis to shard over the data
+  axis (largest dim divisible by the axis size, skipping dims an
+  existing spec already claims);
+- :func:`fsdp_specs` turns that choice into ``PartitionSpec``s for
+  ``device_put`` / shard_map ``in_specs`` (the at-rest 1/N layout);
+- :func:`fsdp_gather` is the just-in-time all-gather to call INSIDE the
+  step right before the params are used.  Its AD transpose is a
+  ``psum_scatter`` — ZeRO's gradient reduce-scatter falls out of
+  autodiff, no hand-written backward.
+
+Optimiser state follows automatically: run the optimiser on the
+*sharded* params/grads (its elementwise state mirrors their width) and
+initialise it with :func:`...training.shard_opt_state` so the moments
+take the params' shardings.
+
+TPU mechanics: the gather is one ``lax.all_gather`` per leaf per use —
+XLA schedules the HBM-resident shards' ICI transfers behind the
+previous layer's compute exactly like any other collective, and a
+``wire_dtype`` of bf16 halves both the gather and the reduce-scatter
+bytes (the ``allreduce_grad_dtype`` analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["fsdp_dims", "fsdp_specs", "fsdp_gather"]
+
+
+def fsdp_dims(params, axis_size: int, specs=None, min_size: int = 2):
+    """Choose, per leaf, the dim FSDP shards over the data axis.
+
+    Returns a pytree of ``Optional[int]`` matching ``params``: the
+    LARGEST dim whose length is divisible by ``axis_size`` (ties →
+    first), or
+    ``None`` when no dim fits or every candidate is shorter than
+    ``min_size * axis_size`` (sharding a tiny vector buys nothing and
+    costs a collective).  ``specs`` (a matching PartitionSpec tree, e.g.
+    TP/EP shardings) marks dims that are already claimed — those are
+    skipped so the layouts compose.
+    """
+    spec_tree = specs if specs is not None else jax.tree.map(
+        lambda _: None, params)
+
+    def pick(leaf, spec) -> Optional[int]:
+        shape = jnp.shape(leaf)
+        taken = () if spec is None else tuple(spec)
+        best = None
+        for d, n in enumerate(shape):
+            if d < len(taken) and taken[d] is not None:
+                continue
+            if n % axis_size or n < min_size * axis_size:
+                continue
+            if best is None or n > shape[best]:
+                best = d
+        return best
+
+    return jax.tree.map(pick, params, spec_tree)
+
+
+def fsdp_specs(params, dims, axis: str = "data", base_specs=None):
+    """PartitionSpec tree for the at-rest layout: ``base_specs`` (or
+    fully-replicated) with ``axis`` inserted at each leaf's chosen dim."""
+    if base_specs is None:
+        base_specs = jax.tree.map(lambda _: P(), params)
+
+    def build(leaf, dim, spec):
+        if dim is None:
+            return spec
+        full = list(spec) + [None] * (dim + 1 - len(spec))
+        if full[dim] is not None:
+            raise ValueError(
+                f"fsdp dim {dim} already sharded as {spec}; pass this "
+                "spec to fsdp_dims so it picks a free dim")
+        full[dim] = axis
+        return P(*full)
+
+    return jax.tree.map(build, params, dims, base_specs)
+
+
+def fsdp_gather(params, dims, axis_name: str = "data", wire_dtype=None):
+    """All-gather the FSDP-sharded leaves back to full width — call
+    INSIDE shard_map, just before the params are consumed.  Grads
+    reduce-scatter through the gather's transpose automatically.
+
+    ``wire_dtype`` (e.g. ``jnp.bfloat16``) casts before the gather so
+    the collective and the gradient reduce-scatter move half the bytes;
+    pass ``None`` to keep the params' own dtype (exact parity with the
+    replicated layout).
+    """
+    wd = None if wire_dtype is None else jnp.dtype(wire_dtype)
+
+    def gather(leaf, dim):
+        if dim is None:
+            return leaf
+        if wd is not None and leaf.dtype != wd:
+            leaf = leaf.astype(wd)
+        return lax.all_gather(leaf, axis_name, axis=dim, tiled=True)
+
+    return jax.tree.map(gather, params, dims)

@@ -349,7 +349,9 @@ def shard_opt_state(optimizer, params):
 
     def pick(path, sd):
         keys = tuple(str(k) for k in path)
-        for start in range(len(keys)):          # longest suffix first
+        # longest suffix first, INCLUDING the empty suffix — a bare
+        # jax.Array params "tree" has the empty path as its only key
+        for start in range(len(keys) + 1):
             hit = by_path.get(keys[start:])
             if hit is not None and hit[0] == sd.shape:
                 return hit[1]

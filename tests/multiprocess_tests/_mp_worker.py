@@ -579,6 +579,59 @@ def scenario_preemption(comm):
     assert all(x == [3] for x in iters), iters
 
 
+def scenario_fsdp_train(comm):
+    """ZeRO-3/FSDP over a PROCESS-SPANNING data axis: the flagship
+    transformer's fsdp layout puts each process's device on a 1/N param
+    shard, the per-layer gathers cross the process boundary, and the
+    losses must match the replicated run exactly."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import optax
+
+    from chainermn_tpu.models import (
+        TransformerConfig, init_transformer, make_train_step, shard_params,
+    )
+    from chainermn_tpu.parallel import MeshConfig
+    from chainermn_tpu.training import shard_opt_state
+
+    B, T = 4, 8
+    dense = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, d_head=8, d_ff=32,
+        n_layers=2, max_seq=T, attention="local", dtype="float32",
+        remat=False)
+    mc = MeshConfig(data=comm.size, devices=jax.devices())
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 32, (B, T + 1)), jnp.int32)
+
+    def train(cfg, steps=2):
+        params = shard_params(
+            mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+        opt = optax.adam(1e-2)
+        opt_state = shard_opt_state(opt, params)
+        step = make_train_step(mc, cfg, opt)
+        out = []
+        for _ in range(steps):
+            params, opt_state, loss = step(
+                params, opt_state, toks[:, :T], toks[:, 1:])
+            out.append(float(jax.block_until_ready(loss)))
+        return out, params
+
+    fsdp_losses, placed = train(dataclasses.replace(dense, fsdp=True))
+    # this process's device really holds only its 1/N slice at rest
+    w1 = placed["blocks"]["w1"]
+    assert w1.addressable_shards[0].data.shape[2] == 16 // comm.size, \
+        w1.addressable_shards[0].data.shape
+    dense_losses, _ = train(dense)
+    np.testing.assert_allclose(fsdp_losses, dense_losses,
+                               rtol=1e-5, atol=1e-5)
+    # every process must agree on the loss trajectory
+    all_losses = comm.allgather_obj(fsdp_losses)
+    for other in all_losses[1:]:
+        np.testing.assert_allclose(other, all_losses[0],
+                                   rtol=1e-6, atol=1e-6)
+
+
 SCENARIOS = {
     name[len("scenario_"):]: fn
     for name, fn in list(globals().items())

@@ -57,6 +57,9 @@ class TestTwoProcess:
     def test_zero1_checkpoint(self, mp_run):
         mp_run("zero1_checkpoint")
 
+    def test_fsdp_train(self, mp_run):
+        mp_run("fsdp_train")
+
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
 
