@@ -1,19 +1,30 @@
 """Shared plumbing for the root-level benchmark scripts.
 
-Both ``bench.py`` (ResNet-50 images/s) and ``bench_transformer.py``
-(LM tokens/s) need the same two pieces:
+All bench scripts (``bench.py`` ResNet-50, ``bench_transformer.py``,
+``bench_attention.py``, ``bench_decode.py``, ``bench_seq2seq.py``)
+share three pieces:
 
-- the per-chip peak bf16 FLOP/s table (MFU denominator), and
+- the per-chip peak bf16 FLOP/s table (MFU denominator),
 - the hermetic child-process runner: the TPU backend on this host can
   hang inside ``jax.devices()``, so measurements run in a child under a
-  hard timeout with bounded retries, and a failure still prints the ONE
-  required JSON line with an ``error`` field instead of an external
-  rc=124 and no record.
+  hard timeout, and a failure still prints the ONE required JSON line
+  instead of an external rc=124 and no record, and
+- the freshest-good measurement cache (``BENCH_MEASURED.json``): every
+  successful run is appended with a timestamp, and when the live
+  attempt fails (the axon backend's init hang can last 10+ minutes —
+  longer than any sane gate timeout) the runner falls back to the
+  freshest cached value for the same metric, marked ``"cached": true``
+  with its timestamp and the live error.  A round must never record
+  ``value: null`` while a recent real measurement exists.
 """
 
+import datetime
 import json
 import os
 import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+CACHE_PATH = os.path.join(_HERE, "BENCH_MEASURED.json")
 
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public
@@ -46,9 +57,98 @@ def pin_platform(platform: str) -> None:
         jax.config.update("jax_platforms", platform)
 
 
-def run_child_with_retries(cmd, cwd, timeouts, metric, unit) -> int:
+def _load_cache():
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"runs": []}
+
+
+def record_measurement(result: dict) -> None:
+    """Append a successful measurement to BENCH_MEASURED.json with a
+    timestamp so it can serve as a gate fallback later.
+
+    Single-writer by convention (this container runs one TPU job at a
+    time — concurrent benches would contend for the one chip anyway);
+    the pid-suffixed tmp name keeps an accidental overlap from
+    interleaving writes into invalid JSON, though the later writer's
+    read-modify-write still wins.
+    """
+    if result.get("value") is None:
+        return
+    cache = _load_cache()
+    entry = dict(result)
+    entry.setdefault(
+        "timestamp",
+        datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"))
+    cache.setdefault("runs", []).append(entry)
+    tmp = f"{CACHE_PATH}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(cache, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, CACHE_PATH)
+
+
+# Entries older than this do not serve as a gate fallback: after a long
+# hardware outage the gate must go back to reporting the outage, not a
+# number measured against weeks-old code.
+MAX_CACHE_AGE_DAYS = 14
+
+
+def freshest_cached(metric: str, match: dict | None = None,
+                    max_age_days: float = MAX_CACHE_AGE_DAYS):
+    """Newest cached run for ``metric`` with a non-null value.
+
+    ``match`` restricts to runs whose recorded fields equal the given
+    values (e.g. ``{"batch": 256, "image": 224}``) so a toy-sized
+    debugging run on real hardware can never stand in for the
+    full-size gate workload.  A run that predates the recording of a
+    matched field (key absent) passes — every NEW run records its full
+    workload config, so the leniency only covers legacy entries and
+    retires itself.  The same applies to timestamps: entries older
+    than ``max_age_days`` are skipped, legacy pre-timestamp entries
+    pass.  Entries are appended chronologically; the last match wins.
+    """
+    now = datetime.datetime.now(datetime.timezone.utc)
+    for run in reversed(_load_cache().get("runs", [])):
+        if run.get("metric") != metric or run.get("value") is None:
+            continue
+        if match and any(k in run and run[k] != v
+                         for k, v in match.items()):
+            continue
+        ts = run.get("timestamp")
+        if ts is not None:
+            try:
+                age = now - datetime.datetime.fromisoformat(ts)
+            except ValueError:
+                age = None
+            if age is not None and age.days >= max_age_days:
+                continue
+        return run
+    return None
+
+
+def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
+                           use_cache=True, cache_match=None) -> int:
     """Run ``cmd`` under per-attempt timeouts until one prints a
-    ``BENCH_RESULT`` line; always print exactly one JSON line."""
+    ``BENCH_RESULT`` line; always print exactly one JSON line.
+
+    With ``use_cache`` (the real-hardware default), success is recorded
+    to the measurement cache and total failure falls back to the
+    freshest cached value for ``metric`` (marked ``cached: true``)
+    rather than reporting null — the axon TPU init hang outlasts any
+    gate timeout, and retrying into it only prolongs the hang, so the
+    right move is one live attempt + cache.  Callers that pin a
+    platform (CPU smoke tests) MUST pass ``use_cache=False``: a toy
+    run must neither masquerade as a hardware measurement in the cache
+    nor have its own failure papered over by one.  ``cache_match``
+    (workload-defining fields, e.g. ``{"batch": 256}``) further pins
+    the fallback to runs of the SAME workload — a small-config
+    hardware debug run is recorded but never served for the full-size
+    gate.
+    """
     errors = []
     for attempt, budget in enumerate(timeouts):
         try:
@@ -62,17 +162,34 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit) -> int:
             continue
         for line in reversed(proc.stdout.splitlines()):
             if line.startswith("BENCH_RESULT "):
-                print(line[len("BENCH_RESULT "):])
+                payload = line[len("BENCH_RESULT "):]
+                if use_cache:
+                    try:
+                        record_measurement(json.loads(payload))
+                    except Exception:
+                        # never lose a live result to a cache-write
+                        # failure (read-only checkout, full disk)
+                        pass
+                print(payload)
                 return 0
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
         errors.append(
             f"attempt {attempt + 1}: rc={proc.returncode}, "
             f"last output: {' | '.join(tail[-3:]) if tail else '<none>'}")
+    error = "; ".join(errors)[-1800:]
+    cached = freshest_cached(metric, cache_match) if use_cache else None
+    if cached is not None:
+        out = dict(cached)
+        out["cached"] = True
+        out["cached_timestamp"] = out.pop("timestamp", None)
+        out["live_error"] = error
+        print(json.dumps(out))
+        return 0
     print(json.dumps({
         "metric": metric,
         "value": None,
         "unit": unit,
         "vs_baseline": None,
-        "error": "; ".join(errors)[-1800:],
+        "error": error,
     }))
     return 0

@@ -62,6 +62,7 @@ def run(batch=4, seq=8192, heads=8, d_head=128, iters=20, warmup=3):
         "vs_baseline": round(speedup, 3),
         "flash_ms": round(flash_ms, 2),
         "xla_ms": round(xla_ms, 2),
+        "batch": batch, "seq": seq,
         "config": f"B{batch} T{seq} H{heads} D{d_head} causal bf16 fwd+bwd",
     }
 
@@ -72,7 +73,7 @@ def main(argv):
     p.add_argument("--seq", type=int, default=8192)
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--iters", type=int, default=20)
-    p.add_argument("--timeouts", type=int, nargs="+", default=[420, 360])
+    p.add_argument("--timeouts", type=int, nargs="+", default=[420])
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
@@ -88,7 +89,9 @@ def main(argv):
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(
-        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
+        use_cache=args.platform is None,
+        cache_match={"batch": args.batch, "seq": args.seq})
 
 
 if __name__ == "__main__":

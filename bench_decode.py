@@ -77,6 +77,7 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
         "tokens_per_sec_per_seq": round(tok_s / batch, 1),
         "device_kind": jax.devices()[0].device_kind,
         "batch": batch, "max_len": max_len,
+        "d_model": d_model, "n_layers": n_layers,
         "n_params": int(n_params),
         "n_kv_heads": n_kv_heads,
         "int8": int8,
@@ -96,7 +97,7 @@ def main(argv):
     p.add_argument("--iters", type=int, default=2)
     p.add_argument("--platform", default=None)
     p.add_argument("--timeouts", type=int, nargs="+",
-                   default=[900, 600])  # the 511-step decode scan compiles slowly
+                   default=[900])  # the 511-step decode scan compiles slowly
     args = p.parse_args(argv)
 
     if args.child:
@@ -117,7 +118,11 @@ def main(argv):
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(
-        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
+        use_cache=args.platform is None,
+        cache_match={"batch": args.batch, "max_len": args.max_len,
+                     "d_model": args.d_model, "n_layers": args.n_layers,
+                     "int8": args.int8})
 
 
 if __name__ == "__main__":

@@ -98,6 +98,7 @@ def run(batch=256, vocab=8000, units=256, layers=2, max_src=48,
         "pad_fraction": round(1 - real_tokens / (batch * max_tgt), 3),
         "units": units,
         "layers": layers,
+        "vocab": vocab,
     }
 
 
@@ -123,7 +124,7 @@ def main(argv):
     p.add_argument("--iters", type=int, default=6)
     p.add_argument("--steps-per-call", type=int, default=4)
     p.add_argument("--platform", default=None)
-    p.add_argument("--timeouts", type=int, nargs="+", default=[420, 360])
+    p.add_argument("--timeouts", type=int, nargs="+", default=[420])
     args = p.parse_args(argv)
     if args.child:
         _child_main(args)
@@ -138,7 +139,10 @@ def main(argv):
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(
-        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
+        use_cache=args.platform is None,
+        cache_match={"batch": args.batch, "units": args.units,
+                     "layers": args.layers, "vocab": args.vocab})
 
 
 if __name__ == "__main__":

@@ -95,6 +95,7 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         "device_kind": kind,
         "step_time_ms": round(dt / iters * 1e3, 2),
         "batch": batch, "seq": seq,
+        "d_model": d_model, "n_layers": n_layers,
         "n_params": int(n_params),
         "attention": attention,
         "n_kv_heads": n_kv_heads,
@@ -127,7 +128,11 @@ def _parent_main(args):
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(
-        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT)
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
+        use_cache=args.platform is None,
+        cache_match={"batch": args.batch, "seq": args.seq,
+                     "d_model": args.d_model, "n_layers": args.n_layers,
+                     "attention": args.attention})
 
 
 def _parse_args(argv):
@@ -146,7 +151,7 @@ def _parse_args(argv):
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "dots", "none"])
     p.add_argument("--platform", default=None)
-    p.add_argument("--timeouts", type=int, nargs="+", default=[480, 420])
+    p.add_argument("--timeouts", type=int, nargs="+", default=[480])
     return p.parse_args(argv)
 
 

@@ -68,6 +68,24 @@ __all__ = [
 ]
 
 
+def _probe_vma_support() -> bool:
+    """Whether this jax exposes shard_map varying-axes (vma) typing.
+
+    ``_lm_head``'s custom VJP needs ``jax.typeof(...).vma`` to place the
+    embed-gradient psum; probing an abstract aval (never a concrete
+    array — that would trigger backend init at import time, which hangs
+    on this container's tunnelled TPU) lets the requirement surface at
+    config construction instead of deep inside the first backward.
+    """
+    try:
+        return hasattr(jax.core.ShapedArray((), jnp.float32), "vma")
+    except Exception:  # pragma: no cover - exotic jax internals change
+        return False
+
+
+_HAS_VMA = _probe_vma_support()
+
+
 @dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -150,6 +168,12 @@ class TransformerConfig:
         return jax.checkpoint
 
     def __post_init__(self):
+        if not _HAS_VMA:
+            raise RuntimeError(
+                "chainermn_tpu's transformer requires a jax whose "
+                "ShapedArray carries .vma (shard_map varying-axes "
+                "typing, jax >= 0.4.34): _lm_head's custom VJP uses it "
+                "to place the embed-gradient psum. Upgrade jax.")
         if self.attention_window < 0:
             raise ValueError(
                 f"attention_window {self.attention_window} must be >= 0")
@@ -341,7 +365,13 @@ def param_specs(cfg: TransformerConfig, quantized: bool = False):
             full = list(blk[name])
             idx = prefix + dim
             full += [None] * (idx + 1 - len(full))
-            assert full[idx] is None, (name, full)
+            if full[idx] is not None:
+                # not an assert: under ``python -O`` a silently-ignored
+                # collision would emit an overlapping PartitionSpec
+                raise ValueError(
+                    f"FSDP dim collision on {name!r}: dim {dim} already "
+                    f"sharded as {full[idx]!r} in {P(*full)}; fix "
+                    "_fsdp_dims so FSDP lands on a free dim")
             full[idx] = "data"
             blk[name] = P(*full)
     if quantized:

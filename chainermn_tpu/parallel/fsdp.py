@@ -40,7 +40,15 @@ from jax.sharding import PartitionSpec as P
 __all__ = ["fsdp_dims", "fsdp_specs", "fsdp_gather"]
 
 
-def fsdp_dims(params, axis_size: int, specs=None, min_size: int = 2):
+def _mentions_axis(entries, axis: str) -> bool:
+    """Whether a PartitionSpec's entries use ``axis`` on any dim (an
+    entry is ``None``, an axis name, or a tuple of axis names)."""
+    return any(axis == a or (isinstance(a, tuple) and axis in a)
+               for a in entries)
+
+
+def fsdp_dims(params, axis_size: int, specs=None, min_size: int = 2,
+              axis: Optional[str] = None):
     """Choose, per leaf, the dim FSDP shards over the data axis.
 
     Returns a pytree of ``Optional[int]`` matching ``params``: the
@@ -50,7 +58,11 @@ def fsdp_dims(params, axis_size: int, specs=None, min_size: int = 2):
     ``min_size * axis_size`` (sharding a tiny vector buys nothing and
     costs a collective).  ``specs`` (a matching PartitionSpec tree, e.g.
     TP/EP shardings) marks dims that are already claimed — those are
-    skipped so the layouts compose.
+    skipped so the layouts compose.  Pass ``axis`` (the FSDP mesh axis
+    name you'll give :func:`fsdp_specs`) to also SKIP any leaf whose
+    spec already mentions that axis on some dim — a mesh axis can
+    appear in a PartitionSpec only once, so such a leaf cannot take an
+    FSDP dim at all.
     """
     spec_tree = specs if specs is not None else jax.tree.map(
         lambda _: None, params)
@@ -58,6 +70,8 @@ def fsdp_dims(params, axis_size: int, specs=None, min_size: int = 2):
     def pick(leaf, spec) -> Optional[int]:
         shape = jnp.shape(leaf)
         taken = () if spec is None else tuple(spec)
+        if axis is not None and _mentions_axis(taken, axis):
+            return None
         best = None
         for d, n in enumerate(shape):
             if d < len(taken) and taken[d] is not None:
@@ -85,6 +99,15 @@ def fsdp_specs(params, dims, axis: str = "data", base_specs=None):
             raise ValueError(
                 f"fsdp dim {dim} already sharded as {spec}; pass this "
                 "spec to fsdp_dims so it picks a free dim")
+        if _mentions_axis(full, axis):
+            # same mesh axis on a DIFFERENT dim would make a duplicate-
+            # axis PartitionSpec that only fails later inside
+            # NamedSharding with a far less actionable error; backstop —
+            # fsdp_dims(..., axis=...) skips such leaves up front
+            raise ValueError(
+                f"mesh axis {axis!r} already appears in {spec}; pass "
+                f"axis={axis!r} (and this spec) to fsdp_dims so it "
+                "skips the leaf, or shard FSDP over a different axis")
         full[dim] = axis
         return P(*full)
 
@@ -96,18 +119,24 @@ def fsdp_gather(params, dims, axis_name: str = "data", wire_dtype=None):
     INSIDE shard_map, just before the params are consumed.  Grads
     reduce-scatter through the gather's transpose automatically.
 
-    ``wire_dtype`` (e.g. ``jnp.bfloat16``) casts before the gather so
-    the collective and the gradient reduce-scatter move half the bytes;
-    pass ``None`` to keep the params' own dtype (exact parity with the
-    replicated layout).
+    ``wire_dtype`` (e.g. ``jnp.bfloat16``) casts before the gather and
+    back after it, so the collective AND the gradient reduce-scatter
+    (the cast's transpose converts the cotangent to ``wire_dtype``
+    before the scatter, back to the param dtype after) move half the
+    bytes while forward/backward compute still sees the params' own
+    dtype.  The only numerics change vs ``None`` is the wire-dtype
+    rounding of the moved values — the ``allreduce_grad_dtype``
+    analogue, exactly as documented.
     """
     wd = None if wire_dtype is None else jnp.dtype(wire_dtype)
 
     def gather(leaf, dim):
         if dim is None:
             return leaf
-        if wd is not None and leaf.dtype != wd:
+        orig = leaf.dtype
+        if wd is not None and orig != wd:
             leaf = leaf.astype(wd)
-        return lax.all_gather(leaf, axis_name, axis=dim, tiled=True)
+        out = lax.all_gather(leaf, axis_name, axis=dim, tiled=True)
+        return out.astype(orig) if out.dtype != orig else out
 
     return jax.tree.map(gather, params, dims)

@@ -40,6 +40,21 @@ def test_fsdp_dims_skips_taken_dims():
         fsdp_specs(params, {"w": 0}, base_specs={"w": P("model", None)})
 
 
+def test_fsdp_dims_skips_leaves_already_on_axis():
+    # a leaf whose base spec already uses the FSDP axis (on any dim)
+    # cannot take an FSDP dim — the axis may appear only once in a
+    # PartitionSpec.  fsdp_dims(axis=...) skips it up front; without
+    # axis=, fsdp_specs is the backstop that refuses the duplicate.
+    params = {"w": jnp.zeros((64, 64)), "v": jnp.zeros((64, 64))}
+    specs = {"w": P("data", None), "v": P("model", None)}
+    dims = fsdp_dims(params, 8, specs=specs, axis="data")
+    assert dims == {"w": None, "v": 1}
+    out = fsdp_specs(params, dims, base_specs=specs)
+    assert out == {"w": P("data", None), "v": P("model", "data")}
+    with pytest.raises(ValueError, match="already appears"):
+        fsdp_specs(params, {"w": 1, "v": None}, base_specs=specs)
+
+
 def _mlp_init():
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
     return {
