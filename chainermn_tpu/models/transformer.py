@@ -722,13 +722,19 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
     return logits, aux
 
 
+# coefficient of the Switch-MoE balancing loss in the training objective
+# (identical across the GPipe/1F1B/interleaved paths so the schedules
+# optimise the same function)
+_AUX_WEIGHT = 0.01
+
+
 def lm_loss(cfg: TransformerConfig, params, inputs, targets):
     """Local-shard mean next-token cross-entropy (+0.01·aux)."""
     logits, aux = transformer_forward(cfg, params, inputs)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(
         logp, targets[..., None], axis=-1).squeeze(-1)
-    return nll.mean() + 0.01 * aux
+    return nll.mean() + _AUX_WEIGHT * aux
 
 
 # --------------------------------------------------------------------- #
@@ -748,17 +754,17 @@ def _make_1f1b_grad(cfg: TransformerConfig):
     gradients (``ln_f`` and the head side of ``embed``) flow through the
     schedule's ``loss_params`` path.
     """
-    if cfg.moe:
-        raise ValueError(
-            f"pipeline_schedule={cfg.pipeline_schedule!r} does not carry "
-            "the Switch-MoE aux "
-            "loss through the schedule yet — use the GPipe schedule for "
-            "MoE configs")
     cd = cfg.compute_dtype
 
-    def stage_fn(p, mb):
-        h, _ = _stage(cfg, p, mb)
-        return h
+    if cfg.moe:
+        # _stage already returns (h, aux); the schedule's with_aux path
+        # carries the Switch balancing loss AND its gradients (every
+        # stage seeds its own aux cotangent at _AUX_WEIGHT)
+        stage_fn = partial(_stage, cfg)
+    else:
+        def stage_fn(p, mb):
+            h, _ = _stage(cfg, p, mb)
+            return h
 
     def grad_body(params, inputs, targets):
         B, T = inputs.shape
@@ -785,15 +791,24 @@ def _make_1f1b_grad(cfg: TransformerConfig):
             return nll.mean()
 
         lp = {"ln_f": params["ln_f"], "embed": params["embed"]}
+        aux_kw = dict(with_aux=True, aux_weight=_AUX_WEIGHT) \
+            if cfg.moe else {}
         if cfg.pipeline_schedule == "interleaved":
-            loss, g_blocks, g_lp, dx = pipeline_train_interleaved(
+            out = pipeline_train_interleaved(
                 stage_fn, loss_fn, params["blocks"], lp, h, targets,
                 axis_name="pipe", num_microbatches=cfg.num_microbatches,
-                num_chunks=cfg.virtual_pipe)
+                num_chunks=cfg.virtual_pipe, **aux_kw)
         else:
-            loss, g_blocks, g_lp, dx = pipeline_train_1f1b(
+            out = pipeline_train_1f1b(
                 stage_fn, loss_fn, params["blocks"], lp, h, targets,
-                axis_name="pipe", num_microbatches=cfg.num_microbatches)
+                axis_name="pipe", num_microbatches=cfg.num_microbatches,
+                **aux_kw)
+        if cfg.moe:
+            loss, aux, g_blocks, g_lp, dx = out
+            # report the same scalar the GPipe path's lm_loss computes
+            loss = loss + _AUX_WEIGHT * aux
+        else:
+            loss, g_blocks, g_lp, dx = out
         (d_ep,) = vjp_embed(dx)
 
         grads = {

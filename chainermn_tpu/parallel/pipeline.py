@@ -64,6 +64,17 @@ def _replicate_bwd(axis_name, src, _, ct):
 _replicate_from.defvjp(_replicate_fwd, _replicate_bwd)
 
 
+def _with_dummy_aux(stage_fn, with_aux):
+    """Normalise ``stage_fn`` to the ``(mb, aux)`` shape.  The dummy aux
+    must DERIVE from mb so its vma matches the varying cotangent seeded
+    in the backward slot (a bare constant zero would type-clash with
+    ``ct_a`` inside ``jax.vjp``)."""
+    if with_aux:
+        return stage_fn
+    return lambda p, mb: (stage_fn(p, mb),
+                          jnp.sum(mb * 0, dtype=jnp.float32))
+
+
 def stack_stage_params(params_list):
     """Stack per-stage pytrees along a new leading ``stage`` axis (to be
     sharded over ``pipe``).  All stages must share one structure — the
@@ -189,6 +200,8 @@ def pipeline_train_1f1b(
     *,
     axis_name: str = "pipe",
     num_microbatches: int,
+    with_aux: bool = False,
+    aux_weight: float = 1.0,
 ):
     """One-forward-one-backward (1F1B) pipelined training step.
 
@@ -222,12 +235,23 @@ def pipeline_train_1f1b(
       loss_params: pytree used by ``loss_fn`` (e.g. final norm + output
         head), replicated over the mesh.
       x: full local batch ``(B, ...)``; ``targets``: ``(B, ...)``.
+      with_aux: ``stage_fn`` returns ``(mb, aux_scalar)``; each stage's
+        per-micro-batch aux (the Switch-MoE balancing loss) is summed
+        over stages, averaged over micro-batches, and returned — AND its
+        gradient flows: every backward slot seeds its own stage's aux
+        cotangent with ``aux_weight``, so ``stage_grads`` differentiates
+        ``mean_mb(loss) + aux_weight * aux`` exactly like the GPipe path
+        differentiating ``loss + aux_weight * pipeline_apply(...)[1]``.
+      aux_weight: the coefficient the aux term carries in the training
+        objective (gradient-side only; the RETURNED aux is unweighted so
+        callers can report/compose it like ``pipeline_apply`` does).
 
     Returns ``(loss, stage_grads, loss_grads, dx)`` — loss is the mean
     over micro-batches (replicated); ``stage_grads`` matches
     ``stage_params`` (this stage's shard, leading axis 1); ``loss_grads``
     matches ``loss_params`` (replicated); ``dx`` is ``∂loss/∂x`` for the
-    layers feeding the pipeline (replicated).
+    layers feeding the pipeline (replicated).  With ``with_aux``:
+    ``(loss, aux, stage_grads, loss_grads, dx)``.
     """
     S = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -242,6 +266,8 @@ def pipeline_train_1f1b(
     mbs = x.reshape(M, B // M, *x.shape[1:])
     tgts = targets.reshape(M, B // M, *targets.shape[1:])
 
+    raw_fn = _with_dummy_aux(stage_fn, with_aux)
+
     K = 2 * S - 1  # stash ring depth: max in-flight per stage is 2(S−1)+1
     up_perm = [(i, i + 1) for i in range(S - 1)]
     down_perm = [(i + 1, i) for i in range(S - 1)]
@@ -252,18 +278,19 @@ def pipeline_train_1f1b(
             acc, new)
 
     def tick(carry, t):
-        act, ct, stash, gp, glp, dx_bank, loss_acc = carry
+        act, ct, stash, gp, glp, dx_bank, loss_acc, aux_acc = carry
 
         # ---- forward slot: stage s forwards micro-batch t − s -------- #
         m_f = t - stage
         fwd_active = (m_f >= 0) & (m_f < M)
         recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
         inp = jnp.where(stage == 0, mbs[jnp.clip(m_f, 0, M - 1)], recv)
-        y = stage_fn(params, inp)
+        y, aux_f = raw_fn(params, inp)
         stash = jnp.where(
             fwd_active,
             lax.dynamic_update_index_in_dim(stash, inp, m_f % K, 0),
             stash)
+        aux_acc = aux_acc + jnp.where(fwd_active, aux_f, 0.0)
 
         # ---- backward slot: stage s backwards t − (2S−2−s) ----------- #
         m_b = t - (2 * S - 2 - stage)
@@ -274,17 +301,24 @@ def pipeline_train_1f1b(
         tgt_b = tgts[jnp.clip(m_b, 0, M - 1)]
 
         def composite(p, lp, xin):
-            yy = stage_fn(p, xin)
-            return yy, loss_fn(lp, yy, tgt_b)
+            yy, aux = raw_fn(p, xin)
+            return yy, loss_fn(lp, yy, tgt_b), aux
 
-        (_, l_b), vjp = jax.vjp(composite, params, loss_params, inp_b)
+        (_, l_b, a_b), vjp = jax.vjp(
+            composite, params, loss_params, inp_b)
         # the last stage seeds its own cotangent from the in-schedule
         # loss; earlier stages consume the downstream stage's dx
         ct_y = jnp.where(is_last, jnp.zeros_like(ct_recv), ct_recv)
         # + l_b*0: the cotangent must carry l_b's full varying-axes set
         # (data/seq/... under composition), not just the pipe axis
         ct_l = jnp.where(is_last, 1.0, 0.0).astype(l_b.dtype) + l_b * 0
-        dp, dlp, dx = vjp((ct_y, ct_l))
+        # EVERY stage seeds its own aux cotangent (each stage's layers
+        # own their balancing loss); inactive-tick garbage is masked out
+        # of gp below, and the dx it pollutes only reaches inactive
+        # upstream slots (the schedule dependency argument).  Built from
+        # the aux primal so dtype AND vma match it exactly.
+        ct_a = jnp.asarray(aux_weight, a_b.dtype) + a_b * 0
+        dp, dlp, dx = vjp((ct_y, ct_l, ct_a))
 
         gp = masked_add(gp, dp, bwd_active)
         # loss_params are REPLICATED, so the shard_map transpose has
@@ -302,7 +336,7 @@ def pipeline_train_1f1b(
         loss_acc = loss_acc + jnp.where(
             bwd_active & is_last, l_b, 0.0)
 
-        return (y, dx, stash, gp, glp, dx_bank, loss_acc), None
+        return (y, dx, stash, gp, glp, dx_bank, loss_acc, aux_acc), None
 
     # zero carries derived from real tensors so they inherit the varying
     # mesh axes (vma discipline, as in pipeline_apply)
@@ -314,8 +348,8 @@ def pipeline_train_1f1b(
     dx0 = lax.pcast(mbs * 0, (axis_name,), to="varying")
     loss0 = jnp.sum(mb0 * 0, dtype=jnp.float32)
 
-    (_, _, _, gp, glp, dx_bank, loss_acc), _ = lax.scan(
-        tick, (mb0, mb0, stash0, gp0, glp0, dx0, loss0),
+    (_, _, _, gp, glp, dx_bank, loss_acc, aux_acc), _ = lax.scan(
+        tick, (mb0, mb0, stash0, gp0, glp0, dx0, loss0, loss0),
         jnp.arange(M + 2 * (S - 1)))
 
     # loss / loss-param grads / input grads live on single stages (last,
@@ -324,7 +358,11 @@ def pipeline_train_1f1b(
     glp = jax.tree.map(lambda a: lax.psum(a, axis_name) / M, glp)
     dx = lax.psum(dx_bank, axis_name).reshape(B, *x.shape[1:]) / M
     gp = jax.tree.map(lambda a: a[None] / M, gp)  # restore stage axis
-    return loss, gp, glp, dx
+    if not with_aux:
+        return loss, gp, glp, dx
+    # same convention as pipeline_apply: stage-sum / micro-batch mean
+    aux = lax.psum(aux_acc, axis_name) / M
+    return loss, aux, gp, glp, dx
 
 
 # --------------------------------------------------------------------- #
@@ -440,6 +478,8 @@ def pipeline_train_interleaved(
     axis_name: str = "pipe",
     num_microbatches: int,
     num_chunks: int,
+    with_aux: bool = False,
+    aux_weight: float = 1.0,
 ):
     """Interleaved 1F1B (Megatron virtual pipeline stages), one SPMD scan.
 
@@ -462,9 +502,14 @@ def pipeline_train_interleaved(
         with ``blocks.reshape(V, S, ...).swapaxes(0, 1)`` so chunk ``c``
         of device ``s`` holds the right layer slice).
       x / targets: full local batch ``(B, ...)``.
+      with_aux / aux_weight: as in :func:`pipeline_train_1f1b` —
+        ``stage_fn`` returns ``(mb, aux_scalar)`` per CHUNK; auxes sum
+        over all ``S·V`` virtual stages, average over micro-batches,
+        and their gradients flow with weight ``aux_weight``.
 
     Returns ``(loss, stage_grads, loss_grads, dx)`` with the same
-    conventions as :func:`pipeline_train_1f1b`.
+    conventions as :func:`pipeline_train_1f1b` (``(loss, aux, ...)``
+    with ``with_aux``).
     """
     S = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -483,6 +528,8 @@ def pipeline_train_interleaved(
     mbs = x.reshape(M, B // M, *x.shape[1:])
     tgts = targets.reshape(M, B // M, *targets.shape[1:])
 
+    raw_fn = _with_dummy_aux(stage_fn, with_aux)
+
     T, f_act, f_m, f_c, b_act, b_m, b_c, K = _interleaved_tables(
         int(S), V, M)
     tbl = [jnp.asarray(a) for a in (f_act, f_m, f_c, b_act, b_m, b_c)]
@@ -495,19 +542,20 @@ def pipeline_train_interleaved(
             params)
 
     def tick(carry, t):
-        act, ct, stash, gp, glp, dx_bank, loss_acc = carry
+        act, ct, stash, gp, glp, dx_bank, loss_acc, aux_acc = carry
         fa, fm, fc, ba, bm, bc = (a[stage, t] for a in tbl)
 
         # ---- forward slot ------------------------------------------- #
         recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
         inject = (stage == 0) & (fc == 0)
         inp = jnp.where(inject, mbs[fm], recv)
-        y = stage_fn(chunk_params(fc), inp)
+        y, aux_f = raw_fn(chunk_params(fc), inp)
         stash = jnp.where(
             fa,
             lax.dynamic_update_index_in_dim(
                 stash, inp[None], fc * K + fm % K, 0),
             stash)
+        aux_acc = aux_acc + jnp.where(fa, aux_f, 0.0)
 
         # ---- backward slot ------------------------------------------ #
         ct_recv = lax.ppermute(ct, axis_name, perm=down_perm) \
@@ -517,14 +565,17 @@ def pipeline_train_interleaved(
         seed = is_last_dev & (bc == V - 1)
 
         def composite(p, lp, xin):
-            yy = stage_fn(p, xin)
-            return yy, loss_fn(lp, yy, tgt_b)
+            yy, aux = raw_fn(p, xin)
+            return yy, loss_fn(lp, yy, tgt_b), aux
 
-        (_, l_b), vjp = jax.vjp(
+        (_, l_b, a_b), vjp = jax.vjp(
             composite, chunk_params(bc), loss_params, inp_b)
         ct_y = jnp.where(seed, jnp.zeros_like(ct_recv), ct_recv)
         ct_l = jnp.where(seed, 1.0, 0.0).astype(l_b.dtype) + l_b * 0
-        dpc, dlp, dx = vjp((ct_y, ct_l))
+        # every virtual stage seeds its own aux cotangent (see 1F1B);
+        # built from the aux primal so dtype and vma match it exactly
+        ct_a = jnp.asarray(aux_weight, a_b.dtype) + a_b * 0
+        dpc, dlp, dx = vjp((ct_y, ct_l, ct_a))
 
         gp = jax.tree.map(
             lambda G, d: G.at[bc].add(
@@ -538,7 +589,7 @@ def pipeline_train_interleaved(
             lax.dynamic_update_index_in_dim(dx_bank, dx, bm, 0),
             dx_bank)
         loss_acc = loss_acc + jnp.where(ba & seed, l_b, 0.0)
-        return (y, dx, stash, gp, glp, dx_bank, loss_acc), None
+        return (y, dx, stash, gp, glp, dx_bank, loss_acc, aux_acc), None
 
     mb0 = lax.pcast(mbs[0] * 0, (axis_name,), to="varying")
     stash0 = jnp.broadcast_to(mb0, (V * K, *mb0.shape)) * 1
@@ -548,11 +599,15 @@ def pipeline_train_interleaved(
     dx0 = lax.pcast(mbs * 0, (axis_name,), to="varying")
     loss0 = jnp.sum(mb0 * 0, dtype=jnp.float32)
 
-    (_, _, _, gp, glp, dx_bank, loss_acc), _ = lax.scan(
-        tick, (mb0, mb0, stash0, gp0, glp0, dx0, loss0), jnp.arange(T))
+    (_, _, _, gp, glp, dx_bank, loss_acc, aux_acc), _ = lax.scan(
+        tick, (mb0, mb0, stash0, gp0, glp0, dx0, loss0, loss0),
+        jnp.arange(T))
 
     loss = lax.psum(loss_acc, axis_name) / M
     glp = jax.tree.map(lambda a: lax.psum(a, axis_name) / M, glp)
     dx = lax.psum(dx_bank, axis_name).reshape(B, *x.shape[1:]) / M
     gp = jax.tree.map(lambda a: a[None] / M, gp)  # restore pipe axis
-    return loss, gp, glp, dx
+    if not with_aux:
+        return loss, gp, glp, dx
+    aux = lax.psum(aux_acc, axis_name) / M
+    return loss, aux, gp, glp, dx

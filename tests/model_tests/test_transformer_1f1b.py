@@ -72,11 +72,38 @@ def test_1f1b_step_matches_gpipe(axes, M):
             err_msg="1F1B parameters diverge from GPipe after 3 steps")
 
 
-def test_1f1b_moe_raises():
-    cfg = tiny_cfg(pipeline_schedule="1f1b", moe=True, n_experts=4)
+def test_1f1b_moe_matches_gpipe():
+    """EP + PP(1F1B): the Switch balancing loss and its gradients must
+    ride the 1F1B schedule — loss trajectory and parameters must match
+    the GPipe schedule, which differentiates loss + 0.01*aux."""
     mc = MeshConfig(pipe=2, expert=2, data=2)
-    with pytest.raises(ValueError, match="1f1b"):
-        make_train_step(mc, cfg, optax.sgd(0.1))
+    toks = tokens()
+    x, y = toks[:, :T], toks[:, 1:]
+
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        cfg = tiny_cfg(pipeline_schedule=sched, moe=True, n_experts=4,
+                       num_microbatches=2)
+        params = shard_params(
+            mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, 2))
+        opt = optax.sgd(0.1)
+        opt_state = jax.jit(opt.init)(params)
+        step = make_train_step(mc, cfg, opt)
+        p, s, losses = params, opt_state, []
+        for _ in range(3):
+            p, s, loss = step(p, s, x, y)
+            losses.append(float(loss))
+        results[sched] = (losses, p)
+
+    np.testing.assert_allclose(
+        results["1f1b"][0], results["gpipe"][0], rtol=1e-4, atol=1e-5,
+        err_msg="MoE 1F1B loss trajectory diverges from GPipe")
+    for a, b in zip(jax.tree.leaves(results["1f1b"][1]),
+                    jax.tree.leaves(results["gpipe"][1])):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-4,
+            err_msg="MoE 1F1B parameters diverge from GPipe (aux "
+                    "gradients lost or double-counted in the schedule)")
 
 
 def test_moe_aux_survives_gpipe_pipelining():

@@ -106,6 +106,37 @@ def test_interleaved_step_matches_gpipe(axes, V, M):
                 err_msg=f"layer {L}")
 
 
+def test_interleaved_moe_matches_gpipe():
+    """EP + interleaved PP: the Switch balancing loss and its gradients
+    must ride the interleaved schedule — loss trajectory must match the
+    GPipe schedule (which differentiates loss + 0.01*aux)."""
+    pipe, V, M = 2, 2, 2
+    mc = MeshConfig(pipe=pipe, expert=2, data=2)
+    toks = tokens()
+    x, y = toks[:, :T], toks[:, 1:]
+
+    results = {}
+    for sched, v in (("gpipe", 1), ("interleaved", V)):
+        cfg = tiny_cfg(pipeline_schedule=sched, virtual_pipe=v,
+                       num_microbatches=M, moe=True, n_experts=4)
+        params = shard_params(
+            mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, pipe))
+        opt = optax.sgd(0.1)
+        opt_state = jax.jit(opt.init)(params)
+        step = make_train_step(mc, cfg, opt)
+        p, s, losses = params, opt_state, []
+        for _ in range(3):
+            p, s, loss = step(p, s, x, y)
+            losses.append(float(loss))
+        results[sched] = (p, losses)
+
+    np.testing.assert_allclose(
+        results["gpipe"][1], results["interleaved"][1],
+        rtol=1e-4, atol=1e-5,
+        err_msg="MoE interleaved loss trajectory diverges from GPipe "
+                "(aux gradients lost or double-counted in the schedule)")
+
+
 def test_interleaved_forward_matches_single_device():
     """The chunk-looped forward path reproduces the unpipelined oracle."""
     pipe, V = 2, 2
