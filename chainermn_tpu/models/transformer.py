@@ -850,11 +850,15 @@ def _check_mesh(mesh_cfg, cfg: TransformerConfig):
             f"mesh axis ({mp}); raise n_kv_heads or shrink the model "
             "axis (shared kv heads shard over the same axis as query "
             "heads)")
-    if cfg.attention == "ulysses" and sp > 1 and cfg.kv_heads % (mp * sp):
+    if cfg.attention == "ulysses" and sp > 1 \
+            and (cfg.n_heads // mp) % sp:
         raise ValueError(
-            f"attention='ulysses' moves kv heads over the seq axis: "
-            f"n_kv_heads={cfg.kv_heads} must be divisible by "
-            f"model*seq ({mp}*{sp})")
+            f"attention='ulysses' splits query heads over the seq axis: "
+            f"n_heads/model ({cfg.n_heads}/{mp}) must be divisible by "
+            f"the seq mesh axis ({sp}).  Shared kv heads need NOT "
+            "divide — they replicate up to lcm for the exchange — and "
+            "ring attention keeps them at true width if the surplus "
+            "factor matters")
     dp = mesh_cfg.mesh.shape.get("data", 1)
     if cfg.fsdp and cfg.d_model % dp:
         raise ValueError(

@@ -23,6 +23,7 @@ non-causal mask pattern); ring keeps activations resident and rotates K/V
 
 from __future__ import annotations
 
+from math import gcd
 from typing import Callable, Optional
 
 from jax import lax
@@ -47,33 +48,45 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
     the pallas flash kernel for production — any ``attn_fn`` must accept
     the ``window`` keyword, if only to reject it).
 
-    GQA/MQA: ``k``/``v`` may carry fewer (shared) heads ``G`` with
-    ``S | G`` and ``G | H`` — the all-to-alls then move K/V at ``G``-head
-    width (the wire saving carries through), and the grouping lines up
+    GQA/MQA: ``k``/``v`` may carry fewer (shared) heads ``G`` (with
+    ``G | H``).  When ``S | G`` the all-to-alls move K/V at ``G``-head
+    width (the wire saving carries through) and the grouping lines up
     locally because query and kv heads shard over the same axis: device
     ``r`` holds query heads ``[r·H/S, (r+1)·H/S)`` whose shared heads are
-    exactly its ``[r·G/S, (r+1)·G/S)`` slice.  A custom ``attn_fn`` that
-    needs matching head counts gets K/V broadcast to query width *after*
-    the exchange (local); the default grouped path never materialises it.
+    exactly its ``[r·G/S, (r+1)·G/S)`` slice.  When ``S ∤ G`` (a 4-kv-head
+    model on a seq≥8 mesh), the shared heads are first repeated
+    consecutively up to ``lcm(G, S)`` — each repeat serves the query heads
+    of one destination shard, so the grouping is preserved — and the
+    exchange moves K/V at that width instead of erroring; the wire cost
+    rises toward (but never beyond) MHA width.  Ring attention handles
+    the same configs with K/V resident at true ``G`` width — prefer it
+    when the surplus factor is large.  A custom ``attn_fn`` that needs
+    matching head counts gets K/V broadcast to query width *after* the
+    exchange (local); the default grouped path never materialises it.
 
     Returns ``(B, T/S, H, D)`` sequence-sharded, numerically identical to
     full attention (no online-softmax approximation anywhere).
     """
     S = lax.axis_size(axis_name)
-    rep = _group_rep(q.shape[2], k.shape[2])
     if S > 1:
-        if q.shape[2] % S:
+        H, G = q.shape[2], k.shape[2]
+        if H % S:
             raise ValueError(
-                f"heads {q.shape[2]} not divisible by seq-axis size {S}")
-        if k.shape[2] % S:
-            raise ValueError(
-                f"kv heads {k.shape[2]} not divisible by seq-axis size "
-                f"{S}: pick n_kv_heads a multiple of the seq mesh axis")
+                f"heads {H} not divisible by seq-axis size {S}")
+        if G % S:
+            # expand shared heads to lcm(G, S): S | lcm by construction,
+            # and lcm | H because G | H and S | H both hold here.
+            # Consecutive repeat (broadcast_kv, THE grouping-invariant
+            # helper) keeps query head h reading (expanded) head
+            # h // (H // lcm) = the repeat of its true shared head
+            # h // (H // G).
+            k, v = broadcast_kv(k, v, S // gcd(G, S))
         # (B, T/S, H, D) → (B, T, H/S, D): scatter heads, gather sequence
         q, k, v = (
             lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
                            tiled=True)
             for t in (q, k, v))
+    rep = _group_rep(q.shape[2], k.shape[2])
     if attn_fn is not None:
         # local post-exchange broadcast for kernels wanting equal heads
         k, v = broadcast_kv(k, v, rep)

@@ -75,15 +75,18 @@ def test_matches_mha_with_repeated_kv():
         np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("axes", [
-    dict(model=2, data=4),
-    dict(seq=4, data=2),
-    dict(pipe=2, model=2, data=2),
+@pytest.mark.parametrize("axes,attn", [
+    (dict(model=2, data=4), "local"),
+    (dict(seq=4, data=2), "ring"),
+    # ulysses with seq(4) > n_kv_heads(2): the over-split path
+    # replicates shared heads up to lcm for the exchange
+    (dict(seq=4, data=2), "ulysses"),
+    (dict(pipe=2, model=2, data=2), "local"),
 ], ids=str)
-def test_sharded_matches_single_device(axes):
+def test_sharded_matches_single_device(axes, attn):
     pipe = axes.get("pipe", 1)
     cfg = gqa_cfg(
-        attention="ring" if axes.get("seq", 1) > 1 else "local",
+        attention=attn,
         num_microbatches=2 if pipe > 1 else 1,
     )
     params = init_transformer(jax.random.PRNGKey(0), cfg, pipe_size=pipe)
@@ -140,9 +143,12 @@ def test_grouped_ring_and_ulysses_match_repeated_kv():
     np.testing.assert_allclose(
         np.asarray(got_local), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
-    # ring: any ring size; ulysses: kv heads must split over seq (S <= G)
+    # ring: any ring size; ulysses: S | G moves true-width K/V, S > G
+    # (over-split, G=2 on seq=4) replicates shared heads up to lcm —
+    # both boundary sides must reproduce the oracle
     for fn, axes in ((ring_attention, dict(seq=4, data=2)),
-                     (ulysses_attention, dict(seq=2, data=4))):
+                     (ulysses_attention, dict(seq=2, data=4)),
+                     (ulysses_attention, dict(seq=4, data=2))):
         mc = MC(**axes)
         got = jax.jit(jax.shard_map(
             lambda q, k, v: fn(q, k, v, axis_name="seq", causal=True),
@@ -151,17 +157,22 @@ def test_grouped_ring_and_ulysses_match_repeated_kv():
         ))(q, k, v)
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4,
-            err_msg=fn.__name__)
+            err_msg=f"{fn.__name__} {axes}")
 
-    # and the ulysses over-split case raises the actionable error
+    # MQA (G=1) on seq=4: maximal surplus factor, still exact
+    k1, v1 = k[:, :, :1], v[:, :, :1]
+    ref1 = local_attention(q, jnp.repeat(k1, H, axis=2),
+                           jnp.repeat(v1, H, axis=2), causal=True)
     mc = MC(seq=4, data=2)
-    with pytest.raises(ValueError, match="kv heads"):
-        jax.jit(jax.shard_map(
-            lambda q, k, v: ulysses_attention(
-                q, k, v, axis_name="seq", causal=True),
-            mesh=mc.mesh,
-            in_specs=P(None, "seq"), out_specs=P(None, "seq"),
-        ))(q, k, v)
+    got = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(
+            q, k, v, axis_name="seq", causal=True),
+        mesh=mc.mesh,
+        in_specs=P(None, "seq"), out_specs=P(None, "seq"),
+    ))(q, k1, v1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref1), rtol=2e-4, atol=2e-4,
+        err_msg="ulysses MQA over-split")
 
 
 def test_mqa_train_step_learns():

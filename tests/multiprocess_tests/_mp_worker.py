@@ -632,6 +632,101 @@ def scenario_fsdp_train(comm):
                                    rtol=1e-6, atol=1e-6)
 
 
+def _tiny_transformer_losses(mc, cfg, steps=2):
+    """Shared driver for the TP/PP data-plane scenarios: init, shard,
+    run ``steps`` train steps on the given mesh, return the losses."""
+    import jax.numpy as jnp
+    import optax
+
+    from chainermn_tpu.models import (
+        init_transformer, make_train_step, shard_params,
+    )
+    from chainermn_tpu.training import shard_opt_state
+
+    B, T = 4, 8
+    pipe = mc.mesh.shape.get("pipe", 1)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T + 1)),
+        jnp.int32)
+    params = shard_params(
+        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, pipe))
+    opt = optax.adam(1e-2)
+    opt_state = shard_opt_state(opt, params)
+    step = make_train_step(mc, cfg, opt)
+    out = []
+    for _ in range(steps):
+        params, opt_state, loss = step(
+            params, opt_state, toks[:, :T], toks[:, 1:])
+        out.append(float(jax.block_until_ready(loss)))
+    return out
+
+
+def scenario_tp_train(comm):
+    """Tensor parallelism ACROSS the process boundary: 2 processes × 1
+    device, ``model=2`` — every layer's column→row psum is a real
+    cross-process collective.  The loss trajectory must equal a
+    process-LOCAL single-device oracle (same init, same data)."""
+    from chainermn_tpu.models import TransformerConfig
+    from chainermn_tpu.parallel import MeshConfig
+
+    assert jax.process_count() == 2 and len(jax.local_devices()) == 1
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, d_head=8, d_ff=32,
+        n_layers=2, max_seq=8, attention="local", dtype="float32",
+        remat=False)
+
+    tp_losses = _tiny_transformer_losses(
+        MeshConfig(model=2, data=1, devices=jax.devices()), cfg)
+    # local oracle: this process's own device, no sharded axes
+    oracle = _tiny_transformer_losses(
+        MeshConfig(data=1, devices=[jax.local_devices()[0]]), cfg)
+    np.testing.assert_allclose(tp_losses, oracle, rtol=1e-5, atol=1e-5)
+    all_losses = comm.allgather_obj(tp_losses)
+    for other in all_losses[1:]:
+        np.testing.assert_allclose(other, all_losses[0],
+                                   rtol=1e-6, atol=1e-6)
+
+
+def scenario_pp_train(comm):
+    """Pipeline parallelism ACROSS the process boundary: 2 processes × 2
+    devices, ``MeshConfig(pipe=2, model=2)`` — pipe is the mesh-major
+    axis, so each stage's ppermute activation hand-off crosses the
+    process boundary while each stage's TP psum stays process-local
+    (the production layout).  Also runs ``MeshConfig(model=2, data=2)``
+    — the VERDICT-named shape, whose grad allreduce spans processes —
+    and checks both against the process-local single-device oracle."""
+    import dataclasses
+
+    from chainermn_tpu.models import TransformerConfig
+    from chainermn_tpu.parallel import MeshConfig
+
+    assert jax.process_count() == 2 and len(jax.local_devices()) == 2
+    base = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, d_head=8, d_ff=32,
+        n_layers=2, max_seq=8, attention="local", dtype="float32",
+        remat=False)
+    oracle = _tiny_transformer_losses(
+        MeshConfig(data=1, devices=[jax.local_devices()[0]]), base)
+
+    for axes, cfg in (
+        (dict(pipe=2, model=2, data=1),
+         dataclasses.replace(base, num_microbatches=2)),
+        (dict(pipe=2, model=2, data=1),
+         dataclasses.replace(base, num_microbatches=2,
+                             pipeline_schedule="1f1b")),
+        (dict(model=2, data=2), base),
+    ):
+        losses = _tiny_transformer_losses(
+            MeshConfig(devices=jax.devices(), **axes), cfg)
+        np.testing.assert_allclose(
+            losses, oracle, rtol=1e-5, atol=1e-5,
+            err_msg=f"{axes} {cfg.pipeline_schedule}")
+        all_losses = comm.allgather_obj(losses)
+        for other in all_losses[1:]:
+            np.testing.assert_allclose(other, all_losses[0],
+                                       rtol=1e-6, atol=1e-6)
+
+
 SCENARIOS = {
     name[len("scenario_"):]: fn
     for name, fn in list(globals().items())

@@ -23,14 +23,20 @@ def _free_port() -> int:
     return port
 
 
-def _worker_env() -> dict:
+def _worker_env(devices_per_proc: int = 1) -> dict:
     env = dict(os.environ)
-    # one plain CPU device per process; scrub TPU-plugin and parent-test
-    # mesh settings so each worker builds its own 1-device world
+    # plain CPU devices; scrub TPU-plugin and parent-test mesh settings
+    # so each worker builds its own world
     for k in list(env):
         if k.startswith(("TPU_", "LIBTPU", "PJRT_", "JAX_", "XLA_")):
             env.pop(k)
     env["JAX_PLATFORMS"] = "cpu"
+    if devices_per_proc > 1:
+        # multi-device processes: global device ids interleave as
+        # (proc 0: 0..d-1), (proc 1: d..2d-1), ... so mesh-minor axes
+        # stay process-local and mesh-major axes span the boundary
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices_per_proc}")
     env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     return env
 
@@ -40,9 +46,10 @@ def mp_run():
     """Run ``scenario`` across ``nprocs`` real processes; fail the test on
     any non-zero worker exit, with both workers' output in the report."""
 
-    def run(scenario: str, nprocs: int = 2, timeout: int = 180):
+    def run(scenario: str, nprocs: int = 2, timeout: int = 180,
+            devices_per_proc: int = 1):
         addr = f"localhost:{_free_port()}"
-        env = _worker_env()
+        env = _worker_env(devices_per_proc)
         procs = [
             subprocess.Popen(
                 [sys.executable, _WORKER, addr, str(nprocs), str(i),

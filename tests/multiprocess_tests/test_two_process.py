@@ -60,6 +60,16 @@ class TestTwoProcess:
     def test_fsdp_train(self, mp_run):
         mp_run("fsdp_train")
 
+    def test_tp_train(self, mp_run):
+        # per-layer TP psum crosses the process boundary (model=2 over
+        # 2 single-device processes)
+        mp_run("tp_train")
+
+    def test_pp_train(self, mp_run):
+        # 2 procs x 2 devices: pipe (mesh-major) ppermute crosses the
+        # process boundary; model stays local; + the model=2,data=2 shape
+        mp_run("pp_train", devices_per_proc=2, timeout=300)
+
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
 
