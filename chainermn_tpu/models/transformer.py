@@ -501,12 +501,19 @@ def _attention(cfg: TransformerConfig, h, blk):
         # shard/jit build time by _check_mesh).
         Hl = blk["wq"].shape[1]
         Hkvl = blk["wkv"].shape[2]
-        q = column_parallel_dense(
-            x, blk["wq"].reshape(D, -1).astype(cd)
-        ).reshape(B, T, Hl, cfg.d_head)
-        kv = column_parallel_dense(
-            x, blk["wkv"].reshape(D, -1).astype(cd)
-        ).reshape(B, T, 2, Hkvl, cfg.d_head)
+        # ONE fused projection dot, like the MHA wqkv path: concatenating
+        # the (local-shard) weights along the output dim reads the
+        # activations once instead of twice — the concat costs one
+        # weight-sized copy, far less than the saved (B,T,D) re-read at
+        # training shapes, and removes a dispatch on the decode path.
+        # The at-rest params stay separate (their TP/FSDP specs differ).
+        dq = Hl * cfg.d_head
+        fused = jnp.concatenate(
+            [blk["wq"].reshape(D, -1), blk["wkv"].reshape(D, -1)],
+            axis=1).astype(cd)
+        qkv = column_parallel_dense(x, fused)
+        q = qkv[..., :dq].reshape(B, T, Hl, cfg.d_head)
+        kv = qkv[..., dq:].reshape(B, T, 2, Hkvl, cfg.d_head)
         k, v = kv[:, :, 0], kv[:, :, 1]
     if cfg.pos_embedding == "rope":
         # rotate by each local token's GLOBAL position BEFORE any ring

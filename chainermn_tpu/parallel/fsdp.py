@@ -134,9 +134,19 @@ def fsdp_gather(params, dims, axis_name: str = "data", wire_dtype=None):
         if dim is None:
             return leaf
         orig = leaf.dtype
-        if wd is not None and orig != wd:
-            leaf = leaf.astype(wd)
+        narrowed = wd is not None and orig != wd
+        if narrowed:
+            # barriers pin BOTH casts against the collective: without
+            # them XLA commutes the elementwise converts across the
+            # all-gather (sinking the narrow-cast / hoisting the
+            # cast-back) and the wire silently widens to the param
+            # dtype — verified in HLO: f32-wide gathers barrier-less.
+            # optimization_barrier transposes to itself, so the
+            # gradient reduce-scatter stays at wire_dtype too.
+            leaf = lax.optimization_barrier(leaf.astype(wd))
         out = lax.all_gather(leaf, axis_name, axis=dim, tiled=True)
-        return out.astype(orig) if out.dtype != orig else out
+        if narrowed:
+            out = lax.optimization_barrier(out).astype(orig)
+        return out
 
     return jax.tree.map(gather, params, dims)

@@ -1,5 +1,12 @@
-"""Utility subsystems: serialization, profiling/tracing, logging."""
+"""Utility subsystems: serialization, profiling/tracing, comm modelling."""
 
+from chainermn_tpu.utils.comm_model import (
+    CollectiveStats,
+    axis_collective_report,
+    collective_stats,
+    stablehlo_collective_stats,
+    wire_bytes_per_device,
+)
 from chainermn_tpu.utils.profiling import (
     Profiler,
     ProfileReport,
@@ -10,11 +17,16 @@ from chainermn_tpu.utils.profiling import (
 from chainermn_tpu.utils.serialization import load_state, save_state
 
 __all__ = [
+    "CollectiveStats",
     "ProfileReport",
     "Profiler",
+    "axis_collective_report",
+    "collective_stats",
     "get_profiler",
     "load_state",
     "profiled_communicator",
     "save_state",
+    "stablehlo_collective_stats",
     "trace",
+    "wire_bytes_per_device",
 ]

@@ -1,0 +1,264 @@
+"""Communication-volume model: per-collective bytes/step from compiled HLO.
+
+The reference's scaling story (ChainerMN's ~90%-efficiency ImageNet
+claims, SURVEY.md §6) was argued from measured multi-node runs; this
+container has ONE real chip, so the equivalent evidence chain here is
+analytic: walk a compiled step's HLO for collective ops, count the bytes
+each moves, convert to wire time with the standard ring formulas and the
+interconnect's published bandwidth, and compare against the measured
+single-chip step time.  ``SCALING.md`` assembles the result.
+
+Axis attribution: a composed-mesh HLO doesn't name mesh axes, so
+:func:`axis_collective_report` compiles the SAME step on single-active-
+axis virtual meshes (e.g. ``data=8``, then ``model=8``) — every
+collective in that program belongs to that axis.  This is exact for the
+per-axis *volume model* because collective volume depends only on the
+axis being reduced/gathered over, not on which other axes exist.
+
+Wire-cost conventions (ring algorithms, ``n`` = axis size, ``s`` =
+tensor bytes): all-reduce moves ``2s(n-1)/n`` per device, all-gather and
+reduce-scatter ``s(n-1)/n`` (s = the FULL tensor), all-to-all
+``s(n-1)/n``, collective-permute ``s``.  XLA may pick tree variants on
+real topologies; ring is the bandwidth-optimal baseline the model uses.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+__all__ = [
+    "CollectiveStats",
+    "collective_stats",
+    "stablehlo_collective_stats",
+    "wire_bytes_per_device",
+    "axis_collective_report",
+]
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_KINDS = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+          "collective-permute")
+
+# one HLO instruction: "%name = SHAPE kind(...)" where SHAPE is a single
+# "f32[8,16]{...}" or a tuple "(f32[8]{..}, bf16[4,4]{..})"; -start
+# variants are the async halves (count those, skip -done duplicates)
+_INSTR_RE = re.compile(
+    r"=\s*(\([^)]*\)|[a-z0-9]+\[[^\]]*\]\S*)\s+"
+    r"(" + "|".join(_KINDS) + r")(-start)?\(")
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+
+
+def _shape_bytes(shape_str: str, is_start: bool = False) -> int:
+    shapes = _SHAPE_RE.findall(shape_str)
+    if is_start and len(shapes) >= 2:
+        # async start ops carry (operands, results, context...) in one
+        # tuple; counting the whole tuple would double the volume.
+        # Element 1 is the result buffer (element 0 the operand).
+        shapes = shapes[1:2]
+    total = 0
+    for dtype, dims in shapes:
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _group_size(line: str) -> Optional[int]:
+    m = _GROUPS_RE.search(line)
+    if not m:
+        return None
+    first = m.group(1).split("}")[0].lstrip("{")
+    ids = [t for t in first.split(",") if t.strip()]
+    return len(ids) or None
+
+
+@dataclass
+class CollectiveStats:
+    """Aggregate of one collective kind in one compiled program."""
+
+    kind: str
+    count: int = 0
+    bytes: int = 0              # summed tensor bytes across call sites
+    group_size: Optional[int] = None   # replica-group size (if uniform)
+
+    def wire_bytes(self, axis_size: Optional[int] = None) -> float:
+        n = axis_size or self.group_size or 2
+        if n < 1:
+            raise ValueError(
+                "non-uniform replica groups in this program "
+                "(group_size=-1); pass axis_size explicitly")
+        full = self.bytes
+        if self.kind == "reduce-scatter":
+            # HLO records the SCATTERED output shape (1/n of the full
+            # tensor); the wire formulas want the full tensor
+            full = self.bytes * n
+        return wire_bytes_per_device(self.kind, full, n)
+
+
+def wire_bytes_per_device(kind: str, tensor_bytes: float, n: int) -> float:
+    """Ring-algorithm bytes each device moves for ``tensor_bytes`` of
+    payload over an ``n``-member group (see module docstring)."""
+    if n <= 1:
+        return 0.0
+    frac = (n - 1) / n
+    if kind == "all-reduce":
+        return 2.0 * tensor_bytes * frac
+    if kind in ("all-gather", "reduce-scatter", "all-to-all"):
+        return tensor_bytes * frac
+    if kind == "collective-permute":
+        return float(tensor_bytes)
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
+def collective_stats(compiled) -> Dict[str, CollectiveStats]:
+    """Parse a ``jax.stages.Compiled``'s HLO for collectives.
+
+    Returns ``{kind: CollectiveStats}``.  Bytes are the OUTPUT tensor
+    sizes at each call site (for all-gather that is the gathered size,
+    matching the wire formulas' conventions); async ``-start``/``-done``
+    pairs are counted once.  A collective inside a ``while`` body (e.g.
+    a pipeline scan) appears once in HLO but runs per iteration — scale
+    by the trip count at the call site if that matters.
+    """
+    try:
+        texts = [m.to_string() for m in compiled.runtime_executable()
+                 .hlo_modules()]
+    except Exception:
+        texts = [compiled.as_text()]
+    out: Dict[str, CollectiveStats] = {}
+    for text in texts:
+        for line in text.splitlines():
+            m = _INSTR_RE.search(line)
+            if not m:
+                continue
+            shape_str, kind = m.group(1), m.group(2)
+            g = _group_size(line)
+            if g == 1:
+                # singleton replica groups come from size-1 mesh axes
+                # (the one-code-path-for-every-mesh-shape discipline);
+                # they move zero wire bytes — skip, don't pollute
+                continue
+            st = out.setdefault(kind, CollectiveStats(kind))
+            st.count += 1
+            st.bytes += _shape_bytes(shape_str, is_start=bool(m.group(3)))
+            if g is not None:
+                st.group_size = g if st.group_size in (None, g) else -1
+    return out
+
+
+_SHLO_KIND = {
+    "all_reduce": "all-reduce", "all_gather": "all-gather",
+    "reduce_scatter": "reduce-scatter", "all_to_all": "all-to-all",
+    "collective_permute": "collective-permute",
+}
+_SHLO_RE = re.compile(
+    r"stablehlo\.(" + "|".join(_SHLO_KIND) + r")\"?[(<]")
+_SHLO_TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z][a-z0-9]*)>")
+_SHLO_DTYPE_BYTES = {
+    "i1": 1, "i8": 1, "ui8": 1, "f8e4m3": 1, "f8e5m2": 1,
+    "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "i32": 4, "ui32": 4, "f32": 4,
+    "i64": 8, "ui64": 8, "f64": 8,
+}
+_SHLO_GROUPS_RE = re.compile(
+    r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<([0-9]+)x([0-9]+)x")
+
+
+def stablehlo_collective_stats(lowered_text: str) \
+        -> Dict[str, CollectiveStats]:
+    """Like :func:`collective_stats` but over ``fn.lower(...).as_text()``
+    (StableHLO) — the program JAX hands the compiler, BEFORE backend
+    legalisation.  This is the dtype-true view: XLA:CPU widens bf16
+    collectives to f32 (no bf16 kernels), so wire-compression modelling
+    must read StableHLO; the optimised-HLO parser remains the
+    backend-truth cross-check for counts.  Caveat: pre-optimisation,
+    so collectives that XLA would DCE still show up here.
+    """
+    out: Dict[str, CollectiveStats] = {}
+    lines = lowered_text.splitlines()
+    for i, line in enumerate(lines):
+        m = _SHLO_RE.search(line)
+        if not m:
+            continue
+        kind = _SHLO_KIND[m.group(1)]
+        gm = _SHLO_GROUPS_RE.search(line)
+        gsize = int(gm.group(2)) if gm else None
+        if gsize == 1:
+            continue        # size-1 mesh axis: zero-wire no-op
+        # Result type: region-carrying ops (all_reduce/reduce_scatter
+        # wrap their reduction computation in `({ ... })`) put the
+        # `(operand) -> result` signature on the line that CLOSES the
+        # region, not the op line — and the op line's last tensor<>
+        # would be the replica_groups attribute (i64!).  Scan forward
+        # to the signature line when `->` isn't present here.
+        sig = line
+        if "->" not in sig:
+            for j in range(i + 1, min(i + 50, len(lines))):
+                if "}) :" in lines[j] and "->" in lines[j]:
+                    sig = lines[j]
+                    break
+            else:
+                continue
+        tail = sig.split("->", 1)[1]
+        shapes = _SHLO_TENSOR_RE.findall(tail)
+        if not shapes:
+            continue
+        dims_s, dtype = shapes[0]
+        if dtype not in _SHLO_DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims_s.split("x"):
+            if d:
+                n *= int(d)
+        st = out.setdefault(kind, CollectiveStats(kind))
+        st.count += 1
+        st.bytes += n * _SHLO_DTYPE_BYTES[dtype]
+        if gsize is not None:
+            st.group_size = gsize if st.group_size in (None, gsize) \
+                else -1
+    return out
+
+
+def axis_collective_report(build_step, axes_sizes, n_devices=8):
+    """Per-mesh-axis collective volume for one training step.
+
+    Args:
+      build_step: ``build_step(mesh_axes: dict) -> (fn, args)`` — builds
+        the jitted step for a mesh with the given axis sizes (every
+        other axis 1) and returns it unlowered with example args.
+      axes_sizes: e.g. ``{"data": 8, "model": 8}`` — each axis is
+        activated ALONE at its size (the single-active-axis trick).
+      n_devices: virtual devices available.
+
+    Returns ``{axis: {"stats": {kind: CollectiveStats}, "axis_size": n,
+    "wire_bytes_per_device": float}}``.
+    """
+    report = {}
+    for axis, n in axes_sizes.items():
+        if n > n_devices:
+            raise ValueError(f"{axis}={n} exceeds {n_devices} devices")
+        fn, args = build_step({axis: n})
+        compiled = fn.lower(*args).compile()
+        stats = collective_stats(compiled)
+        report[axis] = {
+            "axis_size": n,
+            "stats": stats,
+            "wire_bytes_per_device": sum(
+                s.wire_bytes(n) for s in stats.values()),
+        }
+    return report
