@@ -155,6 +155,10 @@ class NativeBatchIterator:
     The returned arrays are **views into a recycled slot**: consume them
     (``jax.device_put`` / copy) before the next ``__next__`` call.  This
     is the single-consumer ring-buffer contract of the native loader.
+    In particular, a ``StandardUpdater`` converter that will HOLD more
+    than one batch (``steps_per_execution`` windows) must copy —
+    ``lambda b: tuple(np.array(a) for a in b)`` — or earlier views in
+    the window will be overwritten by the prefetch threads.
 
     Falls back to equivalent in-process numpy assembly when the native
     library is unavailable (``native_available()`` False).

@@ -57,6 +57,8 @@ _INSTR_RE = re.compile(
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
 _GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+# iota form: replica_groups=[num_groups,group_size]<=[...]
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
 
 
 def _shape_bytes(shape_str: str, is_start: bool = False) -> int:
@@ -80,11 +82,14 @@ def _shape_bytes(shape_str: str, is_start: bool = False) -> int:
 
 def _group_size(line: str) -> Optional[int]:
     m = _GROUPS_RE.search(line)
-    if not m:
-        return None
-    first = m.group(1).split("}")[0].lstrip("{")
-    ids = [t for t in first.split(",") if t.strip()]
-    return len(ids) or None
+    if m:
+        first = m.group(1).split("}")[0].lstrip("{")
+        ids = [t for t in first.split(",") if t.strip()]
+        return len(ids) or None
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        return int(m.group(2)) or None
+    return None
 
 
 @dataclass
@@ -97,11 +102,13 @@ class CollectiveStats:
     group_size: Optional[int] = None   # replica-group size (if uniform)
 
     def wire_bytes(self, axis_size: Optional[int] = None) -> float:
-        n = axis_size or self.group_size or 2
-        if n < 1:
+        n = axis_size or self.group_size
+        if n is None or n < 1:
+            # never guess: a silently-wrong group size corrupts the
+            # whole wire-volume evidence chain
             raise ValueError(
-                "non-uniform replica groups in this program "
-                "(group_size=-1); pass axis_size explicitly")
+                "replica group size unknown (unparsed or non-uniform "
+                "replica_groups); pass axis_size explicitly")
         full = self.bytes
         if self.kind == "reduce-scatter":
             # HLO records the SCATTERED output shape (1/n of the full

@@ -62,6 +62,14 @@ def main():
     p.add_argument("--grad-dtype", default=None,
                    help="allreduce_grad_dtype analogue, e.g. bfloat16")
     p.add_argument("--train-npz", default=None)
+    p.add_argument("--loader", default="serial",
+                   choices=["serial", "native"],
+                   help="'native': the C++ slot-ring prefetch loader "
+                        "(chainermn_tpu.native.NativeBatchIterator) "
+                        "assembles batches in worker threads ahead of "
+                        "the step — the reference's multithreaded "
+                        "chainer.iterators analogue; materialises this "
+                        "process's shard as field arrays")
     p.add_argument("--platform", default=None)
     p.add_argument("--tiny", action="store_true",
                    help="32px/width-8 model on 512 images (CPU smoke run)")
@@ -142,12 +150,42 @@ def main():
         optax.sgd(args.lr, momentum=0.9), comm,
         allreduce_grad_dtype=args.grad_dtype)
 
-    train_it = cmn.SerialIterator(
-        train, args.batchsize, shuffle=True, seed=1)
+    converter = None
+    if args.loader == "native":
+        from chainermn_tpu.native import NativeBatchIterator, \
+            native_available
+
+        if comm.rank == 0:
+            backend = ("ACTIVE" if native_available()
+                       else "unavailable (pure-python fallback)")
+            print(f"native loader: C++ backend {backend}")
+        # the native loader batches memory-resident field arrays:
+        # materialise this process's scattered shard once up front —
+        # bounded, because a full-size synthetic shard would be tens of
+        # GB (SyntheticImages is lazy for exactly that reason)
+        est = len(train) * image * image * 3 * 4
+        if est > 4 << 30:
+            raise SystemExit(
+                f"--loader native materialises the local shard "
+                f"(~{est / 2**30:.0f} GB here): use --tiny or point "
+                "--train-npz at a real on-disk dataset")
+        xs = np.stack([train[i][0] for i in range(len(train))])
+        ys = np.asarray([train[i][1] for i in range(len(train))],
+                        np.int32)
+        train_it = NativeBatchIterator(
+            [xs, ys], args.batchsize, shuffle=True, seed=1)
+        # COPY out of the loader's recycled slot: the updater may hold
+        # several batches at once (steps_per_execution windows) and the
+        # C++ prefetch threads reuse slots as soon as they're released
+        converter = lambda b: tuple(np.array(a) for a in b)
+    else:
+        train_it = cmn.SerialIterator(
+            train, args.batchsize, shuffle=True, seed=1)
     test_it = cmn.SerialIterator(test, args.batchsize, repeat=False)
 
+    updater_kw = {} if converter is None else {"converter": converter}
     updater = cmn.StandardUpdater(
-        train_it, opt, loss_fn, params, comm, state=state)
+        train_it, opt, loss_fn, params, comm, state=state, **updater_kw)
     trainer = cmn.Trainer(updater, (args.epoch, "epoch"), out=args.out)
 
     def metrics_fn(bundle, x, y):

@@ -143,3 +143,22 @@ def test_fallback_shuffle_matches_native():
         xb, yb = next(fb)
         np.testing.assert_array_equal(xa, xb)
         np.testing.assert_array_equal(ya, yb)
+
+
+def test_matches_serial_iterator_batch_for_batch():
+    """The trainer-facing contract: NativeBatchIterator + identity
+    converter must hand StandardUpdater the SAME batch arrays as
+    SerialIterator + default_converter (sequential order — the two
+    shuffles are different algorithms by design)."""
+    from chainermn_tpu import SerialIterator
+    from chainermn_tpu.training import default_converter
+
+    x, y = fields()
+    data = list(zip(x, y))
+    serial = SerialIterator(data, BS, shuffle=False)
+    nat = NativeBatchIterator([x, y], BS, shuffle=False)
+    for _ in range(2 * (N // BS) + 1):      # spans an epoch boundary
+        sx, sy = default_converter(next(serial))
+        nx, ny = next(nat)
+        np.testing.assert_array_equal(nx, sx)
+        np.testing.assert_array_equal(ny, sy)

@@ -112,6 +112,18 @@ def test_hlo_async_start_counts_result_only():
     assert st["collective-permute"].bytes == 2 * 4 * 4, st
 
 
+def test_iota_replica_groups_and_unknown_size():
+    from chainermn_tpu.utils.comm_model import CollectiveStats, _group_size
+
+    assert _group_size("replica_groups=[8,1]<=[8]") == 1
+    assert _group_size("replica_groups=[2,4]<=[8]") == 4
+    assert _group_size("no groups here") is None
+    st = CollectiveStats("all-reduce", count=1, bytes=100)
+    with pytest.raises(ValueError, match="group size unknown"):
+        st.wire_bytes()
+    assert st.wire_bytes(axis_size=4) == 150.0
+
+
 def test_wire_formulas():
     assert wire_bytes_per_device("all-reduce", 100, 1) == 0
     assert wire_bytes_per_device("all-reduce", 100, 4) == 150.0
