@@ -12,9 +12,12 @@ see SURVEY.md).  This is the transformer equivalent, TPU-first:
   ``n_kv_heads``, not ``n_heads``) — exactly the H/Hkv memory saving
   that motivates GQA at inference; the grouped-einsum attention cores
   (:func:`...ring_attention._qk_scores`) read it in place;
-- composes with DP (batch over ``data``) and TP (heads over ``model``)
-  meshes; the decode step is seq-length-1 so SP/PP are out of scope
-  (``seq``/``pipe`` axes must be 1 — raise early, not mid-trace).
+- composes with DP (batch over ``data``), TP (heads over ``model``),
+  and PP (layers + KV cache stage-sharded over ``pipe``; see
+  :func:`_decode_step` — a model too big for one chip's HBM decodes at
+  ~single-chip per-token HBM cost).  The decode step is seq-length-1,
+  so SP stays out of scope (``seq`` axis must be 1 — raise early, not
+  mid-trace).
 
 Greedy (``temperature=0``) or temperature sampling.
 """
@@ -76,10 +79,16 @@ def _dense_q(dense, x, blk, name, cd):
     return y
 
 
-def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
+def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
+                  write_mask=None):
     """One block for ONE new token.  ``h``: (B, 1, D); ``ck``/``cv``:
     (B, max_len, Hkv_local, Dh) this layer's cache; ``pos``: scalar
-    position of the new token.  Returns (h, ck, cv)."""
+    position of the new token.  ``write_mask`` (scalar bool) gates the
+    cache update — pipe-parallel phases where this device does NOT own
+    the running stage must leave their cache untouched, and masking the
+    one-token slice here is O(B·Hkv·Dh) instead of the O(cache) select
+    a whole-buffer ``where`` would cost per phase.  Returns
+    (h, ck, cv)."""
     cd = cfg.compute_dtype
     x = _rms_norm(h, blk["ln1"])
     B, _, D = x.shape
@@ -100,10 +109,14 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
         p1 = jnp.full((1,), pos)
         q = apply_rope(q, p1, cfg.rope_theta)
         k_new = apply_rope(k_new, p1, cfg.rope_theta)
-    ck = lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
-                                  (0, pos, 0, 0))
-    cv = lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
-                                  (0, pos, 0, 0))
+    k_new, v_new = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
+    if write_mask is not None:
+        cur_k = lax.dynamic_slice(ck, (0, pos, 0, 0), k_new.shape)
+        cur_v = lax.dynamic_slice(cv, (0, pos, 0, 0), v_new.shape)
+        k_new = jnp.where(write_mask, k_new, cur_k)
+        v_new = jnp.where(write_mask, v_new, cur_v)
+    ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0, 0))
     # grouped attention of the 1-token query against the whole cache,
     # masked to positions <= pos (static max_len shape)
     s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
@@ -155,8 +168,23 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos):
 
 def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     """Next-token logits for ``tok`` (B,) at position ``pos``; updates
-    the (L, B, max_len, Hkv_local, Dh) cache pair."""
+    the (L_local, B, max_len, Hkv_local, Dh) cache pair.
+
+    Pipe-parallel decode (``pipe`` axis size S > 1): device ``s`` holds
+    ONLY its stage's layers and KV cache — S× model capacity — and the
+    hidden state hands off stage→stage via ``ppermute`` inside a
+    ``S``-phase loop.  Every device runs its local layer scan in every
+    phase (SPMD lockstep; non-owning phases compute masked-out
+    garbage), so per token each device reads its 1/S weight shard S
+    times = ONE full model's bytes — the same HBM traffic that bounds
+    single-chip decode.  PP-decode therefore costs ≈(S−1) ppermute
+    latencies per token while scaling the model S×; the redundant FLOPs
+    are free under the bandwidth bound.  ``S = 1`` degenerates to a
+    single phase with no hand-off (one code path).
+    """
     cd = cfg.compute_dtype
+    S = lax.axis_size("pipe")
+    stage = lax.axis_index("pipe")
     h = params["embed"][tok].astype(cd)
     emb_scale = params.get("embed_scale")
     if emb_scale is not None:
@@ -171,16 +199,36 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     if cfg.virtual_pipe > 1:
         # merge (V, layers_per_chunk) into one L axis; at pipe=1 the
         # virtual-stage order IS the layer order, so this is exact
+        # (pipe>1 interleaves stages across devices — rejected in
+        # _decode_preamble)
         blocks = jax.tree.map(
             lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]),
             blocks)
 
-    def layer(h, xs):
-        blk, ck, cv = xs
-        h, ck, cv = _decode_block(cfg, h, blk, ck, cv, pos)
-        return h, (ck, cv)
+    h_in, out = h, h
+    for p in range(S):
+        mine = stage == p
 
-    h, (ck, cv) = lax.scan(layer, h, (blocks, *caches))
+        def layer(h, xs, mine=mine):
+            blk, ck, cv = xs
+            h, ck, cv = _decode_block(
+                cfg, h, blk, ck, cv, pos,
+                write_mask=None if S == 1 else mine)
+            return h, (ck, cv)
+
+        out, caches = lax.scan(layer, h_in, (blocks, *caches))
+        if p < S - 1:
+            # exactly ONE inter-stage message per phase: the owning
+            # stage's output hops to the next stage (non-receivers get
+            # ppermute's zero fill, masked out by the where)
+            sent = lax.ppermute(out, "pipe", [(p, p + 1)])
+            h_in = jnp.where(stage == p + 1, sent, h_in)
+    ck, cv = caches
+    # only the LAST stage's output is the model's hidden state; zeros
+    # elsewhere make the head a masked partial whose closing psum both
+    # broadcasts the logits and re-replicates the pipe axis (free at
+    # S = 1, where the mask is identity)
+    h = jnp.where(stage == S - 1, out, jnp.zeros_like(out))
     h = _rms_norm(h, params["ln_f"])
     logits = jnp.einsum(
         "btd,vd->btv", h.astype(jnp.float32),
@@ -188,14 +236,12 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     if emb_scale is not None:
         # per-vocab-row scale applies to the logits output channel
         logits = logits * emb_scale[None, :]
-    # close the pipe axis (size 1 in decode): free re-replication that
-    # lets the token buffer stay (data, expert)-varying only
     return lax.psum(logits, "pipe"), (ck, cv)
 
 
 def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
     """Shared validation for the decode factories; returns the resolved
-    ``(max_len, kv_heads_local)``."""
+    ``(max_len, kv_heads_local, layers_local)``."""
     _check_mesh(mesh_cfg, cfg)   # head/kv divisibility, clear errors
     if cfg.fsdp:
         raise ValueError(
@@ -203,25 +249,42 @@ def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
             "weight gathers would land a collective on every generated "
             "token); decode with dataclasses.replace(cfg, fsdp=False, "
             "fsdp_wire_dtype='') and re-place the params")
-    for ax in ("seq", "pipe"):
-        if mesh_cfg.mesh.shape.get(ax, 1) != 1:
-            raise ValueError(
-                f"decoding runs length-1 steps: the {ax!r} mesh axis "
-                f"({mesh_cfg.mesh.shape[ax]}) must be 1 (shard batch "
-                "over data and heads over model instead)")
+    if mesh_cfg.mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "decoding runs length-1 steps: the 'seq' mesh axis "
+            f"({mesh_cfg.mesh.shape['seq']}) must be 1 (shard batch "
+            "over data, heads over model, layers over pipe instead)")
+    pipe = mesh_cfg.mesh.shape.get("pipe", 1)
+    if pipe > 1 and cfg.virtual_pipe > 1:
+        raise ValueError(
+            "pipe-parallel decode with virtual_pipe > 1 is out of "
+            "scope: interleaved chunks put non-contiguous layers on "
+            "each device, so the S-phase hand-off loop would need "
+            "V*S phases for no capacity gain over repacking — decode "
+            "with the blocks repacked to virtual_pipe=1 "
+            "(V-chunk axes merge exactly; see init_transformer's "
+            "layout note)")
+    if cfg.n_layers % pipe:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by the pipe mesh "
+            f"axis ({pipe})")
     max_len = max_len or cfg.max_seq
     if max_len > cfg.max_seq:
         raise ValueError(
             f"max_len {max_len} exceeds cfg.max_seq {cfg.max_seq}")
-    return max_len, cfg.kv_heads // mesh_cfg.mesh.shape.get("model", 1)
+    return (max_len, cfg.kv_heads // mesh_cfg.mesh.shape.get("model", 1),
+            cfg.n_layers // pipe)
 
 
 def _make_cache(cfg: TransformerConfig, rows: int, max_len: int,
-                kv_heads_local: int):
-    """Zero KV cache pair ``(L, rows, max_len, Hkv_local, Dh)``, typed
-    varying over every mesh axis its contents will carry."""
+                kv_heads_local: int, layers_local: int):
+    """Zero KV cache pair ``(L_local, rows, max_len, Hkv_local, Dh)``,
+    typed varying over every mesh axis its contents will carry.
+    ``layers_local`` = this stage's layer count — with pipe-parallel
+    decode each device holds ONLY its stage's cache (the S× capacity
+    win)."""
     return tuple(
-        _vary(jnp.zeros((cfg.n_layers, rows, max_len, kv_heads_local,
+        _vary(jnp.zeros((layers_local, rows, max_len, kv_heads_local,
                          cfg.d_head), cfg.compute_dtype),
               "pipe", "data", "expert", "model")
         for _ in range(2))
@@ -240,7 +303,8 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     :func:`...quantization.quantize_params_int8` (≈half the HBM traffic
     per token).
     """
-    max_len, kv_heads_local = _decode_preamble(mesh_cfg, cfg, max_len)
+    max_len, kv_heads_local, layers_local = _decode_preamble(
+        mesh_cfg, cfg, max_len)
     specs = param_specs(cfg, quantized=quantized)
     batch_spec = P(("data", "expert"))
 
@@ -251,7 +315,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
             key, lax.axis_index("data") * lax.axis_size("expert")
             + lax.axis_index("expert"))
         B, Plen = prompt.shape
-        cache = _make_cache(cfg, B, max_len, kv_heads_local)
+        cache = _make_cache(cfg, B, max_len, kv_heads_local, layers_local)
         buf = jnp.zeros((B, max_len), jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
@@ -314,15 +378,10 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
     Returns ``tokens`` (B, K, max_len) sorted best-first and ``scores``
     (B, K) (length-normalised when α > 0).
     """
-    _check_mesh(mesh_cfg, cfg)
-    for ax in ("seq", "pipe"):
-        if mesh_cfg.mesh.shape.get(ax, 1) != 1:
-            raise ValueError(
-                f"beam search runs length-1 steps: the {ax!r} mesh axis "
-                f"({mesh_cfg.mesh.shape[ax]}) must be 1")
     if beam_size < 1:
         raise ValueError(f"beam_size {beam_size} must be >= 1")
-    max_len, kv_heads_local = _decode_preamble(mesh_cfg, cfg, max_len)
+    max_len, kv_heads_local, layers_local = _decode_preamble(
+        mesh_cfg, cfg, max_len)   # includes _check_mesh
     K = beam_size
 
     specs = param_specs(cfg, quantized=quantized)
@@ -332,7 +391,7 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
         B, Plen = prompt.shape
         # -- prefill at width B (the K beams are identical inside the
         # prompt — no reason to pay K× its FLOPs or reorder gathers) --
-        cache_b = _make_cache(cfg, B, max_len, kv_heads_local)
+        cache_b = _make_cache(cfg, B, max_len, kv_heads_local, layers_local)
 
         def prefill(caches, t):
             _, caches = _decode_step(cfg, params, caches, prompt[:, t], t)

@@ -30,8 +30,10 @@ from train_lm import parse_mesh  # noqa: E402  (sibling example)
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mesh", default="data=-1",
-                   help="decode meshes shard batch (data/expert) and "
-                        "heads (model); seq/pipe must be 1")
+                   help="decode meshes shard batch (data/expert), "
+                        "heads (model), and layers + KV cache (pipe — "
+                        "S-phase hand-off, S-fold model capacity); "
+                        "seq must be 1")
     p.add_argument("--vocab", type=int, default=128)
     p.add_argument("--d-model", type=int, default=64)
     p.add_argument("--n-heads", type=int, default=4)
@@ -81,12 +83,21 @@ def main():
 
     ckpt_file = (os.path.join(args.checkpoint, "lm_state.npz")
                  if args.checkpoint else None)
+    pipe = mc.mesh.shape.get("pipe", 1)
     if ckpt_file and os.path.exists(ckpt_file):
         params = jax.tree.map(
             jnp.asarray, load_state(ckpt_file)["params"])
+        # checkpoints store blocks (P0, L/P0, ...) for whatever pipe
+        # size TRAINED them: regroup to this mesh's pipe size
+        # unconditionally (same layer order, different grouping — a
+        # pipe-trained checkpoint must decode on a pipe=1 mesh too)
+        params = dict(params, blocks=jax.tree.map(
+            lambda a: a.reshape(pipe, -1, *a.shape[2:]),
+            params["blocks"]))
         print(f"loaded {ckpt_file}")
     else:
-        params = init_transformer(jax.random.PRNGKey(args.seed), cfg)
+        params = init_transformer(
+            jax.random.PRNGKey(args.seed), cfg, pipe)
     if args.int8:
         params = quantize_params_int8(cfg, params)
     params = shard_params(mc, cfg, params)

@@ -93,6 +93,24 @@ def test_train_then_generate_roundtrip(tmp_path):
     assert "loaded" in out and "generated:" in out
 
 
+def test_pipe_trained_checkpoint_decodes_anywhere(tmp_path):
+    """A pipe=2-trained checkpoint must decode on the default pipe=1
+    mesh AND on a pipe=2 decode mesh (block regrouping is mesh-to-mesh,
+    and PP-decode's stage-sharded step produces identical tokens)."""
+    ck = str(tmp_path / "ck")
+    _run_example("examples/transformer/train_lm.py",
+                 ["--mesh", "pipe=2,data=4", "--steps", "8",
+                  "--checkpoint", ck])
+    outs = []
+    for mesh in ("data=-1", "pipe=2,data=4"):
+        out = _run_example("examples/transformer/generate.py",
+                           ["--checkpoint", ck, "--vocab", "128",
+                            "--max-len", "16", "--mesh", mesh])
+        assert "loaded" in out and "generated:" in out
+        outs.append(out[out.index("generated:"):])
+    assert outs[0] == outs[1], "pipe decode diverges from pipe=1 decode"
+
+
 def test_mnist_real_npz_path(tmp_path):
     """The --mnist-npz file path must actually be exercised: a generated
     mnist.npz-shaped fixture trains end-to-end and beats chance."""

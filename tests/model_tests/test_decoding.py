@@ -251,3 +251,50 @@ class TestBeamSearch:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_pp_decode_matches_single_device():
+    """Pipe-parallel decode: layers + KV cache stage-sharded over
+    pipe=2, S-phase ppermute hand-off — generated tokens must equal the
+    pipe=1 oracle exactly (greedy argmax)."""
+    cfg = tiny_cfg(n_layers=4)
+    toks = prompt(length=6)
+
+    one = MeshConfig(data=1, devices=jax.devices()[:1])
+    p_flat = init_transformer(jax.random.PRNGKey(0), cfg)
+    oracle = make_generate_fn(one, cfg, max_len=T)(
+        shard_params(one, cfg, p_flat), toks)
+
+    mc = MeshConfig(pipe=2, data=2, model=2)
+    p_pipe = init_transformer(jax.random.PRNGKey(0), cfg, 2)
+    got = make_generate_fn(mc, cfg, max_len=T)(
+        shard_params(mc, cfg, p_pipe), toks)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(oracle))
+
+
+def test_pp_decode_beam_and_guards():
+    """Beam search rides the same pipe-parallel step; virtual-pipe and
+    seq meshes stay clearly rejected."""
+    from chainermn_tpu.models import make_beam_search_fn
+
+    cfg = tiny_cfg(n_layers=4)
+    toks = prompt(length=6)
+    one = MeshConfig(data=1, devices=jax.devices()[:1])
+    p_flat = init_transformer(jax.random.PRNGKey(0), cfg)
+    ot, os_ = make_beam_search_fn(one, cfg, beam_size=2, max_len=T)(
+        shard_params(one, cfg, p_flat), toks)
+
+    mc = MeshConfig(pipe=2, data=4)
+    p_pipe = init_transformer(jax.random.PRNGKey(0), cfg, 2)
+    gt, gs = make_beam_search_fn(mc, cfg, beam_size=2, max_len=T)(
+        shard_params(mc, cfg, p_pipe), toks)
+    np.testing.assert_array_equal(np.asarray(gt), np.asarray(ot))
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(os_),
+                               rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="virtual_pipe"):
+        make_generate_fn(
+            mc, tiny_cfg(n_layers=4, virtual_pipe=2,
+                         pipeline_schedule="interleaved"), max_len=T)
+    with pytest.raises(ValueError, match="seq"):
+        make_generate_fn(MeshConfig(seq=2, data=4), cfg, max_len=T)

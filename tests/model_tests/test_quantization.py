@@ -63,7 +63,7 @@ def _decode_logits(cfg, params, toks, steps, quantized):
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
 
     def body(params, toks):
-        caches = _make_cache(cfg, B, T, cfg.kv_heads)
+        caches = _make_cache(cfg, B, T, cfg.kv_heads, cfg.n_layers)
         outs = []
         for t in range(steps):
             logits, caches = _decode_step(
