@@ -400,7 +400,14 @@ class TpuXlaCommunicator(CommunicatorBase):
         payload in flight per process per round — each process's wire
         traffic and memory stay O(its own send+recv volume), never the
         whole exchange (the property ``shuffle_data_blocks`` relies on
-        for datasets too large to gather anywhere)."""
+        for datasets too large to gather anywhere).
+
+        Latency is O(n) sequential rounds — the bounded-memory trade.
+        Fine at pod process counts (n ≲ 64: the payloads dominate);
+        TODO past ~hundreds of hosts, overlap k rounds in flight
+        (send_obj/recv_obj on k lanes) to cut latency to O(n/k) at
+        O(k·payload) memory — the KV channel's per-pair lanes already
+        permit it."""
         n = 1 if self._obj_local else len(self._member_procs)
         if len(objs) != n:
             raise ValueError(

@@ -274,7 +274,13 @@ class StandardUpdater:
         self.params, self.state, self.opt_state = carry
         step_time = time.perf_counter() - t0
         if pending is not None:
-            # ragged tail batch runs as a plain single step
+            # Ragged tail batch runs as a plain single step.  Its batch
+            # shape differs from the steady-state one, so jit compiles
+            # ONE extra executable the first time each distinct tail
+            # shape appears (then cached) — a deliberate trade: padding
+            # the tail instead would need a mask threaded through every
+            # user loss_fn.  Only non-repeating epoch ends produce
+            # ragged tails; steady training never pays this.
             arrays = tuple(
                 jax.device_put(a, self._batch_sharding) for a in pending)
             t0 = time.perf_counter()

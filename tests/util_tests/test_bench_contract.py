@@ -151,7 +151,10 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
      ["--batch", "8", "--vocab", "64", "--units", "16", "--max-src", "8",
       "--max-tgt", "8", "--warmup", "0", "--iters", "1",
       "--steps-per-call", "2"], "tokens/sec"),
-], ids=["transformer", "decode", "attention", "seq2seq"])
+    ("bench_levers.py",
+     ["--batch", "4", "--image", "32", "--warmup", "0",
+      "--iters", "1"], "x"),
+], ids=["transformer", "decode", "attention", "seq2seq", "levers"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
