@@ -70,6 +70,11 @@ class TestTwoProcess:
         # process boundary; model stays local; + the model=2,data=2 shape
         mp_run("pp_train", devices_per_proc=2, timeout=300)
 
+    def test_sp_ep_train(self, mp_run):
+        # ring-attention ppermute chain and MoE all-to-alls cross the
+        # process boundary (seq=2 / expert=2 over 2 processes)
+        mp_run("sp_ep_train", timeout=300)
+
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
 
