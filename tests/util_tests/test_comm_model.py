@@ -133,6 +133,45 @@ def test_wire_formulas():
         wire_bytes_per_device("broadcast", 1, 2)
 
 
+def test_bench_resnet_dp_step_single_reduce():
+    """Regression pin for the SCALING.md finding: bench.py's DP step
+    must all-reduce each gradient ONCE.  The pre-fix step pmean'd grads
+    that shard_map AD had already psummed — parsed exactly 2.000x the
+    parameter bytes; re-introducing any double reduce trips this."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    try:
+        import bench as rbench
+    finally:
+        sys.path.pop(0)
+    from chainermn_tpu.models import ResNetConfig, init_resnet
+
+    # width=16 keeps the invariant (volumes are width-proportional)
+    # while cutting the dominant XLA compile cost on this 1-core host
+    cfg = ResNetConfig(depth=50, num_classes=100, width=16,
+                       dtype="bfloat16")
+    mc = MeshConfig(data=8, devices=jax.devices()[:8])
+    params, state = init_resnet(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(opt.init)(params)
+    step = rbench.make_step(mc, cfg, opt, steps_per_call=1)
+    x = jax.device_put(jnp.zeros((16, 32, 32, 3), jnp.bfloat16),
+                       mc.sharding("data"))
+    y = jax.device_put(jnp.zeros((16,), jnp.int32), mc.sharding("data"))
+    compiled = step.lower((params, state, opt_state), x, y).compile()
+    st = collective_stats(compiled)["all-reduce"]
+    pb = sum(p.size * p.dtype.itemsize for p in jax.tree.leaves(params))
+    sb = sum(p.size * p.dtype.itemsize for p in jax.tree.leaves(state))
+    # fp32 grads + BN-stat pmeans, with a few % slack for loss scalars;
+    # a double reduce would land at ~2x
+    assert st.bytes >= pb, (st.bytes, pb)
+    assert st.bytes <= (pb + sb) * 1.05, \
+        f"DP step moves {st.bytes} all-reduce bytes for {pb} param " \
+        f"bytes (+{sb} state) — double gradient reduce reintroduced?"
+
+
 def test_axis_report_attributes_dp_gradient_allreduce():
     """A pmean-grads DP step's dominant collective must be an
     all-reduce of ~n_params floats on the data axis."""
