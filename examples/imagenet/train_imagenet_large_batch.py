@@ -164,7 +164,12 @@ def main():
     if args.resumable:
         cp = cmn.extensions.create_multi_node_checkpointer(
             comm, args.out)
-        cp.maybe_load(updater, trainer)
+        resumed_at = cp.maybe_load(updater, trainer)
+        if resumed_at is not None and comm.rank == 0:
+            # explicit marker so resume tests can't pass vacuously
+            # (a silently-inert checkpoint path would retrain from
+            # scratch bit-identically on deterministic configs)
+            print(f"resumed at iteration {resumed_at}")
         trainer.extend(cp, trigger=(max(steps_per_epoch, 1), "iteration"))
         trainer.extend(cmn.extensions.PreemptionCheckpointer(cp, comm))
 

@@ -93,6 +93,53 @@ def test_train_then_generate_roundtrip(tmp_path):
     assert "loaded" in out and "generated:" in out
 
 
+def _epoch_rows(out):
+    """Parse PrintReport lines 'epoch=N  main/loss=X ...' into
+    {epoch: {field: float}}."""
+    rows = {}
+    for line in out.splitlines():
+        if not line.startswith("epoch="):
+            continue
+        kv = dict(part.split("=", 1) for part in line.split())
+        rows[int(kv.pop("epoch"))] = {
+            k: float(v) for k, v in kv.items()}
+    return rows
+
+
+def test_large_batch_interrupted_resume_matches_straight_run(tmp_path):
+    """Example-scale resume equivalence (not just unit scale): stopping
+    the large-batch recipe after epoch 1 and re-launching to epoch 2
+    must reproduce the uninterrupted run's epoch-2 training loss —
+    iterator position/RNG, LR-schedule step, and LogReport history all
+    restored through the example's own --resumable path."""
+    base = ["--tiny", "--batchsize", "64", "--resumable"]
+    straight = _run_example(
+        "examples/imagenet/train_imagenet_large_batch.py",
+        base + ["--epoch", "2", "--out", str(tmp_path / "straight")])
+
+    _run_example(
+        "examples/imagenet/train_imagenet_large_batch.py",
+        base + ["--epoch", "1", "--out", str(tmp_path / "resumed")])
+    snaps = [f for f in os.listdir(tmp_path / "resumed")
+             if f.startswith("snapshot_iter_")]
+    assert snaps, "epoch-1 run wrote no snapshots — resume untestable"
+    resumed = _run_example(
+        "examples/imagenet/train_imagenet_large_batch.py",
+        base + ["--epoch", "2", "--out", str(tmp_path / "resumed")])
+    # guard against a vacuous pass: the relaunch is CLI-identical to
+    # the straight run, so without this marker a silently-inert resume
+    # path would retrain from scratch bit-identically and still match
+    assert "resumed at iteration" in resumed, resumed[-1500:]
+
+    a, b = _epoch_rows(straight), _epoch_rows(resumed)
+    assert 2 in a and 2 in b, (a, b)
+    for field in ("main/loss", "validation/loss", "validation/accuracy"):
+        assert abs(a[2][field] - b[2][field]) <= 1e-5 * max(
+            1.0, abs(a[2][field])), \
+            f"epoch-2 {field}: straight {a[2][field]} vs resumed " \
+            f"{b[2][field]} — resume diverged at example scale"
+
+
 def test_pipe_trained_checkpoint_decodes_anywhere(tmp_path):
     """A pipe=2-trained checkpoint must decode on the default pipe=1
     mesh AND on a pipe=2 decode mesh (block regrouping is mesh-to-mesh,
