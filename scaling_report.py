@@ -151,17 +151,20 @@ def _tfm_case(name, axes, cfg_kw, formula_fn, data_fallback=1):
 
 
 def tfm_dp_formula(cfg, B, T, axes, params):
-    import jax
-
     pb = _param_bytes(params)
-    # per-step volume is the full parameter bytes; the layer-scan's
-    # grad psums sit inside the while body, so the PARSED slice is
-    # embed/norm leaves at full size + block leaves at 1/L
+    embed = _param_bytes(params["embed"])
+    # per-step volume: every parameter's grad psum PLUS one extra
+    # embed-sized psum — the weight-tied embedding's cotangent crosses
+    # the wire twice (lookup-side auto-psum + _lm_head's custom-VJP
+    # psum; SCALING.md section 4).  The layer-scan's block psums sit
+    # inside the while body, so the PARSED slice is embed/norm leaves
+    # (embed twice) + block leaves at 1/L.
     blk = _param_bytes(params["blocks"])
-    slice_bytes = (pb - blk) + blk // cfg.n_layers
+    slice_bytes = (pb - blk) + embed + blk // cfg.n_layers
     return {"all-reduce": {
-        "bytes": pb,
-        "desc": "fp32 grad pmean of every (replicated) parameter",
+        "bytes": pb + embed,
+        "desc": "fp32 grad pmean of every (replicated) parameter + "
+                "the embed-grad double psum (weight tying)",
         "per_tick_bytes": slice_bytes,
         "while_body": True}}
 
@@ -192,6 +195,7 @@ def tfm_fsdp_formula(cfg, B, T, axes, params):
     blk_bytes_bf16 = sum(
         p.size * 2 for p in jax.tree.leaves(blk))
     other = _param_bytes(params) - _param_bytes(blk)
+    embed = _param_bytes(params["embed"])
     # the TPU wire runs at bf16 (StableHLO shows bf16 gathers between
     # optimization_barriers); XLA:CPU has no bf16 collectives and
     # legalises to f32, so the parsed-HLO bytes are EXACTLY 2x these
@@ -210,9 +214,10 @@ def tfm_fsdp_formula(cfg, B, T, axes, params):
             "per_tick_bytes": blk_bytes_bf16 // cfg.n_layers,
             "while_body": True},
         "all-reduce": {
-            "bytes": other,
-            "desc": "non-FSDP leaves (embed/norms) fp32 grad pmean",
-            "per_tick_bytes": other,
+            "bytes": other + embed,
+            "desc": "non-FSDP leaves (embed/norms) fp32 grad pmean + "
+                    "the embed-grad double psum (weight tying)",
+            "per_tick_bytes": other + embed,
             "while_body": True},
     }
 
