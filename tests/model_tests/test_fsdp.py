@@ -147,3 +147,15 @@ def test_fsdp_dmodel_divisibility():
     cfg = tiny_cfg(fsdp=True, d_model=36)
     with pytest.raises(ValueError, match="divisible by the data"):
         make_train_step(mc, cfg, optax.adam(1e-2))
+
+
+def test_moe_fsdp_at_rest_sharding():
+    """MoE expert stacks also rest at 1/N d_model width (loss parity
+    with dense is CASES[2] in test_fsdp_matches_dense)."""
+    mc = MeshConfig(data=2, expert=2, devices=jax.devices()[:4])
+    cfg = tiny_cfg(moe=True, n_experts=4, fsdp=True)
+    params = shard_params(
+        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+    w1 = params["blocks"]["w1"]           # (pipe, L, E, D, F)
+    assert w1.addressable_shards[0].data.shape[3] == cfg.d_model // 2, \
+        w1.addressable_shards[0].data.shape
