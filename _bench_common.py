@@ -131,7 +131,8 @@ def freshest_cached(metric: str, match: dict | None = None,
 
 
 def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
-                           use_cache=True, cache_match=None) -> int:
+                           use_cache=True, cache_match=None,
+                           fallback=True) -> int:
     """Run ``cmd`` under per-attempt timeouts until one prints a
     ``BENCH_RESULT`` line; always print exactly one JSON line.
 
@@ -147,7 +148,10 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
     (workload-defining fields, e.g. ``{"batch": 256}``) further pins
     the fallback to runs of the SAME workload — a small-config
     hardware debug run is recorded but never served for the full-size
-    gate.
+    gate.  ``fallback=False`` keeps recording successes but reports
+    failure as null instead of serving the cache — for live-ness
+    probes (bench_session.py) where a cached value must not read as
+    "the chip is awake".
     """
     errors = []
     for attempt, budget in enumerate(timeouts):
@@ -177,7 +181,8 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
             f"attempt {attempt + 1}: rc={proc.returncode}, "
             f"last output: {' | '.join(tail[-3:]) if tail else '<none>'}")
     error = "; ".join(errors)[-1800:]
-    cached = freshest_cached(metric, cache_match) if use_cache else None
+    cached = freshest_cached(metric, cache_match) \
+        if (use_cache and fallback) else None
     if cached is not None:
         out = dict(cached)
         out["cached"] = True

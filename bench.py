@@ -182,7 +182,8 @@ def _parent_main(args):
     return run_child_with_retries(
         cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
         use_cache=args.platform is None,
-        cache_match={"batch": args.batch, "image": args.image})
+        cache_match={"batch": args.batch, "image": args.image},
+        fallback=not args.no_cache)
 
 
 def _parse_args(argv):
@@ -199,6 +200,11 @@ def _parse_args(argv):
     p.add_argument("--platform", default=None,
                    help="pin JAX platform in the child (e.g. cpu for a "
                         "smoke test)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="liveness-probe mode: record a success to the "
+                        "cache but never SERVE the cache on failure "
+                        "(bench_session.py uses this to tell a live "
+                        "chip from a warm cache)")
     p.add_argument("--timeouts", type=int, nargs="+", default=[420],
                    help="per-attempt child timeouts in seconds; default "
                         "is ONE live attempt — when the axon backend "

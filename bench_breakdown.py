@@ -253,6 +253,35 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
     add("lm_head_loss", ms, fl, bt,
         "final norm + weight-tied head + softmax xent, fwd+bwd")
 
+    # ---- chunked-vocab variant (SPEED.md candidate #1): same math
+    # through _head_nll's custom VJP — never materialises the full
+    # (B, T, 32k) fp32 logits, recomputes per chunk in backward.  The
+    # lm_head_loss row above is its control; the live delta decides
+    # whether loss_chunk becomes the large-vocab default. ------------- #
+    from chainermn_tpu.models.transformer import _head_nll
+
+    for chunk in (256, 512):
+        if seq % chunk:   # CPU smoke configs run tiny seqs
+            continue
+
+        def head_loss_chunked(p, h, yy, _c=chunk):
+            hN = _rms_norm(h, p["ln_f"])
+            nll = _head_nll(cd, _c, hN, p["embed"], yy) / yy.size
+            return lax.pmean(nll, ("data", "expert", "seq"))
+
+        ms, fl, bt = _time(
+            jax.jit(jax.shard_map(
+                lambda p, h, yy: jax.value_and_grad(
+                    head_loss_chunked)(p, h, yy),
+                mesh=mesh,
+                in_specs=(hspecs, P(("data", "expert"), "seq"),
+                          tok_spec),
+                out_specs=(P(), hspecs))),
+            (hp, h0, y), warmup, iters)
+        add(f"lm_head_loss_chunked_{chunk}", ms, fl, bt,
+            f"loss_chunk={chunk}: chunked custom-VJP head, no full "
+            "logits tensor; compare against lm_head_loss")
+
     # ---- embedding lookup -------------------------------------------- #
     def embed_fn(p, xx):
         return lax.pmean(jnp.mean(p["embed"][xx].astype(jnp.float32)),

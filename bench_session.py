@@ -1,0 +1,122 @@
+"""Patient TPU bench session: wait out the axon init-hang, then refresh
+every cached measurement.
+
+The axon TPU backend on this host has a failure mode where backend init
+hangs for 10+ minutes at a time ("the hang mood"), and rapid retries
+prolong it.  Gate-time retries are therefore useless; the winning move
+(VERDICT r2 #1) is to run the live benches *early and repeatedly during
+the round* with long spacing so `BENCH_MEASURED.json` is hot by the
+time the driver's end-of-round gate fires.
+
+This script probes with the cheap headline bench (cache disabled so a
+cached fallback can't masquerade as a live success); on a live number
+it runs the full battery once — each script records its own
+measurements to the cache — then keeps re-probing on a slow heartbeat
+for the rest of the session.  Run detached, e.g. in tmux:
+
+    python bench_session.py --max-hours 10 >> bench_session.log 2>&1
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+PROBE_SPACING_S = 35 * 60     # between failed live probes
+HEARTBEAT_S = 90 * 60         # between battery refreshes once live
+
+# (cmd, per-run timeout seconds).  Each records to BENCH_MEASURED.json
+# on success; order puts the gate metrics first so a short live window
+# still refreshes what the driver reads.
+BATTERY = [
+    (["python", "bench.py"], 900),
+    (["python", "bench_transformer.py"], 1500),
+    (["python", "bench_breakdown.py"], 2400),
+    (["python", "bench_levers.py"], 1800),
+    (["python", "bench_decode.py"], 1500),
+    (["python", "bench_attention.py"], 1200),
+    (["python", "bench_seq2seq.py"], 1200),
+    (["python", "bench_loader.py"], 600),
+]
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe_live() -> bool:
+    """One live headline attempt; True iff a non-cached number landed."""
+    try:
+        proc = subprocess.run(
+            ["python", "bench.py", "--no-cache"], capture_output=True,
+            text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        log("probe: outer timeout (hang mood persists)")
+        return False
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            live = rec.get("value") is not None \
+                and not rec.get("cached")
+            log(f"probe: value={rec.get('value')} "
+                f"cached={rec.get('cached', False)} live={live}")
+            return live
+    log(f"probe: no JSON line (rc={proc.returncode})")
+    return False
+
+
+def run_battery():
+    """True only if every script finished and at least one succeeded —
+    a battery of fast rc!=0 failures must NOT put the session on the
+    slow heartbeat (the chip can wedge in a fail-fast mode too)."""
+    ok = 0
+    for cmd, budget in BATTERY:
+        log(f"battery: {' '.join(cmd)} (timeout {budget}s)")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=budget)
+            tail = proc.stdout.strip().splitlines()
+            log(f"  rc={proc.returncode} "
+                f"{tail[-1][:200] if tail else '<no output>'}")
+            ok += proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            log("  outer timeout — chip went back to sleep; "
+                "stopping battery early")
+            return False
+    if not ok:
+        log("  every battery script failed — staying on probe cadence")
+    return ok > 0
+
+
+def main(argv):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--max-hours", type=float, default=10.0)
+    p.add_argument("--probe-spacing-s", type=int, default=PROBE_SPACING_S)
+    p.add_argument("--heartbeat-s", type=int, default=HEARTBEAT_S)
+    args = p.parse_args(argv)
+    deadline = time.time() + args.max_hours * 3600
+    completed_batteries = 0
+
+    while time.time() < deadline:
+        if probe_live():
+            if run_battery():
+                completed_batteries += 1
+                log(f"battery #{completed_batteries} complete; "
+                    f"heartbeat sleep {args.heartbeat_s}s")
+                time.sleep(args.heartbeat_s)
+            else:
+                time.sleep(args.probe_spacing_s)
+        else:
+            log(f"sleeping {args.probe_spacing_s}s before next probe")
+            time.sleep(args.probe_spacing_s)
+    log(f"done: {completed_batteries} full batteries this session")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

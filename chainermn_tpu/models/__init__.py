@@ -20,7 +20,10 @@ from .transformer import (
     make_forward_fn,
     make_train_step,
     param_specs,
+    regroup_blocks,
+    reshard_train_state,
     shard_params,
+    transformer_backbone,
     transformer_forward,
 )
 
@@ -47,7 +50,10 @@ __all__ = [
     "mlp_apply",
     "param_specs",
     "quantize_params_int8",
+    "regroup_blocks",
+    "reshard_train_state",
     "shard_params",
     "softmax_cross_entropy",
+    "transformer_backbone",
     "transformer_forward",
 ]

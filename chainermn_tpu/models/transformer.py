@@ -133,6 +133,13 @@ class TransformerConfig:
     # param dtype (fp32 — bit-comparable with fsdp=False); "bfloat16"
     # halves the per-layer gather + grad reduce-scatter wire bytes (the
     # allreduce_grad_dtype analogue for the FSDP path)
+    loss_chunk: int = 0  # 0 => one whole-shard (B, T, V) logits tensor
+    # (fp32, XLA fuses log-softmax into its consumers); N>0 => the LM
+    # head + cross-entropy run in token chunks of N via a custom VJP
+    # that never materialises full logits and recomputes them per chunk
+    # in backward (one psum for the accumulated embed grad).  Must
+    # divide the per-shard sequence length.  Trade measured by
+    # bench_breakdown.py's lm_head_loss vs lm_head_loss_chunked rows.
     remat: bool = True
     remat_policy: str = "full"  # "full" | "dots": with "dots" the block
     # checkpoint saves matmul outputs (jax dots_with_no_batch_dims_saveable)
@@ -187,6 +194,9 @@ class TransformerConfig:
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy {self.remat_policy!r} not in (full, dots)")
+        if self.loss_chunk < 0:
+            raise ValueError(
+                f"loss_chunk={self.loss_chunk} must be >= 0")
         if self.virtual_pipe < 1:
             raise ValueError(
                 f"virtual_pipe={self.virtual_pipe} must be >= 1")
@@ -291,6 +301,114 @@ def init_transformer(key, cfg: TransformerConfig, pipe_size: int = 1):
         params["pos"] = jax.random.normal(
             k_pos, (cfg.max_seq, D), jnp.float32) * 0.02
     return params
+
+
+def regroup_blocks(blocks, from_pipe: int, to_pipe: int,
+                   from_virtual: int = 1, to_virtual: int = 1):
+    """Regroup a block stack between pipeline layouts.
+
+    Checkpoints store blocks grouped for whatever pipe mesh TRAINED
+    them — ``(P, L/P, *base)``, or ``(P, V, L/(P·V), *base)`` when the
+    interleaved schedule's ``virtual_pipe = V > 1`` (chunk ``c`` of
+    device ``s`` is virtual stage ``g = c·P + s`` holding the ``g``-th
+    contiguous layer slice, see :func:`init_transformer`).  This
+    flattens to global layer order and regroups for the target layout,
+    so a checkpoint trained on any (pipe, virtual) grouping resumes or
+    decodes on any other — the training-side analogue of
+    ``generate.py``'s decode-mesh regrouping.
+    """
+
+    def leaf(a):
+        if from_virtual > 1:
+            if a.shape[0] != from_pipe or a.shape[1] != from_virtual:
+                raise ValueError(
+                    f"block leaf {a.shape} does not match from_pipe="
+                    f"{from_pipe}, from_virtual={from_virtual}")
+            base = a.shape[3:]
+            # (P, V, lpc) -> (V, P, lpc) -> layer order g·lpc + i
+            layers = a.swapaxes(0, 1).reshape(-1, *base)
+        else:
+            if a.shape[0] != from_pipe:
+                raise ValueError(
+                    f"block leaf {a.shape} does not match "
+                    f"from_pipe={from_pipe}")
+            base = a.shape[2:]
+            layers = a.reshape(-1, *base)
+        L = layers.shape[0]
+        if L % (to_pipe * to_virtual):
+            raise ValueError(
+                f"{L} layers not divisible by to_pipe·to_virtual = "
+                f"{to_pipe}·{to_virtual}")
+        if to_virtual > 1:
+            lpc = L // (to_pipe * to_virtual)
+            return layers.reshape(
+                to_virtual, to_pipe, lpc, *base).swapaxes(0, 1)
+        return layers.reshape(to_pipe, L // to_pipe, *base)
+
+    return jax.tree.map(leaf, blocks)
+
+
+def reshard_train_state(mc, cfg: TransformerConfig, optimizer, params,
+                        opt_state, from_pipe: int = 1,
+                        from_virtual: int = 1):
+    """Re-lay a full training state (params + optax state) onto a
+    different mesh: **elastic resume**.
+
+    The reference could only restart a checkpoint at the identical
+    world size (`chainermn/extensions/checkpoint.py` — same-world-size
+    agreement); here the logical state is mesh-independent, so a run
+    snapshotted on one topology continues on another — different data/
+    model/seq axis sizes, a different pipe grouping (blocks regrouped
+    via :func:`regroup_blocks`), or a different at-rest layout
+    (``fsdp`` on/off) — with the same loss trajectory.
+
+    ``params``/``opt_state`` may be device arrays from a live run on
+    any previous mesh or host arrays from ``utils.serialization.
+    load_state``.  Optimiser moments are param-shaped: every
+    param-structured subtree inside the optax state is regrouped the
+    same way (``optax.tree_map_params``), then each leaf is placed with
+    the sharding ``optimizer.init``'s propagation assigns on the new
+    mesh.  Returns ``(params, opt_state)`` living on ``mc``.
+    """
+    import numpy as _np
+
+    to_pipe = mc.mesh.shape.get("pipe", 1)
+    host_params = jax.tree.map(_np.asarray, params)
+    host_opt = jax.tree.map(_np.asarray, opt_state)
+
+    def regroup(leaf_or_tree):
+        return regroup_blocks(leaf_or_tree, from_pipe, to_pipe,
+                              from_virtual, cfg.virtual_pipe)
+
+    new_params = shard_params(
+        mc, cfg, dict(host_params, blocks=regroup(host_params["blocks"])))
+
+    # params-structured flag tree: True on blocks leaves (the only
+    # leaves whose grouping is mesh-dependent)
+    flags = {k: jax.tree.map(lambda _: k == "blocks", v)
+             for k, v in host_params.items()}
+    host_opt = optax.tree_map_params(
+        optimizer,
+        lambda leaf, is_block: regroup(leaf) if is_block else leaf,
+        host_opt, flags)
+    # template via shard_opt_state, not plain jit(init): zeros_like has
+    # no data dependence on params, so propagation would replicate the
+    # moments — under fsdp that forfeits the shard-width residency
+    from chainermn_tpu.training.optimizers import shard_opt_state
+
+    template = shard_opt_state(optimizer, new_params)
+    mesh_devs = set(mc.mesh.devices.flat)
+
+    def place(h, t):
+        sh = t.sharding
+        if set(sh.device_set) != mesh_devs:
+            # input-independent leaves (e.g. adam's count scalar) come
+            # out of jit on the default device, not the mesh: replicate
+            sh = jax.sharding.NamedSharding(mc.mesh, P())
+        return jax.device_put(h, sh)
+
+    new_opt = jax.tree.map(place, host_opt, template)
+    return new_params, new_opt
 
 
 def _fsdp_dims(cfg: TransformerConfig):
@@ -452,6 +570,114 @@ def _lm_head_bwd(cd, res, g):
 
 
 _lm_head.defvjp(_lm_head_fwd, _lm_head_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _head_nll(cd, chunk, h, embed, targets):
+    """Sum of next-token NLL over the local shard, head applied in token
+    chunks of ``chunk`` so the full ``(B, T, V)`` fp32 logits are never
+    resident — live logits memory is ``(B, chunk, V)``.
+
+    The classic chunked-vocab cross-entropy (SPEED.md candidate #1):
+    forward keeps only the per-chunk NLL partial sums; backward
+    recomputes each chunk's logits, forms ``(softmax - onehot)·g``
+    in-registers (XLA fuses the one-hot iota-compare into the subtract),
+    and accumulates the embed cotangent across chunks in an fp32 scan
+    carry so the vma psum over the data-like axes fires ONCE at the end
+    — a per-chunk psum would multiply the (V, D) all-reduce volume by
+    the chunk count.  Matmul operands ride the MXU at ``cd`` with fp32
+    accumulation, exactly like :func:`_lm_head`."""
+    B, T, D = h.shape
+    if T % chunk:
+        raise ValueError(
+            f"loss_chunk={chunk} must divide the local sequence length "
+            f"{T} (global seq / seq-axis size)")
+    C = T // chunk
+    hc = h.reshape(B, C, chunk, D).transpose(1, 0, 2, 3)
+    tc = targets.reshape(B, C, chunk).transpose(1, 0, 2)
+    ew = embed.astype(cd)
+
+    def body(acc, ht):
+        hh, tt = ht
+        logits = jnp.einsum("bcd,vd->bcv", hh.astype(cd), ew,
+                            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, tt[..., None], axis=-1).sum(dtype=jnp.float32)
+        return acc + nll, None
+
+    # derive the carry seed from h so it inherits h's varying axes
+    # (scan requires carry-in and carry-out vma types to match)
+    acc0 = jnp.sum(h * 0, dtype=jnp.float32)
+    out, _ = lax.scan(body, acc0, (hc, tc))
+    return out
+
+
+def _head_nll_fwd(cd, chunk, h, embed, targets):
+    # residuals are just the primal inputs — no logits saved
+    return _head_nll(cd, chunk, h, embed, targets), (h, embed, targets)
+
+
+def _head_nll_bwd(cd, chunk, res, g):
+    h, embed, targets = res
+    B, T, D = h.shape
+    V = embed.shape[0]
+    C = T // chunk
+    hc = h.reshape(B, C, chunk, D).transpose(1, 0, 2, 3)
+    tc = targets.reshape(B, C, chunk).transpose(1, 0, 2)
+    ew = embed.astype(cd)
+    g32 = g.astype(jnp.float32)
+
+    def body(dw, ht):
+        hh, tt = ht
+        hcd = hh.astype(cd)
+        logits = jnp.einsum("bcd,vd->bcv", hcd, ew,
+                            preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        dl = ((p - jax.nn.one_hot(tt, V, dtype=p.dtype)) * g32).astype(cd)
+        dh_c = jnp.einsum("bcv,vd->bcd", dl, ew,
+                          preferred_element_type=jnp.float32).astype(h.dtype)
+        dw = dw + jnp.einsum("bcv,bcd->vd", dl, hcd,
+                             preferred_element_type=jnp.float32)
+        return dw, dh_c
+
+    dw0 = jnp.zeros((V, D), jnp.float32) \
+        + jnp.sum(h * 0, dtype=jnp.float32) + g32 * 0
+    dw, dhc = lax.scan(body, dw0, (hc, tc))
+    dh = dhc.transpose(1, 0, 2, 3).reshape(B, T, D)
+    dw = dw.astype(embed.dtype)
+    # single psum for the whole accumulated embed cotangent — mirrors
+    # _lm_head_bwd's vma discipline, error contract included (see the
+    # "No silent fallback" comment there)
+    try:
+        vma = tuple(jax.typeof(dw).vma)
+    except AttributeError:  # pragma: no cover - older jax: no vma typing
+        raise RuntimeError(
+            "_head_nll needs jax.typeof(...).vma (shard_map varying-"
+            "axes typing) to place the embed-gradient psum; this jax "
+            "version does not expose it") from None
+    if vma:
+        dw = lax.psum(dw, vma)
+    return dh, dw, None
+
+
+_head_nll.defvjp(_head_nll_fwd, _head_nll_bwd)
+
+
+def _shard_nll_sum(cfg, h_normed, embed, targets):
+    """Local-shard NLL **sum** through the configured head path:
+    ``loss_chunk > 0`` takes the chunked custom-VJP head, else the whole
+    shard's logits materialise once through :func:`_lm_head`."""
+    chunk = cfg.loss_chunk
+    if chunk > 0:
+        # chunk == T is the C=1 edge of the chunked path; a chunk that
+        # does not divide T (including chunk > T) raises in _head_nll
+        return _head_nll(cfg.compute_dtype, chunk, h_normed, embed,
+                         targets)
+    logits = _lm_head(cfg.compute_dtype, h_normed, embed)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1).sum(dtype=jnp.float32)
 
 
 def apply_rope(x, positions, theta: float = 10000.0):
@@ -638,8 +864,8 @@ def _stage(cfg: TransformerConfig, stage_params, h):
     return h, aux
 
 
-def transformer_forward(cfg: TransformerConfig, params, tokens):
-    """Logits for next-token prediction.  Call INSIDE shard_map.
+def transformer_backbone(cfg: TransformerConfig, params, tokens):
+    """Embedding → block stack → final norm.  Call INSIDE shard_map.
 
     Args:
       params: local shards per :func:`param_specs` (blocks carry the
@@ -647,9 +873,10 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
       tokens: ``(B_local, T_local)`` int32 — batch sharded over
         ``("data","expert")``, sequence over ``seq``.
 
-    Returns ``(B_local, T_local, vocab)`` fp32 logits and the summed MoE
-    aux loss (zero when ``moe=False`` or pipelined).
-    """
+    Returns the normed ``(B_local, T_local, d_model)`` hidden states and
+    the summed MoE aux loss (zero when ``moe=False`` or pipelined).
+    The weight-tied LM head is applied by :func:`transformer_forward`
+    (whole-shard logits) or :func:`lm_loss` (optionally chunked)."""
     if cfg.seq_layout == "zigzag" and cfg.attention != "ring":
         raise ValueError(
             'seq_layout="zigzag" is a ring-attention layout; '
@@ -722,11 +949,18 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
         h = lax.psum(h, "pipe")
         aux = lax.psum(aux, "pipe")
 
-    h = _rms_norm(h, params["ln_f"])
-    # weight-tied head; fp32 logits for a stable softmax, compute-dtype
-    # matmul operands (see _lm_head)
-    logits = _lm_head(cfg.compute_dtype, h, params["embed"])
-    return logits, aux
+    return _rms_norm(h, params["ln_f"]), aux
+
+
+def transformer_forward(cfg: TransformerConfig, params, tokens):
+    """``(B_local, T_local, vocab)`` fp32 logits + MoE aux loss.
+
+    Whole-shard logits through the weight-tied head (fp32 for a stable
+    softmax, compute-dtype matmul operands — see :func:`_lm_head`);
+    decoding and forward-only callers want the actual logits tensor, so
+    ``loss_chunk`` does not apply here."""
+    h, aux = transformer_backbone(cfg, params, tokens)
+    return _lm_head(cfg.compute_dtype, h, params["embed"]), aux
 
 
 # coefficient of the Switch-MoE balancing loss in the training objective
@@ -737,11 +971,9 @@ _AUX_WEIGHT = 0.01
 
 def lm_loss(cfg: TransformerConfig, params, inputs, targets):
     """Local-shard mean next-token cross-entropy (+0.01·aux)."""
-    logits, aux = transformer_forward(cfg, params, inputs)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(
-        logp, targets[..., None], axis=-1).squeeze(-1)
-    return nll.mean() + _AUX_WEIGHT * aux
+    h, aux = transformer_backbone(cfg, params, inputs)
+    nll_sum = _shard_nll_sum(cfg, h, params["embed"], targets)
+    return nll_sum / targets.size + _AUX_WEIGHT * aux
 
 
 # --------------------------------------------------------------------- #
@@ -791,11 +1023,7 @@ def _make_1f1b_grad(cfg: TransformerConfig):
 
         def loss_fn(lp, y, tgt):
             hN = _rms_norm(y, lp["ln_f"])
-            logits = _lm_head(cd, hN, lp["embed"])
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, tgt[..., None], axis=-1).squeeze(-1)
-            return nll.mean()
+            return _shard_nll_sum(cfg, hN, lp["embed"], tgt) / tgt.size
 
         lp = {"ln_f": params["ln_f"], "embed": params["embed"]}
         aux_kw = dict(with_aux=True, aux_weight=_AUX_WEIGHT) \

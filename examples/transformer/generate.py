@@ -85,15 +85,22 @@ def main():
                  if args.checkpoint else None)
     pipe = mc.mesh.shape.get("pipe", 1)
     if ckpt_file and os.path.exists(ckpt_file):
-        params = jax.tree.map(
-            jnp.asarray, load_state(ckpt_file)["params"])
-        # checkpoints store blocks (P0, L/P0, ...) for whatever pipe
-        # size TRAINED them: regroup to this mesh's pipe size
-        # unconditionally (same layer order, different grouping — a
-        # pipe-trained checkpoint must decode on a pipe=1 mesh too)
-        params = dict(params, blocks=jax.tree.map(
-            lambda a: a.reshape(pipe, -1, *a.shape[2:]),
-            params["blocks"]))
+        from chainermn_tpu.models import regroup_blocks
+
+        saved = load_state(ckpt_file)
+        params = jax.tree.map(jnp.asarray, saved["params"])
+        # checkpoints store blocks grouped for whatever pipe mesh
+        # TRAINED them ((P0, L/P0, ...), or (P0, V0, lpc, ...) from an
+        # interleaved run — the snapshot records its grouping): regroup
+        # to this decode mesh's pipe size (a pipe-trained checkpoint
+        # must decode on a pipe=1 mesh too, and vice versa).  Legacy
+        # snapshots without the metadata are plain-grouped: P0 is the
+        # blocks' leading dim.
+        first = jax.tree.leaves(params["blocks"])[0]
+        saved_pipe = int(saved.get("pipe", first.shape[0]))
+        saved_v = int(saved.get("virtual_pipe", 1))
+        params = dict(params, blocks=regroup_blocks(
+            params["blocks"], saved_pipe, pipe, saved_v, 1))
         print(f"loaded {ckpt_file}")
     else:
         params = init_transformer(

@@ -143,18 +143,34 @@ def main():
                  if args.checkpoint else None)
     if ckpt_file and os.path.exists(ckpt_file):
         saved = load_state(ckpt_file)
-        # re-place on the mesh: device_put against the freshly built
-        # (correctly sharded) state, NOT bare jnp.asarray — with --fsdp
-        # that would re-materialise params AND both Adam moments
-        # replicated, forfeiting exactly the residency the flag buys
-        def replace_like(saved_tree, like_tree):
-            return jax.tree.map(
-                lambda saved_leaf, like: jax.device_put(
-                    jnp.asarray(saved_leaf), like.sharding),
-                saved_tree, like_tree)
+        saved_pipe = int(saved.get("pipe", pipe))
+        saved_v = int(saved.get("virtual_pipe", V))
+        if (saved_pipe, saved_v) != (pipe, V):
+            # elastic resume: the checkpoint was grouped for a different
+            # pipe mesh — regroup the block stack and re-lay params +
+            # Adam state onto THIS mesh (reference parity was identical
+            # world size only; see models.reshard_train_state)
+            from chainermn_tpu.models import reshard_train_state
 
-        params = replace_like(saved["params"], params)
-        opt_state = replace_like(saved["opt"], opt_state)
+            params, opt_state = reshard_train_state(
+                mc, cfg, opt, saved["params"], saved["opt"],
+                from_pipe=saved_pipe, from_virtual=saved_v)
+            print(f"regrouped checkpoint pipe={saved_pipe}/V={saved_v} "
+                  f"-> pipe={pipe}/V={V}")
+        else:
+            # same grouping: re-place on the mesh via device_put against
+            # the freshly built (correctly sharded) state, NOT bare
+            # jnp.asarray — with --fsdp that would re-materialise params
+            # AND both Adam moments replicated, forfeiting exactly the
+            # residency the flag buys
+            def replace_like(saved_tree, like_tree):
+                return jax.tree.map(
+                    lambda saved_leaf, like: jax.device_put(
+                        jnp.asarray(saved_leaf), like.sharding),
+                    saved_tree, like_tree)
+
+            params = replace_like(saved["params"], params)
+            opt_state = replace_like(saved["opt"], opt_state)
         start = int(saved["step"])
         print(f"resumed at step {start}")
     if start >= args.steps:
@@ -198,6 +214,10 @@ def main():
             "params": jax.tree.map(np.asarray, params),
             "opt": jax.tree.map(np.asarray, opt_state),
             "step": args.steps,
+            # the pipe grouping this state was SAVED with, so a resume
+            # on a different mesh knows how to regroup (elastic resume)
+            "pipe": pipe,
+            "virtual_pipe": V,
         })
         print(f"saved {ckpt_file}")
     return last

@@ -82,6 +82,23 @@ def test_generate_example(extra):
         assert "generated:" in out
 
 
+def test_elastic_resume_across_meshes(tmp_path):
+    """A checkpoint trained on a pure-DP mesh resumes on a pipelined
+    mesh (blocks regrouped, Adam state re-laid) and keeps training —
+    the reference could only restart at the identical world size."""
+    ck = str(tmp_path / "ck")
+    first = _run_example(
+        "examples/transformer/train_lm.py",
+        ["--mesh", "data=8", "--steps", "6", "--checkpoint", ck])
+    assert "saved" in first
+    out = _run_example(
+        "examples/transformer/train_lm.py",
+        ["--mesh", "pipe=2,data=4", "--steps", "12",
+         "--checkpoint", ck])
+    assert "regrouped checkpoint pipe=1/V=1 -> pipe=2/V=1" in out, out
+    assert "resumed at step 6" in out, out
+
+
 def test_train_then_generate_roundtrip(tmp_path):
     ck = str(tmp_path / "ck")
     _run_example("examples/transformer/train_lm.py",
@@ -156,6 +173,21 @@ def test_pipe_trained_checkpoint_decodes_anywhere(tmp_path):
         assert "loaded" in out and "generated:" in out
         outs.append(out[out.index("generated:"):])
     assert outs[0] == outs[1], "pipe decode diverges from pipe=1 decode"
+
+
+def test_interleaved_trained_checkpoint_decodes(tmp_path):
+    """An interleaved-trained checkpoint stores blocks (P, V, lpc, ...);
+    decode must regroup via the recorded pipe/virtual metadata instead
+    of a blind (pipe, -1) reshape (which would keep the wrong rank and
+    scramble chunk-major layer order)."""
+    ck = str(tmp_path / "ck")
+    _run_example("examples/transformer/train_lm.py",
+                 ["--mesh", "pipe=2,data=4", "--schedule", "interleaved",
+                  "--steps", "8", "--checkpoint", ck])
+    out = _run_example("examples/transformer/generate.py",
+                       ["--checkpoint", ck, "--vocab", "128",
+                        "--max-len", "16"])
+    assert "loaded" in out and "generated:" in out
 
 
 def test_mnist_real_npz_path(tmp_path):
