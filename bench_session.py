@@ -45,6 +45,26 @@ def log(msg):
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
+def _live_record(stdout: str):
+    """Last JSON line of a bench run, or None.  A record counts as LIVE
+    only with a non-null, non-cached value — the bench parents exit 0
+    on every terminal path (null and cached fallbacks included), so
+    return codes prove nothing."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _is_live(rec) -> bool:
+    return rec is not None and rec.get("value") is not None \
+        and not rec.get("cached")
+
+
 def probe_live() -> bool:
     """One live headline attempt; True iff a non-cached number landed."""
     try:
@@ -54,43 +74,38 @@ def probe_live() -> bool:
     except subprocess.TimeoutExpired:
         log("probe: outer timeout (hang mood persists)")
         return False
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            live = rec.get("value") is not None \
-                and not rec.get("cached")
-            log(f"probe: value={rec.get('value')} "
-                f"cached={rec.get('cached', False)} live={live}")
-            return live
-    log(f"probe: no JSON line (rc={proc.returncode})")
-    return False
+    rec = _live_record(proc.stdout)
+    if rec is None:
+        log(f"probe: no JSON line (rc={proc.returncode})")
+        return False
+    log(f"probe: value={rec.get('value')} "
+        f"cached={rec.get('cached', False)} live={_is_live(rec)}")
+    return _is_live(rec)
 
 
 def run_battery():
-    """True only if every script finished and at least one succeeded —
-    a battery of fast rc!=0 failures must NOT put the session on the
-    slow heartbeat (the chip can wedge in a fail-fast mode too)."""
-    ok = 0
+    """True only if every script finished and at least one produced a
+    LIVE measurement — a battery of fast failures (rc is 0 even for
+    null/cached fallbacks) must NOT put the session on the slow
+    heartbeat; the chip can wedge in a fail-fast mode too."""
+    live = 0
     for cmd, budget in BATTERY:
         log(f"battery: {' '.join(cmd)} (timeout {budget}s)")
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=budget)
+            rec = _live_record(proc.stdout)
             tail = proc.stdout.strip().splitlines()
-            log(f"  rc={proc.returncode} "
+            log(f"  rc={proc.returncode} live={_is_live(rec)} "
                 f"{tail[-1][:200] if tail else '<no output>'}")
-            ok += proc.returncode == 0
+            live += _is_live(rec)
         except subprocess.TimeoutExpired:
             log("  outer timeout — chip went back to sleep; "
                 "stopping battery early")
             return False
-    if not ok:
-        log("  every battery script failed — staying on probe cadence")
-    return ok > 0
+    if not live:
+        log("  no live measurement landed — staying on probe cadence")
+    return live > 0
 
 
 def main(argv):

@@ -132,8 +132,10 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
 
     x = _rms_norm(h, blk["ln2"])
     if cfg.moe:
-        # per-token Switch routing (same experts; tiny per-step batches
-        # may clip at capacity — acceptable at decode time)
+        # per-token top-k routing, same mode the checkpoint was TRAINED
+        # with (a top-2 model decoded top-1 silently diverges from its
+        # training forward); tiny per-step batches may clip at capacity
+        # — acceptable at decode time
         from chainermn_tpu.parallel.expert import expert_parallel_moe
 
         def expert_fn(pp, tokens):
@@ -158,6 +160,7 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             expert_fn,
             axis_name="expert",
             capacity_factor=cfg.capacity_factor,
+            top_k=cfg.router_top_k,
         )
         h = h + out.reshape(B, 1, D)
     else:

@@ -113,6 +113,8 @@ class TransformerConfig:
     # permutation) — position embeddings follow the layout automatically
     moe: bool = False          # Switch-MoE MLP in every block
     n_experts: int = 8         # global expert count (moe=True)
+    router_top_k: int = 1      # experts per token: 1 = Switch, 2 =
+    # GShard-style top-2 with renormalised gates (capacity scales by k)
     capacity_factor: float = 1.25
     num_microbatches: int = 1  # GPipe M (>1 only useful when pipe > 1)
     pipeline_schedule: str = "gpipe"  # "gpipe" | "1f1b" | "interleaved"
@@ -197,6 +199,10 @@ class TransformerConfig:
         if self.loss_chunk < 0:
             raise ValueError(
                 f"loss_chunk={self.loss_chunk} must be >= 0")
+        if self.moe and not 1 <= self.router_top_k <= self.n_experts:
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]")
         if self.virtual_pipe < 1:
             raise ValueError(
                 f"virtual_pipe={self.virtual_pipe} must be >= 1")
@@ -837,6 +843,7 @@ def _mlp(cfg: TransformerConfig, h, blk):
         expert_fn,
         axis_name="expert",
         capacity_factor=cfg.capacity_factor,
+        top_k=cfg.router_top_k,
     )
     return h + out.reshape(B, T, D), aux
 

@@ -7,8 +7,9 @@ building block").  Shape of the strategy:
 - tokens live data-sharded over the ``expert`` mesh axis (the axis does
   double duty: between MoE blocks it is an extra data axis, inside them it
   is the expert home grid — the standard TPU MoE layout);
-- a linear router picks top-1 expert per token (Switch); tokens are packed
-  into per-expert capacity slots by a dispatch one-hot, so every shape
+- a linear router picks top-k experts per token (k=1: Switch; k>1:
+  GShard-style with renormalised gates); tokens are packed into
+  per-expert capacity slots by a dispatch one-hot, so every shape
   stays static for XLA (dropped overflow tokens pass through as zeros —
   the residual connection carries them, standard Switch semantics);
 - ONE ``all_to_all`` ships slots to the experts' home devices, the expert
@@ -38,9 +39,16 @@ def expert_parallel_moe(
     *,
     axis_name: str = "expert",
     capacity_factor: float = 1.25,
+    top_k: int = 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-1 (Switch) mixture-of-experts over the ``expert`` mesh axis.
+    """Top-k mixture-of-experts over the ``expert`` mesh axis.
     Call INSIDE ``shard_map``.
+
+    ``top_k=1`` is Switch routing (gate = the raw winning probability);
+    ``top_k>1`` is GShard-style: each token visits its k best experts
+    and the k gates are renormalised to sum to one.  Later choices
+    queue behind earlier ones for capacity slots (rank-0 assignments
+    are never dropped in favour of someone's rank-1).
 
     Args:
       x: ``(N, D)`` local tokens (flatten batch×seq first).
@@ -50,7 +58,8 @@ def expert_parallel_moe(
         (shard the global ``(E, ...)`` stack over ``axis_name``).
       expert_fn: ``expert_fn(params_one_expert, tokens) -> tokens`` — the
         per-expert network, vmapped over local experts here.
-      capacity_factor: slots per expert = ``cf · N / E`` (rounded up).
+      capacity_factor: slots per expert = ``cf · k · N / E`` (rounded up).
+      top_k: experts per token (static; 1 ≤ k ≤ E).
 
     Returns ``(out, aux_loss)``: ``out`` is ``(N, D)`` with overflow
     tokens zeroed; ``aux_loss`` the global Switch balancing loss (scalar).
@@ -60,8 +69,10 @@ def expert_parallel_moe(
     E = router_w.shape[-1]
     if E % S:
         raise ValueError(f"{E} experts not divisible by axis size {S}")
+    if not 1 <= top_k <= E:
+        raise ValueError(f"top_k={top_k} must be in [1, E={E}]")
     e_local = E // S
-    cap = max(1, math.ceil(capacity_factor * N / E))
+    cap = max(1, math.ceil(capacity_factor * top_k * N / E))
 
     # --- route (local, no comm) -------------------------------------- #
     # routing/dispatch bookkeeping is fp32 regardless of compute dtype:
@@ -69,16 +80,32 @@ def expert_parallel_moe(
     # after which capacity slots collide and dispatch silently corrupts
     logits = (x @ router_w).astype(jnp.float32)         # (N, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = probs.max(axis=-1).astype(x.dtype)           # (N,)
-    choice = probs.argmax(axis=-1)                      # (N,)
-    onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)   # (N, E)
+    top_p, top_i = lax.top_k(probs, top_k)              # (N, k)
+    if top_k == 1:
+        gates = top_p                                   # raw Switch gate
+    else:
+        gates = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    onehots = jax.nn.one_hot(top_i, E, dtype=jnp.float32)   # (N, k, E)
 
-    # position of each token within its expert's queue; drop past capacity
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # (N, E)
-    keep = pos < cap
-    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
-    dispatch = (onehot[..., None] * slot *
-                keep[..., None]).astype(x.dtype)        # (N, E, C)
+    # position of each assignment within its expert's queue, rank by
+    # rank (k is tiny and static — unrolled); drop past capacity.
+    # dispatch (0/1) fills slots with raw tokens; combine carries the
+    # gate weights for the weighted sum home.
+    counts = jnp.zeros((E,), jnp.float32)
+    dispatch = jnp.zeros((N, E, cap), jnp.float32)
+    combine = jnp.zeros((N, E, cap), jnp.float32)
+    for r in range(top_k):
+        oh = onehots[:, r]                              # (N, E)
+        pos = (jnp.cumsum(oh, axis=0) - 1.0 + counts) * oh
+        keep = pos < cap
+        slot = jax.nn.one_hot(
+            pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        d_r = oh[..., None] * slot * keep[..., None]    # (N, E, C)
+        dispatch = dispatch + d_r
+        combine = combine + d_r * gates[:, r][:, None, None]
+        counts = counts + oh.sum(axis=0)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
 
     # --- dispatch all-to-all ------------------------------------------ #
     slots = jnp.einsum("nec,nd->ecd", dispatch, x)      # (E, C, D)
@@ -95,10 +122,12 @@ def expert_parallel_moe(
     if S > 1:
         hidden = lax.all_to_all(hidden, axis_name, split_axis=1,
                                 concat_axis=0, tiled=True)
-    out = jnp.einsum("ecd,nec->nd", hidden, dispatch) * gate[:, None]
+    out = jnp.einsum("ecd,nec->nd", hidden, combine)
 
     # --- Switch load-balancing loss (global) -------------------------- #
-    frac_tokens = onehot.mean(axis=0)                   # (E,)
+    # fractions use the PRIMARY (rank-0) choice only — the Switch
+    # definition, which GShard's top-2 aux shares; k=1 is unchanged
+    frac_tokens = onehots[:, 0].mean(axis=0)            # (E,)
     frac_probs = probs.mean(axis=0)                     # (E,)
     if S > 1:
         frac_tokens = lax.pmean(frac_tokens, axis_name)

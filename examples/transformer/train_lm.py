@@ -73,6 +73,8 @@ def main():
     p.add_argument("--n-kv-heads", type=int, default=0)
     p.add_argument("--window", type=int, default=0)
     p.add_argument("--moe", action="store_true")
+    p.add_argument("--router-top-k", type=int, default=1,
+                   help="experts per token (1=Switch, 2=GShard top-2)")
     p.add_argument("--seq-layout", default="contiguous",
                    choices=["contiguous", "zigzag"])
     p.add_argument("--fsdp", action="store_true",
@@ -125,39 +127,46 @@ def main():
         pos_embedding=args.pos_embedding,
         seq_layout=args.seq_layout,
         moe=args.moe, n_experts=max(2 * axes.get("expert", 1), 2),
+        router_top_k=args.router_top_k if args.moe else 1,
         num_microbatches=2 if pipe > 1 else 1,
         pipeline_schedule=args.schedule, virtual_pipe=V,
         fsdp=args.fsdp,
         dtype="float32", remat=False,
     )
-    params = shard_params(
-        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, pipe))
     opt = optax.adamw(args.lr)
-    # pins the state's shardings to the params' (with --fsdp the Adam
-    # moments land shard-width; plain jit(init) would replicate them)
-    opt_state = shard_opt_state(opt, params)
-    step = make_train_step(mc, cfg, opt)
-
     start = 0
     ckpt_file = (os.path.join(args.checkpoint, "lm_state.npz")
                  if args.checkpoint else None)
-    if ckpt_file and os.path.exists(ckpt_file):
-        saved = load_state(ckpt_file)
+    saved = (load_state(ckpt_file)
+             if ckpt_file and os.path.exists(ckpt_file) else None)
+    if saved is not None and (
+            int(saved.get("pipe", pipe)),
+            int(saved.get("virtual_pipe", V))) != (pipe, V):
+        # elastic resume: the checkpoint was grouped for a different
+        # pipe mesh — regroup the block stack and re-lay params + Adam
+        # state onto THIS mesh (reference parity was identical world
+        # size only; see models.reshard_train_state).  No fresh init on
+        # this path: a second full state resident next to the resharded
+        # one would double peak memory exactly where large models hurt.
+        from chainermn_tpu.models import reshard_train_state
+
         saved_pipe = int(saved.get("pipe", pipe))
         saved_v = int(saved.get("virtual_pipe", V))
-        if (saved_pipe, saved_v) != (pipe, V):
-            # elastic resume: the checkpoint was grouped for a different
-            # pipe mesh — regroup the block stack and re-lay params +
-            # Adam state onto THIS mesh (reference parity was identical
-            # world size only; see models.reshard_train_state)
-            from chainermn_tpu.models import reshard_train_state
-
-            params, opt_state = reshard_train_state(
-                mc, cfg, opt, saved["params"], saved["opt"],
-                from_pipe=saved_pipe, from_virtual=saved_v)
-            print(f"regrouped checkpoint pipe={saved_pipe}/V={saved_v} "
-                  f"-> pipe={pipe}/V={V}")
-        else:
+        params, opt_state = reshard_train_state(
+            mc, cfg, opt, saved["params"], saved["opt"],
+            from_pipe=saved_pipe, from_virtual=saved_v)
+        print(f"regrouped checkpoint pipe={saved_pipe}/V={saved_v} "
+              f"-> pipe={pipe}/V={V}")
+        start = int(saved["step"])
+        print(f"resumed at step {start}")
+    else:
+        params = shard_params(
+            mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, pipe))
+        # pins the state's shardings to the params' (with --fsdp the
+        # Adam moments land shard-width; plain jit(init) would
+        # replicate them)
+        opt_state = shard_opt_state(opt, params)
+        if saved is not None:
             # same grouping: re-place on the mesh via device_put against
             # the freshly built (correctly sharded) state, NOT bare
             # jnp.asarray — with --fsdp that would re-materialise params
@@ -171,8 +180,9 @@ def main():
 
             params = replace_like(saved["params"], params)
             opt_state = replace_like(saved["opt"], opt_state)
-        start = int(saved["step"])
-        print(f"resumed at step {start}")
+            start = int(saved["step"])
+            print(f"resumed at step {start}")
+    step = make_train_step(mc, cfg, opt)
     if start >= args.steps:
         print(f"nothing to do: resumed step {start} >= --steps "
               f"{args.steps}")

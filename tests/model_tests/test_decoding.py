@@ -93,6 +93,24 @@ def test_cached_matches_full_forward(axes, kw):
         np.asarray(cached), np.asarray(full), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_cached_matches_full_forward(top_k):
+    """MoE decode must route the way the model was TRAINED (a top-2
+    checkpoint decoded top-1 silently diverges): with ample capacity the
+    teacher-forced cached logits equal the training forward for both
+    router modes."""
+    cfg = tiny_cfg(moe=True, n_experts=2, router_top_k=top_k,
+                   capacity_factor=4.0)
+    mc = MeshConfig(data=2, expert=2, devices=jax.devices()[:4])
+    params = shard_params(
+        mc, cfg, init_transformer(jax.random.PRNGKey(3), cfg))
+    toks = prompt(seed=5)
+    full = make_forward_fn(mc, cfg)(params, toks)
+    cached = _cached_logits_all_positions(cfg, params, toks, mc)
+    np.testing.assert_allclose(
+        np.asarray(cached), np.asarray(full), rtol=2e-4, atol=2e-4)
+
+
 def test_greedy_generation_consistent():
     """Greedy generate: every generated token must be the argmax of the
     full forward logits over its prefix (self-consistency oracle)."""
