@@ -13,11 +13,12 @@ see SURVEY.md).  This is the transformer equivalent, TPU-first:
   that motivates GQA at inference; the grouped-einsum attention cores
   (:func:`...ring_attention._qk_scores`) read it in place;
 - composes with DP (batch over ``data``), TP (heads over ``model``),
-  and PP (layers + KV cache stage-sharded over ``pipe``; see
+  PP (layers + KV cache stage-sharded over ``pipe``; see
   :func:`_decode_step` — a model too big for one chip's HBM decodes at
-  ~single-chip per-token HBM cost).  The decode step is seq-length-1,
-  so SP stays out of scope (``seq`` axis must be 1 — raise early, not
-  mid-trace).
+  ~single-chip per-token HBM cost), and SP (the KV cache's LENGTH dim
+  blocked over ``seq``; see :func:`_decode_block` — a context whose
+  cache exceeds one chip's HBM decodes with an R× cache budget at one
+  pmax+psum of token-sized partials per step).
 
 Greedy (``temperature=0``) or temperature sampling.
 """
@@ -82,16 +83,38 @@ def _dense_q(dense, x, blk, name, cd):
 def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
                   write_mask=None):
     """One block for ONE new token.  ``h``: (B, 1, D); ``ck``/``cv``:
-    (B, max_len, Hkv_local, Dh) this layer's cache; ``pos``: scalar
-    position of the new token.  ``write_mask`` (scalar bool) gates the
-    cache update — pipe-parallel phases where this device does NOT own
-    the running stage must leave their cache untouched, and masking the
-    one-token slice here is O(B·Hkv·Dh) instead of the O(cache) select
-    a whole-buffer ``where`` would cost per phase.  Returns
-    (h, ck, cv)."""
+    (B, kv_len_local, Hkv_local, Dh) this layer's cache; ``pos``: scalar
+    GLOBAL position of the new token.  ``write_mask`` (scalar bool)
+    gates the cache update — pipe-parallel phases where this device does
+    NOT own the running stage must leave their cache untouched, and
+    masking the one-token slice here is O(B·Hkv·Dh) instead of the
+    O(cache) select a whole-buffer ``where`` would cost per phase.
+
+    Sequence-parallel KV (``seq`` axis size R > 1): the cache's length
+    dim holds only this member's max_len/R BLOCK of positions (member r
+    owns [r·Tl, (r+1)·Tl)) — R× KV capacity for contexts whose cache
+    exceeds one chip's HBM.  The new token's K/V land on the owning
+    member only; attention becomes each member's partial scores over
+    its block merged by a max/sum-exp reduction over the axis (the
+    psum twin of ring attention's log-space merge) — per token that is
+    one pmax + one psum of (B, H, Dh)-sized partials, NOT a cache-sized
+    gather.  Returns (h, ck, cv)."""
     cd = cfg.compute_dtype
     x = _rms_norm(h, blk["ln1"])
     B, _, D = x.shape
+    R = lax.axis_size("seq")
+    Tl = ck.shape[1]
+    if R > 1:
+        # member pos // Tl owns this position; everyone computes the
+        # same local slot index (pos % Tl is only meaningful on the
+        # owner, but it is always in range, and non-owners' writes are
+        # masked to a rewrite of the current value)
+        seq_mine = (pos // Tl) == lax.axis_index("seq")
+        write_mask = seq_mine if write_mask is None \
+            else jnp.logical_and(write_mask, seq_mine)
+        lpos = pos % Tl
+    else:
+        lpos = pos
     if "wqkv" in blk:
         Hl = blk["wqkv"].shape[2]
         qkv = _dense_q(column_parallel_dense, x, blk, "wqkv", cd)
@@ -111,22 +134,34 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
         k_new = apply_rope(k_new, p1, cfg.rope_theta)
     k_new, v_new = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
     if write_mask is not None:
-        cur_k = lax.dynamic_slice(ck, (0, pos, 0, 0), k_new.shape)
-        cur_v = lax.dynamic_slice(cv, (0, pos, 0, 0), v_new.shape)
+        cur_k = lax.dynamic_slice(ck, (0, lpos, 0, 0), k_new.shape)
+        cur_v = lax.dynamic_slice(cv, (0, lpos, 0, 0), v_new.shape)
         k_new = jnp.where(write_mask, k_new, cur_k)
         v_new = jnp.where(write_mask, v_new, cur_v)
-    ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0, 0))
-    cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0, 0))
-    # grouped attention of the 1-token query against the whole cache,
-    # masked to positions <= pos (static max_len shape)
+    ck = lax.dynamic_update_slice(ck, k_new, (0, lpos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v_new, (0, lpos, 0, 0))
+    # grouped attention of the 1-token query against the (local block
+    # of the) cache, masked to GLOBAL positions <= pos (static shapes)
     s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
-    kpos = jnp.arange(ck.shape[1])
-    allow = kpos <= pos                                   # (max_len,)
+    kpos = jnp.arange(Tl)
+    if R > 1:
+        kpos = kpos + lax.axis_index("seq") * Tl
+    allow = kpos <= pos                                   # (Tl,)
     if cfg.attention_window:
         allow &= (pos - kpos) < cfg.attention_window
-    s = jnp.where(allow[None, None, None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)   # (B,1,Hl,Dh)
+    s = jnp.where(allow[None, None, None], s, _NEG)       # (B, H, 1, Tl)
+    if R > 1:
+        # stable distributed softmax: global max, then exp-sums and
+        # value partials psum'd over the seq axis.  Members whose whole
+        # block is beyond pos contribute exp(_NEG - m) ≈ 0.
+        m = lax.pmax(s.max(axis=-1, keepdims=True), "seq")
+        e = jnp.exp(s - m)
+        n = lax.psum(e.sum(axis=-1, keepdims=True), "seq")
+        o = lax.psum(_pv_mix(e, cv.astype(cd)), "seq")
+        o = (o / n).transpose(0, 2, 1, 3)                 # (B,1,Hl,Dh)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
+        o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)
     h = h + _dense_q(row_parallel_dense, o.reshape(B, 1, -1),
                      blk, "wo", cd)
 
@@ -244,7 +279,7 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
 
 def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
     """Shared validation for the decode factories; returns the resolved
-    ``(max_len, kv_heads_local, layers_local)``."""
+    ``(max_len, kv_len_local, kv_heads_local, layers_local)``."""
     _check_mesh(mesh_cfg, cfg)   # head/kv divisibility, clear errors
     if cfg.fsdp:
         raise ValueError(
@@ -252,11 +287,6 @@ def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
             "weight gathers would land a collective on every generated "
             "token); decode with dataclasses.replace(cfg, fsdp=False, "
             "fsdp_wire_dtype='') and re-place the params")
-    if mesh_cfg.mesh.shape.get("seq", 1) != 1:
-        raise ValueError(
-            "decoding runs length-1 steps: the 'seq' mesh axis "
-            f"({mesh_cfg.mesh.shape['seq']}) must be 1 (shard batch "
-            "over data, heads over model, layers over pipe instead)")
     pipe = mesh_cfg.mesh.shape.get("pipe", 1)
     if pipe > 1 and cfg.virtual_pipe > 1:
         raise ValueError(
@@ -275,21 +305,36 @@ def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
     if max_len > cfg.max_seq:
         raise ValueError(
             f"max_len {max_len} exceeds cfg.max_seq {cfg.max_seq}")
-    return (max_len, cfg.kv_heads // mesh_cfg.mesh.shape.get("model", 1),
+    R = mesh_cfg.mesh.shape.get("seq", 1)
+    if max_len % R:
+        raise ValueError(
+            f"sequence-parallel KV decode blocks the cache over the "
+            f"seq axis: max_len={max_len} must be divisible by the seq "
+            f"mesh axis ({R})")
+    return (max_len, max_len // R,
+            cfg.kv_heads // mesh_cfg.mesh.shape.get("model", 1),
             cfg.n_layers // pipe)
 
 
-def _make_cache(cfg: TransformerConfig, rows: int, max_len: int,
+def _make_cache(cfg: TransformerConfig, rows: int, kv_len_local: int,
                 kv_heads_local: int, layers_local: int):
-    """Zero KV cache pair ``(L_local, rows, max_len, Hkv_local, Dh)``,
-    typed varying over every mesh axis its contents will carry.
+    """Zero KV cache pair ``(L_local, rows, kv_len_local, Hkv_local,
+    Dh)``, typed varying over every mesh axis its contents will carry.
     ``layers_local`` = this stage's layer count — with pipe-parallel
     decode each device holds ONLY its stage's cache (the S× capacity
-    win)."""
+    win); ``kv_len_local`` = max_len / seq-axis-size — with
+    sequence-parallel KV each member holds only its block of positions
+    (the R× context win)."""
+    axes = ["pipe", "data", "expert", "model"]
+    if lax.axis_size("seq") > 1:
+        # seq-varying only when the axis is real: at R == 1 the
+        # single-member softmax path never psums over seq, so a varying
+        # cache would leak seq variance into the logits' vma type
+        axes.append("seq")
     return tuple(
-        _vary(jnp.zeros((layers_local, rows, max_len, kv_heads_local,
-                         cfg.d_head), cfg.compute_dtype),
-              "pipe", "data", "expert", "model")
+        _vary(jnp.zeros((layers_local, rows, kv_len_local,
+                         kv_heads_local, cfg.d_head), cfg.compute_dtype),
+              *axes)
         for _ in range(2))
 
 
@@ -306,7 +351,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     :func:`...quantization.quantize_params_int8` (≈half the HBM traffic
     per token).
     """
-    max_len, kv_heads_local, layers_local = _decode_preamble(
+    max_len, kv_len_local, kv_heads_local, layers_local = _decode_preamble(
         mesh_cfg, cfg, max_len)
     specs = param_specs(cfg, quantized=quantized)
     batch_spec = P(("data", "expert"))
@@ -318,7 +363,8 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
             key, lax.axis_index("data") * lax.axis_size("expert")
             + lax.axis_index("expert"))
         B, Plen = prompt.shape
-        cache = _make_cache(cfg, B, max_len, kv_heads_local, layers_local)
+        cache = _make_cache(cfg, B, kv_len_local, kv_heads_local,
+                            layers_local)
         buf = jnp.zeros((B, max_len), jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
@@ -383,7 +429,7 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
     """
     if beam_size < 1:
         raise ValueError(f"beam_size {beam_size} must be >= 1")
-    max_len, kv_heads_local, layers_local = _decode_preamble(
+    max_len, kv_len_local, kv_heads_local, layers_local = _decode_preamble(
         mesh_cfg, cfg, max_len)   # includes _check_mesh
     K = beam_size
 
@@ -394,7 +440,8 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
         B, Plen = prompt.shape
         # -- prefill at width B (the K beams are identical inside the
         # prompt — no reason to pay K× its FLOPs or reorder gathers) --
-        cache_b = _make_cache(cfg, B, max_len, kv_heads_local, layers_local)
+        cache_b = _make_cache(cfg, B, kv_len_local, kv_heads_local,
+                              layers_local)
 
         def prefill(caches, t):
             _, caches = _decode_step(cfg, params, caches, prompt[:, t], t)

@@ -139,9 +139,9 @@ def main():
                  if args.checkpoint else None)
     saved = (load_state(ckpt_file)
              if ckpt_file and os.path.exists(ckpt_file) else None)
-    if saved is not None and (
-            int(saved.get("pipe", pipe)),
-            int(saved.get("virtual_pipe", V))) != (pipe, V):
+    saved_pipe = int(saved.get("pipe", pipe)) if saved else pipe
+    saved_v = int(saved.get("virtual_pipe", V)) if saved else V
+    if saved is not None and (saved_pipe, saved_v) != (pipe, V):
         # elastic resume: the checkpoint was grouped for a different
         # pipe mesh — regroup the block stack and re-lay params + Adam
         # state onto THIS mesh (reference parity was identical world
@@ -150,15 +150,11 @@ def main():
         # one would double peak memory exactly where large models hurt.
         from chainermn_tpu.models import reshard_train_state
 
-        saved_pipe = int(saved.get("pipe", pipe))
-        saved_v = int(saved.get("virtual_pipe", V))
         params, opt_state = reshard_train_state(
             mc, cfg, opt, saved["params"], saved["opt"],
             from_pipe=saved_pipe, from_virtual=saved_v)
         print(f"regrouped checkpoint pipe={saved_pipe}/V={saved_v} "
               f"-> pipe={pipe}/V={V}")
-        start = int(saved["step"])
-        print(f"resumed at step {start}")
     else:
         params = shard_params(
             mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, pipe))
@@ -180,8 +176,9 @@ def main():
 
             params = replace_like(saved["params"], params)
             opt_state = replace_like(saved["opt"], opt_state)
-            start = int(saved["step"])
-            print(f"resumed at step {start}")
+    if saved is not None:
+        start = int(saved["step"])
+        print(f"resumed at step {start}")
     step = make_train_step(mc, cfg, opt)
     if start >= args.steps:
         print(f"nothing to do: resumed step {start} >= --steps "

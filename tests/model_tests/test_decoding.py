@@ -52,13 +52,10 @@ def _cached_logits_all_positions(cfg, params, toks, mc):
         for d in ("model",):
             mp *= lax.axis_size(d)
         Hkvl = cfg.kv_heads // mp
-        from chainermn_tpu.models.decoding import _vary
+        from chainermn_tpu.models.decoding import _make_cache
 
-        caches = tuple(
-            _vary(jnp.zeros((cfg.n_layers, Bl, Tl, Hkvl, cfg.d_head),
-                            cfg.compute_dtype),
-                  "pipe", "data", "expert", "model")
-            for _ in range(2))
+        R = lax.axis_size("seq")
+        caches = _make_cache(cfg, Bl, Tl // R, Hkvl, cfg.n_layers)
 
         def step(caches, t):
             logits, caches = _decode_step(cfg, params, caches,
@@ -79,16 +76,23 @@ def _cached_logits_all_positions(cfg, params, toks, mc):
     (dict(data=1), {}),
     (dict(data=4, model=2), {}),
     (dict(data=4, model=2), dict(n_kv_heads=2)),
-], ids=["single", "dp-tp", "gqa-tp"])
+    (dict(data=2, seq=2), {}),
+    (dict(data=2, seq=2, model=2), dict(n_kv_heads=2)),
+    (dict(data=2, seq=2), dict(attention_window=6)),
+], ids=["single", "dp-tp", "gqa-tp", "seq-kv", "seq-kv-gqa-tp",
+        "seq-kv-window"])
 def test_cached_matches_full_forward(axes, kw):
     cfg = tiny_cfg(**kw)
-    mc = (MeshConfig(data=1, devices=jax.devices()[:1])
-          if axes == dict(data=1) else MeshConfig(**axes))
-    params = shard_params(
-        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+    n_dev = int(np.prod(list(axes.values())))
+    mc = MeshConfig(**axes, devices=jax.devices()[:n_dev])
+    host = init_transformer(jax.random.PRNGKey(0), cfg)
     toks = prompt()
-    full = make_forward_fn(mc, cfg)(params, toks)
-    cached = _cached_logits_all_positions(cfg, params, toks, mc)
+    # oracle on a seq=1 mesh: attention="local" under a real seq axis
+    # would be shard-local, not full causal
+    one = MeshConfig(data=1, devices=jax.devices()[:1])
+    full = make_forward_fn(one, cfg)(shard_params(one, cfg, host), toks)
+    cached = _cached_logits_all_positions(
+        cfg, shard_params(mc, cfg, host), toks, mc)
     np.testing.assert_allclose(
         np.asarray(cached), np.asarray(full), rtol=2e-4, atol=2e-4)
 
@@ -148,14 +152,55 @@ def test_sampling_needs_key_and_differs():
     assert not np.array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_decode_rejects_seq_pipe_meshes():
+def test_decode_mesh_validation():
     cfg = tiny_cfg()
-    with pytest.raises(ValueError, match="seq"):
-        make_generate_fn(MeshConfig(seq=2, data=4), cfg)
+    # seq-KV blocks the cache over seq: max_len must divide evenly
+    with pytest.raises(ValueError, match="divisible by the seq"):
+        make_generate_fn(MeshConfig(seq=2, data=4), cfg, max_len=T - 1)
     with pytest.raises(ValueError, match="max_len"):
         make_generate_fn(
             MeshConfig(data=1, devices=jax.devices()[:1]), cfg,
             max_len=T + 1)
+
+
+def test_seq_kv_generate_matches_single_device():
+    """Greedy generation with the KV cache blocked over the seq axis is
+    token-identical to single-device decode (the R× cache capacity is
+    an implementation detail, not a semantics change)."""
+    cfg = tiny_cfg()
+    host = init_transformer(jax.random.PRNGKey(4), cfg)
+    p = prompt(seed=9, length=4)
+
+    one = MeshConfig(data=1, devices=jax.devices()[:1])
+    ref = make_generate_fn(one, cfg, max_len=12)(
+        shard_params(one, cfg, host), p)
+
+    mc = MeshConfig(data=2, seq=4)
+    got = make_generate_fn(mc, cfg, max_len=12)(
+        shard_params(mc, cfg, host), p)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_seq_kv_beam_matches_single_device():
+    """Beam search with the length-blocked cache: token- and
+    score-identical to the seq=1 oracle (the beam path reorders caches
+    per step — the reorder must commute with the seq blocking)."""
+    from chainermn_tpu.models import make_beam_search_fn
+
+    cfg = tiny_cfg()
+    host = init_transformer(jax.random.PRNGKey(5), cfg)
+    p = prompt(seed=10, length=4)
+
+    one = MeshConfig(data=1, devices=jax.devices()[:1])
+    ot, os_ = make_beam_search_fn(one, cfg, beam_size=2, max_len=T)(
+        shard_params(one, cfg, host), p)
+
+    mc = MeshConfig(data=2, seq=2, devices=jax.devices()[:4])
+    gt, gs = make_beam_search_fn(mc, cfg, beam_size=2, max_len=T)(
+        shard_params(mc, cfg, host), p)
+    np.testing.assert_array_equal(np.asarray(gt), np.asarray(ot))
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(os_),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_virtual_pipe_packed_params_decode():
@@ -314,5 +359,3 @@ def test_pp_decode_beam_and_guards():
         make_generate_fn(
             mc, tiny_cfg(n_layers=4, virtual_pipe=2,
                          pipeline_schedule="interleaved"), max_len=T)
-    with pytest.raises(ValueError, match="seq"):
-        make_generate_fn(MeshConfig(seq=2, data=4), cfg, max_len=T)
