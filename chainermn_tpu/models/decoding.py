@@ -30,7 +30,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from chainermn_tpu.parallel.ring_attention import _NEG, _pv_mix, _qk_scores
+from chainermn_tpu.parallel.ring_attention import (
+    _NEG,
+    _pv_mix,
+    _qk_scores,
+    local_attention,
+)
 from chainermn_tpu.parallel.tensor import (
     column_parallel_dense,
     row_parallel_dense,
@@ -82,87 +87,126 @@ def _dense_q(dense, x, blk, name, cd):
 
 def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
                   write_mask=None):
-    """One block for ONE new token.  ``h``: (B, 1, D); ``ck``/``cv``:
-    (B, kv_len_local, Hkv_local, Dh) this layer's cache; ``pos``: scalar
-    GLOBAL position of the new token.  ``write_mask`` (scalar bool)
-    gates the cache update — pipe-parallel phases where this device does
-    NOT own the running stage must leave their cache untouched, and
-    masking the one-token slice here is O(B·Hkv·Dh) instead of the
-    O(cache) select a whole-buffer ``where`` would cost per phase.
+    """One block for a CHUNK of new tokens.  ``h``: (B, Tq, D) — Tq = 1
+    in the generation loop, Tq = prompt length in batched prefill;
+    ``ck``/``cv``: (B, kv_len_local, Hkv_local, Dh) this layer's cache;
+    ``pos``: scalar GLOBAL position of the chunk's FIRST token (Tq > 1
+    requires ``pos == 0`` — the prefill contract).  ``write_mask``
+    (scalar bool) gates the cache update — pipe-parallel phases where
+    this device does NOT own the running stage must leave their cache
+    untouched, and masking the written slice here is O(written) instead
+    of the O(cache) select a whole-buffer ``where`` would cost per
+    phase.
 
     Sequence-parallel KV (``seq`` axis size R > 1): the cache's length
     dim holds only this member's max_len/R BLOCK of positions (member r
     owns [r·Tl, (r+1)·Tl)) — R× KV capacity for contexts whose cache
-    exceeds one chip's HBM.  The new token's K/V land on the owning
-    member only; attention becomes each member's partial scores over
-    its block merged by a max/sum-exp reduction over the axis (the
-    psum twin of ring attention's log-space merge) — per token that is
-    one pmax + one psum of (B, H, Dh)-sized partials, NOT a cache-sized
-    gather.  Returns (h, ck, cv)."""
+    exceeds one chip's HBM.  New K/V land on the owning member only;
+    attention becomes each member's partial scores over its block
+    merged by a max/sum-exp reduction over the axis (the psum twin of
+    ring attention's log-space merge) — per chunk that is one pmax +
+    one psum of query-sized partials, NOT a cache-sized gather.
+    Returns (h, ck, cv)."""
     cd = cfg.compute_dtype
     x = _rms_norm(h, blk["ln1"])
-    B, _, D = x.shape
+    B, Tq, D = x.shape
     R = lax.axis_size("seq")
     Tl = ck.shape[1]
-    if R > 1:
-        # member pos // Tl owns this position; everyone computes the
-        # same local slot index (pos % Tl is only meaningful on the
-        # owner, but it is always in range, and non-owners' writes are
-        # masked to a rewrite of the current value)
-        seq_mine = (pos // Tl) == lax.axis_index("seq")
-        write_mask = seq_mine if write_mask is None \
-            else jnp.logical_and(write_mask, seq_mine)
-        lpos = pos % Tl
-    else:
-        lpos = pos
     if "wqkv" in blk:
         Hl = blk["wqkv"].shape[2]
         qkv = _dense_q(column_parallel_dense, x, blk, "wqkv", cd)
-        qkv = qkv.reshape(B, 1, 3, Hl, cfg.d_head)
+        qkv = qkv.reshape(B, Tq, 3, Hl, cfg.d_head)
         q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     else:
         Hl = blk["wq"].shape[1]
         Hkvl = blk["wkv"].shape[2]
         q = _dense_q(column_parallel_dense, x, blk, "wq", cd
-                     ).reshape(B, 1, Hl, cfg.d_head)
+                     ).reshape(B, Tq, Hl, cfg.d_head)
         kv = _dense_q(column_parallel_dense, x, blk, "wkv", cd
-                      ).reshape(B, 1, 2, Hkvl, cfg.d_head)
+                      ).reshape(B, Tq, 2, Hkvl, cfg.d_head)
         k_new, v_new = kv[:, :, 0], kv[:, :, 1]
+    qpos = pos + jnp.arange(Tq)                           # (Tq,)
     if cfg.pos_embedding == "rope":
-        p1 = jnp.full((1,), pos)
-        q = apply_rope(q, p1, cfg.rope_theta)
-        k_new = apply_rope(k_new, p1, cfg.rope_theta)
+        q = apply_rope(q, qpos, cfg.rope_theta)
+        k_new = apply_rope(k_new, qpos, cfg.rope_theta)
     k_new, v_new = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
-    if write_mask is not None:
-        cur_k = lax.dynamic_slice(ck, (0, lpos, 0, 0), k_new.shape)
-        cur_v = lax.dynamic_slice(cv, (0, lpos, 0, 0), v_new.shape)
-        k_new = jnp.where(write_mask, k_new, cur_k)
-        v_new = jnp.where(write_mask, v_new, cur_v)
-    ck = lax.dynamic_update_slice(ck, k_new, (0, lpos, 0, 0))
-    cv = lax.dynamic_update_slice(cv, v_new, (0, lpos, 0, 0))
-    # grouped attention of the 1-token query against the (local block
-    # of the) cache, masked to GLOBAL positions <= pos (static shapes)
-    s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
-    kpos = jnp.arange(Tl)
-    if R > 1:
-        kpos = kpos + lax.axis_index("seq") * Tl
-    allow = kpos <= pos                                   # (Tl,)
-    if cfg.attention_window:
-        allow &= (pos - kpos) < cfg.attention_window
-    s = jnp.where(allow[None, None, None], s, _NEG)       # (B, H, 1, Tl)
-    if R > 1:
-        # stable distributed softmax: global max, then exp-sums and
-        # value partials psum'd over the seq axis.  Members whose whole
-        # block is beyond pos contribute exp(_NEG - m) ≈ 0.
-        m = lax.pmax(s.max(axis=-1, keepdims=True), "seq")
-        e = jnp.exp(s - m)
-        n = lax.psum(e.sum(axis=-1, keepdims=True), "seq")
-        o = lax.psum(_pv_mix(e, cv.astype(cd)), "seq")
-        o = (o / n).transpose(0, 2, 1, 3)                 # (B,1,Hl,Dh)
+
+    if Tq > 1 and R > 1:
+        # blockwise prefill write (pos == 0): pad the chunk's time dim
+        # to a block multiple, each member slices ITS block [r·Tl,
+        # r·Tl+Tl) (start clamped for members wholly beyond the chunk —
+        # their rows are masked invalid) and overwrites its whole local
+        # cache block under the validity mask
+        P_pad = -(-Tq // Tl) * Tl
+        r = lax.axis_index("seq")
+        start = jnp.minimum(r * Tl, P_pad - Tl)
+        g = start + jnp.arange(Tl)                        # global rows
+        valid = (start == r * Tl) & (g < Tq)              # (Tl,)
+        if write_mask is not None:
+            valid = valid & write_mask
+        vmask = valid[None, :, None, None]
+
+        def blk_write(cache, new):
+            padded = jnp.pad(
+                new, ((0, 0), (0, P_pad - Tq), (0, 0), (0, 0)))
+            sl = lax.dynamic_slice(
+                padded, (0, start, 0, 0), (B, Tl) + new.shape[2:])
+            return jnp.where(vmask, sl, cache)
+
+        ck, cv = blk_write(ck, k_new), blk_write(cv, v_new)
     else:
-        p = jax.nn.softmax(s, axis=-1)
-        o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)
-    h = h + _dense_q(row_parallel_dense, o.reshape(B, 1, -1),
+        if R > 1:
+            # member pos // Tl owns this position; everyone computes
+            # the same local slot index (pos % Tl is only meaningful on
+            # the owner, but it is always in range, and non-owners'
+            # writes are masked to a rewrite of the current value)
+            seq_mine = (pos // Tl) == lax.axis_index("seq")
+            write_mask = seq_mine if write_mask is None \
+                else jnp.logical_and(write_mask, seq_mine)
+            lpos = pos % Tl
+        else:
+            lpos = pos
+        if write_mask is not None:
+            cur_k = lax.dynamic_slice(ck, (0, lpos, 0, 0), k_new.shape)
+            cur_v = lax.dynamic_slice(cv, (0, lpos, 0, 0), v_new.shape)
+            k_new = jnp.where(write_mask, k_new, cur_k)
+            v_new = jnp.where(write_mask, v_new, cur_v)
+        ck = lax.dynamic_update_slice(ck, k_new, (0, lpos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v_new, (0, lpos, 0, 0))
+    if Tq > 1:
+        # prefill (pos == 0): the chunk's own K/V — still in hand,
+        # replicated — ARE the entire attendable set, so causal
+        # attention runs directly on them: no max_len-sized cache read
+        # (Tq × max_len masked scores would be mostly waste) and no
+        # distributed merge even under seq-KV
+        o = local_attention(q, k_new.astype(cd), v_new.astype(cd),
+                            causal=True,
+                            window=cfg.attention_window or None)
+    else:
+        # grouped attention of the query against the (local block of
+        # the) cache, masked to GLOBAL key positions <= its position
+        s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
+        kpos = jnp.arange(Tl)
+        if R > 1:
+            kpos = kpos + lax.axis_index("seq") * Tl
+        allow = kpos[None, :] <= qpos[:, None]            # (1, Tl)
+        if cfg.attention_window:
+            allow &= (qpos[:, None] - kpos[None, :]) \
+                < cfg.attention_window
+        s = jnp.where(allow[None, None], s, _NEG)         # (B, H, 1, Tl)
+        if R > 1:
+            # stable distributed softmax: global max, then exp-sums and
+            # value partials psum'd over the seq axis.  Members whose
+            # whole block is beyond pos contribute exp(_NEG - m) ≈ 0.
+            m = lax.pmax(s.max(axis=-1, keepdims=True), "seq")
+            e = jnp.exp(s - m)
+            n = lax.psum(e.sum(axis=-1, keepdims=True), "seq")
+            o = lax.psum(_pv_mix(e, cv.astype(cd)), "seq")
+            o = (o / n).transpose(0, 2, 1, 3)             # (B,1,Hl,Dh)
+        else:
+            p = jax.nn.softmax(s, axis=-1)
+            o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)
+    h = h + _dense_q(row_parallel_dense, o.reshape(B, Tq, -1),
                      blk, "wo", cd)
 
     x = _rms_norm(h, blk["ln2"])
@@ -189,7 +233,7 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             k: blk[k]
             for k in ("w1", "w2", "w1_scale", "w2_scale") if k in blk}
         out, _ = expert_parallel_moe(
-            x.reshape(B, D),
+            x.reshape(B * Tq, D),
             blk["router"].astype(cd),
             expert_params,
             expert_fn,
@@ -197,16 +241,29 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             capacity_factor=cfg.capacity_factor,
             top_k=cfg.router_top_k,
         )
-        h = h + out.reshape(B, 1, D)
+        h = h + out.reshape(B, Tq, D)
     else:
         y = jax.nn.relu(_dense_q(column_parallel_dense, x, blk, "w1", cd))
         h = h + _dense_q(row_parallel_dense, y, blk, "w2", cd)
     return h, ck, cv
 
 
-def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
-    """Next-token logits for ``tok`` (B,) at position ``pos``; updates
-    the (L_local, B, max_len, Hkv_local, Dh) cache pair.
+def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
+                 with_logits: bool = True):
+    """Next-token logits for ``tok`` — (B,) in the generation loop, or
+    a (B, Tq) chunk starting at ``pos`` for batched prefill (Tq prompt
+    tokens through ONE MXU-shaped pass instead of Tq per-token
+    dispatches; ``with_logits=False`` skips the LM head entirely, since
+    prefill only needs the cache filled).  Updates the
+    (L_local, B, kv_len_local, Hkv_local, Dh) cache pair.
+
+    MoE capacity note: chunked prefill routes all B·Tq prompt tokens
+    through expert capacity together — the TRAINING forward's
+    semantics (capacity scales with the token count routed at once) —
+    whereas per-token stepping gives every position its own B-token
+    slot budget.  At a finite ``capacity_factor`` the two can drop
+    different tokens when routing clusters temporally; ample capacity
+    makes them exact (see test_batched_prefill_matches_per_token).
 
     Pipe-parallel decode (``pipe`` axis size S > 1): device ``s`` holds
     ONLY its stage's layers and KV cache — S× model capacity — and the
@@ -223,14 +280,18 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
     cd = cfg.compute_dtype
     S = lax.axis_size("pipe")
     stage = lax.axis_index("pipe")
-    h = params["embed"][tok].astype(cd)
+    Tq = tok.shape[1] if tok.ndim == 2 else 1
+    h = params["embed"][tok].astype(cd)   # (B, D) or (B, Tq, D)
     emb_scale = params.get("embed_scale")
     if emb_scale is not None:
         # int8 embedding rows: dequantize the gathered rows only
-        h = h * emb_scale[tok][:, None].astype(cd)
+        h = h * emb_scale[tok][..., None].astype(cd)
+    if tok.ndim == 1:
+        h = h[:, None, :]
     if cfg.pos_embedding == "learned":
-        h = h + params["pos"][pos].astype(cd)
-    h = h[:, None, :].astype(cd)
+        rows = lax.dynamic_slice_in_dim(params["pos"], pos, Tq)
+        h = h + rows[None].astype(cd)
+    h = h.astype(cd)
     h = _vary(h, "pipe")
     caches = tuple(jax.tree.map(lambda c: _vary(c, "pipe"), caches))
     blocks = jax.tree.map(lambda a: jnp.squeeze(a, 0), params["blocks"])
@@ -262,12 +323,16 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos):
             sent = lax.ppermute(out, "pipe", [(p, p + 1)])
             h_in = jnp.where(stage == p + 1, sent, h_in)
     ck, cv = caches
+    if not with_logits:
+        # prefill: the cache fill IS the product; skip norm + head
+        return None, (ck, cv)
     # only the LAST stage's output is the model's hidden state; zeros
     # elsewhere make the head a masked partial whose closing psum both
     # broadcasts the logits and re-replicates the pipe axis (free at
-    # S = 1, where the mask is identity)
+    # S = 1, where the mask is identity).  Only the LAST position's
+    # logits matter (next-token), so slice before the vocab matmul.
     h = jnp.where(stage == S - 1, out, jnp.zeros_like(out))
-    h = _rms_norm(h, params["ln_f"])
+    h = _rms_norm(h[:, -1:], params["ln_f"])
     logits = jnp.einsum(
         "btd,vd->btv", h.astype(jnp.float32),
         params["embed"].astype(jnp.float32))[:, 0]
@@ -368,6 +433,14 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
         buf = jnp.zeros((B, max_len), jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
+        # batched prefill: positions 0..P-2 fill the cache in ONE
+        # MXU-shaped pass (the per-token scan below starts at the last
+        # prompt position, whose logits seed generation)
+        if Plen > 1:
+            _, cache = _decode_step(
+                cfg, params, cache, prompt[:, :Plen - 1], 0,
+                with_logits=False)
+
         def step(carry, t):
             buf, caches, key = carry
             logits, caches = _decode_step(
@@ -377,16 +450,14 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 nxt = jax.random.categorical(sub, logits / temperature)
             else:
                 nxt = jnp.argmax(logits, axis=-1)
-            # keep prompt tokens; write generated ones past the prompt
-            # (scan range is [0, max_len-1), so t+1 is always in bounds)
-            keep = t + 1 < Plen
-            cur = lax.dynamic_slice(buf, (0, t + 1), (B, 1))[:, 0]
-            val = jnp.where(keep, cur, nxt.astype(jnp.int32))
-            buf = lax.dynamic_update_slice(buf, val[:, None], (0, t + 1))
+            # the scan starts at the LAST prompt position (prefill
+            # covered the rest), so every t+1 is a generated slot
+            buf = lax.dynamic_update_slice(
+                buf, nxt.astype(jnp.int32)[:, None], (0, t + 1))
             return (buf, caches, key), None
 
         (buf, _, _), _ = lax.scan(
-            step, (buf, cache, key), jnp.arange(max_len - 1))
+            step, (buf, cache, key), jnp.arange(Plen - 1, max_len - 1))
         return buf
 
     fn = jax.jit(jax.shard_map(
@@ -443,11 +514,11 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
         cache_b = _make_cache(cfg, B, kv_len_local, kv_heads_local,
                               layers_local)
 
-        def prefill(caches, t):
-            _, caches = _decode_step(cfg, params, caches, prompt[:, t], t)
-            return caches, None
-
-        cache_b, _ = lax.scan(prefill, cache_b, jnp.arange(Plen - 1))
+        # batched prefill: positions 0..P-2 in one MXU-shaped pass
+        if Plen > 1:
+            _, cache_b = _decode_step(
+                cfg, params, cache_b, prompt[:, :Plen - 1], 0,
+                with_logits=False)
         # tile to beam width: flat row b·K + k holds batch b's beam k
         cache = tuple(jnp.repeat(c, K, axis=1) for c in cache_b)
 

@@ -181,6 +181,70 @@ def test_seq_kv_generate_matches_single_device():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+@pytest.mark.parametrize("axes,kw", [
+    (dict(data=1), {}),
+    (dict(data=2, seq=2), dict(n_kv_heads=2)),
+    (dict(pipe=2, data=2), {}),
+    (dict(data=1), dict(moe=True, n_experts=2, capacity_factor=4.0)),
+], ids=["single", "seq-kv-gqa", "pipe", "moe"])
+def test_batched_prefill_matches_per_token(axes, kw):
+    """Batched prefill (one multi-token chunk through _decode_step)
+    must leave the cache in exactly the state the per-token scan does:
+    the next step's logits are identical.
+
+    The MoE case pins capacity_factor=4.0 DELIBERATELY: at ample
+    capacity nothing drops and the two prefills are exact; at a finite
+    factor chunk routing shares one B·Tq slot budget (training-forward
+    semantics) while per-token stepping budgets per position, so drops
+    can differ — a documented semantics choice (see _decode_step),
+    not an equivalence this test could assert."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.models import param_specs
+    from chainermn_tpu.models.decoding import _decode_step, _make_cache
+
+    cfg = tiny_cfg(**kw)
+    pipe = axes.get("pipe", 1)
+    n_dev = int(np.prod(list(axes.values())))
+    mc = MeshConfig(**axes, devices=jax.devices()[:n_dev])
+    params = shard_params(
+        mc, cfg, init_transformer(jax.random.PRNGKey(6), cfg, pipe))
+    toks = prompt(seed=11)
+
+    def body(params, tk):
+        Bl, Tn = tk.shape
+        R = lax.axis_size("seq")
+        Hkvl = cfg.kv_heads // lax.axis_size("model")
+        Ll = jax.tree.leaves(params["blocks"])[0].shape[1]
+
+        def run(batched):
+            caches = _make_cache(cfg, Bl, Tn // R, Hkvl, Ll)
+            if batched:
+                _, caches = _decode_step(
+                    cfg, params, caches, tk[:, :Tn - 1], 0,
+                    with_logits=False)
+            else:
+                def stepf(c, t):
+                    _, c = _decode_step(cfg, params, c, tk[:, t], t)
+                    return c, None
+
+                caches, _ = lax.scan(stepf, caches, jnp.arange(Tn - 1))
+            logits, _ = _decode_step(
+                cfg, params, caches, tk[:, Tn - 1], Tn - 1)
+            return logits
+
+        return run(True), run(False)
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mc.mesh,
+        in_specs=(param_specs(cfg), P(("data", "expert"))),
+        out_specs=(P(("data", "expert")), P(("data", "expert")))))
+    a, b = fn(params, toks)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_seq_kv_beam_matches_single_device():
     """Beam search with the length-blocked cache: token- and
     score-identical to the seq=1 oracle (the beam path reorders caches
