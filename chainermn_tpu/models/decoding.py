@@ -403,19 +403,58 @@ def _make_cache(cfg: TransformerConfig, rows: int, kv_len_local: int,
         for _ in range(2))
 
 
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Truncated-sampling filters on (B, V) fp32 logits: keep the
+    ``top_k`` highest (0 = off) and/or the smallest set whose softmax
+    mass reaches ``top_p`` (nucleus; 1.0 = off), masking the rest to
+    ``_NEG``.  Both run on the sorted logits — one descending sort
+    serves the two filters."""
+    top_k = min(top_k, logits.shape[-1])   # k >= V is a no-op filter
+    if top_k <= 0 and top_p >= 1.0:
+        return logits
+    srt = jnp.sort(logits, axis=-1)[:, ::-1]              # descending
+    keep = jnp.ones_like(logits, bool)
+    if top_k > 0:
+        kth = srt[:, top_k - 1][:, None]
+        keep &= logits >= kth
+    if top_p < 1.0:
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # the cutoff value: smallest sorted logit still inside the
+        # nucleus (the first rank where cumulative mass reaches top_p
+        # is always included, matching the usual shift-by-one rule)
+        inside = (cum - probs) < top_p                    # (B, V) sorted
+        n_keep = inside.sum(axis=-1)                      # >= 1
+        cut = jnp.take_along_axis(
+            srt, (n_keep - 1)[:, None], axis=-1)
+        keep &= logits >= cut
+    return jnp.where(keep, logits, _NEG)
+
+
 def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                      max_len: int = 0, temperature: float = 0.0,
+                     top_k: int = 0, top_p: float = 1.0,
                      quantized: bool = False):
     """Build ``generate(params, prompt, key=None) -> (B, max_len)``.
 
     ``prompt``: (B, P) int32, left-aligned (no padding support — equal
     prompt lengths, the same contract as the reference's translate
     batches); generation fills positions P..max_len-1.  Greedy when
-    ``temperature == 0``, else temperature sampling (``key`` required).
-    ``quantized=True`` expects int8 weight-only params from
-    :func:`...quantization.quantize_params_int8` (≈half the HBM traffic
-    per token).
+    ``temperature == 0``, else temperature sampling (``key`` required)
+    optionally truncated by ``top_k`` (keep the k best tokens) and/or
+    ``top_p`` (nucleus: the smallest set reaching that softmax mass —
+    filters compose, both applied to the raw logits before the
+    temperature).  ``quantized=True`` expects int8 weight-only params
+    from :func:`...quantization.quantize_params_int8` (≈half the HBM
+    traffic per token).
     """
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"top_k={top_k} must be >= 0 and top_p={top_p} in (0, 1]")
+    if (top_k > 0 or top_p < 1.0) and temperature <= 0.0:
+        raise ValueError(
+            "top_k/top_p truncate SAMPLING: set temperature > 0 "
+            "(greedy decoding always takes the argmax)")
     max_len, kv_len_local, kv_heads_local, layers_local = _decode_preamble(
         mesh_cfg, cfg, max_len)
     specs = param_specs(cfg, quantized=quantized)
@@ -447,7 +486,9 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 cfg, params, caches, buf[:, t], t)
             if temperature > 0.0:
                 key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, logits / temperature)
+                nxt = jax.random.categorical(
+                    sub, _filter_logits(logits, top_k, top_p)
+                    / temperature)
             else:
                 nxt = jnp.argmax(logits, axis=-1)
             # the scan starts at the LAST prompt position (prefill

@@ -47,6 +47,10 @@ def main():
                         "repeated across the batch)")
     p.add_argument("--batchsize", type=int, default=8)
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0,
+                   help="sample from the k best tokens only (0 = off)")
+    p.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling mass cutoff (1.0 = off)")
     p.add_argument("--beam", type=int, default=0,
                    help="beam size; 0 = greedy/sampling")
     p.add_argument("--int8", action="store_true",
@@ -126,7 +130,8 @@ def main():
     else:
         gen = make_generate_fn(
             mc, cfg, max_len=args.max_len,
-            temperature=args.temperature, quantized=args.int8)
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, quantized=args.int8)
         out = gen(params, prompt, key=jax.random.PRNGKey(args.seed))
         print("generated:", np.asarray(out)[0].tolist())
     return out

@@ -152,6 +152,54 @@ def test_sampling_needs_key_and_differs():
     assert not np.array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_filter_logits_masks_expected_sets():
+    from chainermn_tpu.models.decoding import _NEG, _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # top_k keeps exactly the k best
+    out = np.asarray(_filter_logits(logits, 2, 1.0))
+    assert (out[0, :2] > _NEG / 2).all() and (out[0, 2:] <= _NEG / 2).all()
+    # nucleus: the first rank reaching 0.7 mass is included, rest cut
+    # (0.7 sits strictly between the 0.5 and 0.8 cumulative masses, so
+    # fp32 rounding of the log->softmax->cumsum roundtrip can't flip
+    # membership at the boundary)
+    out = np.asarray(_filter_logits(logits, 0, 0.7))
+    assert (out[0, :2] > _NEG / 2).all() and (out[0, 2:] <= _NEG / 2).all()
+    # k beyond the vocab is a no-op, not an index error
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(logits, 99, 1.0)), np.asarray(logits))
+    # off-filters are the identity
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(logits, 0, 1.0)), np.asarray(logits))
+    # filters compose: top_k=1 dominates a loose nucleus
+    out = np.asarray(_filter_logits(logits, 1, 0.99))
+    assert (out[0, 1:] <= _NEG / 2).all()
+
+
+def test_top_k1_sampling_is_greedy():
+    """top_k=1 sampling must reproduce greedy token-for-token at any
+    temperature (only the argmax survives the filter)."""
+    cfg = tiny_cfg()
+    mc = MeshConfig(data=1, devices=jax.devices()[:1])
+    params = shard_params(
+        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+    p = prompt(length=4)
+    greedy = make_generate_fn(mc, cfg, max_len=12)(params, p)
+    topk1 = make_generate_fn(
+        mc, cfg, max_len=12, temperature=5.0, top_k=1)(
+        params, p, key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
+
+
+def test_sampling_filter_validation():
+    cfg = tiny_cfg()
+    mc = MeshConfig(data=1, devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="temperature"):
+        make_generate_fn(mc, cfg, max_len=12, top_k=5)
+    with pytest.raises(ValueError, match="top_p"):
+        make_generate_fn(mc, cfg, max_len=12, temperature=1.0, top_p=0.0)
+
+
 def test_decode_mesh_validation():
     cfg = tiny_cfg()
     # seq-KV blocks the cache over seq: max_len must divide evenly
