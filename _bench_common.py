@@ -98,7 +98,8 @@ MAX_CACHE_AGE_DAYS = 14
 
 
 def freshest_cached(metric: str, match: dict | None = None,
-                    max_age_days: float = MAX_CACHE_AGE_DAYS):
+                    max_age_days: float = MAX_CACHE_AGE_DAYS,
+                    require: tuple = ()):
     """Newest cached run for ``metric`` with a non-null value.
 
     ``match`` restricts to runs whose recorded fields equal the given
@@ -107,7 +108,10 @@ def freshest_cached(metric: str, match: dict | None = None,
     full-size gate workload.  A run that predates the recording of a
     matched field (key absent) passes — every NEW run records its full
     workload config, so the leniency only covers legacy entries and
-    retires itself.  The same applies to timestamps: entries older
+    retires itself.  ``require`` names match keys that must be PRESENT
+    in the run: a NON-DEFAULT workload arm (e.g. ``--loss-chunk 512``)
+    must never be served a legacy entry that was silently measured at
+    the default.  The same applies to timestamps: entries older
     than ``max_age_days`` are skipped, legacy pre-timestamp entries
     pass.  Entries are appended chronologically; the last match wins.
     """
@@ -117,6 +121,8 @@ def freshest_cached(metric: str, match: dict | None = None,
             continue
         if match and any(k in run and run[k] != v
                          for k, v in match.items()):
+            continue
+        if any(k not in run for k in require):
             continue
         ts = run.get("timestamp")
         if ts is not None:
@@ -132,7 +138,7 @@ def freshest_cached(metric: str, match: dict | None = None,
 
 def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
                            use_cache=True, cache_match=None,
-                           fallback=True) -> int:
+                           fallback=True, cache_require=()) -> int:
     """Run ``cmd`` under per-attempt timeouts until one prints a
     ``BENCH_RESULT`` line; always print exactly one JSON line.
 
@@ -181,7 +187,7 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
             f"attempt {attempt + 1}: rc={proc.returncode}, "
             f"last output: {' | '.join(tail[-3:]) if tail else '<none>'}")
     error = "; ".join(errors)[-1800:]
-    cached = freshest_cached(metric, cache_match) \
+    cached = freshest_cached(metric, cache_match, require=cache_require) \
         if (use_cache and fallback) else None
     if cached is not None:
         out = dict(cached)

@@ -32,6 +32,8 @@ HEARTBEAT_S = 90 * 60         # between battery refreshes once live
 BATTERY = [
     (["python", "bench.py"], 900),
     (["python", "bench_transformer.py"], 1500),
+    # loss_chunk A/B: the SPEED.md candidate-#1 whole-step comparison
+    (["python", "bench_transformer.py", "--loss-chunk", "512"], 1500),
     (["python", "bench_breakdown.py"], 2400),
     (["python", "bench_levers.py"], 1800),
     (["python", "bench_decode.py"], 1500),

@@ -27,7 +27,7 @@ UNIT = "tokens/sec/chip"
 
 def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         n_kv_heads=0, warmup=3, iters=10, attention="flash",
-        remat_policy="full"):
+        remat_policy="full", loss_chunk=0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -50,6 +50,7 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         # recompute.
         remat=remat_policy != "none",
         remat_policy=remat_policy if remat_policy != "none" else "full",
+        loss_chunk=loss_chunk,
     )
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
     params = shard_params(
@@ -100,6 +101,7 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         "attention": attention,
         "n_kv_heads": n_kv_heads,
         "remat_policy": remat_policy,
+        "loss_chunk": loss_chunk,
         "loss": round(float(loss), 3),
     }
 
@@ -110,7 +112,8 @@ def _child_main(args):
                  n_layers=args.n_layers, n_heads=args.n_heads,
                  n_kv_heads=args.n_kv_heads, warmup=args.warmup,
                  iters=args.iters, attention=args.attention,
-                 remat_policy=args.remat_policy)
+                 remat_policy=args.remat_policy,
+                 loss_chunk=args.loss_chunk)
     print("BENCH_RESULT " + json.dumps(result))
 
 
@@ -124,7 +127,8 @@ def _parent_main(args):
            "--n-kv-heads", str(args.n_kv_heads),
            "--warmup", str(args.warmup), "--iters", str(args.iters),
            "--attention", args.attention,
-           "--remat-policy", args.remat_policy]
+           "--remat-policy", args.remat_policy,
+           "--loss-chunk", str(args.loss_chunk)]
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(
@@ -132,7 +136,11 @@ def _parent_main(args):
         use_cache=args.platform is None,
         cache_match={"batch": args.batch, "seq": args.seq,
                      "d_model": args.d_model, "n_layers": args.n_layers,
-                     "attention": args.attention})
+                     "attention": args.attention,
+                     "loss_chunk": args.loss_chunk},
+        # a non-default chunk arm must never be served a legacy entry
+        # that predates the loss_chunk field (= measured at 0)
+        cache_require=("loss_chunk",) if args.loss_chunk else ())
 
 
 def _parse_args(argv):
@@ -148,6 +156,10 @@ def _parse_args(argv):
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--attention", default="flash",
                    choices=["flash", "local", "ring", "ulysses"])
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help="chunked-vocab cross-entropy chunk size "
+                        "(0 = whole-shard logits); A/B the SPEED.md "
+                        "candidate on hardware")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "dots", "none"])
     p.add_argument("--platform", default=None)

@@ -43,8 +43,10 @@ from chainermn_tpu.parallel.tensor import (
 
 from .transformer import (
     TransformerConfig,
+    _all_gather_invariant,
     _check_mesh,
     _rms_norm,
+    _vp_embed_lookup,
     apply_rope,
     param_specs,
 )
@@ -281,11 +283,17 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
     S = lax.axis_size("pipe")
     stage = lax.axis_index("pipe")
     Tq = tok.shape[1] if tok.ndim == 2 else 1
-    h = params["embed"][tok].astype(cd)   # (B, D) or (B, Tq, D)
     emb_scale = params.get("embed_scale")
-    if emb_scale is not None:
-        # int8 embedding rows: dequantize the gathered rows only
-        h = h * emb_scale[tok][..., None].astype(cd)
+    if cfg.vocab_parallel:
+        # int8 scales (sharded like the rows) apply before the single
+        # psum inside the lookup — one collective either way
+        h = _vp_embed_lookup(
+            params["embed"], tok, scale_local=emb_scale).astype(cd)
+    else:
+        h = params["embed"][tok].astype(cd)   # (B, D) or (B, Tq, D)
+        if emb_scale is not None:
+            # int8 embedding rows: dequantize the gathered rows only
+            h = h * emb_scale[tok][..., None].astype(cd)
     if tok.ndim == 1:
         h = h[:, None, :]
     if cfg.pos_embedding == "learned":
@@ -338,8 +346,15 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
         params["embed"].astype(jnp.float32))[:, 0]
     if emb_scale is not None:
         # per-vocab-row scale applies to the logits output channel
+        # (with vocab_parallel both are the same local shard width)
         logits = logits * emb_scale[None, :]
-    return lax.psum(logits, "pipe"), (ck, cv)
+    logits = lax.psum(logits, "pipe")
+    if cfg.vocab_parallel:
+        # samplers want full-width logits: gather the vocab shards
+        # (invariant: identical on every model member afterwards)
+        logits = _all_gather_invariant(
+            logits, "model", axis=1, tiled=True)
+    return logits, (ck, cv)
 
 
 def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):

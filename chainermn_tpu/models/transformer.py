@@ -51,6 +51,13 @@ from chainermn_tpu.parallel.ring_attention import (
     local_attention,
     ring_attention,
 )
+try:  # public from jax 0.9.x-nightlies on; same primitive either way
+    from jax.lax import all_gather_invariant as _all_gather_invariant
+except ImportError:  # pragma: no cover - version-dependent import path
+    from jax._src.lax.parallel import (
+        all_gather_invariant as _all_gather_invariant,
+    )
+
 from chainermn_tpu.parallel.tensor import (
     column_parallel_dense,
     row_parallel_dense,
@@ -135,6 +142,14 @@ class TransformerConfig:
     # param dtype (fp32 — bit-comparable with fsdp=False); "bfloat16"
     # halves the per-layer gather + grad reduce-scatter wire bytes (the
     # allreduce_grad_dtype analogue for the FSDP path)
+    vocab_parallel: bool = False  # Megatron-style vocab TP: the tied
+    # embedding's vocab dim shards over ``model``.  The LM head computes
+    # only its (B, T, V/M) logits slice — the step's biggest matmul and
+    # its two grad matmuls shrink M× per device — and the cross-entropy
+    # reduces over vocab shards with three tiny collectives (pmax of
+    # the max, psum of the exp-sum, psum of the owner's target logit);
+    # the embedding lookup becomes a masked local gather + one (B,T,D)
+    # psum.  Embed param + grad + moments also land at V/M per device.
     loss_chunk: int = 0  # 0 => one whole-shard (B, T, V) logits tensor
     # (fp32, XLA fuses log-softmax into its consumers); N>0 => the LM
     # head + cross-entropy run in token chunks of N via a custom VJP
@@ -199,6 +214,11 @@ class TransformerConfig:
         if self.loss_chunk < 0:
             raise ValueError(
                 f"loss_chunk={self.loss_chunk} must be >= 0")
+        if self.vocab_parallel and self.loss_chunk:
+            raise ValueError(
+                "vocab_parallel and loss_chunk are alternative "
+                "logits-memory strategies (vocab-sharded vs token-"
+                "chunked); composing them is not supported — pick one")
         if self.moe and not 1 <= self.router_top_k <= self.n_experts:
             raise ValueError(
                 f"router_top_k={self.router_top_k} must be in "
@@ -506,13 +526,14 @@ def param_specs(cfg: TransformerConfig, quantized: bool = False):
             if name in blk and name not in ("router",):
                 blk[name + "_scale"] = scale_spec(
                     blk[name], base_rank, base_axes, prefix + base_rank)
+    emb = P("model") if cfg.vocab_parallel else P()
     specs = {
-        "embed": P(),
+        "embed": emb,
         "blocks": blk,
         "ln_f": P(),
     }
     if quantized:
-        specs["embed_scale"] = P()
+        specs["embed_scale"] = emb
     if cfg.pos_embedding == "learned":
         specs["pos"] = P()
     return specs
@@ -670,10 +691,113 @@ def _head_nll_bwd(cd, chunk, res, g):
 _head_nll.defvjp(_head_nll_fwd, _head_nll_bwd)
 
 
+def _vp_embed_lookup(embed_local, tokens, axis_name: str = "model",
+                     scale_local=None):
+    """Vocab-parallel embedding gather: member r holds vocab rows
+    [r·Vl, (r+1)·Vl); out-of-shard tokens contribute zero and ONE psum
+    assembles the full (..., D) rows — Megatron's VocabParallelEmbedding
+    shape.  AD's transpose scatter-adds each member's cotangent rows
+    into its own shard only (the masked gather keeps it local).
+    ``scale_local`` (the int8 path's per-row dequant scales, sharded
+    like the rows) applies BEFORE the psum so quantized lookups still
+    cost a single collective."""
+    Vl = embed_local.shape[0]
+    loc = tokens - lax.axis_index(axis_name) * Vl
+    ok = (loc >= 0) & (loc < Vl)
+    idx = jnp.clip(loc, 0, Vl - 1)
+    rows = embed_local[idx]
+    if scale_local is not None:
+        rows = rows.astype(scale_local.dtype) \
+            * scale_local[idx][..., None]
+    return lax.psum(jnp.where(ok[..., None], rows, 0), axis_name)
+
+
+@partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _stop_pmax(x, axis_name):
+    """``pmax`` with a pinned zero tangent: jax has no differentiation
+    rule for pmax, and the softmax max anchor genuinely carries no
+    gradient (the lse derivative is exact without it), so declare that
+    instead of tracing into the primitive."""
+    return lax.pmax(x, axis_name)
+
+
+@_stop_pmax.defjvp
+def _stop_pmax_jvp(axis_name, primals, tangents):
+    (x,) = primals
+    out = lax.pmax(x, axis_name)
+    return out, jnp.zeros_like(out)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _vp_head(cd, axis_name, h, embed_local):
+    """Local vocab-shard logits slice with :func:`_lm_head`'s dtype
+    discipline: compute-dtype operands on the MXU, fp32 accumulation —
+    including BOTH transposed gradient matmuls, which a plain einsum
+    would run as fp32 dots against the fp32 logits cotangent."""
+    return jnp.einsum("btd,vd->btv", h.astype(cd),
+                      embed_local.astype(cd),
+                      preferred_element_type=jnp.float32)
+
+
+def _vp_head_fwd(cd, axis_name, h, embed_local):
+    return _vp_head(cd, axis_name, h, embed_local), (h, embed_local)
+
+
+def _vp_head_bwd(cd, axis_name, res, g):
+    h, embed_local = res
+    gl = g.astype(cd)
+    # h is replicated over the vocab axis but consumed by per-shard
+    # slices: its true cotangent is the SUM of the members' partials
+    # (the psum shard_map AD would insert for the plain einsum)
+    dh = lax.psum(
+        jnp.einsum("btv,vd->btd", gl, embed_local.astype(cd),
+                   preferred_element_type=jnp.float32).astype(h.dtype),
+        axis_name)
+    dw = jnp.einsum("btv,btd->vd", gl, h.astype(cd),
+                    preferred_element_type=jnp.float32
+                    ).astype(embed_local.dtype)
+    # the embed SHARD's cotangent psums over the batch-like axes it is
+    # invariant on — but NOT over the vocab axis (each member's shard
+    # gradient is distinct; summing them would be wrong)
+    vma = tuple(a for a in jax.typeof(dw).vma if a != axis_name)
+    if vma:
+        dw = lax.psum(dw, vma)
+    return dh, dw
+
+
+_vp_head.defvjp(_vp_head_fwd, _vp_head_bwd)
+
+
+def _vp_nll_sum(cd, h, embed_local, targets, axis_name: str = "model"):
+    """Vocab-parallel cross-entropy NLL **sum** (Megatron-style).
+
+    Each member computes only its (B, T, V/M) logits slice — the head
+    matmul and both of its grad matmuls shrink M× — and the softmax
+    reduces across shards with three query-sized collectives: pmax of
+    the row max (under stop_gradient: it only anchors the exp), psum of
+    the exp-sum, psum of the owner's target logit."""
+    logits = _vp_head(cd, axis_name, h, embed_local)
+    m = _stop_pmax(jnp.max(lax.stop_gradient(logits), axis=-1),
+                   axis_name)                             # (B, T)
+    se = lax.psum(
+        jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis_name)
+    lse = jnp.log(se) + m                                 # (B, T)
+    Vl = embed_local.shape[0]
+    loc = targets - lax.axis_index(axis_name) * Vl
+    ok = (loc >= 0) & (loc < Vl)
+    tl = jnp.take_along_axis(
+        logits, jnp.clip(loc, 0, Vl - 1)[..., None], axis=-1)[..., 0]
+    tl = lax.psum(jnp.where(ok, tl, 0.0), axis_name)
+    return jnp.sum(lse - tl)
+
+
 def _shard_nll_sum(cfg, h_normed, embed, targets):
     """Local-shard NLL **sum** through the configured head path:
+    ``vocab_parallel`` reduces over model-axis vocab shards,
     ``loss_chunk > 0`` takes the chunked custom-VJP head, else the whole
     shard's logits materialise once through :func:`_lm_head`."""
+    if cfg.vocab_parallel:
+        return _vp_nll_sum(cfg.compute_dtype, h_normed, embed, targets)
     chunk = cfg.loss_chunk
     if chunk > 0:
         # chunk == T is the C=1 edge of the chunked path; a chunk that
@@ -892,7 +1016,10 @@ def transformer_backbone(cfg: TransformerConfig, params, tokens):
     B, T = tokens.shape
     r = lax.axis_index("seq")
 
-    h = params["embed"][tokens]                        # (B, T, D) fp32
+    if cfg.vocab_parallel:
+        h = _vp_embed_lookup(params["embed"], tokens)  # (B, T, D) fp32
+    else:
+        h = params["embed"][tokens]                    # (B, T, D) fp32
     if cfg.pos_embedding == "rope":
         h = h.astype(cd)          # rotations happen inside attention
     elif cfg.seq_layout == "zigzag":
@@ -965,8 +1092,19 @@ def transformer_forward(cfg: TransformerConfig, params, tokens):
     Whole-shard logits through the weight-tied head (fp32 for a stable
     softmax, compute-dtype matmul operands — see :func:`_lm_head`);
     decoding and forward-only callers want the actual logits tensor, so
-    ``loss_chunk`` does not apply here."""
+    ``loss_chunk`` does not apply here and ``vocab_parallel`` gathers
+    the vocab shards back to full width (training's loss path never
+    pays that gather — see :func:`_vp_nll_sum`)."""
     h, aux = transformer_backbone(cfg, params, tokens)
+    if cfg.vocab_parallel:
+        # _vp_head, not _lm_head: the latter's custom VJP psums the
+        # embed cotangent over every varying axis, which would wrongly
+        # sum the DISTINCT vocab shards over model
+        logits = _vp_head(cfg.compute_dtype, "model", h, params["embed"])
+        # invariant gather: the full logits are identical on every
+        # model member, and the vma type must say so for out_specs
+        return _all_gather_invariant(
+            logits, "model", axis=2, tiled=True), aux
     return _lm_head(cfg.compute_dtype, h, params["embed"]), aux
 
 
@@ -1017,7 +1155,10 @@ def _make_1f1b_grad(cfg: TransformerConfig):
         r = lax.axis_index("seq")
 
         def embed_fn(ep):
-            h = ep["embed"][inputs]
+            if cfg.vocab_parallel:
+                h = _vp_embed_lookup(ep["embed"], inputs)
+            else:
+                h = ep["embed"][inputs]
             if cfg.pos_embedding == "rope":
                 return h.astype(cd)
             pos = lax.dynamic_slice_in_dim(ep["pos"], r * T, T, axis=0)
@@ -1101,6 +1242,10 @@ def _check_mesh(mesh_cfg, cfg: TransformerConfig):
             "divide — they replicate up to lcm for the exchange — and "
             "ring attention keeps them at true width if the surplus "
             "factor matters")
+    if cfg.vocab_parallel and cfg.vocab_size % mp:
+        raise ValueError(
+            f"vocab_parallel shards the vocab dim over the model axis: "
+            f"vocab_size={cfg.vocab_size} must be divisible by {mp}")
     dp = mesh_cfg.mesh.shape.get("data", 1)
     if cfg.fsdp and cfg.d_model % dp:
         raise ValueError(

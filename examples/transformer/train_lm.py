@@ -72,6 +72,12 @@ def main():
                    choices=["learned", "rope"])
     p.add_argument("--n-kv-heads", type=int, default=0)
     p.add_argument("--window", type=int, default=0)
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help="chunked-vocab cross-entropy chunk size "
+                        "(0 = whole-shard logits)")
+    p.add_argument("--vocab-parallel", action="store_true",
+                   help="shard the tied embedding's vocab dim over the "
+                        "model axis (Megatron vocab TP)")
     p.add_argument("--moe", action="store_true")
     p.add_argument("--router-top-k", type=int, default=1,
                    help="experts per token (1=Switch, 2=GShard top-2)")
@@ -128,6 +134,8 @@ def main():
         seq_layout=args.seq_layout,
         moe=args.moe, n_experts=max(2 * axes.get("expert", 1), 2),
         router_top_k=args.router_top_k if args.moe else 1,
+        loss_chunk=args.loss_chunk,
+        vocab_parallel=args.vocab_parallel,
         num_microbatches=2 if pipe > 1 else 1,
         pipeline_schedule=args.schedule, virtual_pipe=V,
         fsdp=args.fsdp,
