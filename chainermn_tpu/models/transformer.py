@@ -51,13 +51,9 @@ from chainermn_tpu.parallel.ring_attention import (
     local_attention,
     ring_attention,
 )
-try:  # public from jax 0.9.x-nightlies on; same primitive either way
-    from jax.lax import all_gather_invariant as _all_gather_invariant
-except ImportError:  # pragma: no cover - version-dependent import path
-    from jax._src.lax.parallel import (
-        all_gather_invariant as _all_gather_invariant,
-    )
-
+from chainermn_tpu.parallel._compat import (
+    all_gather_invariant as _all_gather_invariant,
+)
 from chainermn_tpu.parallel.tensor import (
     column_parallel_dense,
     row_parallel_dense,
@@ -691,6 +687,15 @@ def _head_nll_bwd(cd, chunk, res, g):
 _head_nll.defvjp(_head_nll_fwd, _head_nll_bwd)
 
 
+def _vp_shard_index(Vl: int, tokens, axis_name: str):
+    """Vocab-ownership arithmetic, in ONE place: member r owns rows
+    [r·Vl, (r+1)·Vl).  Returns ``(ok, idx)`` — whether each token's row
+    lives on THIS member, and its clipped local index (only meaningful
+    where ``ok``; callers mask)."""
+    loc = tokens - lax.axis_index(axis_name) * Vl
+    return (loc >= 0) & (loc < Vl), jnp.clip(loc, 0, Vl - 1)
+
+
 def _vp_embed_lookup(embed_local, tokens, axis_name: str = "model",
                      scale_local=None):
     """Vocab-parallel embedding gather: member r holds vocab rows
@@ -701,10 +706,7 @@ def _vp_embed_lookup(embed_local, tokens, axis_name: str = "model",
     ``scale_local`` (the int8 path's per-row dequant scales, sharded
     like the rows) applies BEFORE the psum so quantized lookups still
     cost a single collective."""
-    Vl = embed_local.shape[0]
-    loc = tokens - lax.axis_index(axis_name) * Vl
-    ok = (loc >= 0) & (loc < Vl)
-    idx = jnp.clip(loc, 0, Vl - 1)
+    ok, idx = _vp_shard_index(embed_local.shape[0], tokens, axis_name)
     rows = embed_local[idx]
     if scale_local is not None:
         rows = rows.astype(scale_local.dtype) \
@@ -758,8 +760,16 @@ def _vp_head_bwd(cd, axis_name, res, g):
                     ).astype(embed_local.dtype)
     # the embed SHARD's cotangent psums over the batch-like axes it is
     # invariant on — but NOT over the vocab axis (each member's shard
-    # gradient is distinct; summing them would be wrong)
-    vma = tuple(a for a in jax.typeof(dw).vma if a != axis_name)
+    # gradient is distinct; summing them would be wrong).  Same error
+    # contract as _lm_head_bwd's "No silent fallback" note.
+    try:
+        vma = tuple(jax.typeof(dw).vma)
+    except AttributeError:  # pragma: no cover - older jax: no vma typing
+        raise RuntimeError(
+            "_vp_head needs jax.typeof(...).vma (shard_map varying-"
+            "axes typing) to place the embed-shard-gradient psum; this "
+            "jax version does not expose it") from None
+    vma = tuple(a for a in vma if a != axis_name)
     if vma:
         dw = lax.psum(dw, vma)
     return dh, dw
@@ -782,11 +792,8 @@ def _vp_nll_sum(cd, h, embed_local, targets, axis_name: str = "model"):
     se = lax.psum(
         jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis_name)
     lse = jnp.log(se) + m                                 # (B, T)
-    Vl = embed_local.shape[0]
-    loc = targets - lax.axis_index(axis_name) * Vl
-    ok = (loc >= 0) & (loc < Vl)
-    tl = jnp.take_along_axis(
-        logits, jnp.clip(loc, 0, Vl - 1)[..., None], axis=-1)[..., 0]
+    ok, idx = _vp_shard_index(embed_local.shape[0], targets, axis_name)
+    tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
     tl = lax.psum(jnp.where(ok, tl, 0.0), axis_name)
     return jnp.sum(lse - tl)
 

@@ -1,0 +1,13 @@
+"""Version-dependent jax imports, kept in ONE place.
+
+``all_gather_invariant`` is the shard_map primitive that gathers a
+varying value into an identical (vma-invariant) full array on every
+axis member — public from jax 0.9.x-nightlies on, private before.
+"""
+
+try:  # public from jax 0.9.x-nightlies on; same primitive either way
+    from jax.lax import all_gather_invariant
+except ImportError:  # pragma: no cover - version-dependent import path
+    from jax._src.lax.parallel import all_gather_invariant
+
+__all__ = ["all_gather_invariant"]

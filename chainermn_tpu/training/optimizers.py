@@ -186,12 +186,9 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
-try:  # public from jax 0.9.x-nightlies on; same primitive either way
-    from jax.lax import all_gather_invariant as _all_gather_invariant
-except ImportError:  # pragma: no cover - version-dependent import path
-    from jax._src.lax.parallel import (
-        all_gather_invariant as _all_gather_invariant,
-    )
+from chainermn_tpu.parallel._compat import (
+    all_gather_invariant as _all_gather_invariant,
+)
 
 
 def _ensure_varying(x, axis_name):

@@ -731,6 +731,52 @@ def scenario_pp_train(comm):
                                        rtol=1e-6, atol=1e-6)
 
 
+def scenario_decode(comm):
+    """Model-parallel DECODE across the process boundary: 2 processes ×
+    1 device.  Two meshes: ``seq=2`` (sequence-parallel KV — every
+    generated token's pmax/psum softmax merge is a real cross-process
+    collective) and ``model=2`` with ``vocab_parallel`` (the embedding
+    lookup psum and the logits all-gather cross processes).  Greedy
+    tokens must be IDENTICAL to the process-local single-device decode
+    — sampling amplifies any logit drift into divergent sequences, so
+    exact token equality is the right bar."""
+    import dataclasses
+
+    from chainermn_tpu.models import (
+        init_transformer, make_generate_fn, shard_params,
+    )
+    from chainermn_tpu.parallel import MeshConfig
+
+    assert jax.process_count() == 2 and len(jax.local_devices()) == 1
+    base = _tiny_cfg()
+    host = init_transformer(jax.random.PRNGKey(2), base)
+    import jax.numpy as jnp
+
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, base.vocab_size, (4, 3)),
+        jnp.int32)
+
+    one = MeshConfig(data=1, devices=[jax.local_devices()[0]])
+    ref = np.asarray(
+        make_generate_fn(one, base, max_len=8)(
+            shard_params(one, base, host), prompt))
+
+    for name, axes, cfg in (
+        ("seq-kv", dict(seq=2, data=1), base),
+        ("vocab-parallel", dict(model=2, data=1),
+         dataclasses.replace(base, vocab_parallel=True)),
+    ):
+        mc = MeshConfig(devices=jax.devices(), **axes)
+        got = np.asarray(
+            make_generate_fn(mc, cfg, max_len=8)(
+                shard_params(mc, cfg, host), prompt))
+        np.testing.assert_array_equal(
+            got, ref, err_msg=f"cross-process {name} decode diverged")
+        all_toks = comm.allgather_obj(got.tolist())
+        assert all(t == all_toks[0] for t in all_toks[1:]), \
+            f"{name}: processes disagree on generated tokens"
+
+
 def scenario_sp_ep_train(comm):
     """Sequence parallelism (ring attention's ppermute chain) and
     expert parallelism (Switch MoE's all-to-alls) ACROSS the process

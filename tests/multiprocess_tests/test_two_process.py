@@ -75,6 +75,12 @@ class TestTwoProcess:
         # process boundary (seq=2 / expert=2 over 2 processes)
         mp_run("sp_ep_train", timeout=300)
 
+    def test_decode(self, mp_run):
+        # per-token seq-KV softmax merges and vocab-parallel lookup/
+        # gather collectives cross the process boundary; tokens equal
+        # the process-local oracle exactly
+        mp_run("decode", timeout=300)
+
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
 
