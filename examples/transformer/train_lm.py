@@ -44,6 +44,28 @@ def parse_mesh(spec: str):
     return axes
 
 
+def make_text_batches(path, vocab, batch, seq, steps, seed=0):
+    """Real-data path: byte-level LM batches from a text file.
+
+    Bytes ARE the tokens (ids 0-255, so ``--vocab`` must be >= 256 —
+    the spare ids simply go unused); each batch row is a random
+    contiguous (seq+1)-byte window.  The reference's examples consumed
+    real files the same minimal way (no tokenizer dependency)."""
+    if vocab < 256:
+        raise SystemExit(
+            f"--text-file is byte-level: --vocab {vocab} must be >= 256")
+    data = np.frombuffer(open(path, "rb").read(), np.uint8)
+    if data.size < seq + 1:
+        raise SystemExit(
+            f"{path}: {data.size} bytes < seq+1 = {seq + 1}")
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        starts = rng.randint(0, data.size - seq, batch)
+        x = np.stack([data[s:s + seq + 1] for s in starts]).astype(
+            np.int32)
+        yield x[:, :-1], x[:, 1:]
+
+
 def make_batches(vocab, batch, seq, steps, seed=0):
     """Sequences following tok[t+1] = (a*tok[t] + b) % vocab with 10%
     noise — enough structure that a few dozen steps visibly cut loss."""
@@ -72,6 +94,10 @@ def main():
                    choices=["learned", "rope"])
     p.add_argument("--n-kv-heads", type=int, default=0)
     p.add_argument("--window", type=int, default=0)
+    p.add_argument("--text-file", default=None,
+                   help="train on a REAL text file, byte-level tokens "
+                        "(needs --vocab >= 256); default is synthetic "
+                        "data")
     p.add_argument("--loss-chunk", type=int, default=0,
                    help="chunked-vocab cross-entropy chunk size "
                         "(0 = whole-shard logits)")
@@ -203,11 +229,16 @@ def main():
 
         perm = zigzag_indices(axes.get("seq", 1), args.seq).reshape(-1)
 
+    if args.text_file:
+        batches = make_text_batches(
+            args.text_file, args.vocab, args.batchsize, args.seq,
+            args.steps - start, seed=start)
+    else:
+        batches = make_batches(args.vocab, args.batchsize, args.seq,
+                               args.steps - start, seed=start)
     first = last = None
     t0 = time.perf_counter()
-    for i, (x, y) in enumerate(
-            make_batches(args.vocab, args.batchsize, args.seq,
-                         args.steps - start, seed=start)):
+    for i, (x, y) in enumerate(batches):
         if perm is not None:
             x, y = x[:, perm], y[:, perm]
         params, opt_state, loss = step(
