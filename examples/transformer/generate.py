@@ -55,6 +55,10 @@ def main():
                    help="beam size; 0 = greedy/sampling")
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode")
+    p.add_argument("--vocab-parallel", action="store_true",
+                   help="shard the tied embedding over the model axis "
+                        "(serving-side Megatron vocab TP: V/M embed "
+                        "rows resident per device)")
     p.add_argument("--checkpoint", default=None,
                    help="train_lm.py checkpoint dir to load params from")
     p.add_argument("--seed", type=int, default=0)
@@ -82,6 +86,7 @@ def main():
         n_kv_heads=args.n_kv_heads, d_ff=4 * args.d_model,
         n_layers=args.n_layers, max_seq=args.max_len,
         attention="local", pos_embedding=args.pos_embedding,
+        vocab_parallel=args.vocab_parallel,
         dtype="float32", remat=False,
     )
 

@@ -44,26 +44,42 @@ def parse_mesh(spec: str):
     return axes
 
 
+def check_text_args(path, vocab, seq):
+    """Fail fast on --text-file misconfiguration: called right after
+    argument parsing, BEFORE the mesh/params/compile work, so a typo'd
+    path or too-small vocab costs seconds, not a full model setup."""
+    if vocab < 256:
+        raise SystemExit(
+            f"--text-file is byte-level: --vocab {vocab} must be >= 256")
+    if not os.path.exists(path):
+        raise SystemExit(f"--text-file {path}: no such file")
+    if os.path.getsize(path) < seq + 1:
+        raise SystemExit(
+            f"{path}: {os.path.getsize(path)} bytes < seq+1 = {seq + 1}")
+
+
 def make_text_batches(path, vocab, batch, seq, steps, seed=0):
     """Real-data path: byte-level LM batches from a text file.
 
     Bytes ARE the tokens (ids 0-255, so ``--vocab`` must be >= 256 —
     the spare ids simply go unused); each batch row is a random
     contiguous (seq+1)-byte window.  The reference's examples consumed
-    real files the same minimal way (no tokenizer dependency)."""
-    if vocab < 256:
-        raise SystemExit(
-            f"--text-file is byte-level: --vocab {vocab} must be >= 256")
-    data = np.frombuffer(open(path, "rb").read(), np.uint8)
-    if data.size < seq + 1:
-        raise SystemExit(
-            f"{path}: {data.size} bytes < seq+1 = {seq + 1}")
+    real files the same minimal way (no tokenizer dependency).
+    Validates eagerly (not a generator function) and returns the batch
+    iterator."""
+    check_text_args(path, vocab, seq)
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
     rng = np.random.RandomState(seed)
-    for _ in range(steps):
-        starts = rng.randint(0, data.size - seq, batch)
-        x = np.stack([data[s:s + seq + 1] for s in starts]).astype(
-            np.int32)
-        yield x[:, :-1], x[:, 1:]
+
+    def gen():
+        for _ in range(steps):
+            starts = rng.randint(0, data.size - seq, batch)
+            x = np.stack([data[s:s + seq + 1] for s in starts]).astype(
+                np.int32)
+            yield x[:, :-1], x[:, 1:]
+
+    return gen()
 
 
 def make_batches(vocab, batch, seq, steps, seed=0):
@@ -128,6 +144,9 @@ def main():
                         "extensions.MultiNodeCheckpointer)")
     p.add_argument("--platform", default=None)
     args = p.parse_args()
+    if args.text_file:
+        # fail fast, before the mesh/compile work
+        check_text_args(args.text_file, args.vocab, args.seq)
 
     if args.platform:
         import jax

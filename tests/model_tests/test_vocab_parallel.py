@@ -137,6 +137,34 @@ def test_generate_matches_replicated():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+def test_bf16_train_tracks_replicated_bf16():
+    """The production dtype: _vp_head's custom VJP casts the logits
+    cotangent to bf16 for both grad matmuls — the loss trajectory must
+    track the replicated-head bf16 run within bf16 noise."""
+    toks = tokens(8)
+    x, y = toks[:, :T], toks[:, 1:]
+    mc = MeshConfig(model=4, data=2)
+
+    losses = {}
+    for vp in (False, True):
+        cfg = tiny_cfg(dtype="bfloat16", vocab_parallel=vp)
+        # fresh deterministic init per run: the donated step buffers
+        # may alias a shared host array (see the DP-vs-single test)
+        params = shard_params(
+            mc, cfg, init_transformer(jax.random.PRNGKey(2), cfg))
+        opt = optax.sgd(0.1)
+        st = jax.jit(opt.init)(params)
+        step = make_train_step(mc, cfg, opt)
+        p, s, ls = params, st, []
+        for _ in range(5):
+            p, s, loss = step(p, s, x, y)
+            ls.append(float(loss))
+        losses[vp] = ls
+    assert np.isfinite(losses[True]).all()
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=0.03, atol=0.02)
+
+
 def test_int8_generate_matches_replicated_int8():
     """Weight-only int8 decode under vocab TP: the sharded rows and
     their dequant scales ride one psum; tokens match the replicated
