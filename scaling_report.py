@@ -340,6 +340,37 @@ def run():
             "case": c["name"],
             "validation": c["validation"]}), flush=True)
 
+    # ---- vocab-TP delta (comparative, SCALING.md §4): same mesh, ---- #
+    # vocab_parallel on vs off.  The claim: the embed-grad all-reduce
+    # shrinks to the V/M shard while only query-sized collectives are
+    # added, so TOTAL all-reduce bytes strictly drop.
+    vp_case = _tfm_case(
+        "tfm_vocab_tp", {"model": 4, "data": 2},
+        {"vocab_parallel": True},
+        # comparative case: no closed-form — publishing tfm_tp_formula
+        # here would record the REPLICATED-head volume model for the
+        # config whose point is changing exactly that term
+        lambda cfg, B, T, axes, params: {})
+    rep = next(c for c in cases if c["name"] == "tfm_tp")
+    # direct indexing on purpose: if the parser ever stops recognising
+    # the all-reduce op, this must crash loudly, not report a
+    # trivially-true "saving" against zero
+    rep_ar = rep["parsed_hlo"]["all-reduce"]["bytes"]
+    vp_ar = vp_case["parsed_hlo"]["all-reduce"]["bytes"]
+    vp_case["validation"] = {
+        # parser-visible slices (the layer-scan while body is counted
+        # ONCE): comparable across the two runs because the in-body
+        # layer psums are identical — the delta isolates the
+        # out-of-scan embed/lookup/CE terms vocab_parallel changes
+        "all_reduce_slice_bytes_replicated": rep_ar,
+        "all_reduce_slice_bytes_vocab_parallel": vp_ar,
+        "delta_bytes": rep_ar - vp_ar,
+        "vocab_parallel_strictly_less": vp_ar < rep_ar,
+    }
+    print(json.dumps({"case": "tfm_vocab_tp",
+                      "validation": vp_case["validation"]}), flush=True)
+    cases.append(vp_case)
+
     record = {"cases": cases, "notes": [
         "parsed bytes come from collective_stats() over the compiled "
         "step's HLO; formulas are the closed-form volumes SCALING.md "
