@@ -58,28 +58,37 @@ def check_text_args(path, vocab, seq):
             f"{path}: {os.path.getsize(path)} bytes < seq+1 = {seq + 1}")
 
 
-def make_text_batches(path, vocab, batch, seq, steps, seed=0):
-    """Real-data path: byte-level LM batches from a text file.
+def _text_windows(data, batch, seq, steps, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        starts = rng.randint(0, data.size - seq, batch)
+        x = np.stack([data[s:s + seq + 1] for s in starts]).astype(
+            np.int32)
+        yield x[:, :-1], x[:, 1:]
 
-    Bytes ARE the tokens (ids 0-255, so ``--vocab`` must be >= 256 —
-    the spare ids simply go unused); each batch row is a random
-    contiguous (seq+1)-byte window.  The reference's examples consumed
-    real files the same minimal way (no tokenizer dependency).
-    Validates eagerly (not a generator function) and returns the batch
-    iterator."""
+
+def load_text(path, vocab, seq):
+    """Byte corpus split 90/10 into train/held-out ranges (held-out =
+    the file's TAIL, never sampled by training, so the reported
+    perplexity is honest).  A tail too small for one window folds into
+    training and disables eval."""
     check_text_args(path, vocab, seq)
     with open(path, "rb") as f:
         data = np.frombuffer(f.read(), np.uint8)
-    rng = np.random.RandomState(seed)
+    cut = int(0.9 * data.size)
+    # either side too small for one window => no split, no eval
+    if cut < seq + 1 or data.size - cut < seq + 1:
+        return data, None
+    return data[:cut], data[cut:]
 
-    def gen():
-        for _ in range(steps):
-            starts = rng.randint(0, data.size - seq, batch)
-            x = np.stack([data[s:s + seq + 1] for s in starts]).astype(
-                np.int32)
-            yield x[:, :-1], x[:, 1:]
 
-    return gen()
+# byte-level real-data contract: bytes ARE the tokens (ids 0-255, so
+# --vocab must be >= 256; spare ids go unused); each batch row is a
+# random contiguous (seq+1)-byte window over the TRAIN split.  The
+# reference's examples consumed real files the same minimal way (no
+# tokenizer dependency).  The corpus is read ONCE (load_text) and the
+# train/held-out arrays passed around — re-reading between training
+# and eval could silently split different file contents.
 
 
 def make_batches(vocab, batch, seq, steps, seed=0):
@@ -248,9 +257,12 @@ def main():
 
         perm = zigzag_indices(axes.get("seq", 1), args.seq).reshape(-1)
 
+    heldout = None
     if args.text_file:
-        batches = make_text_batches(
-            args.text_file, args.vocab, args.batchsize, args.seq,
+        train_data, heldout = load_text(
+            args.text_file, args.vocab, args.seq)
+        batches = _text_windows(
+            train_data, args.batchsize, args.seq,
             args.steps - start, seed=start)
     else:
         batches = make_batches(args.vocab, args.batchsize, args.seq,
@@ -273,7 +285,34 @@ def main():
 
     if not np.isfinite(last):
         # never persist a diverged state — a resume would train from it
+        # (and a held-out eval of diverged params would just print nan)
         raise SystemExit("non-finite loss")
+
+    if args.text_file:
+        # held-out byte perplexity on the file's tail (never sampled by
+        # training) — the honest generalisation number for the run
+        if heldout is None:
+            print("held-out eval skipped: file too small for a 90/10 "
+                  "split at this --seq")
+        else:
+            from chainermn_tpu.models import make_forward_fn
+
+            fwd = make_forward_fn(mc, cfg)
+            nlls = []
+            for x, y in _text_windows(
+                    heldout, args.batchsize, args.seq, 4, seed=99):
+                if perm is not None:
+                    x, y = x[:, perm], y[:, perm]
+                logits = np.array(fwd(params, jnp.asarray(x)))
+                logits -= logits.max(axis=-1, keepdims=True)
+                logp = logits - np.log(
+                    np.exp(logits).sum(axis=-1, keepdims=True))
+                nlls.append(
+                    -np.take_along_axis(
+                        logp, np.asarray(y)[..., None], axis=-1).mean())
+            ppl = float(np.exp(np.mean(nlls)))
+            print(f"held-out byte perplexity {ppl:.2f} "
+                  f"(uniform would be {args.vocab})")
     if ckpt_file:
         save_state(ckpt_file, {
             "params": jax.tree.map(np.asarray, params),

@@ -204,10 +204,16 @@ def test_lm_real_text_path(tmp_path):
         "examples/transformer/train_lm.py",
         ["--mesh", "data=8", "--steps", "30", "--vocab", "256",
          "--text-file", str(txt)])
-    last = float(out.strip().splitlines()[-1].split("loss")[1]
-                 .split("->")[1].split("over")[0])
+    loss_line = next(ln for ln in out.splitlines()
+                     if ln.startswith("loss ") and "->" in ln)
+    last = float(loss_line.split("->")[1].split("over")[0])
     assert last < math.log(256) * 0.6, \
         f"byte LM barely learned the repetitive corpus: loss {last}"
+    # the held-out tail (never trained on) must also be well-modelled
+    ppl_line = next(ln for ln in out.splitlines()
+                    if ln.startswith("held-out byte perplexity"))
+    ppl = float(ppl_line.split("perplexity")[1].split("(")[0])
+    assert ppl < 100, f"held-out perplexity {ppl} barely beats uniform"
 
 
 def test_mnist_real_npz_path(tmp_path):
