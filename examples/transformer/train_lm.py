@@ -303,10 +303,8 @@ def main():
                     heldout, args.batchsize, args.seq, 4, seed=99):
                 if perm is not None:
                     x, y = x[:, perm], y[:, perm]
-                logits = np.array(fwd(params, jnp.asarray(x)))
-                logits -= logits.max(axis=-1, keepdims=True)
-                logp = logits - np.log(
-                    np.exp(logits).sum(axis=-1, keepdims=True))
+                logp = np.asarray(jax.nn.log_softmax(
+                    fwd(params, jnp.asarray(x)), axis=-1))
                 nlls.append(
                     -np.take_along_axis(
                         logp, np.asarray(y)[..., None], axis=-1).mean())

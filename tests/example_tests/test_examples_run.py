@@ -204,14 +204,16 @@ def test_lm_real_text_path(tmp_path):
         "examples/transformer/train_lm.py",
         ["--mesh", "data=8", "--steps", "30", "--vocab", "256",
          "--text-file", str(txt)])
-    loss_line = next(ln for ln in out.splitlines()
-                     if ln.startswith("loss ") and "->" in ln)
+    loss_line = next((ln for ln in out.splitlines()
+                      if ln.startswith("loss ") and "->" in ln), None)
+    assert loss_line, f"no loss summary line in output:\n{out[-1500:]}"
     last = float(loss_line.split("->")[1].split("over")[0])
     assert last < math.log(256) * 0.6, \
         f"byte LM barely learned the repetitive corpus: loss {last}"
     # the held-out tail (never trained on) must also be well-modelled
-    ppl_line = next(ln for ln in out.splitlines()
-                    if ln.startswith("held-out byte perplexity"))
+    ppl_line = next((ln for ln in out.splitlines()
+                     if ln.startswith("held-out byte perplexity")), None)
+    assert ppl_line, f"no held-out ppl line in output:\n{out[-1500:]}"
     ppl = float(ppl_line.split("perplexity")[1].split("(")[0])
     assert ppl < 100, f"held-out perplexity {ppl} barely beats uniform"
 

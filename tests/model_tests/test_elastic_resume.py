@@ -95,6 +95,9 @@ RESUME_TARGETS = [
      dict(pipe=2, data=2)),
     ("fsdp", dict(fsdp=True), dict(data=8)),
     ("tp_seq", dict(attention="ring"), dict(model=2, seq=2, data=2)),
+    # the embed re-lays from replicated to vocab-sharded on resume
+    ("vocab_tp", dict(attention="ring", vocab_parallel=True),
+     dict(model=2, seq=2, data=2)),
 ]
 
 

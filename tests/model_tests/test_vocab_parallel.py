@@ -93,11 +93,14 @@ def test_loss_and_grads_match_replicated():
             a, b, rtol=1e-5, atol=1e-6), g_rep, g_vp)
 
 
-@pytest.mark.parametrize("sched,axes", [
-    ("gpipe", dict(model=4, data=2)),
-    ("1f1b", dict(pipe=2, model=2, data=2)),
-], ids=["gpipe", "1f1b"])
-def test_train_step_matches_replicated(sched, axes):
+@pytest.mark.parametrize("sched,axes,kw", [
+    ("gpipe", dict(model=4, data=2), {}),
+    ("1f1b", dict(pipe=2, model=2, data=2), {}),
+    ("gpipe", dict(model=4, data=2), dict(fsdp=True)),
+    ("gpipe", dict(expert=2, model=2, data=2),
+     dict(moe=True, n_experts=4, router_top_k=2)),
+], ids=["gpipe", "1f1b", "fsdp", "moe-top2"])
+def test_train_step_matches_replicated(sched, axes, kw):
     toks = tokens(2)
     x, y = toks[:, :T], toks[:, 1:]
     mc = MeshConfig(**axes)
@@ -107,7 +110,7 @@ def test_train_step_matches_replicated(sched, axes):
     for vp in (False, True):
         cfg = tiny_cfg(
             n_layers=4, vocab_parallel=vp, pipeline_schedule=sched,
-            num_microbatches=2 if pipe > 1 else 1)
+            num_microbatches=2 if pipe > 1 else 1, **kw)
         params = shard_params(
             mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg, pipe))
         opt = optax.adam(1e-2)
