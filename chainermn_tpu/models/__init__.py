@@ -3,7 +3,11 @@ ResNet-50, seq2seq NMT) re-built TPU-first, plus the flagship transformer
 exercising every parallelism axis."""
 
 from .convnets import ConvNetConfig, convnet_apply, init_convnet
-from .decoding import make_beam_search_fn, make_generate_fn
+from .decoding import (
+    make_beam_search_fn,
+    make_generate_fn,
+    make_speculative_generate_fn,
+)
 from .quantization import quantize_params_int8
 from .mlp import accuracy, init_mlp, mlp_apply, softmax_cross_entropy
 from .resnet import ResNetConfig, init_resnet, resnet_apply
@@ -46,6 +50,7 @@ __all__ = [
     "make_beam_search_fn",
     "make_forward_fn",
     "make_generate_fn",
+    "make_speculative_generate_fn",
     "make_train_step",
     "mlp_apply",
     "param_specs",

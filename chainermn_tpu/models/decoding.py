@@ -51,7 +51,8 @@ from .transformer import (
     param_specs,
 )
 
-__all__ = ["make_generate_fn", "make_beam_search_fn"]
+__all__ = ["make_generate_fn", "make_beam_search_fn",
+           "make_speculative_generate_fn"]
 
 
 def _vary(x, *axes):
@@ -88,7 +89,7 @@ def _dense_q(dense, x, blk, name, cd):
 
 
 def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
-                  write_mask=None):
+                  write_mask=None, chunk_attends_cache=False):
     """One block for a CHUNK of new tokens.  ``h``: (B, Tq, D) — Tq = 1
     in the generation loop, Tq = prompt length in batched prefill;
     ``ck``/``cv``: (B, kv_len_local, Hkv_local, Dh) this layer's cache;
@@ -175,7 +176,7 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             v_new = jnp.where(write_mask, v_new, cur_v)
         ck = lax.dynamic_update_slice(ck, k_new, (0, lpos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v_new, (0, lpos, 0, 0))
-    if Tq > 1:
+    if Tq > 1 and not chunk_attends_cache:
         # prefill (pos == 0): the chunk's own K/V — still in hand,
         # replicated — ARE the entire attendable set, so causal
         # attention runs directly on them: no max_len-sized cache read
@@ -185,17 +186,20 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
                             causal=True,
                             window=cfg.attention_window or None)
     else:
-        # grouped attention of the query against the (local block of
-        # the) cache, masked to GLOBAL key positions <= its position
+        # grouped attention of the queries against the (local block of
+        # the) cache, masked to GLOBAL key positions <= each query's
+        # position.  Tq > 1 lands here for mid-sequence chunks
+        # (speculative verify): the chunk's K/V were just written, so
+        # the cache holds everything each query may attend to.
         s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
         kpos = jnp.arange(Tl)
         if R > 1:
             kpos = kpos + lax.axis_index("seq") * Tl
-        allow = kpos[None, :] <= qpos[:, None]            # (1, Tl)
+        allow = kpos[None, :] <= qpos[:, None]            # (Tq, Tl)
         if cfg.attention_window:
             allow &= (qpos[:, None] - kpos[None, :]) \
                 < cfg.attention_window
-        s = jnp.where(allow[None, None], s, _NEG)         # (B, H, 1, Tl)
+        s = jnp.where(allow[None, None], s, _NEG)         # (B, H, Tq, Tl)
         if R > 1:
             # stable distributed softmax: global max, then exp-sums and
             # value partials psum'd over the seq axis.  Members whose
@@ -204,7 +208,7 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             e = jnp.exp(s - m)
             n = lax.psum(e.sum(axis=-1, keepdims=True), "seq")
             o = lax.psum(_pv_mix(e, cv.astype(cd)), "seq")
-            o = (o / n).transpose(0, 2, 1, 3)             # (B,1,Hl,Dh)
+            o = (o / n).transpose(0, 2, 1, 3)             # (B,Tq,Hl,Dh)
         else:
             p = jax.nn.softmax(s, axis=-1)
             o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)
@@ -251,7 +255,8 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
 
 
 def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
-                 with_logits: bool = True):
+                 with_logits: bool = True, all_logits: bool = False,
+                 chunk_attends_cache: bool = False):
     """Next-token logits for ``tok`` — (B,) in the generation loop, or
     a (B, Tq) chunk starting at ``pos`` for batched prefill (Tq prompt
     tokens through ONE MXU-shaped pass instead of Tq per-token
@@ -297,7 +302,14 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
     if tok.ndim == 1:
         h = h[:, None, :]
     if cfg.pos_embedding == "learned":
-        rows = lax.dynamic_slice_in_dim(params["pos"], pos, Tq)
+        # per-index clipped gather, NOT dynamic_slice: a chunk that
+        # overhangs the table (speculative decode's final round) must
+        # corrupt only its own out-of-range rows — dynamic_slice clamps
+        # the whole slice START, silently shifting every position
+        rows = jnp.take(
+            params["pos"],
+            jnp.clip(pos + jnp.arange(Tq), 0,
+                     params["pos"].shape[0] - 1), axis=0)
         h = h + rows[None].astype(cd)
     h = h.astype(cd)
     h = _vary(h, "pipe")
@@ -320,7 +332,8 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
             blk, ck, cv = xs
             h, ck, cv = _decode_block(
                 cfg, h, blk, ck, cv, pos,
-                write_mask=None if S == 1 else mine)
+                write_mask=None if S == 1 else mine,
+                chunk_attends_cache=chunk_attends_cache)
             return h, (ck, cv)
 
         out, caches = lax.scan(layer, h_in, (blocks, *caches))
@@ -337,23 +350,27 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
     # only the LAST stage's output is the model's hidden state; zeros
     # elsewhere make the head a masked partial whose closing psum both
     # broadcasts the logits and re-replicates the pipe axis (free at
-    # S = 1, where the mask is identity).  Only the LAST position's
-    # logits matter (next-token), so slice before the vocab matmul.
+    # S = 1, where the mask is identity).  Generation wants only the
+    # LAST position's logits (slice before the vocab matmul);
+    # speculative verify (``all_logits``) needs every position's.
     h = jnp.where(stage == S - 1, out, jnp.zeros_like(out))
-    h = _rms_norm(h[:, -1:], params["ln_f"])
+    h = _rms_norm(h if all_logits else h[:, -1:], params["ln_f"])
     logits = jnp.einsum(
         "btd,vd->btv", h.astype(jnp.float32),
-        params["embed"].astype(jnp.float32))[:, 0]
+        params["embed"].astype(jnp.float32))
+    if not all_logits:
+        logits = logits[:, 0]
     if emb_scale is not None:
         # per-vocab-row scale applies to the logits output channel
-        # (with vocab_parallel both are the same local shard width)
-        logits = logits * emb_scale[None, :]
+        # (with vocab_parallel both are the same local shard width;
+        # broadcasts over (B, V) and (B, Tq, V) alike)
+        logits = logits * emb_scale
     logits = lax.psum(logits, "pipe")
     if cfg.vocab_parallel:
         # samplers want full-width logits: gather the vocab shards
         # (invariant: identical on every model member afterwards)
         logits = _all_gather_invariant(
-            logits, "model", axis=1, tiled=True)
+            logits, "model", axis=logits.ndim - 1, tiled=True)
     return logits, (ck, cv)
 
 
@@ -529,6 +546,142 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
         if key is None:
             key = jax.random.PRNGKey(0)
         return fn(params, prompt, key)
+
+    return generate
+
+
+def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
+                                 draft_cfg: TransformerConfig, *,
+                                 k: int = 4, max_len: int = 0,
+                                 quantized: bool = False,
+                                 draft_quantized: bool = False):
+    """Greedy speculative decoding: a cheap DRAFT model proposes ``k``
+    tokens per round, the target verifies them in ONE (k+1)-token chunk
+    forward — the accepted prefix plus the target's own next token land
+    together, so each round emits 1..k+1 tokens for one read of the
+    target's weights instead of one per token.  Decode is HBM-bound on
+    weights; with a good draft this multiplies tokens/sec by roughly
+    the mean accepted length.
+
+    Output is **token-identical to the target's own greedy decode**
+    (only verified matches are accepted; the corrective token is the
+    target's argmax in an all-accepted context) — the draft affects
+    speed, never content.  Acceptance is batch-min (rows advance in
+    lockstep at the worst row's rate): exactness is preserved, and the
+    speedup is best at the small batches latency-bound serving runs.
+
+    ``draft_cfg`` must share ``vocab_size`` and ``max_seq``; pipe/TP
+    meshes compose; the ``seq`` axis must be 1 (mid-sequence chunk
+    writes don't block over seq-KV).  Returns
+    ``generate(params, draft_params, prompt) -> (B, max_len)``.
+    """
+    if k < 1:
+        raise ValueError(f"k={k} must be >= 1")
+    if draft_cfg.vocab_size != cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab_size} != target "
+            f"{cfg.vocab_size}")
+    if mesh_cfg.mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "speculative decoding writes mid-sequence chunks, which "
+            "the seq-KV blockwise layout does not support: use a "
+            "seq=1 mesh (shard batch/heads/layers instead)")
+    max_len, kv_len_local, kv_heads_local, layers_local = \
+        _decode_preamble(mesh_cfg, cfg, max_len)
+    _, d_kv_len, d_kv_heads_local, d_layers_local = _decode_preamble(
+        mesh_cfg, draft_cfg, max_len)
+    specs = param_specs(cfg, quantized=quantized)
+    d_specs = param_specs(draft_cfg, quantized=draft_quantized)
+    batch_spec = P(("data", "expert"))
+    # rounds may overshoot max_len by up to k+1 tokens: pad the buffer
+    # and caches, slice the pad off at the end
+    pad = k + 1
+
+    def body(params, d_params, prompt):
+        B, Plen = prompt.shape
+        t_cache = _make_cache(cfg, B, kv_len_local + pad,
+                              kv_heads_local, layers_local)
+        d_cache = _make_cache(draft_cfg, B, d_kv_len + pad,
+                              d_kv_heads_local, d_layers_local)
+        buf = jnp.zeros((B, max_len + pad), jnp.int32)
+        buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+        if Plen > 1:
+            _, t_cache = _decode_step(
+                cfg, params, t_cache, prompt[:, :Plen - 1], 0,
+                with_logits=False)
+            _, d_cache = _decode_step(
+                draft_cfg, d_params, d_cache, prompt[:, :Plen - 1], 0,
+                with_logits=False)
+
+        def cond(carry):
+            return carry[1] < max_len - 1
+
+        def round_body(carry):
+            buf, pos, t_cache, d_cache = carry
+            cur = lax.dynamic_slice(buf, (0, pos), (B, 1))[:, 0]
+            # --- draft proposes k greedy tokens ----------------------- #
+            props = []
+            d_cur = cur
+            for j in range(k):      # static unroll, k is small
+                dlog, d_cache = _decode_step(
+                    draft_cfg, d_params, d_cache, d_cur, pos + j)
+                d_cur = jnp.argmax(dlog, axis=-1).astype(jnp.int32)
+                props.append(d_cur)
+            # one extra cache-fill step for the LAST proposal: k steps
+            # yield k proposals but only k-1 of their K/V writes — after
+            # a fully-accepted round pos advances past pos+k, and a
+            # never-written slot there would stay a zero-K/V hole every
+            # later draft query attends, silently decaying acceptance
+            # (partial accepts overwrite this slot next round anyway)
+            _, d_cache = _decode_step(
+                draft_cfg, d_params, d_cache, d_cur, pos + k,
+                with_logits=False)
+            prop = jnp.stack(props, axis=1)               # (B, k)
+            # --- target verifies the whole proposal in one chunk ------ #
+            chunk = jnp.concatenate([cur[:, None], prop], axis=1)
+            tlog, t_cache = _decode_step(
+                cfg, params, t_cache, chunk, pos,
+                all_logits=True, chunk_attends_cache=True)
+            g = jnp.argmax(tlog, axis=-1).astype(jnp.int32)  # (B, k+1)
+            # g[:, j] = target's token for position pos+j+1 given the
+            # chunk prefix through pos+j; prop[:, j] was the draft's
+            # token for the same position — valid to compare only while
+            # every earlier proposal matched
+            match = prop == g[:, :k]                      # (B, k)
+            lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            # GLOBAL batch-min: every data shard advances in lockstep,
+            # keeping pos axis-invariant (the while carry/cond need it)
+            n_acc = lax.pmin(
+                jnp.min(lead.sum(axis=1)), ("data", "expert"))
+            # append prop[:, :n_acc] then the corrective/bonus token
+            # g[:, n_acc]: blend into the existing buffer slab so the
+            # positions beyond n_acc stay untouched
+            slab = lax.dynamic_slice(buf, (0, pos + 1), (B, k + 1))
+            j_idx = jnp.arange(k + 1)
+            bonus = jnp.take_along_axis(
+                g, jnp.full((B, 1), n_acc), axis=1)[:, 0]
+            slab = jnp.where(
+                j_idx[None, :] < n_acc, jnp.concatenate(
+                    [prop, prop[:, -1:]], axis=1),
+                jnp.where(j_idx[None, :] == n_acc,
+                          bonus[:, None], slab))
+            buf = lax.dynamic_update_slice(buf, slab, (0, pos + 1))
+            return buf, pos + n_acc + 1, t_cache, d_cache
+
+        buf, _, _, _ = lax.while_loop(
+            cond, round_body,
+            (buf, jnp.int32(Plen - 1), t_cache, d_cache))
+        return buf[:, :max_len]
+
+    fn = jax.jit(jax.shard_map(
+        body,
+        mesh=mesh_cfg.mesh,
+        in_specs=(specs, d_specs, batch_spec),
+        out_specs=batch_spec,
+    ))
+
+    def generate(params, draft_params, prompt):
+        return fn(params, draft_params, prompt)
 
     return generate
 

@@ -53,6 +53,12 @@ def main():
                    help="nucleus sampling mass cutoff (1.0 = off)")
     p.add_argument("--beam", type=int, default=0,
                    help="beam size; 0 = greedy/sampling")
+    p.add_argument("--speculative-k", type=int, default=0,
+                   help="speculative decoding: draft proposes k tokens "
+                        "per round (0 = off); output is token-identical "
+                        "to plain greedy")
+    p.add_argument("--draft-layers", type=int, default=0,
+                   help="draft model depth (default n_layers/2)")
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode")
     p.add_argument("--vocab-parallel", action="store_true",
@@ -111,9 +117,11 @@ def main():
         params = dict(params, blocks=regroup_blocks(
             params["blocks"], saved_pipe, pipe, saved_v, 1))
         print(f"loaded {ckpt_file}")
+        ckpt_loaded = True
     else:
         params = init_transformer(
             jax.random.PRNGKey(args.seed), cfg, pipe)
+        ckpt_loaded = False
     if args.int8:
         params = quantize_params_int8(cfg, params)
     params = shard_params(mc, cfg, params)
@@ -124,7 +132,37 @@ def main():
     prompt = jnp.asarray(
         np.tile(np.asarray(toks, np.int32), (args.batchsize, 1)))
 
-    if args.beam > 0:
+    if args.speculative_k > 0:
+        import dataclasses
+
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        d_layers = args.draft_layers or max(1, args.n_layers // 2)
+        d_cfg = dataclasses.replace(cfg, n_layers=d_layers)
+        if ckpt_loaded and pipe == 1:
+            # truncated draft: the checkpoint's FIRST d_layers blocks
+            # with the shared embed/norms — a real (if crude) draft
+            # whose acceptance reflects the trained model, unlike a
+            # random init that can only demonstrate the mechanics
+            d_tree = dict(params, blocks=jax.tree.map(
+                lambda a: a[:, :d_layers], params["blocks"]))
+            d_params = shard_params(
+                mc, d_cfg, jax.tree.map(np.asarray, d_tree))
+            d_quant = args.int8
+            note = "draft = target's first layers"
+        else:
+            d_params = shard_params(mc, d_cfg, init_transformer(
+                jax.random.PRNGKey(args.seed + 1), d_cfg, pipe))
+            d_quant = False
+            note = "random draft (mechanics demo — expect ~1 tok/round)"
+        print(f"speculative k={args.speculative_k}, {d_layers}-layer "
+              f"draft: {note}")
+        spec = make_speculative_generate_fn(
+            mc, cfg, d_cfg, k=args.speculative_k, max_len=args.max_len,
+            quantized=args.int8, draft_quantized=d_quant)
+        out = spec(params, d_params, prompt)
+        print("generated:", np.asarray(out)[0].tolist())
+    elif args.beam > 0:
         bs = make_beam_search_fn(
             mc, cfg, beam_size=args.beam, max_len=args.max_len,
             length_penalty=0.6, quantized=args.int8)

@@ -315,6 +315,112 @@ def test_seq_kv_beam_matches_single_device():
                                rtol=1e-5, atol=1e-6)
 
 
+class TestSpeculative:
+    """Greedy speculative decoding: the draft model affects SPEED only
+    — output must be token-identical to the target's own greedy decode
+    no matter how good or bad the draft is.
+
+    Targets are TRAINED briefly first: the chunk-verify computes the
+    same logits as per-token stepping up to fp reassociation, and a
+    random-init model's argmax gaps sit inside that noise — a few SGD
+    steps make the argmax decisive (the realistic regime; near-tie
+    flips are an fp artifact, not a speculative-logic property)."""
+
+    def _trained_host(self, cfg, seed):
+        import optax
+
+        from chainermn_tpu.models import make_train_step
+
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(
+            one, cfg, init_transformer(jax.random.PRNGKey(seed), cfg))
+        opt = optax.adam(1e-2)
+        st = jax.jit(opt.init)(params)
+        step = make_train_step(one, cfg, opt)
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(
+            (np.arange(B * (T + 1)).reshape(B, T + 1) * 7 + 3) % VOCAB,
+            jnp.int32)
+        for _ in range(30):
+            params, st, _ = step(params, st, x[:, :T], x[:, 1:])
+        return jax.tree.map(np.asarray, params)
+
+    def _target_greedy(self, cfg, host, p, max_len):
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        return np.asarray(
+            make_generate_fn(one, cfg, max_len=max_len)(
+                shard_params(one, cfg, host), p))
+
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_perfect_draft_matches_greedy(self, k):
+        """Draft == target: every proposal verifies, rounds stride k+1
+        — and the tokens are exactly the greedy sequence."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg()
+        host = self._trained_host(cfg, 0)
+        p = prompt(seed=12, length=4)
+        ref = self._target_greedy(cfg, host, p, T)
+
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        spec = make_speculative_generate_fn(one, cfg, cfg, k=k,
+                                            max_len=T)
+        params = shard_params(one, cfg, host)
+        got = np.asarray(spec(params, params, p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_weak_draft_still_matches_greedy(self, ):
+        """A DIFFERENT (shallower, differently-initialised) draft:
+        acceptance is partial and the corrective path runs — output
+        still exactly the target's greedy tokens."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = self._trained_host(cfg, 0)
+        d_host = self._trained_host(d_cfg, 9)
+        p = prompt(seed=13, length=4)
+        ref = self._target_greedy(cfg, host, p, T)
+
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        spec = make_speculative_generate_fn(one, cfg, d_cfg, k=3,
+                                            max_len=T)
+        got = np.asarray(spec(shard_params(one, cfg, host),
+                              shard_params(one, d_cfg, d_host), p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_tp_mesh_matches_greedy(self):
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = self._trained_host(cfg, 1)
+        d_host = self._trained_host(d_cfg, 8)
+        p = prompt(seed=14, length=4)
+        ref = self._target_greedy(cfg, host, p, T)
+
+        mc = MeshConfig(data=2, model=2, devices=jax.devices()[:4])
+        spec = make_speculative_generate_fn(mc, cfg, d_cfg, k=3,
+                                            max_len=T)
+        got = np.asarray(spec(shard_params(mc, cfg, host),
+                              shard_params(mc, d_cfg, d_host), p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_validation(self):
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg()
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        with pytest.raises(ValueError, match="k="):
+            make_speculative_generate_fn(one, cfg, cfg, k=0)
+        with pytest.raises(ValueError, match="vocab"):
+            make_speculative_generate_fn(
+                one, cfg, tiny_cfg(vocab_size=VOCAB * 2))
+        with pytest.raises(ValueError, match="seq"):
+            make_speculative_generate_fn(
+                MeshConfig(seq=2, data=4), cfg, cfg)
+
+
 def test_virtual_pipe_packed_params_decode():
     """Params packed for the interleaved schedule (pipe=1, V=2) decode
     identically to flat packing."""
