@@ -406,6 +406,54 @@ class TestSpeculative:
                               shard_params(mc, d_cfg, d_host), p))
         np.testing.assert_array_equal(got, ref)
 
+    def test_pipe_mesh_matches_greedy(self):
+        """PP-decode composes: the verify chunk rides the S-phase
+        ppermute hand-off with stage-masked cache writes."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = self._trained_host(cfg, 2)
+        d_host = self._trained_host(d_cfg, 7)
+        p = prompt(seed=15, length=4)
+        ref = self._target_greedy(cfg, host, p, T)
+
+        from chainermn_tpu.models import regroup_blocks
+
+        mc = MeshConfig(pipe=2, data=2, devices=jax.devices()[:4])
+        spec = make_speculative_generate_fn(mc, cfg, d_cfg, k=3,
+                                            max_len=T)
+        got = np.asarray(spec(
+            shard_params(mc, cfg, dict(host, blocks=regroup_blocks(
+                host["blocks"], 1, 2))),
+            shard_params(mc, d_cfg, dict(d_host, blocks=regroup_blocks(
+                d_host["blocks"], 1, 2))), p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_int8_matches_int8_greedy(self):
+        """Weight-only int8 target + draft: tokens equal the int8
+        target's own greedy decode (int8 changes the logits, so the
+        oracle is the QUANTIZED greedy run)."""
+        from chainermn_tpu.models import (
+            make_speculative_generate_fn, quantize_params_int8)
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = quantize_params_int8(cfg, self._trained_host(cfg, 3))
+        d_host = quantize_params_int8(d_cfg, self._trained_host(d_cfg, 6))
+        p = prompt(seed=16, length=4)
+
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T, quantized=True)(
+                shard_params(one, cfg, host), p))
+        spec = make_speculative_generate_fn(
+            one, cfg, d_cfg, k=3, max_len=T, quantized=True,
+            draft_quantized=True)
+        got = np.asarray(spec(shard_params(one, cfg, host),
+                              shard_params(one, d_cfg, d_host), p))
+        np.testing.assert_array_equal(got, ref)
+
     def test_validation(self):
         from chainermn_tpu.models import make_speculative_generate_fn
 
