@@ -554,7 +554,8 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                                  draft_cfg: TransformerConfig, *,
                                  k: int = 4, max_len: int = 0,
                                  quantized: bool = False,
-                                 draft_quantized: bool = False):
+                                 draft_quantized: bool = False,
+                                 with_stats: bool = False):
     """Greedy speculative decoding: a cheap DRAFT model proposes ``k``
     tokens per round, the target verifies them in ONE (k+1)-token chunk
     forward — the accepted prefix plus the target's own next token land
@@ -573,7 +574,12 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
     ``draft_cfg`` must share ``vocab_size`` and ``max_seq``; pipe/TP
     meshes compose; the ``seq`` axis must be 1 (mid-sequence chunk
     writes don't block over seq-KV).  Returns
-    ``generate(params, draft_params, prompt) -> (B, max_len)``.
+    ``generate(params, draft_params, prompt) -> (B, max_len)``, or
+    with ``with_stats=True`` ``-> (tokens, mean_accepted)`` where
+    ``mean_accepted`` (scalar fp32, in [0, k]) is the average number
+    of draft proposals accepted per round — the observability a draft
+    needs tuning against (each round emits ``mean_accepted + 1``
+    tokens for one target chunk read).
     """
     if k < 1:
         raise ValueError(f"k={k} must be >= 1")
@@ -617,7 +623,7 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
             return carry[1] < max_len - 1
 
         def round_body(carry):
-            buf, pos, t_cache, d_cache = carry
+            buf, pos, acc_sum, rounds, t_cache, d_cache = carry
             cur = lax.dynamic_slice(buf, (0, pos), (B, 1))[:, 0]
             # --- draft proposes k greedy tokens ----------------------- #
             props = []
@@ -666,22 +672,27 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                 jnp.where(j_idx[None, :] == n_acc,
                           bonus[:, None], slab))
             buf = lax.dynamic_update_slice(buf, slab, (0, pos + 1))
-            return buf, pos + n_acc + 1, t_cache, d_cache
+            return (buf, pos + n_acc + 1, acc_sum + n_acc, rounds + 1,
+                    t_cache, d_cache)
 
-        buf, _, _, _ = lax.while_loop(
+        buf, _, acc_sum, rounds, _, _ = lax.while_loop(
             cond, round_body,
-            (buf, jnp.int32(Plen - 1), t_cache, d_cache))
-        return buf[:, :max_len]
+            (buf, jnp.int32(Plen - 1), jnp.int32(0), jnp.int32(0),
+             t_cache, d_cache))
+        mean_acc = acc_sum.astype(jnp.float32) \
+            / jnp.maximum(rounds, 1).astype(jnp.float32)
+        return buf[:, :max_len], mean_acc
 
     fn = jax.jit(jax.shard_map(
         body,
         mesh=mesh_cfg.mesh,
         in_specs=(specs, d_specs, batch_spec),
-        out_specs=batch_spec,
+        out_specs=(batch_spec, P()),
     ))
 
     def generate(params, draft_params, prompt):
-        return fn(params, draft_params, prompt)
+        toks, mean_acc = fn(params, draft_params, prompt)
+        return (toks, mean_acc) if with_stats else toks
 
     return generate
 

@@ -364,10 +364,12 @@ class TestSpeculative:
 
         one = MeshConfig(data=1, devices=jax.devices()[:1])
         spec = make_speculative_generate_fn(one, cfg, cfg, k=k,
-                                            max_len=T)
+                                            max_len=T, with_stats=True)
         params = shard_params(one, cfg, host)
-        got = np.asarray(spec(params, params, p))
-        np.testing.assert_array_equal(got, ref)
+        got, mean_acc = spec(params, params, p)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        # a perfect draft's proposals all verify: acceptance == k
+        assert float(mean_acc) == pytest.approx(k), float(mean_acc)
 
     def test_weak_draft_still_matches_greedy(self, ):
         """A DIFFERENT (shallower, differently-initialised) draft:
@@ -384,10 +386,11 @@ class TestSpeculative:
 
         one = MeshConfig(data=1, devices=jax.devices()[:1])
         spec = make_speculative_generate_fn(one, cfg, d_cfg, k=3,
-                                            max_len=T)
-        got = np.asarray(spec(shard_params(one, cfg, host),
-                              shard_params(one, d_cfg, d_host), p))
-        np.testing.assert_array_equal(got, ref)
+                                            max_len=T, with_stats=True)
+        got, mean_acc = spec(shard_params(one, cfg, host),
+                             shard_params(one, d_cfg, d_host), p)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        assert 0.0 <= float(mean_acc) <= 3.0
 
     def test_tp_mesh_matches_greedy(self):
         from chainermn_tpu.models import make_speculative_generate_fn
