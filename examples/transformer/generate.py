@@ -159,8 +159,12 @@ def main():
               f"draft: {note}")
         spec = make_speculative_generate_fn(
             mc, cfg, d_cfg, k=args.speculative_k, max_len=args.max_len,
-            quantized=args.int8, draft_quantized=d_quant)
-        out = spec(params, d_params, prompt)
+            quantized=args.int8, draft_quantized=d_quant,
+            with_stats=True)
+        out, mean_acc = spec(params, d_params, prompt)
+        print(f"mean accepted proposals/round: {float(mean_acc):.2f} "
+              f"of k={args.speculative_k} "
+              f"(~{float(mean_acc) + 1:.2f} tokens per target read)")
         print("generated:", np.asarray(out)[0].tolist())
     elif args.beam > 0:
         bs = make_beam_search_fn(
