@@ -777,6 +777,59 @@ def scenario_decode(comm):
             f"{name}: processes disagree on generated tokens"
 
 
+def scenario_speculative_decode(comm):
+    """Speculative decoding ACROSS the process boundary: 2 processes ×
+    1 device, ``data=2`` — the per-round acceptance pmin and the
+    verify-chunk collectives run inside a cross-process while_loop.
+    Tokens must equal the process-local greedy oracle, and both
+    processes must agree on the acceptance statistic."""
+    from chainermn_tpu.models import (
+        init_transformer, make_generate_fn,
+        make_speculative_generate_fn, shard_params,
+    )
+    from chainermn_tpu.parallel import MeshConfig
+
+    assert jax.process_count() == 2 and len(jax.local_devices()) == 1
+    cfg = _tiny_cfg(n_layers=4)
+    d_cfg = _tiny_cfg(n_layers=2)
+    host = init_transformer(jax.random.PRNGKey(4), cfg)
+    d_host = init_transformer(jax.random.PRNGKey(5), d_cfg)
+    import jax.numpy as jnp
+
+    prompt = jnp.asarray(
+        np.random.RandomState(6).randint(0, cfg.vocab_size, (4, 3)),
+        jnp.int32)
+
+    one = MeshConfig(data=1, devices=[jax.local_devices()[0]])
+    ref = np.asarray(
+        make_generate_fn(one, cfg, max_len=8)(
+            shard_params(one, cfg, host), prompt))
+
+    mc = MeshConfig(data=2, devices=jax.devices())
+    spec = make_speculative_generate_fn(
+        mc, cfg, d_cfg, k=2, max_len=8, with_stats=True)
+    # the batch spans the process boundary: feed the sharded global
+    # array (dp_train's pattern), reassemble the sharded output over
+    # the object channel for the equality check — keyed by each
+    # shard's OWN row offset, not process index (device order need
+    # not follow process order)
+    sh = mc.sharding(("data", "expert"))
+    got, mean_acc = spec(shard_params(mc, cfg, host),
+                         shard_params(mc, d_cfg, d_host),
+                         jax.device_put(prompt, sh))
+    shard = got.addressable_shards[0]
+    row0 = shard.index[0].start or 0
+    alls = dict(comm.allgather_obj(
+        (int(row0), np.asarray(shard.data).tolist())))
+    full = np.concatenate(
+        [np.asarray(alls[r], np.int32) for r in sorted(alls)], axis=0)
+    np.testing.assert_array_equal(
+        full, ref, err_msg="cross-process speculative decode diverged")
+    accs = comm.allgather_obj(float(mean_acc))
+    assert all(abs(a - accs[0]) < 1e-6 for a in accs), \
+        f"processes disagree on acceptance: {accs}"
+
+
 def scenario_sp_ep_train(comm):
     """Sequence parallelism (ring attention's ppermute chain) and
     expert parallelism (Switch MoE's all-to-alls) ACROSS the process

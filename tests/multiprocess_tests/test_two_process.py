@@ -81,6 +81,11 @@ class TestTwoProcess:
         # the process-local oracle exactly
         mp_run("decode", timeout=300)
 
+    def test_speculative_decode(self, mp_run):
+        # the acceptance pmin + verify-chunk collectives run inside a
+        # cross-process while_loop; tokens equal the local oracle
+        mp_run("speculative_decode", timeout=300)
+
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
 
