@@ -36,7 +36,12 @@ BATTERY = [
     (["python", "bench_transformer.py", "--loss-chunk", "512"], 1500),
     (["python", "bench_breakdown.py"], 2400),
     (["python", "bench_levers.py"], 1800),
-    (["python", "bench_decode.py"], 1500),
+    (["python", "bench_decode.py"], 1800),
+    # the feature-purpose row: cheap truncated draft, k sweep, measured
+    # acceptance, speedup vs plain greedy on the same 16-layer target
+    (["python", "bench_decode.py", "--cheap-draft", "--n-layers", "16"],
+     2100),
+    (["python", "bench_decode.py", "--int8"], 1800),
     (["python", "bench_attention.py"], 1200),
     (["python", "bench_seq2seq.py"], 1200),
     (["python", "bench_loader.py"], 600),

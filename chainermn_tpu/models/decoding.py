@@ -134,6 +134,18 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
         k_new = apply_rope(k_new, qpos, cfg.rope_theta)
     k_new, v_new = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
 
+    if Tq > 1 and R > 1 and chunk_attends_cache:
+        # the blockwise write below assumes the chunk starts at global
+        # position 0 (prefill); a mid-sequence chunk (speculative
+        # verify) under seq-KV would land its rows in the wrong blocks
+        # and silently corrupt the cache.  The speculative factory
+        # rejects seq>1 up front — this local guard keeps any future
+        # caller honest rather than relying on that distant check.
+        raise ValueError(
+            "chunked mid-sequence decode (Tq > 1 with "
+            "chunk_attends_cache) is not supported under "
+            "sequence-parallel KV (seq axis > 1): the blockwise cache "
+            "write requires the prefill contract pos == 0")
     if Tq > 1 and R > 1:
         # blockwise prefill write (pos == 0): pad the chunk's time dim
         # to a block multiple, each member slices ITS block [r·Tl,
@@ -475,8 +487,9 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     ``temperature == 0``, else temperature sampling (``key`` required)
     optionally truncated by ``top_k`` (keep the k best tokens) and/or
     ``top_p`` (nucleus: the smallest set reaching that softmax mass —
-    filters compose, both applied to the raw logits before the
-    temperature).  ``quantized=True`` expects int8 weight-only params
+    filters compose, both applied AFTER the temperature scaling, the
+    same order as HF ``generate``, so ported sampling configs truncate
+    the same sets).  ``quantized=True`` expects int8 weight-only params
     from :func:`...quantization.quantize_params_int8` (≈half the HBM
     traffic per token).
     """
@@ -518,9 +531,13 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 cfg, params, caches, buf[:, t], t)
             if temperature > 0.0:
                 key, sub = jax.random.split(key)
+                # temperature FIRST, filters second (the HF/common
+                # convention): top_k membership is scale-invariant but
+                # the nucleus set is not, so configs ported from other
+                # stacks truncate identically only in this order
                 nxt = jax.random.categorical(
-                    sub, _filter_logits(logits, top_k, top_p)
-                    / temperature)
+                    sub, _filter_logits(logits / temperature,
+                                        top_k, top_p))
             else:
                 nxt = jnp.argmax(logits, axis=-1)
             # the scan starts at the LAST prompt position (prefill

@@ -124,6 +124,11 @@ def main():
         ckpt_loaded = False
     if args.int8:
         params = quantize_params_int8(cfg, params)
+    # keep the pre-shard host tree: the truncated speculative draft
+    # below slices layers from it, which must happen BEFORE sharding —
+    # on a multi-process mesh the sharded leaves are not fully
+    # addressable from any single host
+    host_params = params
     params = shard_params(mc, cfg, params)
 
     toks = [int(t) for t in args.prompt.split(",") if t.strip()]
@@ -144,10 +149,10 @@ def main():
             # with the shared embed/norms — a real (if crude) draft
             # whose acceptance reflects the trained model, unlike a
             # random init that can only demonstrate the mechanics
-            d_tree = dict(params, blocks=jax.tree.map(
-                lambda a: a[:, :d_layers], params["blocks"]))
-            d_params = shard_params(
-                mc, d_cfg, jax.tree.map(np.asarray, d_tree))
+            d_tree = dict(host_params, blocks=jax.tree.map(
+                lambda a: np.asarray(a)[:, :d_layers],
+                host_params["blocks"]))
+            d_params = shard_params(mc, d_cfg, d_tree)
             d_quant = args.int8
             note = "draft = target's first layers"
         else:

@@ -174,6 +174,11 @@ def test_filter_logits_masks_expected_sets():
     # filters compose: top_k=1 dominates a loose nucleus
     out = np.asarray(_filter_logits(logits, 1, 0.99))
     assert (out[0, 1:] <= _NEG / 2).all()
+    # the sampling path filters AFTER temperature (HF convention): a
+    # hot temperature flattens the distribution and WIDENS the nucleus
+    # — at T=4 the 0.7-mass set grows from 2 tokens to 3
+    out = np.asarray(_filter_logits(logits / 4.0, 0, 0.7))
+    assert (out[0, :3] > _NEG / 2).all() and out[0, 3] <= _NEG / 2
 
 
 def test_top_k1_sampling_is_greedy():
@@ -456,6 +461,44 @@ class TestSpeculative:
         got = np.asarray(spec(shard_params(one, cfg, host),
                               shard_params(one, d_cfg, d_host), p))
         np.testing.assert_array_equal(got, ref)
+
+    def test_truncated_cheap_draft_speeds_and_matches(self):
+        """The ``bench_decode.py --cheap-draft`` construction at test
+        scale: a target whose deep-layer residual outputs are damped, a
+        draft made of its first layers + shared embed/final norm.  The
+        draft's function then tracks the target's (the regime a trained
+        big-model draft earns — a 30-step tiny model's truncated prefix
+        is NOT predictive on its own, acceptance 0.0, verified while
+        writing this test), so this pins the two properties the bench
+        row rests on: acceptance well above the random floor, and
+        token-exact greedy output regardless."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = self._trained_host(cfg, 0)
+
+        def damp(name, a):
+            if name not in ("wo", "w2"):
+                return a
+            scale = np.where(np.arange(a.shape[1]) < 2, 1.0,
+                             0.003).astype(a.dtype)
+            return a * scale.reshape(1, -1, *([1] * (a.ndim - 2)))
+
+        host = dict(host, blocks={
+            k: damp(k, v) for k, v in host["blocks"].items()})
+        d_host = dict(host, blocks=jax.tree.map(
+            lambda a: a[:, :2], host["blocks"]))
+        p = prompt(seed=17, length=4)
+        ref = self._target_greedy(cfg, host, p, T)
+
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        spec = make_speculative_generate_fn(one, cfg, d_cfg, k=4,
+                                            max_len=T, with_stats=True)
+        got, mean_acc = spec(shard_params(one, cfg, host),
+                             shard_params(one, d_cfg, d_host), p)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        assert float(mean_acc) > 2.0, float(mean_acc)
 
     def test_validation(self):
         from chainermn_tpu.models import make_speculative_generate_fn
