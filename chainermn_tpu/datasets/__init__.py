@@ -19,6 +19,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .bpe import BPETokenizer, train_bpe  # noqa: F401  (re-export)
+
 __all__ = [
     "scatter_dataset",
     "scatter_index",
@@ -26,6 +28,8 @@ __all__ = [
     "shuffle_data_blocks",
     "SubDataset",
     "EmptyDataset",
+    "BPETokenizer",
+    "train_bpe",
 ]
 
 

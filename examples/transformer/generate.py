@@ -45,6 +45,14 @@ def main():
     p.add_argument("--prompt", default="1,2,3",
                    help="comma-separated token ids (one sequence, "
                         "repeated across the batch)")
+    p.add_argument("--tokenizer", default=None,
+                   help="bpe.json written by train_lm.py "
+                        "--tokenizer-vocab: enables --prompt-text and "
+                        "decodes generated ids back to text (pass the "
+                        "same --vocab the training run printed)")
+    p.add_argument("--prompt-text", default=None,
+                   help="text prompt, encoded with --tokenizer "
+                        "(overrides --prompt)")
     p.add_argument("--batchsize", type=int, default=8)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0,
@@ -131,9 +139,24 @@ def main():
     host_params = params
     params = shard_params(mc, cfg, params)
 
-    toks = [int(t) for t in args.prompt.split(",") if t.strip()]
+    tok = None
+    if args.tokenizer:
+        from chainermn_tpu.datasets import BPETokenizer
+
+        tok = BPETokenizer.load(args.tokenizer)
+    if args.prompt_text is not None:
+        if tok is None:
+            raise SystemExit("--prompt-text needs --tokenizer")
+        toks = tok.encode(args.prompt_text)
+    else:
+        toks = [int(t) for t in args.prompt.split(",") if t.strip()]
     if not toks or any(not 0 <= t < args.vocab for t in toks):
         raise SystemExit(f"prompt ids must be in [0, {args.vocab})")
+
+    def show(ids, label="generated"):
+        print(f"{label}:", list(map(int, ids)))
+        if tok is not None:
+            print(f"{label} text:", repr(tok.decode_text(ids)))
     prompt = jnp.asarray(
         np.tile(np.asarray(toks, np.int32), (args.batchsize, 1)))
 
@@ -170,22 +193,22 @@ def main():
         print(f"mean accepted proposals/round: {float(mean_acc):.2f} "
               f"of k={args.speculative_k} "
               f"(~{float(mean_acc) + 1:.2f} tokens per target read)")
-        print("generated:", np.asarray(out)[0].tolist())
+        show(np.asarray(out)[0].tolist())
     elif args.beam > 0:
         bs = make_beam_search_fn(
             mc, cfg, beam_size=args.beam, max_len=args.max_len,
             length_penalty=0.6, quantized=args.int8)
         out, scores = bs(params, prompt)
         for k in range(args.beam):
-            print(f"beam {k} (score {float(scores[0, k]):+.3f}): "
-                  f"{np.asarray(out)[0, k].tolist()}")
+            show(np.asarray(out)[0, k].tolist(),
+                 label=f"beam {k} (score {float(scores[0, k]):+.3f})")
     else:
         gen = make_generate_fn(
             mc, cfg, max_len=args.max_len,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, quantized=args.int8)
         out = gen(params, prompt, key=jax.random.PRNGKey(args.seed))
-        print("generated:", np.asarray(out)[0].tolist())
+        show(np.asarray(out)[0].tolist())
     return out
 
 

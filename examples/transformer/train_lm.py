@@ -44,13 +44,14 @@ def parse_mesh(spec: str):
     return axes
 
 
-def check_text_args(path, vocab, seq):
+def check_text_args(path, vocab, seq, tokenized=False):
     """Fail fast on --text-file misconfiguration: called right after
     argument parsing, BEFORE the mesh/params/compile work, so a typo'd
     path or too-small vocab costs seconds, not a full model setup."""
-    if vocab < 256:
+    if vocab < 256 and not tokenized:
         raise SystemExit(
-            f"--text-file is byte-level: --vocab {vocab} must be >= 256")
+            f"--text-file is byte-level: --vocab {vocab} must be >= 256"
+            " (or pass --tokenizer-vocab for a subword vocabulary)")
     if not os.path.exists(path):
         raise SystemExit(f"--text-file {path}: no such file")
     if os.path.getsize(path) < seq + 1:
@@ -91,6 +92,49 @@ def load_text(path, vocab, seq):
 # and eval could silently split different file contents.
 
 
+def load_text_tokenized(path, tok_vocab, seq, ckpt_dir):
+    """--tokenizer-vocab path: split the RAW BYTES 90/10 first (the
+    held-out text is the same regardless of tokenizer choices), train
+    a byte-level BPE on the train split only (training it on held-out
+    bytes would leak tail statistics into the vocabulary), then encode
+    both sides.  Merges persist as ``bpe.json`` beside the checkpoint;
+    a resume loads them instead of retraining — token ids must mean
+    the same thing across runs or the resumed model is garbage."""
+    from chainermn_tpu.datasets import BPETokenizer, train_bpe
+
+    check_text_args(path, 256, seq, tokenized=True)
+    with open(path, "rb") as f:
+        raw = f.read()
+    cut = int(0.9 * len(raw))
+    bpe_path = os.path.join(ckpt_dir, "bpe.json") if ckpt_dir else None
+    if bpe_path and os.path.exists(bpe_path):
+        tok = BPETokenizer.load(bpe_path)
+        if tok.vocab_size > tok_vocab:
+            raise SystemExit(
+                f"{bpe_path} holds {tok.vocab_size} ids > "
+                f"--tokenizer-vocab {tok_vocab}: stale tokenizer from "
+                "an earlier run — delete the file or match the flag")
+        print(f"loaded tokenizer {bpe_path} ({tok.vocab_size} ids; "
+              "delete the file to retrain)")
+    else:
+        t0 = time.perf_counter()
+        tok = train_bpe(raw[:cut], tok_vocab)
+        print(f"trained BPE: {tok.vocab_size} ids "
+              f"({time.perf_counter() - t0:.1f}s)")
+        if bpe_path:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            tok.save(bpe_path)
+            print(f"saved {bpe_path}")
+    train = np.asarray(tok.encode(raw[:cut]), np.int32)
+    held = np.asarray(tok.encode(raw[cut:]), np.int32)
+    if train.size < seq + 1:
+        raise SystemExit(
+            f"{path}: {train.size} train tokens < seq+1 = {seq + 1}")
+    if held.size < seq + 1:
+        held = None
+    return train, held, tok
+
+
 def make_batches(vocab, batch, seq, steps, seed=0):
     """Sequences following tok[t+1] = (a*tok[t] + b) % vocab with 10%
     noise — enough structure that a few dozen steps visibly cut loss."""
@@ -123,6 +167,13 @@ def main():
                    help="train on a REAL text file, byte-level tokens "
                         "(needs --vocab >= 256); default is synthetic "
                         "data")
+    p.add_argument("--tokenizer-vocab", type=int, default=0,
+                   help="with --text-file: train/load a byte-level BPE "
+                        "subword vocabulary of up to this many ids "
+                        "(0 = raw bytes).  Merges persist as bpe.json "
+                        "beside --checkpoint and round-trip through "
+                        "generate.py --tokenizer; held-out perplexity "
+                        "is then reported per token AND per byte")
     p.add_argument("--loss-chunk", type=int, default=0,
                    help="chunked-vocab cross-entropy chunk size "
                         "(0 = whole-shard logits)")
@@ -153,9 +204,16 @@ def main():
                         "extensions.MultiNodeCheckpointer)")
     p.add_argument("--platform", default=None)
     args = p.parse_args()
+    if args.tokenizer_vocab and not args.text_file:
+        raise SystemExit("--tokenizer-vocab needs --text-file")
+    if args.tokenizer_vocab and args.tokenizer_vocab <= 256:
+        raise SystemExit(
+            f"--tokenizer-vocab {args.tokenizer_vocab} must exceed 256 "
+            "(ids 0-255 are the raw bytes; merges come on top)")
     if args.text_file:
         # fail fast, before the mesh/compile work
-        check_text_args(args.text_file, args.vocab, args.seq)
+        check_text_args(args.text_file, args.vocab, args.seq,
+                        tokenized=bool(args.tokenizer_vocab))
 
     if args.platform:
         import jax
@@ -172,6 +230,19 @@ def main():
     from chainermn_tpu.parallel import MeshConfig
     from chainermn_tpu.training import shard_opt_state
     from chainermn_tpu.utils.serialization import load_state, save_state
+
+    tok = tok_train = tok_held = None
+    if args.text_file and args.tokenizer_vocab:
+        # before cfg: the learned vocabulary decides the model's vocab
+        tok_train, tok_held, tok = load_text_tokenized(
+            args.text_file, args.tokenizer_vocab, args.seq,
+            args.checkpoint)
+        vocab = max(args.vocab, -(-tok.vocab_size // 128) * 128)
+        if vocab != args.vocab:
+            print(f"model vocab {vocab} (tokenizer {tok.vocab_size} "
+                  "ids, padded up to a 128-multiple for clean "
+                  "sharding and MXU tiling)")
+            args.vocab = vocab
 
     axes = parse_mesh(args.mesh)
     mc = MeshConfig(**axes)
@@ -259,8 +330,11 @@ def main():
 
     heldout = None
     if args.text_file:
-        train_data, heldout = load_text(
-            args.text_file, args.vocab, args.seq)
+        if tok is not None:
+            train_data, heldout = tok_train, tok_held
+        else:
+            train_data, heldout = load_text(
+                args.text_file, args.vocab, args.seq)
         batches = _text_windows(
             train_data, args.batchsize, args.seq,
             args.steps - start, seed=start)
@@ -289,8 +363,11 @@ def main():
         raise SystemExit("non-finite loss")
 
     if args.text_file:
-        # held-out byte perplexity on the file's tail (never sampled by
-        # training) — the honest generalisation number for the run
+        # held-out perplexity on the file's tail (never sampled by
+        # training) — the honest generalisation number for the run.
+        # With a tokenizer, report per-token AND per-byte: per-byte
+        # (exp of total nll over decoded byte count) is the number
+        # comparable across vocabularies, byte-level runs included.
         if heldout is None:
             print("held-out eval skipped: file too small for a 90/10 "
                   "split at this --seq")
@@ -298,19 +375,29 @@ def main():
             from chainermn_tpu.models import make_forward_fn
 
             fwd = make_forward_fn(mc, cfg)
-            nlls = []
+            total_nll = total_tokens = total_bytes = 0.0
             for x, y in _text_windows(
                     heldout, args.batchsize, args.seq, 4, seed=99):
                 if perm is not None:
                     x, y = x[:, perm], y[:, perm]
                 logp = np.asarray(jax.nn.log_softmax(
                     fwd(params, jnp.asarray(x)), axis=-1))
-                nlls.append(
-                    -np.take_along_axis(
-                        logp, np.asarray(y)[..., None], axis=-1).mean())
-            ppl = float(np.exp(np.mean(nlls)))
-            print(f"held-out byte perplexity {ppl:.2f} "
-                  f"(uniform would be {args.vocab})")
+                total_nll += float(-np.take_along_axis(
+                    logp, np.asarray(y)[..., None], axis=-1).sum())
+                total_tokens += y.size
+                total_bytes += (tok.n_bytes(y.reshape(-1))
+                                if tok is not None else y.size)
+            tok_ppl = float(np.exp(total_nll / total_tokens))
+            byte_ppl = float(np.exp(total_nll / total_bytes))
+            if tok is not None:
+                print(f"held-out token perplexity {tok_ppl:.2f} "
+                      f"(uniform over the {tok.vocab_size} tokenizer "
+                      f"ids would be {tok.vocab_size}); "
+                      f"byte perplexity {byte_ppl:.2f} at "
+                      f"{total_bytes / total_tokens:.2f} bytes/token")
+            else:
+                print(f"held-out byte perplexity {byte_ppl:.2f} "
+                      f"(uniform would be {args.vocab})")
     if ckpt_file:
         save_state(ckpt_file, {
             "params": jax.tree.map(np.asarray, params),

@@ -1,0 +1,81 @@
+"""Byte-level BPE tokenizer (datasets.bpe) — round-trip exactness,
+merge determinism, compression, and persistence."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.datasets import BPETokenizer, train_bpe
+
+CORPUS = (b"the quick brown fox jumps over the lazy dog\n"
+          b"the quick brown fox jumps again and again\n" * 50
+          + b"sphinx of black quartz judge my vow\n" * 20)
+
+
+def test_empty_tokenizer_is_byte_identity():
+    tok = BPETokenizer([])
+    assert tok.vocab_size == 256
+    data = b"any bytes \x00\xff at all"
+    ids = tok.encode(data)
+    assert ids == list(data)
+    assert tok.decode(ids) == data
+
+
+def test_roundtrip_exact_any_bytes():
+    tok = train_bpe(CORPUS, 300)
+    for text in [b"the quick brown fox", b"unseen words zzzqqq",
+                 b"\x00\x01\xfe\xff binary", b"", b"   \n\t mixed \n",
+                 "unicode café ✓".encode("utf-8")]:
+        assert tok.decode(tok.encode(text)) == text
+    # str input is utf-8'd first; decode_text round-trips it
+    assert tok.decode_text(tok.encode("café ✓")) \
+        == "café ✓"
+
+
+def test_training_compresses_and_is_deterministic():
+    tok = train_bpe(CORPUS, 320)
+    assert 256 < tok.vocab_size <= 320
+    ids = tok.encode(CORPUS)
+    # the corpus is highly repetitive: subwords must beat bytes clearly
+    assert len(ids) < 0.6 * len(CORPUS)
+    assert tok.n_bytes(ids) == len(CORPUS)
+    tok2 = train_bpe(CORPUS, 320)
+    assert tok2.merges == tok.merges
+
+
+def test_merges_never_cross_whitespace_chunks():
+    tok = train_bpe(b"ab ab ab ab ab ab ab ab", 300)
+    for tid in range(256, tok.vocab_size):
+        exp = tok.decode([tid])
+        # a merged token is either all-whitespace or has no internal
+        # space/nonspace junction crossing (chunk = \s*\S+ keeps any
+        # leading whitespace attached, so ' ab' is legal, 'b a' is not)
+        assert b"b a" not in exp
+
+
+def test_early_stop_below_min_frequency():
+    # every chunk unique -> no pair reaches min_frequency=2
+    tok = train_bpe(b"one two three four", 1000, min_frequency=2)
+    assert tok.vocab_size < 300
+
+
+def test_save_load_roundtrip(tmp_path):
+    tok = train_bpe(CORPUS, 300)
+    path = str(tmp_path / "bpe.json")
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    assert tok2.merges == tok.merges
+    assert tok2.encode(b"the quick fox") == tok.encode(b"the quick fox")
+
+
+def test_out_of_vocab_ids_decode_empty():
+    tok = train_bpe(CORPUS, 280)
+    assert tok.decode([65, tok.vocab_size + 7, 66]) == b"AB"
+    assert tok.n_bytes(np.asarray([65, tok.vocab_size + 7])) == 1
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="vocab_size"):
+        train_bpe(b"abc", 256)
+    with pytest.raises(ValueError, match="creation order"):
+        BPETokenizer([(999, 1000)])
+    assert train_bpe(b"", 300).vocab_size == 256
