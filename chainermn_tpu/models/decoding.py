@@ -564,6 +564,9 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
             key = jax.random.PRNGKey(0)
         return fn(params, prompt, key)
 
+    # the underlying jitted program, exposed for lowering/inspection
+    # (utils.comm_model parses its HLO for the decode wire model)
+    generate._jitted = fn
     return generate
 
 
@@ -711,6 +714,7 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
         toks, mean_acc = fn(params, draft_params, prompt)
         return (toks, mean_acc) if with_stats else toks
 
+    generate._jitted = fn
     return generate
 
 

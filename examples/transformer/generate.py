@@ -132,11 +132,11 @@ def main():
         ckpt_loaded = False
     if args.int8:
         params = quantize_params_int8(cfg, params)
-    # keep the pre-shard host tree: the truncated speculative draft
-    # below slices layers from it, which must happen BEFORE sharding —
-    # on a multi-process mesh the sharded leaves are not fully
-    # addressable from any single host
-    host_params = params
+    # keep the pre-shard host tree ONLY when the speculative draft will
+    # slice layers from it (that must happen BEFORE sharding — on a
+    # multi-process mesh the sharded leaves are not fully addressable
+    # from any single host); otherwise let it free after placement
+    host_params = params if args.speculative_k > 0 else None
     params = shard_params(mc, cfg, params)
 
     tok = None
@@ -176,6 +176,7 @@ def main():
                 lambda a: np.asarray(a)[:, :d_layers],
                 host_params["blocks"]))
             d_params = shard_params(mc, d_cfg, d_tree)
+            host_params = d_tree = None    # release the host copies
             d_quant = args.int8
             note = "draft = target's first layers"
         else:

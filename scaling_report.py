@@ -162,10 +162,11 @@ def tfm_dp_formula(cfg, B, T, axes, params):
     blk = _param_bytes(params["blocks"])
     slice_bytes = (pb - blk) + embed + blk // cfg.n_layers
     return {"all-reduce": {
-        "bytes": pb + embed,
+        "bytes": pb + embed + 4,
         "desc": "fp32 grad pmean of every (replicated) parameter + "
-                "the embed-grad double psum (weight tying)",
-        "per_tick_bytes": slice_bytes,
+                "the embed-grad double psum (weight tying) + the "
+                "scalar loss pmean",
+        "per_tick_bytes": slice_bytes, "slice_extra_bytes": 4,
         "while_body": True}}
 
 
@@ -277,6 +278,165 @@ def tfm_pp_formula(cfg, B, T, axes, params):
         "while_body": True}}
 
 
+# ------------------------------------------------------------------ #
+# decode-path cases (SCALING.md section 7): the same parser over the
+# compiled GENERATION program.  Both the generation loop and each
+# model's layer loop compile to while bodies, so the parsed bytes are
+# per-token / per-layer slices — exactly the unit the per-token wire
+# model wants.  Cases run in float32 (the decode tests' dtype) so no
+# CPU bf16-legalisation factor applies; SCALING.md notes the bf16 wire
+# halves activation volumes on TPU.
+# ------------------------------------------------------------------ #
+
+
+def _decode_case(name, axes, cfg_kw, formula_fn, speculative_k=0):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models import (
+        TransformerConfig, init_transformer, make_generate_fn,
+        make_speculative_generate_fn, regroup_blocks, shard_params,
+    )
+    from chainermn_tpu.parallel import MeshConfig
+    from chainermn_tpu.utils import collective_stats
+
+    B, P, MAX = 4, 5, 16
+    base = dict(
+        vocab_size=256, d_model=64, n_heads=4, d_head=16, d_ff=256,
+        n_layers=4, max_seq=MAX, attention="local",
+        pos_embedding="rope", dtype="float32", remat=False)
+    base.update(cfg_kw)
+    cfg = TransformerConfig(**base)
+    n_dev = int(np.prod(list(axes.values())))
+    mc = MeshConfig(devices=jax.devices()[:n_dev], **axes)
+    pipe = axes.get("pipe", 1)
+    host = init_transformer(jax.random.PRNGKey(0), cfg)
+    if pipe > 1:
+        host = dict(host, blocks=regroup_blocks(host["blocks"], 1, pipe))
+    params = shard_params(mc, cfg, host)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, P)),
+        jnp.int32)
+    if speculative_k:
+        d_cfg = dataclasses.replace(cfg, n_layers=cfg.n_layers // 2)
+        d_host = dict(host, blocks=jax.tree.map(
+            lambda a: a[:, :d_cfg.n_layers], host["blocks"]))
+        d_params = shard_params(mc, d_cfg, d_host)
+        gen = make_speculative_generate_fn(
+            mc, cfg, d_cfg, k=speculative_k, max_len=MAX)
+        lowered = gen._jitted.lower(params, d_params, prompt)
+    else:
+        gen = make_generate_fn(mc, cfg, max_len=MAX)
+        lowered = gen._jitted.lower(
+            params, prompt, jax.random.PRNGKey(0))
+    stats = collective_stats(lowered.compile())
+    return {
+        "name": name,
+        "axes": axes,
+        "config": {k: base[k] for k in
+                   ("d_model", "n_layers", "d_ff", "vocab_size")},
+        "B": B, "P": P, "max_len": MAX,
+        "speculative_k": speculative_k,
+        "parsed_hlo": {k: {"count": v.count, "bytes": v.bytes}
+                       for k, v in stats.items()},
+        "formula": formula_fn(cfg, B, P, axes, speculative_k),
+    }
+
+
+def _local_batch(B, axes):
+    # decode shards the batch over data x expert: the parsed (and
+    # per-device wire) shapes carry the LOCAL batch
+    return B // (axes.get("data", 1) * axes.get("expert", 1))
+
+
+def dec_tp_formula(cfg, B, P, axes, k=0):
+    # per token per layer: the Megatron pair's forward half — wo + w2
+    # row-parallel psums of the (B_local, 1, D) activation (no backward
+    # at decode).  Parser slices: one generation layer body (2 units) +
+    # the prefill chunk's layer body (2 (P-1)-sized units) = 2P units.
+    unit = _local_batch(B, axes) * cfg.d_model * 4
+    return {"all-reduce": {
+        "bytes": 2 * cfg.n_layers * unit,
+        "desc": "2 row-parallel (B,1,D) psums per layer per token "
+                "(per device)",
+        "per_tick_bytes": unit, "while_body": True}}
+
+
+def dec_vocab_tp_formula(cfg, B, P, axes, k=0):
+    Bl = _local_batch(B, axes)
+    unit = Bl * cfg.d_model * 4
+    return {
+        "all-reduce": {
+            "bytes": (2 * cfg.n_layers + 1) * unit,
+            "desc": "layer psums + the vocab-parallel embed-lookup "
+                    "psum per token",
+            "per_tick_bytes": unit, "while_body": True},
+        "all-gather": {
+            # samplers want full-width logits: (B_local, V) f32 per
+            # token (HLO records the gathered output size); prefill
+            # skips the head entirely
+            "bytes": Bl * cfg.vocab_size * 4,
+            "desc": "per-token logits gather over the vocab shards",
+            "per_tick_bytes": Bl * cfg.vocab_size * 4,
+            "while_body": True},
+    }
+
+
+def dec_seq_kv_formula(cfg, B, P, axes, k=0):
+    # distributed softmax merge per layer per token: pmax of the score
+    # max (B,H,1,1) + psum of the exp-sum (B,H,1,1) + psum of the value
+    # partials (B,H,1,Dh) — query-sized, never cache-sized.  Prefill
+    # attends its own chunk locally (no seq collective).
+    Bl, H = _local_batch(B, axes), cfg.n_heads
+    unit = (2 * Bl * H + Bl * H * cfg.d_head) * 4
+    return {"all-reduce": {
+        "bytes": cfg.n_layers * unit,
+        "desc": "pmax + 2 psums of query-sized partials per layer "
+                "per token",
+        "per_tick_bytes": unit, "while_body": True}}
+
+
+def dec_pipe_formula(cfg, B, P, axes, k=0):
+    S = axes.get("pipe", 1)
+    Bl = _local_batch(B, axes)
+    unit = Bl * cfg.d_model * 4
+    return {
+        "collective-permute": {
+            "bytes": (S - 1) * unit,
+            "desc": "S-1 stage hand-offs of the (B,1,D) activation "
+                    "per token (prefill: one (B,P-1,D) hop per phase)",
+            "per_tick_bytes": unit, "while_body": True},
+        "all-reduce": {
+            # the head's closing psum doubles as the last stage's
+            # logits broadcast: (B_local, V) f32 per token
+            "bytes": Bl * cfg.vocab_size * 4,
+            "desc": "per-token logits psum over pipe",
+            "per_tick_bytes": Bl * cfg.vocab_size * 4,
+            "while_body": True},
+    }
+
+
+def dec_spec_formula(cfg, B, P, axes, k):
+    # per round over TP: k+1 draft layer-scan bodies (k proposals + the
+    # last-proposal cache fill) each 2 psums of (B,1,D), plus the
+    # verify chunk's layer body at width k+1 — all the same (B,*,D)
+    # psum family, so one unit covers them; the per-round total is the
+    # SCALING.md extrapolation number.  The round's batch-min
+    # acceptance pmin is one s32 scalar (4 bytes) — accounted exactly
+    # via slice_extra_bytes, not rounded away.
+    unit = _local_batch(B, axes) * cfg.d_model * 4
+    Ld, L = cfg.n_layers // 2, cfg.n_layers
+    return {"all-reduce": {
+        "bytes": 2 * (k + 1) * Ld * unit + 2 * L * (k + 1) * unit + 4,
+        "desc": "draft steps + (k+1)-wide verify chunk psums + the "
+                "scalar acceptance pmin per round",
+        "per_tick_bytes": unit, "slice_extra_bytes": 4,
+        "while_body": True}}
+
+
 def run():
     _setup_cpu(8)
 
@@ -299,6 +459,20 @@ def run():
         "tfm_pp", {"pipe": 4, "data": 2},
         {"num_microbatches": 4}, tfm_pp_formula))
 
+    # decode-path cases (section 7)
+    cases.append(_decode_case(
+        "dec_tp", {"model": 4, "data": 2}, {}, dec_tp_formula))
+    cases.append(_decode_case(
+        "dec_vocab_tp", {"model": 4, "data": 2},
+        {"vocab_parallel": True}, dec_vocab_tp_formula))
+    cases.append(_decode_case(
+        "dec_seq_kv", {"seq": 2, "data": 4}, {}, dec_seq_kv_formula))
+    cases.append(_decode_case(
+        "dec_pipe", {"pipe": 2, "data": 4}, {}, dec_pipe_formula))
+    cases.append(_decode_case(
+        "dec_speculative_tp", {"model": 4, "data": 2}, {},
+        dec_spec_formula, speculative_k=2))
+
     for c in cases:
         c["validation"] = {}
         n_axis = c.get("axis_size") or max(
@@ -308,8 +482,15 @@ def run():
             # automatic grad psums only exist post-partitioning); the
             # StableHLO parse (c["parsed"]) witnesses the requested
             # wire dtypes
-            parsed = c.get("parsed_hlo", c["parsed"]).get(
-                kind, {"bytes": 0})["bytes"]
+            parsed_src = c.get("parsed_hlo") or c.get("parsed")
+            if not parsed_src or kind not in parsed_src:
+                # a formula claims a collective the parse never saw:
+                # that is a broken case (or a broken parser), not a
+                # trivially-passing zero-byte row
+                raise RuntimeError(
+                    f"case {c['name']}: formula names {kind!r} but the "
+                    f"HLO parse found {sorted((parsed_src or {}))}")
+            parsed = parsed_src[kind]["bytes"]
             if kind == "reduce-scatter":
                 # HLO records the scattered (1/n) output shape
                 parsed *= n_axis
@@ -320,13 +501,16 @@ def run():
             if f.get("while_body"):
                 # scan/while bodies are parsed once per body; validate
                 # that the parsed slice is a whole number of unit
-                # payloads, and report that count
+                # payloads, and report that count.  slice_extra_bytes
+                # names known scalar collectives (loss psum, acceptance
+                # pmin) so they don't break the whole-unit check.
                 unit = f["per_tick_bytes"]
+                extra = f.get("slice_extra_bytes", 0)
                 c["validation"][kind] = {
                     "parsed_bytes": parsed,
                     "unit_payload_bytes": unit,
-                    "units_visible": round(parsed / unit, 3),
-                    "whole_units": parsed % unit == 0,
+                    "units_visible": round((parsed - extra) / unit, 3),
+                    "whole_units": (parsed - extra) % unit == 0,
                 }
                 continue
             ratio = parsed / f["bytes"] if f["bytes"] else None

@@ -206,3 +206,33 @@ def test_axis_report_attributes_dp_gradient_allreduce():
     assert st.group_size == 8
     assert report["data"]["wire_bytes_per_device"] >= \
         2 * n_params * 4 * 7 / 8
+
+
+def test_decode_program_parses_per_token_slices():
+    """The decode factories expose their jitted program (`._jitted`) and
+    the parser recovers the per-token collective slices the SCALING.md
+    section-6 model is built on: a TP decode shows the 2-per-layer
+    row-parallel psums at (B_local, 1, D) f32 — 2P whole units across
+    the generation + prefill while bodies (scaling_report.py dec_tp)."""
+    from chainermn_tpu.models import (
+        TransformerConfig, init_transformer, make_generate_fn,
+        shard_params,
+    )
+
+    B, P_len, MAX = 4, 5, 12
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, d_head=8, d_ff=64,
+        n_layers=2, max_seq=MAX, attention="local",
+        pos_embedding="rope", dtype="float32", remat=False)
+    mc = MeshConfig(model=2, data=2, devices=jax.devices()[:4])
+    params = shard_params(
+        mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+    prompt = jnp.zeros((B, P_len), jnp.int32)
+    gen = make_generate_fn(mc, cfg, max_len=MAX)
+    stats = collective_stats(
+        gen._jitted.lower(params, prompt, jax.random.PRNGKey(0))
+        .compile())
+    st = stats["all-reduce"]
+    unit = (B // 2) * cfg.d_model * 4          # (B_local, 1, D) f32
+    assert st.bytes == 2 * P_len * unit, (st, unit)
+    assert st.group_size == 2
