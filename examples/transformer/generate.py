@@ -182,6 +182,7 @@ def main():
         else:
             d_params = shard_params(mc, d_cfg, init_transformer(
                 jax.random.PRNGKey(args.seed + 1), d_cfg, pipe))
+            host_params = None          # unused on this branch: free it
             d_quant = False
             note = "random draft (mechanics demo — expect ~1 tok/round)"
         print(f"speculative k={args.speculative_k}, {d_layers}-layer "

@@ -279,7 +279,7 @@ def tfm_pp_formula(cfg, B, T, axes, params):
 
 
 # ------------------------------------------------------------------ #
-# decode-path cases (SCALING.md section 7): the same parser over the
+# decode-path cases (SCALING.md section 6): the same parser over the
 # compiled GENERATION program.  Both the generation loop and each
 # model's layer loop compile to while bodies, so the parsed bytes are
 # per-token / per-layer slices — exactly the unit the per-token wire
@@ -459,7 +459,7 @@ def run():
         "tfm_pp", {"pipe": 4, "data": 2},
         {"num_microbatches": 4}, tfm_pp_formula))
 
-    # decode-path cases (section 7)
+    # decode-path cases (section 6)
     cases.append(_decode_case(
         "dec_tp", {"model": 4, "data": 2}, {}, dec_tp_formula))
     cases.append(_decode_case(

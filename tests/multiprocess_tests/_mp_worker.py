@@ -879,6 +879,26 @@ def scenario_sp_ep_train(comm):
                                    rtol=1e-6, atol=1e-6)
 
 
+def scenario_alltoall_window(comm):
+    """8-process alltoall_obj: the windowed pairwise-lane path (send
+    look-ahead over the KV channel) must deliver every payload to the
+    right peer at window sizes below, at, and above the round count
+    (n-1 = 7) — window=1 being the strictly-alternating legacy
+    pattern."""
+    r = comm.inter_rank
+    n = comm.inter_size
+    assert n == 8, n
+    for window in (1, 3, 8):
+        sent = [{"from": r, "to": j, "w": window,
+                 "pad": "x" * (50 * r + j)} for j in range(n)]
+        got = comm.alltoall_obj(sent, window=window)
+        assert [g["from"] for g in got] == list(range(n)), got
+        assert all(g["to"] == r and g["w"] == window for g in got), got
+        assert [len(g["pad"]) for g in got] == [50 * p + r
+                                                for p in range(n)], got
+    comm.barrier()
+
+
 SCENARIOS = {
     name[len("scenario_"):]: fn
     for name, fn in list(globals().items())

@@ -42,6 +42,11 @@ class TestTwoProcess:
         # the KV group collectives (whole-world ones would deadlock)
         mp_run("split", nprocs=4)
 
+    def test_alltoall_window(self, mp_run):
+        # 8 processes: the windowed pairwise-lane alltoall at window
+        # sizes below, at, and above the round count
+        mp_run("alltoall_window", nprocs=8, timeout=300)
+
     def test_snapshot(self, mp_run):
         mp_run("snapshot")
 
