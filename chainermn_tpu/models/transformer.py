@@ -151,7 +151,9 @@ class TransformerConfig:
     # head + cross-entropy run in token chunks of N via a custom VJP
     # that never materialises full logits and recomputes them per chunk
     # in backward (one psum for the accumulated embed grad).  Must
-    # divide the per-shard sequence length.  Trade measured by
+    # divide the per-shard sequence length.  Composes with
+    # vocab_parallel (live logits (B, chunk, V/M) — both savings
+    # multiply; see _vp_head_nll).  Trade measured by
     # bench_breakdown.py's lm_head_loss vs lm_head_loss_chunked rows.
     remat: bool = True
     remat_policy: str = "full"  # "full" | "dots": with "dots" the block
@@ -210,11 +212,6 @@ class TransformerConfig:
         if self.loss_chunk < 0:
             raise ValueError(
                 f"loss_chunk={self.loss_chunk} must be >= 0")
-        if self.vocab_parallel and self.loss_chunk:
-            raise ValueError(
-                "vocab_parallel and loss_chunk are alternative "
-                "logits-memory strategies (vocab-sharded vs token-"
-                "chunked); composing them is not supported — pick one")
         if self.moe and not 1 <= self.router_top_k <= self.n_experts:
             raise ValueError(
                 f"router_top_k={self.router_top_k} must be in "
@@ -557,6 +554,27 @@ def _lm_head(cd, h, embed):
                       preferred_element_type=jnp.float32)
 
 
+def _psum_over_vma(grad, fn_name: str, exclude: tuple = ()):
+    """Shared tail of every custom-VJP head backward: psum ``grad``
+    over the mesh axes its local partial is varying on (size-1 axes
+    and the single-device oracle fold to identity), excluding
+    ``exclude`` (a vocab-shard axis whose per-member gradients are
+    distinct and must NOT be summed).  custom_vjp hides the einsum
+    transpose's linearity from the vma checker, so the reduction must
+    be explicit.  No silent fallback: on a jax too old for vma typing
+    the reduction CANNOT be reconstructed, and skipping it would mean
+    unreduced grads — fail instead."""
+    try:
+        vma = tuple(jax.typeof(grad).vma)
+    except AttributeError:  # pragma: no cover - older jax: no vma typing
+        raise RuntimeError(
+            f"{fn_name} needs jax.typeof(...).vma (shard_map varying-"
+            "axes typing) to place its gradient psum; this jax version "
+            "does not expose it") from None
+    vma = tuple(a for a in vma if a not in exclude)
+    return lax.psum(grad, vma) if vma else grad
+
+
 def _lm_head_fwd(cd, h, embed):
     return _lm_head(cd, h, embed), (h, embed)
 
@@ -573,22 +591,9 @@ def _lm_head_bwd(cd, res, g):
                     preferred_element_type=jnp.float32).astype(embed.dtype)
     # embed is replicated over every mesh axis; its true cotangent is
     # the SUM of the per-member partials, which the standard einsum
-    # transpose would emit as shard_map's automatic psum.  custom_vjp
-    # hides that linearity from the vma checker, so reduce explicitly
-    # over whatever axes the local partial is varying on (size-1 axes
-    # and the single-device oracle fold to identity).  No silent
-    # fallback: on a jax too old for vma typing the reduction CANNOT be
-    # reconstructed here, and skipping it would mean unreduced embed
-    # grads — fail instead.
-    try:
-        vma = tuple(jax.typeof(dw).vma)
-    except AttributeError:  # pragma: no cover - older jax: no vma typing
-        raise RuntimeError(
-            "_lm_head needs jax.typeof(...).vma (shard_map varying-axes "
-            "typing) to place the embed-gradient psum; this jax version "
-            "does not expose it") from None
-    if vma:
-        dw = lax.psum(dw, vma)
+    # transpose would emit as shard_map's automatic psum (see
+    # _psum_over_vma's contract)
+    dw = _psum_over_vma(dw, "_lm_head")
     return dh, dw
 
 
@@ -669,18 +674,9 @@ def _head_nll_bwd(cd, chunk, res, g):
     dw, dhc = lax.scan(body, dw0, (hc, tc))
     dh = dhc.transpose(1, 0, 2, 3).reshape(B, T, D)
     dw = dw.astype(embed.dtype)
-    # single psum for the whole accumulated embed cotangent — mirrors
-    # _lm_head_bwd's vma discipline, error contract included (see the
-    # "No silent fallback" comment there)
-    try:
-        vma = tuple(jax.typeof(dw).vma)
-    except AttributeError:  # pragma: no cover - older jax: no vma typing
-        raise RuntimeError(
-            "_head_nll needs jax.typeof(...).vma (shard_map varying-"
-            "axes typing) to place the embed-gradient psum; this jax "
-            "version does not expose it") from None
-    if vma:
-        dw = lax.psum(dw, vma)
+    # single psum for the whole accumulated embed cotangent — a
+    # per-chunk psum would multiply the (V, D) all-reduce volume by C
+    dw = _psum_over_vma(dw, "_head_nll")
     return dh, dw, None
 
 
@@ -760,18 +756,8 @@ def _vp_head_bwd(cd, axis_name, res, g):
                     ).astype(embed_local.dtype)
     # the embed SHARD's cotangent psums over the batch-like axes it is
     # invariant on — but NOT over the vocab axis (each member's shard
-    # gradient is distinct; summing them would be wrong).  Same error
-    # contract as _lm_head_bwd's "No silent fallback" note.
-    try:
-        vma = tuple(jax.typeof(dw).vma)
-    except AttributeError:  # pragma: no cover - older jax: no vma typing
-        raise RuntimeError(
-            "_vp_head needs jax.typeof(...).vma (shard_map varying-"
-            "axes typing) to place the embed-shard-gradient psum; this "
-            "jax version does not expose it") from None
-    vma = tuple(a for a in vma if a != axis_name)
-    if vma:
-        dw = lax.psum(dw, vma)
+    # gradient is distinct; summing them would be wrong)
+    dw = _psum_over_vma(dw, "_vp_head", exclude=(axis_name,))
     return dh, dw
 
 
@@ -798,12 +784,114 @@ def _vp_nll_sum(cd, h, embed_local, targets, axis_name: str = "model"):
     return jnp.sum(lse - tl)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _vp_head_nll(cd, axis_name, chunk, h, embed_local, targets):
+    """Token-chunked **and** vocab-parallel NLL sum — the composition
+    of :func:`_head_nll` and :func:`_vp_nll_sum`: live logits shrink to
+    ``(B, chunk, V/M)`` (both savings multiply), each chunk pays the
+    three query-sized shard reductions, and backward recomputes
+    per-chunk while accumulating the embed-SHARD cotangent in an fp32
+    scan carry so its cross-axis psum fires once — never per chunk."""
+    B, T, D = h.shape
+    if T % chunk:
+        raise ValueError(
+            f"loss_chunk={chunk} must divide the local sequence length "
+            f"{T} (global seq / seq-axis size)")
+    C = T // chunk
+    Vl = embed_local.shape[0]
+    hc = h.reshape(B, C, chunk, D).transpose(1, 0, 2, 3)
+    tc = targets.reshape(B, C, chunk).transpose(1, 0, 2)
+    ew = embed_local.astype(cd)
+
+    def body(acc, ht):
+        hh, tt = ht
+        logits = jnp.einsum("bcd,vd->bcv", hh.astype(cd), ew,
+                            preferred_element_type=jnp.float32)
+        m = _stop_pmax(jnp.max(lax.stop_gradient(logits), axis=-1),
+                       axis_name)
+        se = lax.psum(
+            jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis_name)
+        lse = jnp.log(se) + m
+        ok, idx = _vp_shard_index(Vl, tt, axis_name)
+        tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+        tl = lax.psum(jnp.where(ok, tl, 0.0), axis_name)
+        return acc + jnp.sum(lse - tl, dtype=jnp.float32), None
+
+    # seed from h so the carry inherits h's varying axes and stays
+    # model-invariant, exactly like the unchunked path's output
+    acc0 = jnp.sum(h * 0, dtype=jnp.float32)
+    out, _ = lax.scan(body, acc0, (hc, tc))
+    return out
+
+
+def _vp_head_nll_fwd(cd, axis_name, chunk, h, embed_local, targets):
+    return _vp_head_nll(cd, axis_name, chunk, h, embed_local, targets), \
+        (h, embed_local, targets)
+
+
+def _vp_head_nll_bwd(cd, axis_name, chunk, res, g):
+    h, embed_local, targets = res
+    B, T, D = h.shape
+    Vl = embed_local.shape[0]
+    C = T // chunk
+    hc = h.reshape(B, C, chunk, D).transpose(1, 0, 2, 3)
+    tc = targets.reshape(B, C, chunk).transpose(1, 0, 2)
+    ew = embed_local.astype(cd)
+    g32 = g.astype(jnp.float32)
+
+    def body(dw, ht):
+        hh, tt = ht
+        hcd = hh.astype(cd)
+        logits = jnp.einsum("bcd,vd->bcv", hcd, ew,
+                            preferred_element_type=jnp.float32)
+        # recompute the global softmax's denominator (same two
+        # query-sized collectives as forward)
+        m = lax.pmax(jnp.max(logits, axis=-1), axis_name)
+        se = lax.psum(
+            jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis_name)
+        lse = jnp.log(se) + m
+        p = jnp.exp(logits - lse[..., None])   # local slice, global sm
+        ok, idx = _vp_shard_index(Vl, tt, axis_name)
+        onehot = jax.nn.one_hot(idx, Vl, dtype=p.dtype) * ok[..., None]
+        dl = ((p - onehot) * g32).astype(cd)
+        # h is model-invariant but consumed per shard slice: its true
+        # cotangent sums the members' partials (see _vp_head_bwd) —
+        # cast BEFORE the psum so the bf16 wire volume matches it too
+        dh_c = lax.psum(
+            jnp.einsum("bcv,vd->bcd", dl, ew,
+                       preferred_element_type=jnp.float32
+                       ).astype(h.dtype), axis_name)
+        dw = dw + jnp.einsum("bcv,bcd->vd", dl, hcd,
+                             preferred_element_type=jnp.float32)
+        return dw, dh_c
+
+    # carry seed carries BOTH h's and the shard's varying axes so the
+    # accumulated dw types like the body's output
+    dw0 = jnp.zeros((Vl, D), jnp.float32) \
+        + jnp.sum(h * 0, dtype=jnp.float32) \
+        + jnp.sum(embed_local * 0, dtype=jnp.float32) + g32 * 0
+    dw, dhc = lax.scan(body, dw0, (hc, tc))
+    dh = dhc.transpose(1, 0, 2, 3).reshape(B, T, D)
+    dw = dw.astype(embed_local.dtype)
+    # single psum over the batch-like axes, NOT the vocab axis (each
+    # member's shard gradient is distinct) — once, never per chunk
+    dw = _psum_over_vma(dw, "_vp_head_nll", exclude=(axis_name,))
+    return dh, dw, None
+
+
+_vp_head_nll.defvjp(_vp_head_nll_fwd, _vp_head_nll_bwd)
+
+
 def _shard_nll_sum(cfg, h_normed, embed, targets):
     """Local-shard NLL **sum** through the configured head path:
     ``vocab_parallel`` reduces over model-axis vocab shards,
-    ``loss_chunk > 0`` takes the chunked custom-VJP head, else the whole
-    shard's logits materialise once through :func:`_lm_head`."""
+    ``loss_chunk > 0`` takes the chunked custom-VJP head, and the two
+    COMPOSE (live logits ``(B, chunk, V/M)``); else the whole shard's
+    logits materialise once through :func:`_lm_head`."""
     if cfg.vocab_parallel:
+        if cfg.loss_chunk > 0:
+            return _vp_head_nll(cfg.compute_dtype, "model",
+                                cfg.loss_chunk, h_normed, embed, targets)
         return _vp_nll_sum(cfg.compute_dtype, h_normed, embed, targets)
     chunk = cfg.loss_chunk
     if chunk > 0:

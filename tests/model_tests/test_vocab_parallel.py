@@ -189,9 +189,41 @@ def test_int8_generate_matches_replicated_int8():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+def test_loss_chunk_composes_with_vocab_parallel():
+    """loss_chunk + vocab_parallel COMPOSE (r4): live logits shrink to
+    (B, chunk, V/M).  Loss AND every gradient — including the embed
+    shards' — must equal the plain replicated-head run on the same
+    mesh; chunk == T pins the C=1 edge."""
+    toks = tokens(5)
+    x, y = toks[:, :T], toks[:, 1:]
+    mc = MeshConfig(model=4, data=2)
+    host = init_transformer(jax.random.PRNGKey(4), tiny_cfg())
+
+    l_rep, g_rep = _grads(
+        tiny_cfg(), mc, shard_params(mc, tiny_cfg(), host), x, y)
+    for chunk in (4, T):
+        cfg = tiny_cfg(vocab_parallel=True, loss_chunk=chunk)
+        l_c, g_c = _grads(cfg, mc, shard_params(mc, cfg, host), x, y)
+        assert abs(l_rep - l_c) < 1e-5, (chunk, l_rep, l_c)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-5, atol=1e-6), g_rep, g_c)
+
+
+def test_loss_chunk_vocab_parallel_needs_divisible_T():
+    cfg = tiny_cfg(vocab_parallel=True, loss_chunk=5)  # 5 does not | 16
+    mc = MeshConfig(model=4, data=2)
+    with pytest.raises(ValueError, match="divide the local sequence"):
+        make_train_step(mc, cfg, optax.sgd(0.1))(
+            shard_params(mc, cfg,
+                         init_transformer(jax.random.PRNGKey(0), cfg)),
+            jax.jit(optax.sgd(0.1).init)(
+                shard_params(mc, cfg, init_transformer(
+                    jax.random.PRNGKey(0), cfg))),
+            tokens()[:, :T], tokens()[:, 1:])
+
+
 def test_vocab_parallel_validation():
-    with pytest.raises(ValueError, match="alternative"):
-        tiny_cfg(vocab_parallel=True, loss_chunk=4)
     cfg = tiny_cfg(vocab_parallel=True, vocab_size=62)
     with pytest.raises(ValueError, match="divisible"):
         make_forward_fn(MeshConfig(model=4, data=2), cfg)

@@ -879,6 +879,30 @@ def scenario_sp_ep_train(comm):
                                    rtol=1e-6, atol=1e-6)
 
 
+def scenario_vocab_tp_loss_chunk_train(comm):
+    """Chunked-vocab cross-entropy COMPOSED with Megatron vocab TP,
+    across the process boundary: model=2 over 2 single-device
+    processes, so the per-chunk CE reductions and the vocab-sharded
+    embedding/head collectives are real cross-process traffic.  Both
+    features are exact rearrangements of the softmax, so the loss
+    trajectory must equal a process-local single-device oracle with
+    NEITHER enabled."""
+    from chainermn_tpu.parallel import MeshConfig
+
+    assert jax.process_count() == 2 and len(jax.local_devices()) == 1
+    oracle = _tiny_transformer_losses(
+        MeshConfig(data=1, devices=[jax.local_devices()[0]]),
+        _tiny_cfg())
+    losses = _tiny_transformer_losses(
+        MeshConfig(model=2, data=1, devices=jax.devices()),
+        _tiny_cfg(loss_chunk=8, vocab_parallel=True))
+    np.testing.assert_allclose(losses, oracle, rtol=1e-5, atol=1e-5)
+    all_losses = comm.allgather_obj(losses)
+    for other in all_losses[1:]:
+        np.testing.assert_allclose(other, all_losses[0],
+                                   rtol=1e-6, atol=1e-6)
+
+
 def scenario_alltoall_window(comm):
     """8-process alltoall_obj: the windowed pairwise-lane path (send
     look-ahead over the KV channel) must deliver every payload to the

@@ -42,6 +42,11 @@ class TestTwoProcess:
         # the KV group collectives (whole-world ones would deadlock)
         mp_run("split", nprocs=4)
 
+    def test_vocab_tp_loss_chunk_train(self, mp_run):
+        # chunked-vocab CE + vocab-parallel embedding over model=2
+        # spanning processes, loss-equal to the process-local oracle
+        mp_run("vocab_tp_loss_chunk_train", timeout=300)
+
     def test_alltoall_window(self, mp_run):
         # 8 processes: the windowed pairwise-lane alltoall at window
         # sizes below, at, and above the round count
