@@ -45,6 +45,10 @@ BATTERY = [
     (["python", "bench_attention.py"], 1200),
     (["python", "bench_seq2seq.py"], 1200),
     (["python", "bench_loader.py"], 600),
+    # the quality bar: train the LM example on a book-scale corpus with
+    # a BPE tokenizer to a held-out-ppl target, interruption + resume
+    # included (the README results row)
+    (["python", "bench_quality.py", "--full"], 3300),
 ]
 
 
