@@ -136,6 +136,12 @@ def _run_quality(workdir, corpus_mb, steps, tok_vocab, d_model,
     half = steps // 2
     out_a, dt_a = _run_train(common + ["--steps", str(half)], platform)
     out_b, dt_b = _run_train(common + ["--steps", str(steps)], platform)
+    # the synthetic corpus's word list bounds how many merges BPE can
+    # actually reach — record the ids REACHED, not just the budget
+    ids_line = next((ln for ln in out_a.splitlines()
+                     if ln.startswith("trained BPE:")), "")
+    ids_reached = int(ids_line.split(":")[1].split("ids")[0]) \
+        if ids_line else None
     if f"resumed at step {half}" not in out_b:
         raise RuntimeError(
             f"resume marker missing from phase B output:\n{out_b[-1500:]}")
@@ -157,6 +163,7 @@ def _run_quality(workdir, corpus_mb, steps, tok_vocab, d_model,
         "bytes_per_token": round(bytes_per_tok, 2),
         "corpus_bytes": n_bytes,
         "tokenizer_vocab": tok_vocab,
+        "tokenizer_ids_reached": ids_reached,
         "steps": steps, "seq": seq, "batch": batch,
         "d_model": d_model, "n_layers": n_layers,
         "wall_s_phase_a": round(dt_a, 1),
@@ -169,9 +176,11 @@ def main(argv):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--child", action="store_true")
     p.add_argument("--full", action="store_true",
-                   help="the chip-scale quality run (4 MB corpus, 8k "
-                        "BPE, 25M-param model); default is a smoke "
-                        "config any platform can finish in minutes")
+                   help="the chip-scale quality run (4 MB corpus, BPE "
+                        "budget 8k — the ids actually reached on the "
+                        "synthetic corpus are recorded — ~3M-param "
+                        "model); default is a smoke config any "
+                        "platform can finish in minutes")
     p.add_argument("--platform", default=None)
     p.add_argument("--timeouts", type=int, nargs="+", default=[3000])
     args = p.parse_args(argv)

@@ -478,6 +478,7 @@ def _filter_logits(logits, top_k: int, top_p: float):
 def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                      max_len: int = 0, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 1.0,
+                     eos_id: int = -1, pad_id: int = 0,
                      quantized: bool = False):
     """Build ``generate(params, prompt, key=None) -> (B, max_len)``.
 
@@ -489,7 +490,16 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     ``top_p`` (nucleus: the smallest set reaching that softmax mass —
     filters compose, both applied AFTER the temperature scaling, the
     same order as HF ``generate``, so ported sampling configs truncate
-    the same sets).  ``quantized=True`` expects int8 weight-only params
+    the same sets).
+
+    ``eos_id >= 0`` enables early stopping: a row that emits it is
+    frozen (later positions fill with ``pad_id``), and the loop exits
+    as soon as EVERY row across the sharded batch is done — a
+    ``lax.while_loop`` whose stop flag is the pmin of the shards'
+    all-done bits, so real serving batches stop paying per-token HBM
+    reads the moment the last row finishes rather than at ``max_len``
+    (eos tokens in the PROMPT are ignored, matching the usual
+    convention).  ``quantized=True`` expects int8 weight-only params
     from :func:`...quantization.quantize_params_int8` (≈half the HBM
     traffic per token).
     """
@@ -500,6 +510,11 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
         raise ValueError(
             "top_k/top_p truncate SAMPLING: set temperature > 0 "
             "(greedy decoding always takes the argmax)")
+    if eos_id >= cfg.vocab_size or (eos_id >= 0
+                                    and not 0 <= pad_id < cfg.vocab_size):
+        raise ValueError(
+            f"eos_id={eos_id} / pad_id={pad_id} must be < vocab_size "
+            f"{cfg.vocab_size} (pad in range when eos is enabled)")
     max_len, kv_len_local, kv_heads_local, layers_local = _decode_preamble(
         mesh_cfg, cfg, max_len)
     specs = param_specs(cfg, quantized=quantized)
@@ -514,7 +529,11 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
         B, Plen = prompt.shape
         cache = _make_cache(cfg, B, kv_len_local, kv_heads_local,
                             layers_local)
-        buf = jnp.zeros((B, max_len), jnp.int32)
+        # with eos enabled the loop can exit before writing every
+        # position: seed the buffer with pad so the unwritten tail
+        # reads as padding, not as token 0
+        buf = jnp.full((B, max_len), max(pad_id, 0) if eos_id >= 0
+                       else 0, jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
         # batched prefill: positions 0..P-2 fill the cache in ONE
@@ -525,8 +544,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 cfg, params, cache, prompt[:, :Plen - 1], 0,
                 with_logits=False)
 
-        def step(carry, t):
-            buf, caches, key = carry
+        def token_step(buf, caches, key, t, done):
             logits, caches = _decode_step(
                 cfg, params, caches, buf[:, t], t)
             if temperature > 0.0:
@@ -540,14 +558,51 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                                         top_k, top_p))
             else:
                 nxt = jnp.argmax(logits, axis=-1)
-            # the scan starts at the LAST prompt position (prefill
+            nxt = nxt.astype(jnp.int32)
+            if eos_id >= 0:
+                # frozen rows emit pad; eos itself is written first
+                nxt = jnp.where(done, pad_id, nxt)
+                done = done | (nxt == eos_id)
+            # generation starts at the LAST prompt position (prefill
             # covered the rest), so every t+1 is a generated slot
             buf = lax.dynamic_update_slice(
-                buf, nxt.astype(jnp.int32)[:, None], (0, t + 1))
-            return (buf, caches, key), None
+                buf, nxt[:, None], (0, t + 1))
+            return buf, caches, key, done
 
-        (buf, _, _), _ = lax.scan(
-            step, (buf, cache, key), jnp.arange(Plen - 1, max_len - 1))
+        # typed varying over the batch axes so the while carry matches
+        # the body's output (done is updated from batch-sharded tokens)
+        done = _vary(jnp.zeros((B,), bool), "data", "expert")
+        if eos_id < 0:
+            def step(carry, t):
+                buf, caches, key = carry
+                buf, caches, key, _ = token_step(
+                    buf, caches, key, t, done)
+                return (buf, caches, key), None
+
+            (buf, _, _), _ = lax.scan(
+                step, (buf, cache, key),
+                jnp.arange(Plen - 1, max_len - 1))
+        else:
+            def cond(carry):
+                buf, caches, key, t, done = carry
+                # the while condition must be mesh-invariant: keep
+                # going while ANY shard still has an unfinished row —
+                # pmax of the shards' not-all-done bits (done derives
+                # from logits, already invariant over model/seq/pipe)
+                running = lax.pmax(
+                    (~jnp.all(done)).astype(jnp.int32),
+                    ("data", "expert"))
+                return (t < max_len - 1) & (running > 0)
+
+            def wbody(carry):
+                buf, caches, key, t, done = carry
+                buf, caches, key, done = token_step(
+                    buf, caches, key, t, done)
+                return (buf, caches, key, t + 1, done)
+
+            buf, _, _, _, _ = lax.while_loop(
+                cond, wbody,
+                (buf, cache, key, jnp.int32(Plen - 1), done))
         return buf
 
     fn = jax.jit(jax.shard_map(

@@ -59,6 +59,11 @@ def main():
                    help="sample from the k best tokens only (0 = off)")
     p.add_argument("--top-p", type=float, default=1.0,
                    help="nucleus sampling mass cutoff (1.0 = off)")
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help="early stopping: rows that emit this token "
+                        "freeze (later positions = --pad-id) and "
+                        "generation exits when every row is done")
+    p.add_argument("--pad-id", type=int, default=0)
     p.add_argument("--beam", type=int, default=0,
                    help="beam size; 0 = greedy/sampling")
     p.add_argument("--speculative-k", type=int, default=0,
@@ -160,6 +165,10 @@ def main():
     prompt = jnp.asarray(
         np.tile(np.asarray(toks, np.int32), (args.batchsize, 1)))
 
+    if args.eos_id >= 0 and args.speculative_k > 0:
+        raise SystemExit(
+            "--eos-id is not supported with --speculative-k (the "
+            "verify chunk has no per-row freeze); drop one of the two")
     if args.speculative_k > 0:
         import dataclasses
 
@@ -199,7 +208,8 @@ def main():
     elif args.beam > 0:
         bs = make_beam_search_fn(
             mc, cfg, beam_size=args.beam, max_len=args.max_len,
-            length_penalty=0.6, quantized=args.int8)
+            eos_id=args.eos_id, length_penalty=0.6,
+            quantized=args.int8)
         out, scores = bs(params, prompt)
         for k in range(args.beam):
             show(np.asarray(out)[0, k].tolist(),
@@ -208,7 +218,8 @@ def main():
         gen = make_generate_fn(
             mc, cfg, max_len=args.max_len,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, quantized=args.int8)
+            top_p=args.top_p, eos_id=args.eos_id, pad_id=args.pad_id,
+            quantized=args.int8)
         out = gen(params, prompt, key=jax.random.PRNGKey(args.seed))
         show(np.asarray(out)[0].tolist())
     return out

@@ -320,6 +320,74 @@ def test_seq_kv_beam_matches_single_device():
                                rtol=1e-5, atol=1e-6)
 
 
+class TestEosEarlyStop:
+    """eos_id early stopping: frozen rows pad, unfrozen rows are
+    bit-identical to the no-eos run (per-row computations are
+    independent), prompt eos is ignored, and the sharded while-loop's
+    pmax stop flag agrees across meshes."""
+
+    PAD = 7
+
+    def _expected(self, ref, Plen, eos):
+        exp = np.asarray(ref).copy()
+        for b in range(exp.shape[0]):
+            hits = np.where(exp[b, Plen:] == eos)[0]
+            if hits.size:
+                exp[b, Plen + hits[0] + 1:] = self.PAD
+        return exp
+
+    def _run(self, axes, n_dev):
+        cfg = tiny_cfg()
+        host = init_transformer(jax.random.PRNGKey(6), cfg)
+        # a prompt CONTAINING candidate eos values must not freeze rows
+        p = prompt(seed=20, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(
+                shard_params(one, cfg, host), p))
+        # eos = a token some row actually generates mid-sequence
+        eos = int(ref[0, 6])
+        mc = MeshConfig(**axes, devices=jax.devices()[:n_dev])
+        got = np.asarray(
+            make_generate_fn(mc, cfg, max_len=T, eos_id=eos,
+                             pad_id=self.PAD)(
+                shard_params(mc, cfg, host), p))
+        np.testing.assert_array_equal(got, self._expected(ref, 4, eos))
+        return ref, p, host, cfg
+
+    def test_single_device_freeze_and_pad(self):
+        self._run(dict(data=1), 1)
+
+    def test_sharded_batch_mesh(self):
+        # rows finish at different times across shards; the pmax stop
+        # flag must keep every shard stepping until the global last row
+        self._run(dict(data=2, model=2), 4)
+
+    def test_eos_never_fires_matches_plain(self):
+        cfg = tiny_cfg()
+        host = init_transformer(jax.random.PRNGKey(6), cfg)
+        p = prompt(seed=21, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(params, p))
+        unused = [v for v in range(VOCAB)
+                  if v not in np.asarray(ref)][0]
+        got = np.asarray(
+            make_generate_fn(one, cfg, max_len=T, eos_id=unused,
+                             pad_id=self.PAD)(params, p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_validation(self):
+        cfg = tiny_cfg()
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        with pytest.raises(ValueError, match="eos_id"):
+            make_generate_fn(one, cfg, max_len=T, eos_id=VOCAB)
+        with pytest.raises(ValueError, match="pad_id"):
+            make_generate_fn(one, cfg, max_len=T, eos_id=1,
+                             pad_id=VOCAB)
+
+
 class TestSpeculative:
     """Greedy speculative decoding: the draft model affects SPEED only
     — output must be token-identical to the target's own greedy decode
