@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -89,7 +90,8 @@ def _dense_q(dense, x, blk, name, cd):
 
 
 def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
-                  write_mask=None, chunk_attends_cache=False):
+                  write_mask=None, chunk_attends_cache=False,
+                  pos_offset=None):
     """One block for a CHUNK of new tokens.  ``h``: (B, Tq, D) — Tq = 1
     in the generation loop, Tq = prompt length in batched prefill;
     ``ck``/``cv``: (B, kv_len_local, Hkv_local, Dh) this layer's cache;
@@ -130,9 +132,21 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
         k_new, v_new = kv[:, :, 0], kv[:, :, 1]
     qpos = pos + jnp.arange(Tq)                           # (Tq,)
     if cfg.pos_embedding == "rope":
-        q = apply_rope(q, qpos, cfg.rope_theta)
-        k_new = apply_rope(k_new, qpos, cfg.rope_theta)
+        if pos_offset is None:
+            rpos = qpos
+        else:
+            # left-padded rows: slot s holds the row's token number
+            # s - offset (clipped for the pad slots, whose K/V are
+            # masked out of every real query's attention below)
+            rpos = jnp.maximum(qpos[None, :] - pos_offset[:, None], 0)
+        q = apply_rope(q, rpos, cfg.rope_theta)
+        k_new = apply_rope(k_new, rpos, cfg.rope_theta)
     k_new, v_new = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
+    if pos_offset is not None and R > 1:
+        raise ValueError(
+            "left-padded prompts (pos_offset) are not supported under "
+            "sequence-parallel KV (seq axis > 1): shard batch/heads/"
+            "layers instead")
 
     if Tq > 1 and R > 1 and chunk_attends_cache:
         # the blockwise write below assumes the chunk starts at global
@@ -209,9 +223,18 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             kpos = kpos + lax.axis_index("seq") * Tl
         allow = kpos[None, :] <= qpos[:, None]            # (Tq, Tl)
         if cfg.attention_window:
+            # slot distance == per-row token distance (both ends shift
+            # by the same pad offset), so the window needs no offset
             allow &= (qpos[:, None] - kpos[None, :]) \
                 < cfg.attention_window
-        s = jnp.where(allow[None, None], s, _NEG)         # (B, H, Tq, Tl)
+        if pos_offset is not None:
+            # per-row validity: slots before the row's first real
+            # token hold pad K/V — no query may attend them
+            allow = allow[None] \
+                & (kpos[None, None, :] >= pos_offset[:, None, None])
+            s = jnp.where(allow[:, None], s, _NEG)        # (B,H,Tq,Tl)
+        else:
+            s = jnp.where(allow[None, None], s, _NEG)     # (B,H,Tq,Tl)
         if R > 1:
             # stable distributed softmax: global max, then exp-sums and
             # value partials psum'd over the seq axis.  Members whose
@@ -268,7 +291,7 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
 
 def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
                  with_logits: bool = True, all_logits: bool = False,
-                 chunk_attends_cache: bool = False):
+                 chunk_attends_cache: bool = False, pos_offset=None):
     """Next-token logits for ``tok`` — (B,) in the generation loop, or
     a (B, Tq) chunk starting at ``pos`` for batched prefill (Tq prompt
     tokens through ONE MXU-shaped pass instead of Tq per-token
@@ -318,11 +341,16 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
         # overhangs the table (speculative decode's final round) must
         # corrupt only its own out-of-range rows — dynamic_slice clamps
         # the whole slice START, silently shifting every position
+        idx = pos + jnp.arange(Tq)
+        if pos_offset is not None:
+            # left-padded rows: per-row token numbers (pad slots clip
+            # to 0; their values are masked out of attention anyway)
+            idx = idx[None, :] - pos_offset[:, None]
         rows = jnp.take(
             params["pos"],
-            jnp.clip(pos + jnp.arange(Tq), 0,
-                     params["pos"].shape[0] - 1), axis=0)
-        h = h + rows[None].astype(cd)
+            jnp.clip(idx, 0, params["pos"].shape[0] - 1), axis=0)
+        h = h + (rows if pos_offset is not None
+                 else rows[None]).astype(cd)
     h = h.astype(cd)
     h = _vary(h, "pipe")
     caches = tuple(jax.tree.map(lambda c: _vary(c, "pipe"), caches))
@@ -345,7 +373,8 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
             h, ck, cv = _decode_block(
                 cfg, h, blk, ck, cv, pos,
                 write_mask=None if S == 1 else mine,
-                chunk_attends_cache=chunk_attends_cache)
+                chunk_attends_cache=chunk_attends_cache,
+                pos_offset=pos_offset)
             return h, (ck, cv)
 
         out, caches = lax.scan(layer, h_in, (blocks, *caches))
@@ -480,11 +509,19 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                      top_k: int = 0, top_p: float = 1.0,
                      eos_id: int = -1, pad_id: int = 0,
                      quantized: bool = False):
-    """Build ``generate(params, prompt, key=None) -> (B, max_len)``.
+    """Build ``generate(params, prompt, key=None, prompt_lens=None)
+    -> (B, max_len)``.
 
-    ``prompt``: (B, P) int32, left-aligned (no padding support — equal
-    prompt lengths, the same contract as the reference's translate
-    batches); generation fills positions P..max_len-1.  Greedy when
+    ``prompt``: (B, P) int32; generation fills positions P..max_len-1.
+    Equal-length prompts need nothing more (the reference's translate
+    contract).  **Variable-length prompts**: RIGHT-align each row (real
+    tokens at ``prompt[b, P-lens[b]:]``, anything in the pad slots) and
+    pass ``prompt_lens`` (B,) — each row then decodes exactly as it
+    would alone: per-row RoPE/learned positions start at the row's
+    first real token, and a per-row attention-validity mask keeps every
+    query off the pad slots' K/V.  Not supported under seq-KV
+    (``seq`` axis > 1) — shard batch/heads/layers instead; with MoE,
+    pad tokens do consume router capacity during prefill.  Greedy when
     ``temperature == 0``, else temperature sampling (``key`` required)
     optionally truncated by ``top_k`` (keep the k best tokens) and/or
     ``top_p`` (nucleus: the smallest set reaching that softmax mass —
@@ -520,7 +557,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     specs = param_specs(cfg, quantized=quantized)
     batch_spec = P(("data", "expert"))
 
-    def body(params, prompt, key):
+    def _body(params, prompt, key, offsets):
         # decorrelate sampling across batch shards (same key on every
         # device would draw identical noise for different examples)
         key = jax.random.fold_in(
@@ -538,15 +575,20 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
 
         # batched prefill: positions 0..P-2 fill the cache in ONE
         # MXU-shaped pass (the per-token scan below starts at the last
-        # prompt position, whose logits seed generation)
+        # prompt position, whose logits seed generation).  Left-padded
+        # prompts route through the cache-attending path: its per-row
+        # validity mask keeps every real query off the pad slots' K/V
+        # (the chunk-local fast path has no row dimension in its mask)
         if Plen > 1:
             _, cache = _decode_step(
                 cfg, params, cache, prompt[:, :Plen - 1], 0,
-                with_logits=False)
+                with_logits=False,
+                chunk_attends_cache=offsets is not None,
+                pos_offset=offsets)
 
         def token_step(buf, caches, key, t, done):
             logits, caches = _decode_step(
-                cfg, params, caches, buf[:, t], t)
+                cfg, params, caches, buf[:, t], t, pos_offset=offsets)
             if temperature > 0.0:
                 key, sub = jax.random.split(key)
                 # temperature FIRST, filters second (the HF/common
@@ -605,19 +647,45 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 (buf, cache, key, jnp.int32(Plen - 1), done))
         return buf
 
+    def body(params, prompt, key):
+        return _body(params, prompt, key, None)
+
+    def body_padded(params, prompt, lens, key):
+        return _body(params, prompt, key,
+                     jnp.int32(prompt.shape[1]) - lens)
+
     fn = jax.jit(jax.shard_map(
         body,
         mesh=mesh_cfg.mesh,
         in_specs=(specs, batch_spec, P()),
         out_specs=batch_spec,
     ))
+    lazy = {}   # the padded program compiles on first use only
 
-    def generate(params, prompt, key=None):
+    def generate(params, prompt, key=None, prompt_lens=None):
         if temperature > 0.0 and key is None:
             raise ValueError("temperature sampling needs a PRNG key")
         if key is None:
             key = jax.random.PRNGKey(0)
-        return fn(params, prompt, key)
+        if prompt_lens is None:
+            return fn(params, prompt, key)
+        lens = np.asarray(prompt_lens)
+        P_len = prompt.shape[1]
+        if lens.shape != (prompt.shape[0],) \
+                or (lens < 1).any() or (lens > P_len).any():
+            raise ValueError(
+                f"prompt_lens must be ({prompt.shape[0]},) ints in "
+                f"[1, {P_len}] (rows RIGHT-aligned: real tokens are "
+                f"prompt[b, P-lens[b]:]), got {lens}")
+        if "padded" not in lazy:
+            lazy["padded"] = jax.jit(jax.shard_map(
+                body_padded,
+                mesh=mesh_cfg.mesh,
+                in_specs=(specs, batch_spec, batch_spec, P()),
+                out_specs=batch_spec,
+            ))
+        return lazy["padded"](
+            params, prompt, jnp.asarray(lens, jnp.int32), key)
 
     # the underlying jitted program, exposed for lowering/inspection
     # (utils.comm_model parses its HLO for the decode wire model)

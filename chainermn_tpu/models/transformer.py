@@ -907,9 +907,11 @@ def _shard_nll_sum(cfg, h_normed, embed, targets):
 
 def apply_rope(x, positions, theta: float = 10000.0):
     """Rotary embedding (rotate-half convention) on ``x`` (..., T, H, D)
-    at absolute ``positions`` (T,).  Rotations are absolute per token but
-    the QK dot depends only on position DIFFERENCES — so sharded callers
-    (ring shards, zigzag layouts, KV caches) just pass each token's own
+    at absolute ``positions`` — ``(T,)`` shared across the batch, or
+    ``(B, T)`` per-row (left-padded decoding gives each row its own
+    position origin).  Rotations are absolute per token but the QK dot
+    depends only on position DIFFERENCES — so sharded callers (ring
+    shards, zigzag layouts, KV caches) just pass each token's own
     global position and relative attention falls out, with no position
     parameters to learn or extend.
 
@@ -919,9 +921,9 @@ def apply_rope(x, positions, theta: float = 10000.0):
     stage signature."""
     half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None]  # (T, half)
-    cos = jnp.cos(ang)[:, None].astype(x.dtype)    # (T, 1, half)
-    sin = jnp.sin(ang)[:, None].astype(x.dtype)
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
+    cos = jnp.cos(ang)[..., None, :].astype(x.dtype)  # (..., T, 1, half)
+    sin = jnp.sin(ang)[..., None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
