@@ -53,6 +53,12 @@ def main():
     p.add_argument("--prompt-text", default=None,
                    help="text prompt, encoded with --tokenizer "
                         "(overrides --prompt)")
+    p.add_argument("--prompt-file", default=None,
+                   help="file with ONE prompt per line — text (with "
+                        "--tokenizer) or comma-separated ids; rows may "
+                        "have different lengths (right-aligned with "
+                        "padding, decoded via prompt_lens); the batch "
+                        "is the line count")
     p.add_argument("--batchsize", type=int, default=8)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0,
@@ -149,21 +155,61 @@ def main():
         from chainermn_tpu.datasets import BPETokenizer
 
         tok = BPETokenizer.load(args.tokenizer)
-    if args.prompt_text is not None:
-        if tok is None:
-            raise SystemExit("--prompt-text needs --tokenizer")
-        toks = tok.encode(args.prompt_text)
+
+    def check_ids(ids, what):
+        if not ids or any(not 0 <= t < args.vocab for t in ids):
+            raise SystemExit(
+                f"{what}: prompt ids must be in [0, {args.vocab}) "
+                f"and non-empty")
+        return ids
+
+    prompt_lens = None
+    if args.prompt_file is not None:
+        if args.beam > 0 or args.speculative_k > 0:
+            raise SystemExit(
+                "--prompt-file (variable-length batch) works with "
+                "greedy/sampling only — beam and speculative decoding "
+                "require equal prompt lengths")
+        rows = []
+        with open(args.prompt_file) as f:
+            for i, ln in enumerate(f):
+                if not ln.strip():
+                    continue          # blank lines skipped, numbering
+                ln = ln.rstrip("\n")  # stays physical for errors
+                rows.append(check_ids(
+                    tok.encode(ln) if tok is not None else
+                    [int(t) for t in ln.split(",") if t.strip()],
+                    f"line {i + 1}"))
+        if not rows:
+            raise SystemExit(f"{args.prompt_file}: no prompts in file")
+        dshard = mc.mesh.shape.get("data", 1) \
+            * mc.mesh.shape.get("expert", 1)
+        if len(rows) % dshard:
+            raise SystemExit(
+                f"{args.prompt_file}: {len(rows)} prompts do not "
+                f"divide over the mesh's data×expert axes ({dshard}) "
+                "— pad the file or pick a smaller --mesh")
+        P_len = max(len(r) for r in rows)
+        prompt_lens = np.asarray([len(r) for r in rows])
+        prompt = np.zeros((len(rows), P_len), np.int32)
+        for b, r in enumerate(rows):      # right-aligned
+            prompt[b, P_len - len(r):] = r
+        prompt = jnp.asarray(prompt)
     else:
-        toks = [int(t) for t in args.prompt.split(",") if t.strip()]
-    if not toks or any(not 0 <= t < args.vocab for t in toks):
-        raise SystemExit(f"prompt ids must be in [0, {args.vocab})")
+        if args.prompt_text is not None:
+            if tok is None:
+                raise SystemExit("--prompt-text needs --tokenizer")
+            toks = tok.encode(args.prompt_text)
+        else:
+            toks = [int(t) for t in args.prompt.split(",") if t.strip()]
+        check_ids(toks, "--prompt")
+        prompt = jnp.asarray(
+            np.tile(np.asarray(toks, np.int32), (args.batchsize, 1)))
 
     def show(ids, label="generated"):
         print(f"{label}:", list(map(int, ids)))
         if tok is not None:
             print(f"{label} text:", repr(tok.decode_text(ids)))
-    prompt = jnp.asarray(
-        np.tile(np.asarray(toks, np.int32), (args.batchsize, 1)))
 
     if args.eos_id >= 0 and args.speculative_k > 0:
         raise SystemExit(
@@ -220,8 +266,15 @@ def main():
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, eos_id=args.eos_id, pad_id=args.pad_id,
             quantized=args.int8)
-        out = gen(params, prompt, key=jax.random.PRNGKey(args.seed))
-        show(np.asarray(out)[0].tolist())
+        out = gen(params, prompt, key=jax.random.PRNGKey(args.seed),
+                  prompt_lens=prompt_lens)
+        out_np = np.asarray(out)
+        if prompt_lens is not None:
+            for b in range(out_np.shape[0]):
+                start = prompt.shape[1] - int(prompt_lens[b])
+                show(out_np[b, start:].tolist(), label=f"row {b}")
+        else:
+            show(out_np[0].tolist())
     return out
 
 

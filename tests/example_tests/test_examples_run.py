@@ -261,6 +261,18 @@ def test_lm_bpe_tokenizer_path(tmp_path):
          "--prompt-text", "the quick brown", "--vocab", vocab,
          "--d-model", "32", "--n-layers", "2", "--max-len", "16"])
     assert "generated text:" in gen and "the quick brown" in gen
+    # variable-length batch: one prompt per line, right-aligned with
+    # prompt_lens under the hood, per-row decoded text out
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("the quick brown\na stitch in time saves\n" * 4)
+    gen = _run_example(
+        "examples/transformer/generate.py",
+        ["--checkpoint", ck, "--tokenizer", str(tmp_path / "ck" /
+                                                "bpe.json"),
+         "--prompt-file", str(pf), "--vocab", vocab,
+         "--d-model", "32", "--n-layers", "2", "--max-len", "16"])
+    assert "row 0 text: 'the quick brown" in gen
+    assert "row 7 text: 'a stitch in time saves" in gen
 
 
 def test_mnist_real_npz_path(tmp_path):
