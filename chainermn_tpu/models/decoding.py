@@ -669,14 +669,36 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
             key = jax.random.PRNGKey(0)
         if prompt_lens is None:
             return fn(params, prompt, key)
-        lens = np.asarray(prompt_lens)
         P_len = prompt.shape[1]
-        if lens.shape != (prompt.shape[0],) \
-                or (lens < 1).any() or (lens > P_len).any():
-            raise ValueError(
-                f"prompt_lens must be ({prompt.shape[0]},) ints in "
-                f"[1, {P_len}] (rows RIGHT-aligned: real tokens are "
-                f"prompt[b, P-lens[b]:]), got {lens}")
+        if isinstance(prompt_lens, jax.Array) \
+                and not prompt_lens.is_fully_addressable:
+            # a multi-process global array: validate shape/dtype and
+            # THIS host's addressable shards (the others validate
+            # their own — every process runs this same code)
+            if prompt_lens.shape != (prompt.shape[0],):
+                raise ValueError(
+                    f"prompt_lens shape {prompt_lens.shape} != "
+                    f"({prompt.shape[0]},)")
+            if not jnp.issubdtype(prompt_lens.dtype, jnp.integer):
+                raise ValueError(
+                    f"prompt_lens dtype {prompt_lens.dtype} must be "
+                    "integer")
+            for sh in prompt_lens.addressable_shards:
+                local = np.asarray(sh.data)
+                if (local < 1).any() or (local > P_len).any():
+                    raise ValueError(
+                        f"prompt_lens values must be in [1, {P_len}]; "
+                        f"this host's shard holds {local}")
+            lens = prompt_lens.astype(jnp.int32)
+        else:
+            lens = np.asarray(prompt_lens)
+            if lens.shape != (prompt.shape[0],) \
+                    or (lens < 1).any() or (lens > P_len).any():
+                raise ValueError(
+                    f"prompt_lens must be ({prompt.shape[0]},) ints in "
+                    f"[1, {P_len}] (rows RIGHT-aligned: real tokens "
+                    f"are prompt[b, P-lens[b]:]), got {lens}")
+            lens = jnp.asarray(lens, jnp.int32)
         if "padded" not in lazy:
             lazy["padded"] = jax.jit(jax.shard_map(
                 body_padded,
@@ -684,8 +706,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 in_specs=(specs, batch_spec, batch_spec, P()),
                 out_specs=batch_spec,
             ))
-        return lazy["padded"](
-            params, prompt, jnp.asarray(lens, jnp.int32), key)
+        return lazy["padded"](params, prompt, lens, key)
 
     # the underlying jitted program, exposed for lowering/inspection
     # (utils.comm_model parses its HLO for the decode wire model)

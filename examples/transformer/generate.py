@@ -175,7 +175,7 @@ def main():
             for i, ln in enumerate(f):
                 if not ln.strip():
                     continue          # blank lines skipped, numbering
-                ln = ln.rstrip("\n")  # stays physical for errors
+                ln = ln.rstrip("\r\n")  # CRLF-safe; numbering physical
                 rows.append(check_ids(
                     tok.encode(ln) if tok is not None else
                     [int(t) for t in ln.split(",") if t.strip()],

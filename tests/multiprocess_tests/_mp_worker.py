@@ -776,6 +776,33 @@ def scenario_decode(comm):
         assert all(t == all_toks[0] for t in all_toks[1:]), \
             f"{name}: processes disagree on generated tokens"
 
+    # padded + eos over a cross-process data axis: the early-stop
+    # while-loop's pmax flag and the per-row pad masks span the
+    # boundary; tokens must equal the process-local padded oracle
+    lens = np.asarray([3, 1, 2, 3])
+    padded = np.full((4, 3), 7, np.int32)
+    rng = np.random.RandomState(8)
+    for b, L in enumerate(lens):
+        padded[b, 3 - L:] = rng.randint(0, base.vocab_size, L)
+    pl = jnp.asarray(padded)
+    kw = dict(max_len=8, eos_id=5, pad_id=0)
+    ref2 = np.asarray(
+        make_generate_fn(one, base, **kw)(
+            shard_params(one, base, host), pl, prompt_lens=lens))
+    mc = MeshConfig(data=2, devices=jax.devices())
+    sh = mc.sharding(("data", "expert"))
+    got = make_generate_fn(mc, base, **kw)(
+        shard_params(mc, base, host), jax.device_put(pl, sh),
+        prompt_lens=jax.device_put(jnp.asarray(lens, jnp.int32), sh))
+    shard = got.addressable_shards[0]
+    row0 = shard.index[0].start or 0
+    alls = dict(comm.allgather_obj(
+        (int(row0), np.asarray(shard.data).tolist())))
+    full = np.concatenate(
+        [np.asarray(alls[r], np.int32) for r in sorted(alls)], axis=0)
+    np.testing.assert_array_equal(
+        full, ref2, err_msg="cross-process padded+eos decode diverged")
+
 
 def scenario_speculative_decode(comm):
     """Speculative decoding ACROSS the process boundary: 2 processes ×
