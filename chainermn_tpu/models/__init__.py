@@ -6,6 +6,7 @@ from .convnets import ConvNetConfig, convnet_apply, init_convnet
 from .decoding import (
     make_beam_search_fn,
     make_generate_fn,
+    make_lookup_generate_fn,
     make_speculative_generate_fn,
 )
 from .quantization import quantize_params_int8
@@ -50,6 +51,7 @@ __all__ = [
     "make_beam_search_fn",
     "make_forward_fn",
     "make_generate_fn",
+    "make_lookup_generate_fn",
     "make_speculative_generate_fn",
     "make_train_step",
     "mlp_apply",

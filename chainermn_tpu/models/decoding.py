@@ -53,7 +53,7 @@ from .transformer import (
 )
 
 __all__ = ["make_generate_fn", "make_beam_search_fn",
-           "make_speculative_generate_fn"]
+           "make_speculative_generate_fn", "make_lookup_generate_fn"]
 
 
 def _vary(x, *axes):
@@ -807,35 +807,8 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                 draft_cfg, d_params, d_cache, d_cur, pos + k,
                 with_logits=False)
             prop = jnp.stack(props, axis=1)               # (B, k)
-            # --- target verifies the whole proposal in one chunk ------ #
-            chunk = jnp.concatenate([cur[:, None], prop], axis=1)
-            tlog, t_cache = _decode_step(
-                cfg, params, t_cache, chunk, pos,
-                all_logits=True, chunk_attends_cache=True)
-            g = jnp.argmax(tlog, axis=-1).astype(jnp.int32)  # (B, k+1)
-            # g[:, j] = target's token for position pos+j+1 given the
-            # chunk prefix through pos+j; prop[:, j] was the draft's
-            # token for the same position — valid to compare only while
-            # every earlier proposal matched
-            match = prop == g[:, :k]                      # (B, k)
-            lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
-            # GLOBAL batch-min: every data shard advances in lockstep,
-            # keeping pos axis-invariant (the while carry/cond need it)
-            n_acc = lax.pmin(
-                jnp.min(lead.sum(axis=1)), ("data", "expert"))
-            # append prop[:, :n_acc] then the corrective/bonus token
-            # g[:, n_acc]: blend into the existing buffer slab so the
-            # positions beyond n_acc stay untouched
-            slab = lax.dynamic_slice(buf, (0, pos + 1), (B, k + 1))
-            j_idx = jnp.arange(k + 1)
-            bonus = jnp.take_along_axis(
-                g, jnp.full((B, 1), n_acc), axis=1)[:, 0]
-            slab = jnp.where(
-                j_idx[None, :] < n_acc, jnp.concatenate(
-                    [prop, prop[:, -1:]], axis=1),
-                jnp.where(j_idx[None, :] == n_acc,
-                          bonus[:, None], slab))
-            buf = lax.dynamic_update_slice(buf, slab, (0, pos + 1))
+            buf, t_cache, n_acc = _verify_and_commit(
+                cfg, params, t_cache, buf, pos, cur, prop, k)
             return (buf, pos + n_acc + 1, acc_sum + n_acc, rounds + 1,
                     t_cache, d_cache)
 
@@ -856,6 +829,150 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
 
     def generate(params, draft_params, prompt):
         toks, mean_acc = fn(params, draft_params, prompt)
+        return (toks, mean_acc) if with_stats else toks
+
+    generate._jitted = fn
+    return generate
+
+
+def _verify_and_commit(cfg, params, t_cache, buf, pos, cur, prop, k):
+    """The speculative round's second half, shared by every proposer
+    (draft model, prompt lookup): the target verifies ``prop`` (B, k)
+    in ONE (k+1)-wide chunk forward, the accepted prefix plus the
+    target's corrective/bonus token land in ``buf``, and acceptance is
+    the GLOBAL batch-min so every data shard advances in lockstep
+    (the while carry/cond need ``pos`` axis-invariant).  Returns
+    ``(buf, t_cache, n_acc)``."""
+    B = cur.shape[0]
+    chunk = jnp.concatenate([cur[:, None], prop], axis=1)
+    tlog, t_cache = _decode_step(
+        cfg, params, t_cache, chunk, pos,
+        all_logits=True, chunk_attends_cache=True)
+    g = jnp.argmax(tlog, axis=-1).astype(jnp.int32)   # (B, k+1)
+    # g[:, j] = target's token for position pos+j+1 given the chunk
+    # prefix through pos+j; prop[:, j] was the proposer's token for
+    # the same position — valid to compare only while every earlier
+    # proposal matched
+    match = prop == g[:, :k]                          # (B, k)
+    lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+    n_acc = lax.pmin(
+        jnp.min(lead.sum(axis=1)), ("data", "expert"))
+    # append prop[:, :n_acc] then the corrective/bonus token
+    # g[:, n_acc]: blend into the existing buffer slab so the
+    # positions beyond n_acc stay untouched
+    slab = lax.dynamic_slice(buf, (0, pos + 1), (B, k + 1))
+    j_idx = jnp.arange(k + 1)
+    bonus = jnp.take_along_axis(
+        g, jnp.full((B, 1), n_acc), axis=1)[:, 0]
+    slab = jnp.where(
+        j_idx[None, :] < n_acc, jnp.concatenate(
+            [prop, prop[:, -1:]], axis=1),
+        jnp.where(j_idx[None, :] == n_acc,
+                  bonus[:, None], slab))
+    buf = lax.dynamic_update_slice(buf, slab, (0, pos + 1))
+    return buf, t_cache, n_acc
+
+
+def make_lookup_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
+                            k: int = 4, ngram: int = 2,
+                            max_len: int = 0, quantized: bool = False,
+                            with_stats: bool = False):
+    """Greedy prompt-lookup decoding: speculative decoding whose
+    proposer is an N-GRAM MATCH against the already-generated context
+    instead of a draft model (Saxena's prompt-lookup trick).  Each
+    round takes the last ``ngram`` tokens, finds their most recent
+    earlier occurrence in the buffer, proposes the ``k`` tokens that
+    followed it, and lets the target verify the whole chunk — so
+    copying-heavy workloads (summarisation, code edit, RAG quoting)
+    emit several tokens per target-weight read with NO second model,
+    no extra memory, and the same exact-greedy guarantee as
+    :func:`make_speculative_generate_fn` (a miss costs one verify
+    chunk and still emits one correct token).
+
+    The matcher is pure vectorised compare/gather on the (B, L) token
+    buffer — a few KB of integer work per round, nothing a TPU
+    notices next to the verify matmuls.  Prompts must be at least
+    ``ngram`` long; ``seq`` mesh axis must be 1 (same mid-sequence
+    chunk contract as speculative).  Returns ``generate(params,
+    prompt)`` (``with_stats=True`` appends mean accepted proposals
+    per round, the number to watch: it IS the speedup lever).
+    """
+    if k < 1 or ngram < 1:
+        raise ValueError(f"k={k} and ngram={ngram} must be >= 1")
+    if mesh_cfg.mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "prompt-lookup decoding writes mid-sequence chunks, which "
+            "the seq-KV blockwise layout does not support: use a "
+            "seq=1 mesh (shard batch/heads/layers instead)")
+    max_len, kv_len_local, kv_heads_local, layers_local = \
+        _decode_preamble(mesh_cfg, cfg, max_len)
+    specs = param_specs(cfg, quantized=quantized)
+    batch_spec = P(("data", "expert"))
+    pad = k + 1
+    L = max_len + pad
+
+    def body(params, prompt):
+        B, Plen = prompt.shape
+        if Plen < ngram:
+            raise ValueError(
+                f"prompt length {Plen} < ngram {ngram}: the first "
+                "lookup window would cross the buffer start")
+        t_cache = _make_cache(cfg, B, kv_len_local + pad,
+                              kv_heads_local, layers_local)
+        buf = jnp.zeros((B, L), jnp.int32)
+        buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+        if Plen > 1:
+            _, t_cache = _decode_step(
+                cfg, params, t_cache, prompt[:, :Plen - 1], 0,
+                with_logits=False)
+
+        # static window table: window w covers buf[w .. w+ngram-1]
+        # and ENDS at position w+ngram-1
+        widx = jnp.arange(L - ngram + 1)[:, None] + jnp.arange(ngram)
+        ends = jnp.arange(L - ngram + 1) + ngram - 1
+
+        def cond(carry):
+            return carry[1] < max_len - 1
+
+        def round_body(carry):
+            buf, pos, acc_sum, rounds, t_cache = carry
+            cur = lax.dynamic_slice(buf, (0, pos), (B, 1))[:, 0]
+            # --- lookup proposer ---------------------------------- #
+            suffix = lax.dynamic_slice(
+                buf, (0, pos - (ngram - 1)), (B, ngram))
+            windows = buf[:, widx]                    # (B, W, ngram)
+            hit = (windows == suffix[:, None, :]).all(-1) \
+                & (ends[None, :] < pos)               # (B, W)
+            # most recent earlier occurrence; -1 = no match, which
+            # clamps src to the buffer head (proposing the first k
+            # prompt tokens — an arbitrary but harmless guess:
+            # verification keeps output exact regardless)
+            j = jnp.max(jnp.where(hit, ends[None, :], -1), axis=1)
+            src = jnp.clip(
+                j[:, None] + 1 + jnp.arange(k)[None], 0, L - 1)
+            prop = jnp.take_along_axis(buf, src, axis=1)  # (B, k)
+            buf, t_cache, n_acc = _verify_and_commit(
+                cfg, params, t_cache, buf, pos, cur, prop, k)
+            return (buf, pos + n_acc + 1, acc_sum + n_acc,
+                    rounds + 1, t_cache)
+
+        buf, _, acc_sum, rounds, _ = lax.while_loop(
+            cond, round_body,
+            (buf, jnp.int32(Plen - 1), jnp.int32(0), jnp.int32(0),
+             t_cache))
+        mean_acc = acc_sum.astype(jnp.float32) \
+            / jnp.maximum(rounds, 1).astype(jnp.float32)
+        return buf[:, :max_len], mean_acc
+
+    fn = jax.jit(jax.shard_map(
+        body,
+        mesh=mesh_cfg.mesh,
+        in_specs=(specs, batch_spec),
+        out_specs=(batch_spec, P()),
+    ))
+
+    def generate(params, prompt):
+        toks, mean_acc = fn(params, prompt)
         return (toks, mean_acc) if with_stats else toks
 
     generate._jitted = fn

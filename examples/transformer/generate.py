@@ -78,6 +78,13 @@ def main():
                         "to plain greedy")
     p.add_argument("--draft-layers", type=int, default=0,
                    help="draft model depth (default n_layers/2)")
+    p.add_argument("--lookup-k", type=int, default=0,
+                   help="prompt-lookup decoding: propose k tokens from "
+                        "the last n-gram's most recent earlier "
+                        "occurrence in the context — speculative "
+                        "decoding with NO draft model; output is "
+                        "token-identical to plain greedy")
+    p.add_argument("--lookup-ngram", type=int, default=2)
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode")
     p.add_argument("--vocab-parallel", action="store_true",
@@ -165,11 +172,11 @@ def main():
 
     prompt_lens = None
     if args.prompt_file is not None:
-        if args.beam > 0 or args.speculative_k > 0:
+        if args.beam > 0 or args.speculative_k > 0 or args.lookup_k > 0:
             raise SystemExit(
                 "--prompt-file (variable-length batch) works with "
-                "greedy/sampling only — beam and speculative decoding "
-                "require equal prompt lengths")
+                "greedy/sampling only — beam, speculative, and lookup "
+                "decoding require equal prompt lengths")
         rows = []
         with open(args.prompt_file) as f:
             for i, ln in enumerate(f):
@@ -211,11 +218,28 @@ def main():
         if tok is not None:
             print(f"{label} text:", repr(tok.decode_text(ids)))
 
-    if args.eos_id >= 0 and args.speculative_k > 0:
+    if args.eos_id >= 0 and (args.speculative_k > 0
+                             or args.lookup_k > 0):
         raise SystemExit(
-            "--eos-id is not supported with --speculative-k (the "
-            "verify chunk has no per-row freeze); drop one of the two")
-    if args.speculative_k > 0:
+            "--eos-id is not supported with --speculative-k/--lookup-k "
+            "(the verify chunk has no per-row freeze); drop one")
+    if args.lookup_k > 0 and (args.speculative_k > 0 or args.beam > 0):
+        raise SystemExit(
+            "--lookup-k is its own decode mode; drop --speculative-k/"
+            "--beam")
+    if args.lookup_k > 0:
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        lk = make_lookup_generate_fn(
+            mc, cfg, k=args.lookup_k, ngram=args.lookup_ngram,
+            max_len=args.max_len, quantized=args.int8, with_stats=True)
+        out, mean_acc = lk(params, prompt)
+        print(f"prompt-lookup k={args.lookup_k} "
+              f"ngram={args.lookup_ngram}: mean accepted "
+              f"proposals/round {float(mean_acc):.2f} "
+              f"(~{float(mean_acc) + 1:.2f} tokens per target read)")
+        show(np.asarray(out)[0].tolist())
+    elif args.speculative_k > 0:
         import dataclasses
 
         from chainermn_tpu.models import make_speculative_generate_fn

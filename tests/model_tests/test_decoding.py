@@ -666,6 +666,86 @@ class TestSpeculative:
                 MeshConfig(seq=2, data=4), cfg, cfg)
 
 
+class TestLookupDecoding:
+    """Prompt-lookup decoding: exact-greedy output no matter what the
+    n-gram matcher proposes, and real acceptance on the workloads it
+    exists for (repetitive/copying text)."""
+
+    def _trained(self, cfg, seed=0):
+        return TestSpeculative._trained_host(
+            TestSpeculative(), cfg, seed)
+
+    @pytest.mark.parametrize("k,ngram", [(2, 1), (4, 2), (3, 3)])
+    def test_matches_greedy(self, k, ngram):
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg()
+        host = self._trained(cfg)
+        p = prompt(seed=40, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(params, p))
+        got, acc = make_lookup_generate_fn(
+            one, cfg, k=k, ngram=ngram, max_len=T, with_stats=True)(
+            params, p)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        assert 0.0 <= float(acc) <= k
+
+    def test_repetitive_sequence_accepts(self):
+        """The trained tiny model emits short repeats ("60 60 60 60");
+        with IDENTICAL rows (acceptance is batch-min — mixed batches
+        clamp to the worst row) lookup proposals must land at least
+        once, proving the matcher finds real earlier occurrences."""
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg()
+        host = self._trained(cfg)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        row = np.random.RandomState(40).randint(0, VOCAB, 4)
+        p = jnp.asarray(np.tile(row, (B, 1)), jnp.int32)
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(params, p))
+        got, acc = make_lookup_generate_fn(
+            one, cfg, k=3, ngram=2, max_len=T, with_stats=True)(
+            params, p)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        assert float(acc) > 0.05, float(acc)
+
+    def test_tp_mesh_matches_greedy(self):
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        host = self._trained(cfg, 1)
+        p = prompt(seed=42, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(
+                shard_params(one, cfg, host), p))
+        mc = MeshConfig(data=2, model=2, devices=jax.devices()[:4])
+        got = np.asarray(make_lookup_generate_fn(
+            mc, cfg, k=3, ngram=2, max_len=T)(
+            shard_params(mc, cfg, host), p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_validation(self):
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg()
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        with pytest.raises(ValueError, match="k="):
+            make_lookup_generate_fn(one, cfg, k=0)
+        with pytest.raises(ValueError, match="seq"):
+            make_lookup_generate_fn(MeshConfig(seq=2, data=4), cfg)
+        # prompt shorter than the ngram window fails at trace time
+        gen = make_lookup_generate_fn(one, cfg, k=2, ngram=4, max_len=T)
+        params = shard_params(
+            one, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+        with pytest.raises(ValueError, match="ngram"):
+            gen(params, prompt(length=2))
+
+
 def test_virtual_pipe_packed_params_decode():
     """Params packed for the interleaved schedule (pipe=1, V=2) decode
     identically to flat packing."""
