@@ -334,6 +334,108 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
     return record
 
 
+def analyze(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
+            n_kv_heads=0, attention="flash", remat_policy="full",
+            vocab=32000, loss_chunk=0, record=True):
+    """First-principles roofline for the train step: closed-form FLOPs
+    and HBM bytes (every term itemised in the output), each TPU
+    generation's step-time floor ``max(flops/peak, bytes/bw)``, and
+    the MFU ceiling that floor implies.  Backend-independent on
+    purpose: XLA ``cost_analysis`` on a non-TPU backend counts
+    scan/while bodies ONCE (measured here: a 300M step reported 4.8
+    TFLOPs where the per-layer arithmetic alone is ~33), so an
+    abstract-compile approach silently lies off-chip — arithmetic
+    doesn't."""
+    D, L, V, B, T = d_model, n_layers, vocab, batch, seq
+    kv = n_kv_heads or n_heads
+    tokens = B * T
+    N_block = L * (2 * D * D * (1 + kv / n_heads)   # fused q + kv
+                   + 2 * D * D                      # wo (in+out width)
+                   + 8 * D * D)                     # mlp w1 + w2
+    N = N_block + V * D                             # + tied embed/head
+    # matmul flops: 2 MACs per weight per token, fwd; bwd doubles
+    # (grad wrt inputs + wrt weights); full remat re-runs fwd once,
+    # `dots` saves matmul outputs so recompute is ~elementwise (~0)
+    rec = {"full": 1.0, "dots": 0.15, "none": 0.0}[remat_policy]
+    fwd_mm = 2.0 * tokens * N
+    # flash attention core, causal: QK^T + PV = 4·B·T²·D·(1/2), fwd
+    fwd_attn = 2.0 * L * B * T * T * D
+    F = (3.0 + rec) * (fwd_mm + fwd_attn)
+    flops_terms = {
+        "matmul_fwd": fwd_mm, "attention_fwd": fwd_attn,
+        "bwd_factor": 2.0, "remat_recompute_factor": rec,
+    }
+    # HBM bytes: fp32 params/grads/moments, bf16 activations
+    p4 = N * 4.0
+    bytes_terms = {
+        # fwd + bwd + recompute read the (fp32) weights
+        "param_reads": (2.0 + rec) * p4,
+        "grad_write_read": 2.0 * p4,
+        # adamw: read p/m/v, write p/m/v (+ grad read counted above)
+        "optimizer": 6.0 * p4,
+        # full remat saves only the L layer-boundary activations
+        # (write fwd + read bwd); `dots` saves matmul outputs (~6
+        # D-wide tensors per layer: qkv, attn-out, wo, w1, w2 +
+        # norms); no remat saves every intermediate incl. the 4D-wide
+        # MLP hidden (~10 D-widths/layer, rough — flash keeps the T²
+        # score internals out of HBM either way)
+        "activation_checkpoints":
+            (2.0 * L * B * T * D * 2)
+            * {"full": 1.0, "dots": 6.0, "none": 10.0}[remat_policy],
+        # the fp32 logits tensor: written fwd, read in bwd (XLA fuses
+        # log-softmax into consumers but the (B,T,V) buffer itself is
+        # resident unless loss_chunk skips it)
+        "logits": 0.0 if loss_chunk else 2.0 * tokens * V * 4.0,
+        "embed_io": tokens * D * 2.0 * 2,      # lookup out + grad in
+    }
+    Bt = float(sum(bytes_terms.values()))
+    F = float(F)
+    out = {
+        "metric": "transformer_step_roofline",
+        "config": {"batch": batch, "seq": seq, "d_model": d_model,
+                   "n_layers": n_layers, "n_heads": n_heads,
+                   "n_kv_heads": n_kv_heads, "attention": attention,
+                   "remat_policy": remat_policy, "vocab": vocab,
+                   "loss_chunk": loss_chunk},
+        "n_params": int(N),
+        "flops": F, "bytes": Bt,
+        "flops_terms": {k: float(v) for k, v in flops_terms.items()},
+        "bytes_terms": {k: round(v / 1e9, 2) for k, v
+                        in bytes_terms.items()},
+        "bytes_unit_note": "bytes_terms in GB",
+        "intensity_flops_per_byte": round(F / Bt, 1),
+        "rooflines": {},
+    }
+    for kind, peak, bw in (("v5e", 197e12, 819e9),
+                           ("v4", 275e12, 1228e9),
+                           ("v5p", 459e12, 2765e9)):
+        t_c, t_m = F / peak, Bt / bw
+        t = max(t_c, t_m)
+        out["rooflines"][kind] = {
+            "t_compute_ms": round(t_c * 1e3, 1),
+            "t_memory_ms": round(t_m * 1e3, 1),
+            "bound": "memory" if t_m > t_c else "compute",
+            "step_floor_ms": round(t * 1e3, 1),
+            "tokens_per_sec_ceiling": round(tokens / t),
+            "mfu_ceiling": round(min(1.0, t_c / t), 3),
+        }
+    # merge into SPEED_RAW.json without clobbering a measured breakdown
+    if record:
+        try:
+            try:
+                with open(RAW_PATH) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                rec = {}
+            rec["roofline"] = out
+            with open(RAW_PATH, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        except OSError:
+            pass
+    return out
+
+
 def main(argv):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--batch", type=int, default=8)
@@ -348,8 +450,23 @@ def main(argv):
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--iters", type=int, default=8)
     p.add_argument("--platform", default=None)
+    p.add_argument("--analyze-only", action="store_true",
+                   help="no execution: closed-form first-principles "
+                        "FLOPs/bytes (every term itemised) + per-TPU "
+                        "roofline floors and MFU ceilings")
+    p.add_argument("--no-record", action="store_true",
+                   help="analyze-only: print without touching "
+                        "SPEED_RAW.json (tests use this)")
     args = p.parse_args(argv)
     pin_platform(args.platform)
+    if args.analyze_only:
+        print(json.dumps(analyze(
+            batch=args.batch, seq=args.seq, d_model=args.d_model,
+            n_layers=args.n_layers, n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads, attention=args.attention,
+            remat_policy=args.remat_policy,
+            record=not args.no_record)))
+        return 0
     record = run(batch=args.batch, seq=args.seq, d_model=args.d_model,
                  n_layers=args.n_layers, n_heads=args.n_heads,
                  n_kv_heads=args.n_kv_heads, attention=args.attention,

@@ -160,3 +160,33 @@ def test_other_benches_contract(script, args, unit):
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
         expect_value=True)
     assert rec["unit"] == unit
+
+
+def test_breakdown_analyze_only_roofline():
+    """--analyze-only: first-principles FLOPs/bytes with itemised
+    terms, per-generation floors, and the headline claim SPEED.md
+    leans on — the 300M bench config is COMPUTE-bound (intensity far
+    past every TPU ridge), so no roofline ceiling excuses MFU."""
+    import json
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "bench_breakdown.py", "--platform", "cpu",
+         "--analyze-only", "--no-record"],
+        capture_output=True, text=True, timeout=300, cwd=_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "transformer_step_roofline"
+    # terms must sum to the totals they itemise (GB rounding tolerance)
+    assert abs(sum(rec["bytes_terms"].values()) * 1e9
+               - rec["bytes"]) < 1e8
+    f = rec["flops_terms"]
+    assert rec["flops"] == pytest.approx(
+        (1 + f["bwd_factor"] + f["remat_recompute_factor"])
+        * (f["matmul_fwd"] + f["attention_fwd"]))
+    for kind, roof in rec["rooflines"].items():
+        assert roof["bound"] == "compute", (kind, roof)
+        assert roof["mfu_ceiling"] == 1.0
+        assert roof["step_floor_ms"] == roof["t_compute_ms"]
+    assert rec["intensity_flops_per_byte"] > 1000
