@@ -349,8 +349,8 @@ def analyze(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
     D, L, V, B, T = d_model, n_layers, vocab, batch, seq
     kv = n_kv_heads or n_heads
     tokens = B * T
-    N_block = L * (2 * D * D * (1 + kv / n_heads)   # fused q + kv
-                   + 2 * D * D                      # wo (in+out width)
+    N_block = L * (D * D * (1 + 2 * kv / n_heads)   # q + k + v projs
+                   + D * D                          # wo
                    + 8 * D * D)                     # mlp w1 + w2
     N = N_block + V * D                             # + tied embed/head
     # matmul flops: 2 MACs per weight per token, fwd; bwd doubles

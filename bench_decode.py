@@ -42,7 +42,8 @@ def _timed(fn, iters, n_warm=1):
 
 
 def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
-        n_heads=16, n_kv_heads=4, warmup=1, iters=2, int8=False):
+        n_heads=16, n_kv_heads=4, warmup=1, iters=2, int8=False,
+        kv_int8=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -58,6 +59,7 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
         n_kv_heads=n_kv_heads, d_head=d_model // n_heads,
         d_ff=4 * d_model, n_layers=n_layers, max_seq=max_len,
         attention="local", pos_embedding="rope", dtype="bfloat16",
+        kv_cache_dtype="int8" if kv_int8 else "",
         remat=False,
     )
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
@@ -153,6 +155,7 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
         "n_params": int(n_params),
         "n_kv_heads": n_kv_heads,
         "int8": int8,
+        "kv_int8": kv_int8,
         "prefill_len": p2 - 1,
         "prefill_tokens_per_sec":
             round(prefill_tok_s, 1) if prefill_tok_s else None,
@@ -285,6 +288,9 @@ def main(argv):
     p.add_argument("--d-model", type=int, default=1024)
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode (quantize_params_int8)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache (kv_cache_dtype='int8'): half "
+                        "the cache HBM; composes with --int8")
     p.add_argument("--cheap-draft", action="store_true",
                    help="speculative decoding with a truncated cheap "
                         "draft: k sweep + measured acceptance + speedup "
@@ -301,10 +307,10 @@ def main(argv):
     p.add_argument("--timeouts", type=int, nargs="+",
                    default=[1500])  # several decode-loop compiles
     args = p.parse_args(argv)
-    if args.cheap_draft and args.int8:
+    if args.cheap_draft and (args.int8 or args.kv_int8):
         p.error("--cheap-draft measures the bf16 draft-vs-target "
-                "economics; run --int8 separately (the flag would be "
-                "silently ignored otherwise)")
+                "economics; run --int8/--kv-int8 separately (the "
+                "flags would be silently ignored otherwise)")
 
     if args.child:
         pin_platform(args.platform)
@@ -320,7 +326,8 @@ def main(argv):
             print("BENCH_RESULT " + json.dumps(run(
                 batch=args.batch, max_len=args.max_len,
                 n_layers=args.n_layers, d_model=args.d_model,
-                warmup=args.warmup, iters=args.iters, int8=args.int8)))
+                warmup=args.warmup, iters=args.iters, int8=args.int8,
+                kv_int8=args.kv_int8)))
         return 0
 
     here = os.path.abspath(__file__)
@@ -332,6 +339,7 @@ def main(argv):
            "--draft-layers", str(args.draft_layers),
            "--eps", str(args.eps)] \
         + (["--int8"] if args.int8 else []) \
+        + (["--kv-int8"] if args.kv_int8 else []) \
         + (["--cheap-draft"] if args.cheap_draft else [])
     if args.platform:
         cmd += ["--platform", args.platform]
@@ -343,7 +351,7 @@ def main(argv):
         if args.cheap_draft else
         {"batch": args.batch, "max_len": args.max_len,
          "d_model": args.d_model, "n_layers": args.n_layers,
-         "int8": args.int8})
+         "int8": args.int8, "kv_int8": args.kv_int8})
     return run_child_with_retries(
         cmd, os.path.dirname(here), args.timeouts, metric, UNIT,
         use_cache=args.platform is None, cache_match=cache_match)

@@ -42,6 +42,8 @@ BATTERY = [
     (["python", "bench_decode.py", "--cheap-draft", "--n-layers", "16"],
      2100),
     (["python", "bench_decode.py", "--int8"], 1800),
+    # int8 weights + int8 KV cache: the full serving-quantisation stack
+    (["python", "bench_decode.py", "--int8", "--kv-int8"], 1800),
     (["python", "bench_attention.py"], 1200),
     (["python", "bench_seq2seq.py"], 1200),
     (["python", "bench_loader.py"], 600),

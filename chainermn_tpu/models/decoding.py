@@ -89,19 +89,22 @@ def _dense_q(dense, x, blk, name, cd):
     return y
 
 
-def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
+def _decode_block(cfg: TransformerConfig, h, blk, caches, pos,
                   write_mask=None, chunk_attends_cache=False,
                   pos_offset=None):
     """One block for a CHUNK of new tokens.  ``h``: (B, Tq, D) — Tq = 1
     in the generation loop, Tq = prompt length in batched prefill;
-    ``ck``/``cv``: (B, kv_len_local, Hkv_local, Dh) this layer's cache;
-    ``pos``: scalar GLOBAL position of the chunk's FIRST token (Tq > 1
-    requires ``pos == 0`` — the prefill contract).  ``write_mask``
-    (scalar bool) gates the cache update — pipe-parallel phases where
-    this device does NOT own the running stage must leave their cache
-    untouched, and masking the written slice here is O(written) instead
-    of the O(cache) select a whole-buffer ``where`` would cost per
-    phase.
+    ``caches``: this layer's ``(ck, cv)`` pair of (B, kv_len_local,
+    Hkv_local, Dh) buffers — or ``(ck, cv, ck_s, cv_s)`` with
+    ``kv_cache_dtype="int8"``, where the values are int8 and the
+    scales carry a trailing singleton so every write below treats
+    values and scales identically; ``pos``: scalar GLOBAL position of
+    the chunk's FIRST token (Tq > 1 requires ``pos == 0`` — the
+    prefill contract).  ``write_mask`` (scalar bool) gates the cache
+    update — pipe-parallel phases where this device does NOT own the
+    running stage must leave their cache untouched, and masking the
+    written slice here is O(written) instead of the O(cache) select a
+    whole-buffer ``where`` would cost per phase.
 
     Sequence-parallel KV (``seq`` axis size R > 1): the cache's length
     dim holds only this member's max_len/R BLOCK of positions (member r
@@ -111,8 +114,10 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
     merged by a max/sum-exp reduction over the axis (the psum twin of
     ring attention's log-space merge) — per chunk that is one pmax +
     one psum of query-sized partials, NOT a cache-sized gather.
-    Returns (h, ck, cv)."""
+    Returns (h, caches)."""
     cd = cfg.compute_dtype
+    ck, cv, *scales = caches
+    ck_s, cv_s = scales if scales else (None, None)
     x = _rms_norm(h, blk["ln1"])
     B, Tq, D = x.shape
     R = lax.axis_size("seq")
@@ -141,7 +146,23 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             rpos = jnp.maximum(qpos[None, :] - pos_offset[:, None], 0)
         q = apply_rope(q, rpos, cfg.rope_theta)
         k_new = apply_rope(k_new, rpos, cfg.rope_theta)
-    k_new, v_new = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
+    # the chunk's own K/V at compute precision — the prefill fast path
+    # attends these directly (cache-dtype quantisation applies only to
+    # what later steps READ BACK)
+    k_raw, v_raw = k_new, v_new
+    if ck_s is not None:
+        # int8 KV: per-(token, head) absmax scale, trailing singleton
+        def quant(t, sdtype):
+            s = jnp.maximum(
+                jnp.max(jnp.abs(t), axis=-1, keepdims=True) / 127.0,
+                1e-8).astype(sdtype)
+            q8 = jnp.round(t / s.astype(t.dtype)).astype(jnp.int8)
+            return q8, s
+
+        k_new, k_sc = quant(k_new, ck_s.dtype)
+        v_new, v_sc = quant(v_new, cv_s.dtype)
+    else:
+        k_new, v_new = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
     if pos_offset is not None and R > 1:
         raise ValueError(
             "left-padded prompts (pos_offset) are not supported under "
@@ -183,6 +204,8 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             return jnp.where(vmask, sl, cache)
 
         ck, cv = blk_write(ck, k_new), blk_write(cv, v_new)
+        if ck_s is not None:
+            ck_s, cv_s = blk_write(ck_s, k_sc), blk_write(cv_s, v_sc)
     else:
         if R > 1:
             # member pos // Tl owns this position; everyone computes
@@ -195,20 +218,23 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             lpos = pos % Tl
         else:
             lpos = pos
-        if write_mask is not None:
-            cur_k = lax.dynamic_slice(ck, (0, lpos, 0, 0), k_new.shape)
-            cur_v = lax.dynamic_slice(cv, (0, lpos, 0, 0), v_new.shape)
-            k_new = jnp.where(write_mask, k_new, cur_k)
-            v_new = jnp.where(write_mask, v_new, cur_v)
-        ck = lax.dynamic_update_slice(ck, k_new, (0, lpos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v_new, (0, lpos, 0, 0))
+        def slot_write(cache, new):
+            if write_mask is not None:
+                cur = lax.dynamic_slice(
+                    cache, (0, lpos, 0, 0), new.shape)
+                new = jnp.where(write_mask, new, cur)
+            return lax.dynamic_update_slice(cache, new, (0, lpos, 0, 0))
+
+        ck, cv = slot_write(ck, k_new), slot_write(cv, v_new)
+        if ck_s is not None:
+            ck_s, cv_s = slot_write(ck_s, k_sc), slot_write(cv_s, v_sc)
     if Tq > 1 and not chunk_attends_cache:
         # prefill (pos == 0): the chunk's own K/V — still in hand,
         # replicated — ARE the entire attendable set, so causal
         # attention runs directly on them: no max_len-sized cache read
         # (Tq × max_len masked scores would be mostly waste) and no
         # distributed merge even under seq-KV
-        o = local_attention(q, k_new.astype(cd), v_new.astype(cd),
+        o = local_attention(q, k_raw.astype(cd), v_raw.astype(cd),
                             causal=True,
                             window=cfg.attention_window or None)
     else:
@@ -217,7 +243,11 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
         # position.  Tq > 1 lands here for mid-sequence chunks
         # (speculative verify): the chunk's K/V were just written, so
         # the cache holds everything each query may attend to.
-        s = _qk_scores(q, ck.astype(cd)) * (cfg.d_head ** -0.5)
+        kk = ck.astype(cd) * ck_s.astype(cd) if ck_s is not None \
+            else ck.astype(cd)
+        vv = cv.astype(cd) * cv_s.astype(cd) if cv_s is not None \
+            else cv.astype(cd)
+        s = _qk_scores(q, kk) * (cfg.d_head ** -0.5)
         kpos = jnp.arange(Tl)
         if R > 1:
             kpos = kpos + lax.axis_index("seq") * Tl
@@ -242,11 +272,11 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
             m = lax.pmax(s.max(axis=-1, keepdims=True), "seq")
             e = jnp.exp(s - m)
             n = lax.psum(e.sum(axis=-1, keepdims=True), "seq")
-            o = lax.psum(_pv_mix(e, cv.astype(cd)), "seq")
+            o = lax.psum(_pv_mix(e, vv), "seq")
             o = (o / n).transpose(0, 2, 1, 3)             # (B,Tq,Hl,Dh)
         else:
             p = jax.nn.softmax(s, axis=-1)
-            o = _pv_mix(p, cv.astype(cd)).transpose(0, 2, 1, 3)
+            o = _pv_mix(p, vv).transpose(0, 2, 1, 3)
     h = h + _dense_q(row_parallel_dense, o.reshape(B, Tq, -1),
                      blk, "wo", cd)
 
@@ -286,7 +316,7 @@ def _decode_block(cfg: TransformerConfig, h, blk, ck, cv, pos,
     else:
         y = jax.nn.relu(_dense_q(column_parallel_dense, x, blk, "w1", cd))
         h = h + _dense_q(row_parallel_dense, y, blk, "w2", cd)
-    return h, ck, cv
+    return h, ((ck, cv) if ck_s is None else (ck, cv, ck_s, cv_s))
 
 
 def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
@@ -369,13 +399,13 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
         mine = stage == p
 
         def layer(h, xs, mine=mine):
-            blk, ck, cv = xs
-            h, ck, cv = _decode_block(
-                cfg, h, blk, ck, cv, pos,
+            blk, *cc = xs
+            h, cc = _decode_block(
+                cfg, h, blk, tuple(cc), pos,
                 write_mask=None if S == 1 else mine,
                 chunk_attends_cache=chunk_attends_cache,
                 pos_offset=pos_offset)
-            return h, (ck, cv)
+            return h, cc
 
         out, caches = lax.scan(layer, h_in, (blocks, *caches))
         if p < S - 1:
@@ -384,10 +414,9 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
             # ppermute's zero fill, masked out by the where)
             sent = lax.ppermute(out, "pipe", [(p, p + 1)])
             h_in = jnp.where(stage == p + 1, sent, h_in)
-    ck, cv = caches
     if not with_logits:
         # prefill: the cache fill IS the product; skip norm + head
-        return None, (ck, cv)
+        return None, tuple(caches)
     # only the LAST stage's output is the model's hidden state; zeros
     # elsewhere make the head a masked partial whose closing psum both
     # broadcasts the logits and re-replicates the pipe axis (free at
@@ -412,7 +441,7 @@ def _decode_step(cfg: TransformerConfig, params, caches, tok, pos,
         # (invariant: identical on every model member afterwards)
         logits = _all_gather_invariant(
             logits, "model", axis=logits.ndim - 1, tiled=True)
-    return logits, (ck, cv)
+    return logits, tuple(caches)
 
 
 def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
@@ -462,18 +491,25 @@ def _make_cache(cfg: TransformerConfig, rows: int, kv_len_local: int,
     decode each device holds ONLY its stage's cache (the S× capacity
     win); ``kv_len_local`` = max_len / seq-axis-size — with
     sequence-parallel KV each member holds only its block of positions
-    (the R× context win)."""
+    (the R× context win).  ``kv_cache_dtype="int8"`` stores values
+    int8 plus fp32 per-(token, head) scales with a trailing singleton
+    (so cache writes treat values and scales identically) — half the
+    cache HBM, which is what bounds long-context decode."""
     axes = ["pipe", "data", "expert", "model"]
     if lax.axis_size("seq") > 1:
         # seq-varying only when the axis is real: at R == 1 the
         # single-member softmax path never psums over seq, so a varying
         # cache would leak seq variance into the logits' vma type
         axes.append("seq")
+    int8 = cfg.kv_cache_dtype == "int8"
+    val_dtype = jnp.int8 if int8 else cfg.compute_dtype
+    shapes = [(layers_local, rows, kv_len_local, kv_heads_local,
+               cfg.d_head, val_dtype)] * 2
+    if int8:
+        shapes += [(layers_local, rows, kv_len_local, kv_heads_local,
+                    1, jnp.float32)] * 2
     return tuple(
-        _vary(jnp.zeros((layers_local, rows, kv_len_local,
-                         kv_heads_local, cfg.d_head), cfg.compute_dtype),
-              *axes)
-        for _ in range(2))
+        _vary(jnp.zeros(sh[:-1], sh[-1]), *axes) for sh in shapes)
 
 
 def _filter_logits(logits, top_k: int, top_p: float):

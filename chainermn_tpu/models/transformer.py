@@ -155,6 +155,13 @@ class TransformerConfig:
     # vocab_parallel (live logits (B, chunk, V/M) — both savings
     # multiply; see _vp_head_nll).  Trade measured by
     # bench_breakdown.py's lm_head_loss vs lm_head_loss_chunked rows.
+    kv_cache_dtype: str = ""  # decode-time KV cache storage: "" =>
+    # compute dtype; "int8" => values int8 with a per-(token, head)
+    # absmax scale — halves cache HBM traffic and doubles the context
+    # a chip's memory holds.  Long-context decode is cache-bound, not
+    # weight-bound, so this is the serving twin of weight-only int8
+    # (quantize_params_int8); the two compose.  Training never reads
+    # this field.
     remat: bool = True
     remat_policy: str = "full"  # "full" | "dots": with "dots" the block
     # checkpoint saves matmul outputs (jax dots_with_no_batch_dims_saveable)
@@ -209,6 +216,10 @@ class TransformerConfig:
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy {self.remat_policy!r} not in (full, dots)")
+        if self.kv_cache_dtype not in ("", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype {self.kv_cache_dtype!r} not in "
+                "('', 'int8')")
         if self.loss_chunk < 0:
             raise ValueError(
                 f"loss_chunk={self.loss_chunk} must be >= 0")

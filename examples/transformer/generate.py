@@ -87,6 +87,10 @@ def main():
     p.add_argument("--lookup-ngram", type=int, default=2)
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache with per-(token, head) scales: "
+                        "half the cache HBM (the long-context decode "
+                        "bound); composes with --int8 weights")
     p.add_argument("--vocab-parallel", action="store_true",
                    help="shard the tied embedding over the model axis "
                         "(serving-side Megatron vocab TP: V/M embed "
@@ -119,6 +123,7 @@ def main():
         n_layers=args.n_layers, max_seq=args.max_len,
         attention="local", pos_embedding=args.pos_embedding,
         vocab_parallel=args.vocab_parallel,
+        kv_cache_dtype="int8" if args.kv_int8 else "",
         dtype="float32", remat=False,
     )
 
