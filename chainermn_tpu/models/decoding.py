@@ -156,7 +156,12 @@ def _decode_block(cfg: TransformerConfig, h, blk, caches, pos,
             s = jnp.maximum(
                 jnp.max(jnp.abs(t), axis=-1, keepdims=True) / 127.0,
                 1e-8).astype(sdtype)
-            q8 = jnp.round(t / s.astype(t.dtype)).astype(jnp.int8)
+            # clip BEFORE the int8 cast: in bf16 the scale rounds below
+            # the true absmax/127, so the max element's ratio can land
+            # on +128 — out of int8 range, sign-flipping on wraparound
+            # backends (same guard as quantize_params_int8)
+            q8 = jnp.clip(jnp.round(t / s.astype(t.dtype)),
+                          -127, 127).astype(jnp.int8)
             return q8, s
 
         k_new, k_sc = quant(k_new, ck_s.dtype)

@@ -262,3 +262,32 @@ class TestInt8KVCache:
     def test_validation(self):
         with pytest.raises(ValueError, match="kv_cache_dtype"):
             tiny_cfg(kv_cache_dtype="fp8")
+
+    def test_bf16_quant_never_overflows_int8(self):
+        """bf16 scales round below absmax/127, so the max element's
+        ratio can land on +128 — the clip keeps every cached value in
+        [-127, 127] (without it, wraparound backends sign-flip the
+        LARGEST K/V component of ~17% of (token, head) rows)."""
+        cfg = tiny_cfg(kv_cache_dtype="int8", dtype="bfloat16")
+        mc = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(
+            mc, cfg, init_transformer(jax.random.PRNGKey(6), cfg))
+
+        def body(params, toks):
+            caches = _make_cache(cfg, B, T, cfg.kv_heads, cfg.n_layers)
+            _, caches = _decode_step(cfg, params, caches, toks, 0,
+                                     with_logits=False)
+            # the cache is typed varying over every mesh axis: reduce
+            # to invariant scalars for a P() output
+            axes = ("pipe", "data", "expert", "model")
+            return jnp.stack([
+                jnp.stack((lax.pmin(jnp.min(c.astype(jnp.int32)), axes),
+                           lax.pmax(jnp.max(c.astype(jnp.int32)), axes)))
+                for c in caches[:2]])
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mc.mesh,
+            in_specs=(param_specs(cfg), P(("data", "expert"))),
+            out_specs=P()))
+        stats = np.asarray(fn(params, prompt(6, T)))
+        assert stats.min() >= -127 and stats.max() <= 127, stats
