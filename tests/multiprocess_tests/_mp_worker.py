@@ -632,6 +632,19 @@ def scenario_fsdp_train(comm):
                                    rtol=1e-6, atol=1e-6)
 
 
+def _gather_rows(comm, got):
+    """Reassemble a batch-sharded decode output across processes: each
+    process contributes its own shard KEYED BY ITS ROW OFFSET — device
+    order need not follow process order, so process index must never
+    decide row placement."""
+    shard = got.addressable_shards[0]
+    row0 = shard.index[0].start or 0
+    alls = dict(comm.allgather_obj(
+        (int(row0), np.asarray(shard.data).tolist())))
+    return np.concatenate(
+        [np.asarray(alls[r], np.int32) for r in sorted(alls)], axis=0)
+
+
 def _tiny_cfg(**kw):
     """The shared tiny transformer of the data-plane scenarios — one
     definition so every scenario provably tests the same model."""
@@ -794,12 +807,7 @@ def scenario_decode(comm):
     got = make_generate_fn(mc, base, **kw)(
         shard_params(mc, base, host), jax.device_put(pl, sh),
         prompt_lens=jax.device_put(jnp.asarray(lens, jnp.int32), sh))
-    shard = got.addressable_shards[0]
-    row0 = shard.index[0].start or 0
-    alls = dict(comm.allgather_obj(
-        (int(row0), np.asarray(shard.data).tolist())))
-    full = np.concatenate(
-        [np.asarray(alls[r], np.int32) for r in sorted(alls)], axis=0)
+    full = _gather_rows(comm, got)
     np.testing.assert_array_equal(
         full, ref2, err_msg="cross-process padded+eos decode diverged")
 
@@ -844,17 +852,48 @@ def scenario_speculative_decode(comm):
     got, mean_acc = spec(shard_params(mc, cfg, host),
                          shard_params(mc, d_cfg, d_host),
                          jax.device_put(prompt, sh))
-    shard = got.addressable_shards[0]
-    row0 = shard.index[0].start or 0
-    alls = dict(comm.allgather_obj(
-        (int(row0), np.asarray(shard.data).tolist())))
-    full = np.concatenate(
-        [np.asarray(alls[r], np.int32) for r in sorted(alls)], axis=0)
+    full = _gather_rows(comm, got)
     np.testing.assert_array_equal(
         full, ref, err_msg="cross-process speculative decode diverged")
     accs = comm.allgather_obj(float(mean_acc))
     assert all(abs(a - accs[0]) < 1e-6 for a in accs), \
         f"processes disagree on acceptance: {accs}"
+
+
+def scenario_lookup_decode(comm):
+    """Prompt-lookup decoding ACROSS the process boundary: data=2 over
+    2 single-device processes — the n-gram matcher is row-local but
+    the acceptance pmin and verify-chunk collectives span processes.
+    Tokens must equal the process-local greedy oracle."""
+    from chainermn_tpu.models import (
+        init_transformer, make_generate_fn, make_lookup_generate_fn,
+        shard_params,
+    )
+    from chainermn_tpu.parallel import MeshConfig
+
+    assert jax.process_count() == 2 and len(jax.local_devices()) == 1
+    cfg = _tiny_cfg()
+    host = init_transformer(jax.random.PRNGKey(7), cfg)
+    import jax.numpy as jnp
+
+    prompt = jnp.asarray(
+        np.random.RandomState(9).randint(0, cfg.vocab_size, (4, 3)),
+        jnp.int32)
+    one = MeshConfig(data=1, devices=[jax.local_devices()[0]])
+    ref = np.asarray(
+        make_generate_fn(one, cfg, max_len=8)(
+            shard_params(one, cfg, host), prompt))
+
+    mc = MeshConfig(data=2, devices=jax.devices())
+    sh = mc.sharding(("data", "expert"))
+    got, mean_acc = make_lookup_generate_fn(
+        mc, cfg, k=2, ngram=2, max_len=8, with_stats=True)(
+        shard_params(mc, cfg, host), jax.device_put(prompt, sh))
+    full = _gather_rows(comm, got)
+    np.testing.assert_array_equal(
+        full, ref, err_msg="cross-process lookup decode diverged")
+    accs = comm.allgather_obj(float(mean_acc))
+    assert all(abs(a - accs[0]) < 1e-6 for a in accs), accs
 
 
 def scenario_sp_ep_train(comm):

@@ -96,6 +96,11 @@ class TestTwoProcess:
         # cross-process while_loop; tokens equal the local oracle
         mp_run("speculative_decode", timeout=300)
 
+    def test_lookup_decode(self, mp_run):
+        # the draft-free proposer: row-local n-gram matching, shared
+        # acceptance pmin and verify chunk across the boundary
+        mp_run("lookup_decode", timeout=300)
+
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
 
