@@ -758,6 +758,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
 def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                                  draft_cfg: TransformerConfig, *,
                                  k: int = 4, max_len: int = 0,
+                                 temperature: float = 0.0,
                                  quantized: bool = False,
                                  draft_quantized: bool = False,
                                  with_stats: bool = False):
@@ -776,11 +777,27 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
     lockstep at the worst row's rate): exactness is preserved, and the
     speedup is best at the small batches latency-bound serving runs.
 
+    ``temperature > 0`` switches to **speculative SAMPLING** (the
+    Leviathan/Chen acceptance-rejection scheme): the draft SAMPLES its
+    proposals, each is accepted with probability
+    ``min(1, p_target/p_draft)``, and the round's last committed token
+    draws from the residual ``max(0, p_t − p_d)`` on a rejection or
+    from ``p_t`` outright otherwise — the output is
+    **distribution-identical to sampling the target directly**, the
+    draft only changes speed.  Acceptance stays the GLOBAL batch-min
+    for SPMD lockstep; exactness survives the early cut because a row
+    whose own rejection lies beyond the cut commits its ACCEPTED
+    proposal at the cut position — per row, every committed token is
+    the accept-branch/residual-branch pair whose mixture equals
+    ``p_t``, independent of the other rows' outcomes (pinned by a
+    statistical test against direct sampling).
+
     ``draft_cfg`` must share ``vocab_size`` and ``max_seq``; pipe/TP
     meshes compose; the ``seq`` axis must be 1 (mid-sequence chunk
     writes don't block over seq-KV).  Returns
-    ``generate(params, draft_params, prompt) -> (B, max_len)``, or
-    with ``with_stats=True`` ``-> (tokens, mean_accepted)`` where
+    ``generate(params, draft_params, prompt, key=None) -> (B,
+    max_len)`` (``key`` required when sampling), or with
+    ``with_stats=True`` ``-> (tokens, mean_accepted)`` where
     ``mean_accepted`` (scalar fp32, in [0, k]) is the average number
     of draft proposals accepted per round — the observability a draft
     needs tuning against (each round emits ``mean_accepted + 1``
@@ -788,6 +805,8 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
     """
     if k < 1:
         raise ValueError(f"k={k} must be >= 1")
+    if temperature < 0.0:
+        raise ValueError(f"temperature {temperature} must be >= 0")
     if draft_cfg.vocab_size != cfg.vocab_size:
         raise ValueError(
             f"draft vocab {draft_cfg.vocab_size} != target "
@@ -808,8 +827,12 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
     # and caches, slice the pad off at the end
     pad = k + 1
 
-    def body(params, d_params, prompt):
+    def body(params, d_params, prompt, key):
         B, Plen = prompt.shape
+        # decorrelate sampling across batch shards (see make_generate_fn)
+        key = jax.random.fold_in(
+            key, lax.axis_index("data") * lax.axis_size("expert")
+            + lax.axis_index("expert"))
         t_cache = _make_cache(cfg, B, kv_len_local + pad,
                               kv_heads_local, layers_local)
         d_cache = _make_cache(draft_cfg, B, d_kv_len + pad,
@@ -828,15 +851,26 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
             return carry[1] < max_len - 1
 
         def round_body(carry):
-            buf, pos, acc_sum, rounds, t_cache, d_cache = carry
+            buf, pos, acc_sum, rounds, t_cache, d_cache, key = carry
             cur = lax.dynamic_slice(buf, (0, pos), (B, 1))[:, 0]
-            # --- draft proposes k greedy tokens ----------------------- #
-            props = []
+            # --- draft proposes k tokens (greedy, or sampled from its
+            # own temperature distribution) ---------------------------- #
+            props, d_lps, d_ps = [], [], []
             d_cur = cur
             for j in range(k):      # static unroll, k is small
                 dlog, d_cache = _decode_step(
                     draft_cfg, d_params, d_cache, d_cur, pos + j)
-                d_cur = jnp.argmax(dlog, axis=-1).astype(jnp.int32)
+                if temperature > 0.0:
+                    key, sub = jax.random.split(key)
+                    lp = jax.nn.log_softmax(
+                        dlog.astype(jnp.float32) / temperature, -1)
+                    d_cur = jax.random.categorical(sub, lp) \
+                        .astype(jnp.int32)
+                    d_lps.append(jnp.take_along_axis(
+                        lp, d_cur[:, None], 1)[:, 0])
+                    d_ps.append(jnp.exp(lp))
+                else:
+                    d_cur = jnp.argmax(dlog, axis=-1).astype(jnp.int32)
                 props.append(d_cur)
             # one extra cache-fill step for the LAST proposal: k steps
             # yield k proposals but only k-1 of their K/V writes — after
@@ -848,15 +882,69 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                 draft_cfg, d_params, d_cache, d_cur, pos + k,
                 with_logits=False)
             prop = jnp.stack(props, axis=1)               # (B, k)
-            buf, t_cache, n_acc = _verify_and_commit(
-                cfg, params, t_cache, buf, pos, cur, prop, k)
+            if temperature <= 0.0:
+                buf, t_cache, n_acc = _verify_and_commit(
+                    cfg, params, t_cache, buf, pos, cur, prop, k)
+                return (buf, pos + n_acc + 1, acc_sum + n_acc,
+                        rounds + 1, t_cache, d_cache, key)
+            # --- speculative SAMPLING verify (Leviathan/Chen) -------- #
+            tlog, t_cache = _decode_step(
+                cfg, params, t_cache,
+                jnp.concatenate([cur[:, None], prop], axis=1), pos,
+                all_logits=True, chunk_attends_cache=True)
+            t_lp = jax.nn.log_softmax(
+                tlog.astype(jnp.float32) / temperature, -1)  # (B,k+1,V)
+            d_lp = jnp.stack(d_lps, axis=1)                  # (B, k)
+            t_at_prop = jnp.take_along_axis(
+                t_lp[:, :k], prop[..., None], -1)[..., 0]    # (B, k)
+            key, sub = jax.random.split(key)
+            u = jax.random.uniform(sub, prop.shape, minval=1e-20)
+            # accept while u < p_t/p_d, in log space (u<1 makes the
+            # min(1, ·) implicit); cumulative: later slots only count
+            # while every earlier proposal was accepted
+            acc = jnp.log(u) < (t_at_prop - d_lp)
+            lead = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+            row_acc = lead.sum(axis=1)                       # (B,)
+            n_acc = lax.pmin(
+                jnp.min(row_acc), ("data", "expert"))
+            # the committed token at the cut position, PER ROW:
+            # - rejected exactly there -> residual max(0, p_t − p_d);
+            # - accepted there but cut early (another row bound the
+            #   batch-min) -> commit the ACCEPTED proposal.  A fresh
+            #   p_t draw here would be biased: the committed token
+            #   must stay the accept-branch/residual-branch PAIR whose
+            #   mixture is what equals p_t — replacing the accept
+            #   branch's min(p_d, p_t) with α·p_t breaks the identity
+            #   (a statistical test caught exactly this);
+            # - accepted everything (n_acc == k) -> the standard bonus
+            #   draw from p_t at position k.
+            V = t_lp.shape[-1]
+            t_p_cut = jnp.exp(lax.dynamic_slice(
+                t_lp, (0, n_acc, 0), (B, 1, V))[:, 0])       # (B, V)
+            d_p = jnp.stack(d_ps, axis=1)                    # (B, k, V)
+            cut_lt_k = jnp.minimum(n_acc, k - 1)   # clip; unused at k
+            d_p_cut = lax.dynamic_slice(
+                d_p, (0, cut_lt_k, 0), (B, 1, V))[:, 0]
+            resid = jnp.maximum(t_p_cut - d_p_cut, 0.0)
+            rs = resid.sum(-1, keepdims=True)
+            resid = jnp.where(rs > 1e-9, resid / rs, t_p_cut)
+            rejected_here = (row_acc == n_acc) & (n_acc < k)
+            dist = jnp.where(rejected_here[:, None], resid, t_p_cut)
+            key, sub = jax.random.split(key)
+            sampled = jax.random.categorical(
+                sub, jnp.log(jnp.maximum(dist, 1e-30))) \
+                .astype(jnp.int32)
+            prop_cut = lax.dynamic_slice(
+                prop, (0, cut_lt_k), (B, 1))[:, 0]
+            bonus = jnp.where(row_acc > n_acc, prop_cut, sampled)
+            buf = _commit_round(buf, pos, prop, bonus, n_acc, k)
             return (buf, pos + n_acc + 1, acc_sum + n_acc, rounds + 1,
-                    t_cache, d_cache)
+                    t_cache, d_cache, key)
 
-        buf, _, acc_sum, rounds, _, _ = lax.while_loop(
+        buf, _, acc_sum, rounds, _, _, _ = lax.while_loop(
             cond, round_body,
             (buf, jnp.int32(Plen - 1), jnp.int32(0), jnp.int32(0),
-             t_cache, d_cache))
+             t_cache, d_cache, key))
         mean_acc = acc_sum.astype(jnp.float32) \
             / jnp.maximum(rounds, 1).astype(jnp.float32)
         return buf[:, :max_len], mean_acc
@@ -864,24 +952,44 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
     fn = jax.jit(jax.shard_map(
         body,
         mesh=mesh_cfg.mesh,
-        in_specs=(specs, d_specs, batch_spec),
+        in_specs=(specs, d_specs, batch_spec, P()),
         out_specs=(batch_spec, P()),
     ))
 
-    def generate(params, draft_params, prompt):
-        toks, mean_acc = fn(params, draft_params, prompt)
+    def generate(params, draft_params, prompt, key=None):
+        if temperature > 0.0 and key is None:
+            raise ValueError(
+                "speculative sampling needs a PRNG key")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        toks, mean_acc = fn(params, draft_params, prompt, key)
         return (toks, mean_acc) if with_stats else toks
 
     generate._jitted = fn
     return generate
 
 
+def _commit_round(buf, pos, prop, bonus, n_acc, k):
+    """Land one speculative round's outcome in ``buf``: the accepted
+    prefix ``prop[:, :n_acc]`` then the ``bonus`` token — blended into
+    the existing slab so positions beyond ``n_acc`` stay untouched."""
+    B = prop.shape[0]
+    slab = lax.dynamic_slice(buf, (0, pos + 1), (B, k + 1))
+    j_idx = jnp.arange(k + 1)
+    slab = jnp.where(
+        j_idx[None, :] < n_acc, jnp.concatenate(
+            [prop, prop[:, -1:]], axis=1),
+        jnp.where(j_idx[None, :] == n_acc,
+                  bonus[:, None], slab))
+    return lax.dynamic_update_slice(buf, slab, (0, pos + 1))
+
+
 def _verify_and_commit(cfg, params, t_cache, buf, pos, cur, prop, k):
-    """The speculative round's second half, shared by every proposer
-    (draft model, prompt lookup): the target verifies ``prop`` (B, k)
-    in ONE (k+1)-wide chunk forward, the accepted prefix plus the
-    target's corrective/bonus token land in ``buf``, and acceptance is
-    the GLOBAL batch-min so every data shard advances in lockstep
+    """The GREEDY speculative round's second half, shared by every
+    proposer (draft model, prompt lookup): the target verifies ``prop``
+    (B, k) in ONE (k+1)-wide chunk forward, the accepted prefix plus
+    the target's corrective/bonus token land in ``buf``, and acceptance
+    is the GLOBAL batch-min so every data shard advances in lockstep
     (the while carry/cond need ``pos`` axis-invariant).  Returns
     ``(buf, t_cache, n_acc)``."""
     B = cur.shape[0]
@@ -898,19 +1006,9 @@ def _verify_and_commit(cfg, params, t_cache, buf, pos, cur, prop, k):
     lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
     n_acc = lax.pmin(
         jnp.min(lead.sum(axis=1)), ("data", "expert"))
-    # append prop[:, :n_acc] then the corrective/bonus token
-    # g[:, n_acc]: blend into the existing buffer slab so the
-    # positions beyond n_acc stay untouched
-    slab = lax.dynamic_slice(buf, (0, pos + 1), (B, k + 1))
-    j_idx = jnp.arange(k + 1)
     bonus = jnp.take_along_axis(
         g, jnp.full((B, 1), n_acc), axis=1)[:, 0]
-    slab = jnp.where(
-        j_idx[None, :] < n_acc, jnp.concatenate(
-            [prop, prop[:, -1:]], axis=1),
-        jnp.where(j_idx[None, :] == n_acc,
-                  bonus[:, None], slab))
-    buf = lax.dynamic_update_slice(buf, slab, (0, pos + 1))
+    buf = _commit_round(buf, pos, prop, bonus, n_acc, k)
     return buf, t_cache, n_acc
 
 

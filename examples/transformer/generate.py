@@ -228,6 +228,12 @@ def main():
         raise SystemExit(
             "--eos-id is not supported with --speculative-k/--lookup-k "
             "(the verify chunk has no per-row freeze); drop one")
+    if (args.top_k > 0 or args.top_p < 1.0) and (
+            args.speculative_k > 0 or args.lookup_k > 0):
+        raise SystemExit(
+            "--top-k/--top-p are not supported with --speculative-k/"
+            "--lookup-k (the acceptance-rejection scheme samples the "
+            "full distributions); drop the truncation flags")
     if args.lookup_k > 0 and (args.speculative_k > 0 or args.beam > 0):
         raise SystemExit(
             "--lookup-k is its own decode mode; drop --speculative-k/"
@@ -273,9 +279,11 @@ def main():
               f"draft: {note}")
         spec = make_speculative_generate_fn(
             mc, cfg, d_cfg, k=args.speculative_k, max_len=args.max_len,
+            temperature=args.temperature,
             quantized=args.int8, draft_quantized=d_quant,
             with_stats=True)
-        out, mean_acc = spec(params, d_params, prompt)
+        out, mean_acc = spec(params, d_params, prompt,
+                             key=jax.random.PRNGKey(args.seed))
         print(f"mean accepted proposals/round: {float(mean_acc):.2f} "
               f"of k={args.speculative_k} "
               f"(~{float(mean_acc) + 1:.2f} tokens per target read)")

@@ -327,7 +327,8 @@ def _decode_case(name, axes, cfg_kw, formula_fn, speculative_k=0):
         d_params = shard_params(mc, d_cfg, d_host)
         gen = make_speculative_generate_fn(
             mc, cfg, d_cfg, k=speculative_k, max_len=MAX)
-        lowered = gen._jitted.lower(params, d_params, prompt)
+        lowered = gen._jitted.lower(params, d_params, prompt,
+                                    jax.random.PRNGKey(0))
     else:
         gen = make_generate_fn(mc, cfg, max_len=MAX)
         lowered = gen._jitted.lower(
