@@ -517,6 +517,40 @@ def _make_cache(cfg: TransformerConfig, rows: int, kv_len_local: int,
         _vary(jnp.zeros(sh[:-1], sh[-1]), *axes) for sh in shapes)
 
 
+def _validate_prompt_lens(prompt, prompt_lens):
+    """Shared ``prompt_lens`` validation for the padded decode entry
+    points (generate, beam search).  Returns the int32 lens array.  A
+    multi-process global array cannot be fetched host-side — validate
+    shape/dtype and THIS host's addressable shards (every process runs
+    this same code on its own shards)."""
+    P_len = prompt.shape[1]
+    if isinstance(prompt_lens, jax.Array) \
+            and not prompt_lens.is_fully_addressable:
+        if prompt_lens.shape != (prompt.shape[0],):
+            raise ValueError(
+                f"prompt_lens shape {prompt_lens.shape} != "
+                f"({prompt.shape[0]},)")
+        if not jnp.issubdtype(prompt_lens.dtype, jnp.integer):
+            raise ValueError(
+                f"prompt_lens dtype {prompt_lens.dtype} must be "
+                "integer")
+        for sh in prompt_lens.addressable_shards:
+            local = np.asarray(sh.data)
+            if (local < 1).any() or (local > P_len).any():
+                raise ValueError(
+                    f"prompt_lens values must be in [1, {P_len}]; "
+                    f"this host's shard holds {local}")
+        return prompt_lens.astype(jnp.int32)
+    lens = np.asarray(prompt_lens)
+    if lens.shape != (prompt.shape[0],) \
+            or (lens < 1).any() or (lens > P_len).any():
+        raise ValueError(
+            f"prompt_lens must be ({prompt.shape[0]},) ints in "
+            f"[1, {P_len}] (rows RIGHT-aligned: real tokens are "
+            f"prompt[b, P-lens[b]:]), got {lens}")
+    return jnp.asarray(lens, jnp.int32)
+
+
 def _filter_logits(logits, top_k: int, top_p: float):
     """Truncated-sampling filters on (B, V) fp32 logits: keep the
     ``top_k`` highest (0 = off) and/or the smallest set whose softmax
@@ -710,36 +744,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
             key = jax.random.PRNGKey(0)
         if prompt_lens is None:
             return fn(params, prompt, key)
-        P_len = prompt.shape[1]
-        if isinstance(prompt_lens, jax.Array) \
-                and not prompt_lens.is_fully_addressable:
-            # a multi-process global array: validate shape/dtype and
-            # THIS host's addressable shards (the others validate
-            # their own — every process runs this same code)
-            if prompt_lens.shape != (prompt.shape[0],):
-                raise ValueError(
-                    f"prompt_lens shape {prompt_lens.shape} != "
-                    f"({prompt.shape[0]},)")
-            if not jnp.issubdtype(prompt_lens.dtype, jnp.integer):
-                raise ValueError(
-                    f"prompt_lens dtype {prompt_lens.dtype} must be "
-                    "integer")
-            for sh in prompt_lens.addressable_shards:
-                local = np.asarray(sh.data)
-                if (local < 1).any() or (local > P_len).any():
-                    raise ValueError(
-                        f"prompt_lens values must be in [1, {P_len}]; "
-                        f"this host's shard holds {local}")
-            lens = prompt_lens.astype(jnp.int32)
-        else:
-            lens = np.asarray(prompt_lens)
-            if lens.shape != (prompt.shape[0],) \
-                    or (lens < 1).any() or (lens > P_len).any():
-                raise ValueError(
-                    f"prompt_lens must be ({prompt.shape[0]},) ints in "
-                    f"[1, {P_len}] (rows RIGHT-aligned: real tokens "
-                    f"are prompt[b, P-lens[b]:]), got {lens}")
-            lens = jnp.asarray(lens, jnp.int32)
+        lens = _validate_prompt_lens(prompt, prompt_lens)
         if "padded" not in lazy:
             lazy["padded"] = jax.jit(jax.shard_map(
                 body_padded,
@@ -1136,6 +1141,11 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
     with ``eos_id``).  ``length_penalty`` α applies GNMT normalisation
     ``score / ((5+len)/6)^α`` for the final ranking.
 
+    Variable-length prompts: RIGHT-align the rows and pass
+    ``prompt_lens`` (B,) exactly as in :func:`make_generate_fn` — the
+    per-row position origins and pad-slot masks thread through every
+    beam's steps (beams share their row's offset).
+
     Returns ``tokens`` (B, K, max_len) sorted best-first and ``scores``
     (B, K) (length-normalised when α > 0).
     """
@@ -1148,7 +1158,7 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
     specs = param_specs(cfg, quantized=quantized)
     batch_spec = P(("data", "expert"))
 
-    def body(params, prompt):
+    def _body(params, prompt, offsets):
         B, Plen = prompt.shape
         # -- prefill at width B (the K beams are identical inside the
         # prompt — no reason to pay K× its FLOPs or reorder gathers) --
@@ -1156,10 +1166,16 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
                               layers_local)
 
         # batched prefill: positions 0..P-2 in one MXU-shaped pass
+        # (padded rows route through the cache-attending path, whose
+        # validity mask carries the row dimension)
         if Plen > 1:
             _, cache_b = _decode_step(
                 cfg, params, cache_b, prompt[:, :Plen - 1], 0,
-                with_logits=False)
+                with_logits=False,
+                chunk_attends_cache=offsets is not None,
+                pos_offset=offsets)
+        # every beam inherits its batch row's pad offset
+        offs_bk = None if offsets is None else jnp.repeat(offsets, K)
         # tile to beam width: flat row b·K + k holds batch b's beam k
         cache = tuple(jnp.repeat(c, K, axis=1) for c in cache_b)
 
@@ -1179,7 +1195,8 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
         def step(carry, t):
             buf, scores, finished, caches = carry
             logits, caches = _decode_step(
-                cfg, params, caches, buf.reshape(B * K, max_len)[:, t], t)
+                cfg, params, caches, buf.reshape(B * K, max_len)[:, t],
+                t, pos_offset=offs_bk)
             logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, -1)
             V = logp.shape[-1]
             # finished beams propose exactly one candidate (their score,
@@ -1235,9 +1252,33 @@ def make_beam_search_fn(mesh_cfg, cfg: TransformerConfig, *,
         scores = jnp.take_along_axis(scores, order, axis=1)
         return buf, scores
 
-    return jax.jit(jax.shard_map(
+    def body(params, prompt):
+        return _body(params, prompt, None)
+
+    def body_padded(params, prompt, lens):
+        return _body(params, prompt,
+                     jnp.int32(prompt.shape[1]) - lens)
+
+    fn = jax.jit(jax.shard_map(
         body,
         mesh=mesh_cfg.mesh,
         in_specs=(specs, batch_spec),
         out_specs=(batch_spec, batch_spec),
     ))
+    lazy = {}
+
+    def beam_search(params, prompt, prompt_lens=None):
+        if prompt_lens is None:
+            return fn(params, prompt)
+        lens = _validate_prompt_lens(prompt, prompt_lens)
+        if "padded" not in lazy:
+            lazy["padded"] = jax.jit(jax.shard_map(
+                body_padded,
+                mesh=mesh_cfg.mesh,
+                in_specs=(specs, batch_spec, batch_spec),
+                out_specs=(batch_spec, batch_spec),
+            ))
+        return lazy["padded"](params, prompt, lens)
+
+    beam_search._jitted = fn
+    return beam_search

@@ -177,10 +177,10 @@ def main():
 
     prompt_lens = None
     if args.prompt_file is not None:
-        if args.beam > 0 or args.speculative_k > 0 or args.lookup_k > 0:
+        if args.speculative_k > 0 or args.lookup_k > 0:
             raise SystemExit(
                 "--prompt-file (variable-length batch) works with "
-                "greedy/sampling only — beam, speculative, and lookup "
+                "greedy/sampling/beam only — speculative and lookup "
                 "decoding require equal prompt lengths")
         rows = []
         with open(args.prompt_file) as f:
@@ -293,10 +293,17 @@ def main():
             mc, cfg, beam_size=args.beam, max_len=args.max_len,
             eos_id=args.eos_id, length_penalty=0.6,
             quantized=args.int8)
-        out, scores = bs(params, prompt)
-        for k in range(args.beam):
-            show(np.asarray(out)[0, k].tolist(),
-                 label=f"beam {k} (score {float(scores[0, k]):+.3f})")
+        out, scores = bs(params, prompt, prompt_lens=prompt_lens)
+        out_np, sc = np.asarray(out), np.asarray(scores)
+        if prompt_lens is not None:
+            for b in range(out_np.shape[0]):    # best beam per row
+                start = prompt.shape[1] - int(prompt_lens[b])
+                show(out_np[b, 0, start:].tolist(),
+                     label=f"row {b} best (score {sc[b, 0]:+.3f})")
+        else:
+            for k in range(args.beam):
+                show(out_np[0, k].tolist(),
+                     label=f"beam {k} (score {sc[0, k]:+.3f})")
     else:
         gen = make_generate_fn(
             mc, cfg, max_len=args.max_len,

@@ -560,6 +560,9 @@ def run():
         "parsed bytes come from collective_stats() over the compiled "
         "step's HLO; formulas are the closed-form volumes SCALING.md "
         "extrapolates to benchmark scale",
+        "collective COUNTS can jitter across XLA compiles (zero-byte "
+        "all-reduces appear/disappear with fusion choices); every "
+        "validation is BYTE-based for exactly that reason",
         "while-body collectives (pipeline scan) are parsed once per "
         "body; their validation row compares per-tick bytes",
     ]}

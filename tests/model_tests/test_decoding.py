@@ -438,6 +438,40 @@ class TestPaddedPrompts:
                                     attention_window=4),
                            dict(data=1), 1)
 
+    def test_beam_search_padded_rows_match_solo(self):
+        """Beam search with prompt_lens: every row's K hypotheses and
+        scores equal its unpadded solo beam run — the per-row offsets
+        ride through the beam reorder gathers untouched."""
+        from chainermn_tpu.models import make_beam_search_fn
+
+        cfg = tiny_cfg(pos_embedding="rope")
+        host = init_transformer(jax.random.PRNGKey(7), cfg)
+        P_len, G, K = 6, 6, 2
+        rng = np.random.RandomState(32)
+        lens = np.asarray([6, 4, 2, 5])
+        rows = [rng.randint(0, VOCAB, (n,)).astype(np.int32)
+                for n in lens]
+        padded = np.full((B, P_len), 63, np.int32)
+        for b, r in enumerate(rows):
+            padded[b, P_len - lens[b]:] = r
+
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        toks, scores = make_beam_search_fn(
+            one, cfg, beam_size=K, max_len=P_len + G)(
+            params, jnp.asarray(padded), prompt_lens=lens)
+        for b, r in enumerate(rows):
+            st, ss = make_beam_search_fn(
+                one, cfg, beam_size=K, max_len=lens[b] + G)(
+                params, jnp.tile(r, (B, 1)))
+            np.testing.assert_array_equal(
+                np.asarray(toks)[b, :, P_len:],
+                np.asarray(st)[0, :, lens[b]:],
+                err_msg=f"row {b}")
+            np.testing.assert_allclose(
+                np.asarray(scores)[b], np.asarray(ss)[0],
+                rtol=1e-5, atol=1e-5)
+
     def test_equal_lens_match_plain_path(self):
         """prompt_lens = full length everywhere must reproduce the
         plain (unpadded) program token-for-token."""
