@@ -860,6 +860,51 @@ def scenario_speculative_decode(comm):
         f"processes disagree on acceptance: {accs}"
 
 
+def scenario_speculative_sampling(comm):
+    """Speculative SAMPLING across the process boundary: the per-round
+    acceptance pmin, the shard-decorrelated PRNG fold, and the
+    while-loop key carry all span processes.  Same-key runs must be
+    deterministic, processes must agree on the acceptance statistic,
+    and different keys must draw different sequences."""
+    import dataclasses
+
+    from chainermn_tpu.models import (
+        init_transformer, make_speculative_generate_fn, shard_params,
+    )
+    from chainermn_tpu.parallel import MeshConfig
+
+    assert jax.process_count() == 2 and len(jax.local_devices()) == 1
+    cfg = _tiny_cfg(n_layers=4)
+    d_cfg = dataclasses.replace(cfg, n_layers=2)
+    host = init_transformer(jax.random.PRNGKey(11), cfg)
+    d_host = dict(host, blocks=jax.tree.map(
+        lambda a: a[:, :2], host["blocks"]))
+    import jax.numpy as jnp
+
+    prompt = jnp.asarray(
+        np.random.RandomState(12).randint(0, cfg.vocab_size, (4, 3)),
+        jnp.int32)
+    mc = MeshConfig(data=2, devices=jax.devices())
+    sh = mc.sharding(("data", "expert"))
+    spec = make_speculative_generate_fn(
+        mc, cfg, d_cfg, k=2, max_len=8, temperature=1.0,
+        with_stats=True)
+    params = shard_params(mc, cfg, host)
+    d_params = shard_params(mc, d_cfg, d_host)
+    gp = jax.device_put(prompt, sh)
+    a1, acc = spec(params, d_params, gp, key=jax.random.PRNGKey(3))
+    a2, _ = spec(params, d_params, gp, key=jax.random.PRNGKey(3))
+    b1, _ = spec(params, d_params, gp, key=jax.random.PRNGKey(4))
+    ra1, ra2, rb1 = (_gather_rows(comm, t) for t in (a1, a2, b1))
+    np.testing.assert_array_equal(ra1, ra2,
+                                  err_msg="same key, different tokens")
+    assert not np.array_equal(ra1, rb1), "keys ignored"
+    assert (ra1 >= 0).all() and (ra1 < cfg.vocab_size).all()
+    np.testing.assert_array_equal(ra1[:, :3], np.asarray(prompt))
+    accs = comm.allgather_obj(float(acc))
+    assert all(abs(x - accs[0]) < 1e-6 for x in accs), accs
+
+
 def scenario_lookup_decode(comm):
     """Prompt-lookup decoding ACROSS the process boundary: data=2 over
     2 single-device processes — the n-gram matcher is row-local but

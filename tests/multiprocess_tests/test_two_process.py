@@ -96,6 +96,11 @@ class TestTwoProcess:
         # cross-process while_loop; tokens equal the local oracle
         mp_run("speculative_decode", timeout=300)
 
+    def test_speculative_sampling(self, mp_run):
+        # acceptance pmin + shard-decorrelated keys + while-loop key
+        # carry across the boundary; same-key determinism
+        mp_run("speculative_sampling", timeout=300)
+
     def test_lookup_decode(self, mp_run):
         # the draft-free proposer: row-local n-gram matching, shared
         # acceptance pmin and verify chunk across the boundary
