@@ -438,6 +438,15 @@ class TestPaddedPrompts:
                                     attention_window=4),
                            dict(data=1), 1)
 
+    def test_int8_kv_cache_composes(self):
+        """Padded rows with an int8 KV cache still decode row-for-row
+        identically to their int8 solo runs — quantisation is
+        per-(token, head), so the pad-slot masking and per-row
+        position origins are orthogonal to it."""
+        self._rows_vs_solo(
+            tiny_cfg(pos_embedding="rope", kv_cache_dtype="int8"),
+            dict(data=1), 1)
+
     def test_beam_search_padded_rows_match_solo(self):
         """Beam search with prompt_lens: every row's K hypotheses and
         scores equal its unpadded solo beam run — the per-row offsets
