@@ -842,6 +842,26 @@ class TestLookupDecoding:
             shard_params(mc, cfg, host), p))
         np.testing.assert_array_equal(got, ref)
 
+    def test_int8_weights_match_int8_greedy(self):
+        """Lookup decoding over weight-only int8: exact vs the int8
+        greedy oracle (int8 changes the logits, so the quantized run
+        is the right reference)."""
+        from chainermn_tpu.models import (
+            make_lookup_generate_fn, quantize_params_int8)
+
+        cfg = tiny_cfg(n_layers=4)
+        host = quantize_params_int8(cfg, self._trained(cfg, 2))
+        p = prompt(seed=43, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T, quantized=True)(
+                params, p))
+        got = np.asarray(make_lookup_generate_fn(
+            one, cfg, k=3, ngram=2, max_len=T, quantized=True)(
+            params, p))
+        np.testing.assert_array_equal(got, ref)
+
     def test_validation(self):
         from chainermn_tpu.models import make_lookup_generate_fn
 
