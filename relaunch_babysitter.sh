@@ -1,0 +1,25 @@
+#!/bin/bash
+# Kill any running babysitter/probe and start a fresh one, detached.
+# Run as `bash relaunch_babysitter.sh`.  Only processes whose comm is
+# literally `python` are ever signaled: the agent-harness wrapper
+# shells embed the full invoking command line (including these
+# pattern strings), so a bare pkill -f self-matches and kills the
+# invoker — which is exactly how three prior relaunch attempts died
+# with exit 144.
+cd "$(dirname "$0")"
+kill_pythons_matching() {
+    for pid in $(pgrep -f "$1"); do
+        comm=$(cat "/proc/$pid/comm" 2>/dev/null)
+        [ "$comm" = "python" ] && kill "$pid" 2>/dev/null
+    done
+}
+kill_pythons_matching 'bench_session.py'
+# probe + every battery child (bench.py, bench_transformer.py, ...) +
+# hang_doctor probe children (python /tmp/tmpXXXX.py) — an orphaned
+# one keeps holding the axon relay grant and contends with the fresh
+# session's first probe
+kill_pythons_matching 'bench[_.]'
+kill_pythons_matching '/tmp/tmp.*\.py'
+sleep 1
+nohup python bench_session.py --max-hours "${1:-11}" >> bench_session.log 2>&1 &
+echo "babysitter pid $!"
