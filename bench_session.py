@@ -159,9 +159,12 @@ def diagnose(failures: int, done: set):
             log(f"doctor[{rec['variant']}]: {rec['outcome']} "
                 f"{rec['duration_s']}s stages={rec['stages']}")
         # a CPU-platform child success (forced machinery test or a
-        # silent backend fallback) is not a chip wake
-        woke = any(r["outcome"] == "ok" and hang_doctor.is_tpu_record(r)
-                   for r in recs)
+        # silent backend fallback) is not a chip wake — and neither is
+        # a success under a non-default env knob: bench.py runs under
+        # the DEFAULT env, so fast-retrying it off a knob-variant wake
+        # would just hammer the still-hanging default path
+        woke = any(r["outcome"] == "ok" and r["variant"] == "default"
+                   and hang_doctor.is_tpu_record(r) for r in recs)
         log(f"doctor verdict: {hang_doctor.summarize()['verdict']}")
     except Exception as e:  # diagnosis must never kill the babysitter
         log(f"doctor: failed with {type(e).__name__}: {e}")

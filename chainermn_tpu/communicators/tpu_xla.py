@@ -406,12 +406,14 @@ class TpuXlaCommunicator(CommunicatorBase):
         about whether one's *receivers* have consumed one's publishes
         (a skewed peer lets every other process race ahead and strand
         O(n) payloads on the coordination service).  After a barrier at
-        round d, every payload for rounds ≤ d is provably consumed, so
-        the store holds at most ~``window`` of each process's payloads
-        at any time — per-process memory and KV footprint stay
-        O(window · payload + recv volume), never the whole exchange
-        (the property ``shuffle_data_blocks`` relies on for datasets
-        too large to gather anywhere).
+        round d, every payload for rounds ≤ d is provably consumed;
+        since sends run ahead to round d+window−1 while the last fence
+        only guarantees consumption through the previous multiple of
+        window, the store holds at most ``2·window − 1`` of each
+        process's payloads at any time — per-process memory and KV
+        footprint stay O(window · payload + recv volume), never the
+        whole exchange (the property ``shuffle_data_blocks`` relies on
+        for datasets too large to gather anywhere).
 
         Latency is O(n) recv rounds with publish latency hidden inside
         the window and n/window barrier fences.  ``window=1``

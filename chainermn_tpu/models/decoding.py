@@ -627,6 +627,11 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
         raise ValueError(
             f"eos_id={eos_id} / pad_id={pad_id} must be < vocab_size "
             f"{cfg.vocab_size} (pad in range when eos is enabled)")
+    # pad_id == eos_id is allowed (the HF GPT-2 convention sets
+    # pad_token = eos_token): frozen rows then fill their tail with the
+    # eos token, which is unambiguous to consumers that trim at the
+    # FIRST eos — everything from it onward is end-of-sequence either
+    # way.
     max_len, kv_len_local, kv_heads_local, layers_local = _decode_preamble(
         mesh_cfg, cfg, max_len)
     specs = param_specs(cfg, quantized=quantized)

@@ -175,6 +175,14 @@ def main():
                 f"and non-empty")
         return ids
 
+    def parse_int_ids(text, what):
+        try:
+            return [int(t) for t in text.split(",") if t.strip()]
+        except ValueError:
+            raise SystemExit(
+                f"{what}: expected comma-separated token ids (got "
+                f"{text[:40]!r}) — for text prompts pass --tokenizer")
+
     prompt_lens = None
     if args.prompt_file is not None:
         if args.speculative_k > 0 or args.lookup_k > 0:
@@ -190,7 +198,7 @@ def main():
                 ln = ln.rstrip("\r\n")  # CRLF-safe; numbering physical
                 rows.append(check_ids(
                     tok.encode(ln) if tok is not None else
-                    [int(t) for t in ln.split(",") if t.strip()],
+                    parse_int_ids(ln, f"line {i + 1}"),
                     f"line {i + 1}"))
         if not rows:
             raise SystemExit(f"{args.prompt_file}: no prompts in file")
@@ -213,7 +221,7 @@ def main():
                 raise SystemExit("--prompt-text needs --tokenizer")
             toks = tok.encode(args.prompt_text)
         else:
-            toks = [int(t) for t in args.prompt.split(",") if t.strip()]
+            toks = parse_int_ids(args.prompt, "--prompt")
         check_ids(toks, "--prompt")
         prompt = jnp.asarray(
             np.tile(np.asarray(toks, np.int32), (args.batchsize, 1)))

@@ -305,14 +305,18 @@ def summarize():
         "verdict_window_h": VERDICT_WINDOW_S // 3600,
         "probes_in_window": len(recent),
         "longest_timeout_outlasted_s": longest,
-        "verdict": _verdict(recent, longest),
+        "verdict": _verdict(recent, longest, total=len(recs)),
     }
     with open(SUMMARY, "w") as f:
         json.dump(summary, f, indent=1)
     return summary
 
 
-def _verdict(recs, longest):
+def _verdict(recs, longest, total=None):
+    if not recs and total:
+        return (f"no probes in the last {VERDICT_WINDOW_S // 3600}h "
+                f"window ({total} older probes on record - see "
+                f"by_variant)")
     ok_by_variant = {}
     for r in recs:
         ok_by_variant.setdefault(r["variant"], []).append(

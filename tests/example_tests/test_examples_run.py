@@ -13,7 +13,7 @@ _ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def _run_example(relpath, args, timeout=420):
+def _example_env():
     env = dict(os.environ)
     for k in list(env):
         if k.startswith(("TPU_", "LIBTPU", "PJRT_", "JAX_")):
@@ -21,10 +21,17 @@ def _run_example(relpath, args, timeout=420):
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_example(relpath, args, timeout=420, check=True):
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, relpath), "--platform", "cpu",
          *args],
-        capture_output=True, text=True, timeout=timeout, cwd=_ROOT, env=env)
+        capture_output=True, text=True, timeout=timeout, cwd=_ROOT,
+        env=_example_env())
+    if not check:
+        return proc
     assert proc.returncode == 0, (
         f"{relpath} failed rc={proc.returncode}\n--- stdout ---\n"
         f"{proc.stdout[-3000:]}\n--- stderr ---\n{proc.stderr[-3000:]}")
@@ -97,6 +104,18 @@ def test_elastic_resume_across_meshes(tmp_path):
          "--checkpoint", ck])
     assert "regrouped checkpoint pipe=1/V=1 -> pipe=2/V=1" in out, out
     assert "resumed at step 6" in out, out
+
+
+def test_generate_text_prompt_without_tokenizer_is_clean_error(tmp_path):
+    """A text prompt file without --tokenizer must exit with a message
+    pointing at --tokenizer, not a raw int() ValueError traceback."""
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("the quick brown fox\njumps over the lazy dog\n")
+    proc = _run_example("examples/transformer/generate.py",
+                        ["--prompt-file", str(pf)], check=False)
+    assert proc.returncode != 0
+    assert "--tokenizer" in proc.stderr
+    assert "Traceback" not in proc.stderr, proc.stderr[-2000:]
 
 
 def test_train_then_generate_roundtrip(tmp_path):

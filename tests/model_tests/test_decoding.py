@@ -386,6 +386,10 @@ class TestEosEarlyStop:
         with pytest.raises(ValueError, match="pad_id"):
             make_generate_fn(one, cfg, max_len=T, eos_id=1,
                              pad_id=VOCAB)
+        # pad MAY alias eos (HF GPT-2 convention: pad_token ==
+        # eos_token) — trim-at-first-eos disambiguates, so this must
+        # build without error
+        make_generate_fn(one, cfg, max_len=T, eos_id=1, pad_id=1)
 
 
 class TestPaddedPrompts:
