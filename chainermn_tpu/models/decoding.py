@@ -579,6 +579,53 @@ def _filter_logits(logits, top_k: int, top_p: float):
     return jnp.where(keep, logits, _NEG)
 
 
+def _validate_sampling_filters(top_k: int, top_p: float,
+                               temperature: float):
+    """Shared filter validation: ``top_k``/``top_p`` truncate SAMPLING
+    distributions, so they require ``temperature > 0`` everywhere they
+    appear (generate, speculative)."""
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"top_k={top_k} must be >= 0 and top_p={top_p} in (0, 1]")
+    if (top_k > 0 or top_p < 1.0) and temperature <= 0.0:
+        raise ValueError(
+            "top_k/top_p truncate SAMPLING: set temperature > 0 "
+            "(greedy decoding always takes the argmax)")
+
+
+def _validate_eos_pad(cfg: TransformerConfig, eos_id: int, pad_id: int):
+    """Shared eos/pad range validation for every decode factory."""
+    if eos_id >= cfg.vocab_size or (eos_id >= 0
+                                    and not 0 <= pad_id < cfg.vocab_size):
+        raise ValueError(
+            f"eos_id={eos_id} / pad_id={pad_id} must be < vocab_size "
+            f"{cfg.vocab_size} (pad in range when eos is enabled)")
+
+
+def _apply_eos_round(buf, pos, n_acc, k, done, eos_id, pad_id):
+    """Post-commit eos bookkeeping for one speculative/lookup round.
+
+    The round committed slots ``pos+1 .. pos+n_acc+1``.  Per row:
+    everything after the FIRST committed eos becomes ``pad_id`` (the
+    eos itself is kept — same convention as :func:`make_generate_fn`),
+    and a row that was already done has ALL its committed slots padded
+    (its proposals were garbage generated from pad context).  Exactness
+    is untouched: only positions at or past a row's first eos are
+    rewritten, and plain generate pads exactly those.  Returns
+    ``(buf, done)``."""
+    B = buf.shape[0]
+    slab = lax.dynamic_slice(buf, (0, pos + 1), (B, k + 1))
+    j = jnp.arange(k + 1)
+    committed = j[None, :] <= n_acc                       # (1, k+1)
+    is_eos = (slab == eos_id) & committed
+    # first committed eos per row; k+1 = none this round
+    first = jnp.min(jnp.where(is_eos, j[None, :], k + 1), axis=1)
+    mask_pad = committed & (done[:, None] | (j[None, :] > first[:, None]))
+    slab = jnp.where(mask_pad, pad_id, slab)
+    done = done | (first <= n_acc)
+    return lax.dynamic_update_slice(buf, slab, (0, pos + 1)), done
+
+
 def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                      max_len: int = 0, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 1.0,
@@ -615,18 +662,8 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     from :func:`...quantization.quantize_params_int8` (≈half the HBM
     traffic per token).
     """
-    if top_k < 0 or not 0.0 < top_p <= 1.0:
-        raise ValueError(
-            f"top_k={top_k} must be >= 0 and top_p={top_p} in (0, 1]")
-    if (top_k > 0 or top_p < 1.0) and temperature <= 0.0:
-        raise ValueError(
-            "top_k/top_p truncate SAMPLING: set temperature > 0 "
-            "(greedy decoding always takes the argmax)")
-    if eos_id >= cfg.vocab_size or (eos_id >= 0
-                                    and not 0 <= pad_id < cfg.vocab_size):
-        raise ValueError(
-            f"eos_id={eos_id} / pad_id={pad_id} must be < vocab_size "
-            f"{cfg.vocab_size} (pad in range when eos is enabled)")
+    _validate_sampling_filters(top_k, top_p, temperature)
+    _validate_eos_pad(cfg, eos_id, pad_id)
     # pad_id == eos_id is allowed (the HF GPT-2 convention sets
     # pad_token = eos_token): frozen rows then fill their tail with the
     # eos token, which is unambiguous to consumers that trim at the
@@ -769,6 +806,8 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                                  draft_cfg: TransformerConfig, *,
                                  k: int = 4, max_len: int = 0,
                                  temperature: float = 0.0,
+                                 top_k: int = 0, top_p: float = 1.0,
+                                 eos_id: int = -1, pad_id: int = 0,
                                  quantized: bool = False,
                                  draft_quantized: bool = False,
                                  with_stats: bool = False):
@@ -802,21 +841,42 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
     ``p_t``, independent of the other rows' outcomes (pinned by a
     statistical test against direct sampling).
 
+    ``top_k``/``top_p`` compose with speculative sampling by
+    truncating BOTH distributions (after the temperature scaling, the
+    same HF order as :func:`make_generate_fn`): the draft proposes
+    from its filtered distribution p_d′ and the acceptance test,
+    residual, and bonus draw all run on the target's filtered p_t′ —
+    the Leviathan/Chen identity holds for ANY distribution pair, so
+    the output is distribution-identical to sampling the target
+    directly with the same filters.
+
+    ``eos_id >= 0`` enables early stopping with the exact
+    :func:`make_generate_fn` semantics (first eos kept, tail padded
+    with ``pad_id``, loop exits when every row across the sharded
+    batch is done); frozen rows report full-``k`` acceptance so their
+    garbage proposals never bind the batch-min.  Variable-length
+    prompts: RIGHT-align the rows and pass ``prompt_lens`` to
+    ``generate`` exactly as in :func:`make_generate_fn` — the per-row
+    position origins and pad-slot masks thread through the draft
+    steps and the verify chunks alike.
+
     ``draft_cfg`` must share ``vocab_size`` and ``max_seq``; pipe/TP
     meshes compose; the ``seq`` axis must be 1 (mid-sequence chunk
     writes don't block over seq-KV).  Returns
-    ``generate(params, draft_params, prompt, key=None) -> (B,
-    max_len)`` (``key`` required when sampling), or with
-    ``with_stats=True`` ``-> (tokens, mean_accepted)`` where
-    ``mean_accepted`` (scalar fp32, in [0, k]) is the average number
-    of draft proposals accepted per round — the observability a draft
-    needs tuning against (each round emits ``mean_accepted + 1``
-    tokens for one target chunk read).
+    ``generate(params, draft_params, prompt, key=None,
+    prompt_lens=None) -> (B, max_len)`` (``key`` required when
+    sampling), or with ``with_stats=True`` ``-> (tokens,
+    mean_accepted)`` where ``mean_accepted`` (scalar fp32, in [0, k])
+    is the average number of draft proposals accepted per round — the
+    observability a draft needs tuning against (each round emits
+    ``mean_accepted + 1`` tokens for one target chunk read).
     """
     if k < 1:
         raise ValueError(f"k={k} must be >= 1")
     if temperature < 0.0:
         raise ValueError(f"temperature {temperature} must be >= 0")
+    _validate_sampling_filters(top_k, top_p, temperature)
+    _validate_eos_pad(cfg, eos_id, pad_id)
     if draft_cfg.vocab_size != cfg.vocab_size:
         raise ValueError(
             f"draft vocab {draft_cfg.vocab_size} != target "
@@ -837,7 +897,7 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
     # and caches, slice the pad off at the end
     pad = k + 1
 
-    def body(params, d_params, prompt, key):
+    def body(params, d_params, prompt, key, offsets):
         B, Plen = prompt.shape
         # decorrelate sampling across batch shards (see make_generate_fn)
         key = jax.random.fold_in(
@@ -847,21 +907,36 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                               kv_heads_local, layers_local)
         d_cache = _make_cache(draft_cfg, B, d_kv_len + pad,
                               d_kv_heads_local, d_layers_local)
-        buf = jnp.zeros((B, max_len + pad), jnp.int32)
+        # pad-seed when eos can exit early (see make_generate_fn)
+        buf = jnp.full((B, max_len + pad),
+                       max(pad_id, 0) if eos_id >= 0 else 0, jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
         if Plen > 1:
             _, t_cache = _decode_step(
                 cfg, params, t_cache, prompt[:, :Plen - 1], 0,
-                with_logits=False)
+                with_logits=False,
+                chunk_attends_cache=offsets is not None,
+                pos_offset=offsets)
             _, d_cache = _decode_step(
                 draft_cfg, d_params, d_cache, prompt[:, :Plen - 1], 0,
-                with_logits=False)
+                with_logits=False,
+                chunk_attends_cache=offsets is not None,
+                pos_offset=offsets)
 
         def cond(carry):
-            return carry[1] < max_len - 1
+            pos, done = carry[1], carry[7]
+            going = pos < max_len - 1
+            if eos_id >= 0:
+                # mesh-invariant early exit, as in make_generate_fn
+                running = lax.pmax(
+                    (~jnp.all(done)).astype(jnp.int32),
+                    ("data", "expert"))
+                going &= running > 0
+            return going
 
         def round_body(carry):
-            buf, pos, acc_sum, rounds, t_cache, d_cache, key = carry
+            (buf, pos, acc_sum, rounds, t_cache, d_cache, key,
+             done) = carry
             cur = lax.dynamic_slice(buf, (0, pos), (B, 1))[:, 0]
             # --- draft proposes k tokens (greedy, or sampled from its
             # own temperature distribution) ---------------------------- #
@@ -869,11 +944,15 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
             d_cur = cur
             for j in range(k):      # static unroll, k is small
                 dlog, d_cache = _decode_step(
-                    draft_cfg, d_params, d_cache, d_cur, pos + j)
+                    draft_cfg, d_params, d_cache, d_cur, pos + j,
+                    pos_offset=offsets)
                 if temperature > 0.0:
                     key, sub = jax.random.split(key)
-                    lp = jax.nn.log_softmax(
-                        dlog.astype(jnp.float32) / temperature, -1)
+                    # temperature first, then truncation — p_d′, the
+                    # draft side of the filtered acceptance pair
+                    lp = jax.nn.log_softmax(_filter_logits(
+                        dlog.astype(jnp.float32) / temperature,
+                        top_k, top_p), -1)
                     d_cur = jax.random.categorical(sub, lp) \
                         .astype(jnp.int32)
                     d_lps.append(jnp.take_along_axis(
@@ -890,20 +969,34 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
             # (partial accepts overwrite this slot next round anyway)
             _, d_cache = _decode_step(
                 draft_cfg, d_params, d_cache, d_cur, pos + k,
-                with_logits=False)
+                with_logits=False, pos_offset=offsets)
             prop = jnp.stack(props, axis=1)               # (B, k)
             if temperature <= 0.0:
                 buf, t_cache, n_acc = _verify_and_commit(
-                    cfg, params, t_cache, buf, pos, cur, prop, k)
+                    cfg, params, t_cache, buf, pos, cur, prop, k,
+                    pos_offset=offsets,
+                    done=done if eos_id >= 0 else None)
+                if eos_id >= 0:
+                    buf, done = _apply_eos_round(
+                        buf, pos, n_acc, k, done, eos_id, pad_id)
                 return (buf, pos + n_acc + 1, acc_sum + n_acc,
-                        rounds + 1, t_cache, d_cache, key)
+                        rounds + 1, t_cache, d_cache, key, done)
             # --- speculative SAMPLING verify (Leviathan/Chen) -------- #
             tlog, t_cache = _decode_step(
                 cfg, params, t_cache,
                 jnp.concatenate([cur[:, None], prop], axis=1), pos,
-                all_logits=True, chunk_attends_cache=True)
-            t_lp = jax.nn.log_softmax(
-                tlog.astype(jnp.float32) / temperature, -1)  # (B,k+1,V)
+                all_logits=True, chunk_attends_cache=True,
+                pos_offset=offsets)
+            # temperature, then the SAME truncation as the draft side:
+            # p_t′ — acceptance/residual/bonus below all run on the
+            # filtered pair, whose mixture identity is what plain
+            # filtered sampling produces
+            t_in = tlog.astype(jnp.float32) / temperature  # (B,k+1,V)
+            if top_k > 0 or top_p < 1.0:
+                t_in = _filter_logits(
+                    t_in.reshape(B * (k + 1), -1),
+                    top_k, top_p).reshape(t_in.shape)
+            t_lp = jax.nn.log_softmax(t_in, -1)            # (B,k+1,V)
             d_lp = jnp.stack(d_lps, axis=1)                  # (B, k)
             t_at_prop = jnp.take_along_axis(
                 t_lp[:, :k], prop[..., None], -1)[..., 0]    # (B, k)
@@ -915,6 +1008,10 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
             acc = jnp.log(u) < (t_at_prop - d_lp)
             lead = jnp.cumprod(acc.astype(jnp.int32), axis=1)
             row_acc = lead.sum(axis=1)                       # (B,)
+            if eos_id >= 0:
+                # frozen rows never bind the batch-min (their padded
+                # context proposes garbage); their commits pad below
+                row_acc = jnp.where(done, k, row_acc)
             n_acc = lax.pmin(
                 jnp.min(row_acc), ("data", "expert"))
             # the committed token at the cut position, PER ROW:
@@ -948,31 +1045,56 @@ def make_speculative_generate_fn(mesh_cfg, cfg: TransformerConfig,
                 prop, (0, cut_lt_k), (B, 1))[:, 0]
             bonus = jnp.where(row_acc > n_acc, prop_cut, sampled)
             buf = _commit_round(buf, pos, prop, bonus, n_acc, k)
+            if eos_id >= 0:
+                buf, done = _apply_eos_round(
+                    buf, pos, n_acc, k, done, eos_id, pad_id)
             return (buf, pos + n_acc + 1, acc_sum + n_acc, rounds + 1,
-                    t_cache, d_cache, key)
+                    t_cache, d_cache, key, done)
 
-        buf, _, acc_sum, rounds, _, _, _ = lax.while_loop(
+        done = _vary(jnp.zeros((B,), bool), "data", "expert")
+        buf, _, acc_sum, rounds, _, _, _, _ = lax.while_loop(
             cond, round_body,
             (buf, jnp.int32(Plen - 1), jnp.int32(0), jnp.int32(0),
-             t_cache, d_cache, key))
+             t_cache, d_cache, key, done))
         mean_acc = acc_sum.astype(jnp.float32) \
             / jnp.maximum(rounds, 1).astype(jnp.float32)
         return buf[:, :max_len], mean_acc
 
+    def body_plain(params, d_params, prompt, key):
+        return body(params, d_params, prompt, key, None)
+
+    def body_padded(params, d_params, prompt, lens, key):
+        return body(params, d_params, prompt, key,
+                    jnp.int32(prompt.shape[1]) - lens)
+
     fn = jax.jit(jax.shard_map(
-        body,
+        body_plain,
         mesh=mesh_cfg.mesh,
         in_specs=(specs, d_specs, batch_spec, P()),
         out_specs=(batch_spec, P()),
     ))
+    lazy = {}   # the padded program compiles on first use only
 
-    def generate(params, draft_params, prompt, key=None):
+    def generate(params, draft_params, prompt, key=None,
+                 prompt_lens=None):
         if temperature > 0.0 and key is None:
             raise ValueError(
                 "speculative sampling needs a PRNG key")
         if key is None:
             key = jax.random.PRNGKey(0)
-        toks, mean_acc = fn(params, draft_params, prompt, key)
+        if prompt_lens is None:
+            toks, mean_acc = fn(params, draft_params, prompt, key)
+            return (toks, mean_acc) if with_stats else toks
+        lens = _validate_prompt_lens(prompt, prompt_lens)
+        if "padded" not in lazy:
+            lazy["padded"] = jax.jit(jax.shard_map(
+                body_padded,
+                mesh=mesh_cfg.mesh,
+                in_specs=(specs, d_specs, batch_spec, batch_spec, P()),
+                out_specs=(batch_spec, P()),
+            ))
+        toks, mean_acc = lazy["padded"](
+            params, draft_params, prompt, lens, key)
         return (toks, mean_acc) if with_stats else toks
 
     generate._jitted = fn
@@ -994,19 +1116,25 @@ def _commit_round(buf, pos, prop, bonus, n_acc, k):
     return lax.dynamic_update_slice(buf, slab, (0, pos + 1))
 
 
-def _verify_and_commit(cfg, params, t_cache, buf, pos, cur, prop, k):
+def _verify_and_commit(cfg, params, t_cache, buf, pos, cur, prop, k,
+                       pos_offset=None, done=None):
     """The GREEDY speculative round's second half, shared by every
     proposer (draft model, prompt lookup): the target verifies ``prop``
     (B, k) in ONE (k+1)-wide chunk forward, the accepted prefix plus
     the target's corrective/bonus token land in ``buf``, and acceptance
     is the GLOBAL batch-min so every data shard advances in lockstep
-    (the while carry/cond need ``pos`` axis-invariant).  Returns
+    (the while carry/cond need ``pos`` axis-invariant).
+    ``pos_offset`` threads left-padded rows' per-row position origins
+    through the verify chunk; ``done`` (B,) marks eos-frozen rows,
+    which report a full-k acceptance so garbage proposed from their pad
+    context never binds the batch-min (their committed tokens are
+    padded afterwards by :func:`_apply_eos_round`).  Returns
     ``(buf, t_cache, n_acc)``."""
     B = cur.shape[0]
     chunk = jnp.concatenate([cur[:, None], prop], axis=1)
     tlog, t_cache = _decode_step(
         cfg, params, t_cache, chunk, pos,
-        all_logits=True, chunk_attends_cache=True)
+        all_logits=True, chunk_attends_cache=True, pos_offset=pos_offset)
     g = jnp.argmax(tlog, axis=-1).astype(jnp.int32)   # (B, k+1)
     # g[:, j] = target's token for position pos+j+1 given the chunk
     # prefix through pos+j; prop[:, j] was the proposer's token for
@@ -1014,8 +1142,10 @@ def _verify_and_commit(cfg, params, t_cache, buf, pos, cur, prop, k):
     # proposal matched
     match = prop == g[:, :k]                          # (B, k)
     lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
-    n_acc = lax.pmin(
-        jnp.min(lead.sum(axis=1)), ("data", "expert"))
+    row_acc = lead.sum(axis=1)
+    if done is not None:
+        row_acc = jnp.where(done, k, row_acc)
+    n_acc = lax.pmin(jnp.min(row_acc), ("data", "expert"))
     bonus = jnp.take_along_axis(
         g, jnp.full((B, 1), n_acc), axis=1)[:, 0]
     buf = _commit_round(buf, pos, prop, bonus, n_acc, k)
@@ -1024,7 +1154,9 @@ def _verify_and_commit(cfg, params, t_cache, buf, pos, cur, prop, k):
 
 def make_lookup_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                             k: int = 4, ngram: int = 2,
-                            max_len: int = 0, quantized: bool = False,
+                            max_len: int = 0,
+                            eos_id: int = -1, pad_id: int = 0,
+                            quantized: bool = False,
                             with_stats: bool = False):
     """Greedy prompt-lookup decoding: speculative decoding whose
     proposer is an N-GRAM MATCH against the already-generated context
@@ -1042,12 +1174,24 @@ def make_lookup_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     buffer — a few KB of integer work per round, nothing a TPU
     notices next to the verify matmuls.  Prompts must be at least
     ``ngram`` long; ``seq`` mesh axis must be 1 (same mid-sequence
-    chunk contract as speculative).  Returns ``generate(params,
-    prompt)`` (``with_stats=True`` appends mean accepted proposals
-    per round, the number to watch: it IS the speedup lever).
+    chunk contract as speculative).
+
+    ``eos_id >= 0`` enables early stopping with the exact
+    :func:`make_generate_fn` semantics (first eos kept, tail padded,
+    mesh-wide early exit; frozen rows report full-``k`` acceptance so
+    they never bind the batch-min).  Variable-length prompts:
+    RIGHT-align and pass ``prompt_lens`` — the matcher runs over the
+    padded buffer (windows touching pad slots just propose garbage,
+    which verification corrects; acceptance on short rows recovers as
+    their generated context grows).
+
+    Returns ``generate(params, prompt, prompt_lens=None)``
+    (``with_stats=True`` appends mean accepted proposals per round,
+    the number to watch: it IS the speedup lever).
     """
     if k < 1 or ngram < 1:
         raise ValueError(f"k={k} and ngram={ngram} must be >= 1")
+    _validate_eos_pad(cfg, eos_id, pad_id)
     if mesh_cfg.mesh.shape.get("seq", 1) != 1:
         raise ValueError(
             "prompt-lookup decoding writes mid-sequence chunks, which "
@@ -1060,7 +1204,7 @@ def make_lookup_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     pad = k + 1
     L = max_len + pad
 
-    def body(params, prompt):
+    def body(params, prompt, offsets):
         B, Plen = prompt.shape
         if Plen < ngram:
             raise ValueError(
@@ -1068,12 +1212,16 @@ def make_lookup_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 "lookup window would cross the buffer start")
         t_cache = _make_cache(cfg, B, kv_len_local + pad,
                               kv_heads_local, layers_local)
-        buf = jnp.zeros((B, L), jnp.int32)
+        # pad-seed when eos can exit early (see make_generate_fn)
+        buf = jnp.full((B, L),
+                       max(pad_id, 0) if eos_id >= 0 else 0, jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
         if Plen > 1:
             _, t_cache = _decode_step(
                 cfg, params, t_cache, prompt[:, :Plen - 1], 0,
-                with_logits=False)
+                with_logits=False,
+                chunk_attends_cache=offsets is not None,
+                pos_offset=offsets)
 
         # static window table: window w covers buf[w .. w+ngram-1]
         # and ENDS at position w+ngram-1
@@ -1081,10 +1229,17 @@ def make_lookup_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
         ends = jnp.arange(L - ngram + 1) + ngram - 1
 
         def cond(carry):
-            return carry[1] < max_len - 1
+            pos, done = carry[1], carry[5]
+            going = pos < max_len - 1
+            if eos_id >= 0:
+                running = lax.pmax(
+                    (~jnp.all(done)).astype(jnp.int32),
+                    ("data", "expert"))
+                going &= running > 0
+            return going
 
         def round_body(carry):
-            buf, pos, acc_sum, rounds, t_cache = carry
+            buf, pos, acc_sum, rounds, t_cache, done = carry
             cur = lax.dynamic_slice(buf, (0, pos), (B, 1))[:, 0]
             # --- lookup proposer ---------------------------------- #
             suffix = lax.dynamic_slice(
@@ -1101,27 +1256,51 @@ def make_lookup_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 j[:, None] + 1 + jnp.arange(k)[None], 0, L - 1)
             prop = jnp.take_along_axis(buf, src, axis=1)  # (B, k)
             buf, t_cache, n_acc = _verify_and_commit(
-                cfg, params, t_cache, buf, pos, cur, prop, k)
+                cfg, params, t_cache, buf, pos, cur, prop, k,
+                pos_offset=offsets,
+                done=done if eos_id >= 0 else None)
+            if eos_id >= 0:
+                buf, done = _apply_eos_round(
+                    buf, pos, n_acc, k, done, eos_id, pad_id)
             return (buf, pos + n_acc + 1, acc_sum + n_acc,
-                    rounds + 1, t_cache)
+                    rounds + 1, t_cache, done)
 
-        buf, _, acc_sum, rounds, _ = lax.while_loop(
+        done = _vary(jnp.zeros((B,), bool), "data", "expert")
+        buf, _, acc_sum, rounds, _, _ = lax.while_loop(
             cond, round_body,
             (buf, jnp.int32(Plen - 1), jnp.int32(0), jnp.int32(0),
-             t_cache))
+             t_cache, done))
         mean_acc = acc_sum.astype(jnp.float32) \
             / jnp.maximum(rounds, 1).astype(jnp.float32)
         return buf[:, :max_len], mean_acc
 
+    def body_plain(params, prompt):
+        return body(params, prompt, None)
+
+    def body_padded(params, prompt, lens):
+        return body(params, prompt, jnp.int32(prompt.shape[1]) - lens)
+
     fn = jax.jit(jax.shard_map(
-        body,
+        body_plain,
         mesh=mesh_cfg.mesh,
         in_specs=(specs, batch_spec),
         out_specs=(batch_spec, P()),
     ))
+    lazy = {}   # the padded program compiles on first use only
 
-    def generate(params, prompt):
-        toks, mean_acc = fn(params, prompt)
+    def generate(params, prompt, prompt_lens=None):
+        if prompt_lens is None:
+            toks, mean_acc = fn(params, prompt)
+            return (toks, mean_acc) if with_stats else toks
+        lens = _validate_prompt_lens(prompt, prompt_lens)
+        if "padded" not in lazy:
+            lazy["padded"] = jax.jit(jax.shard_map(
+                body_padded,
+                mesh=mesh_cfg.mesh,
+                in_specs=(specs, batch_spec, batch_spec),
+                out_specs=(batch_spec, P()),
+            ))
+        toks, mean_acc = lazy["padded"](params, prompt, lens)
         return (toks, mean_acc) if with_stats else toks
 
     generate._jitted = fn

@@ -185,11 +185,6 @@ def main():
 
     prompt_lens = None
     if args.prompt_file is not None:
-        if args.speculative_k > 0 or args.lookup_k > 0:
-            raise SystemExit(
-                "--prompt-file (variable-length batch) works with "
-                "greedy/sampling/beam only — speculative and lookup "
-                "decoding require equal prompt lengths")
         rows = []
         with open(args.prompt_file) as f:
             for i, ln in enumerate(f):
@@ -231,33 +226,39 @@ def main():
         if tok is not None:
             print(f"{label} text:", repr(tok.decode_text(ids)))
 
-    if args.eos_id >= 0 and (args.speculative_k > 0
-                             or args.lookup_k > 0):
-        raise SystemExit(
-            "--eos-id is not supported with --speculative-k/--lookup-k "
-            "(the verify chunk has no per-row freeze); drop one")
-    if (args.top_k > 0 or args.top_p < 1.0) and (
-            args.speculative_k > 0 or args.lookup_k > 0):
-        raise SystemExit(
-            "--top-k/--top-p are not supported with --speculative-k/"
-            "--lookup-k (the acceptance-rejection scheme samples the "
-            "full distributions); drop the truncation flags")
     if args.lookup_k > 0 and (args.speculative_k > 0 or args.beam > 0):
         raise SystemExit(
             "--lookup-k is its own decode mode; drop --speculative-k/"
             "--beam")
+    if args.lookup_k > 0 and (args.temperature > 0 or args.top_k > 0
+                              or args.top_p < 1.0):
+        raise SystemExit(
+            "--lookup-k is exact-GREEDY decoding; --temperature/"
+            "--top-k/--top-p have no effect there — drop them (for "
+            "sampled speculation use --speculative-k)")
+
+    def show_batch(out_np):
+        """Per-row display for ragged batches, first row otherwise."""
+        if prompt_lens is not None:
+            for b in range(out_np.shape[0]):
+                start = prompt.shape[1] - int(prompt_lens[b])
+                show(out_np[b, start:].tolist(), label=f"row {b}")
+        else:
+            show(out_np[0].tolist())
+
     if args.lookup_k > 0:
         from chainermn_tpu.models import make_lookup_generate_fn
 
         lk = make_lookup_generate_fn(
             mc, cfg, k=args.lookup_k, ngram=args.lookup_ngram,
-            max_len=args.max_len, quantized=args.int8, with_stats=True)
-        out, mean_acc = lk(params, prompt)
+            max_len=args.max_len, eos_id=args.eos_id,
+            pad_id=args.pad_id, quantized=args.int8, with_stats=True)
+        out, mean_acc = lk(params, prompt, prompt_lens=prompt_lens)
         print(f"prompt-lookup k={args.lookup_k} "
               f"ngram={args.lookup_ngram}: mean accepted "
               f"proposals/round {float(mean_acc):.2f} "
               f"(~{float(mean_acc) + 1:.2f} tokens per target read)")
-        show(np.asarray(out)[0].tolist())
+        show_batch(np.asarray(out))
     elif args.speculative_k > 0:
         import dataclasses
 
@@ -287,15 +288,17 @@ def main():
               f"draft: {note}")
         spec = make_speculative_generate_fn(
             mc, cfg, d_cfg, k=args.speculative_k, max_len=args.max_len,
-            temperature=args.temperature,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, eos_id=args.eos_id, pad_id=args.pad_id,
             quantized=args.int8, draft_quantized=d_quant,
             with_stats=True)
         out, mean_acc = spec(params, d_params, prompt,
-                             key=jax.random.PRNGKey(args.seed))
+                             key=jax.random.PRNGKey(args.seed),
+                             prompt_lens=prompt_lens)
         print(f"mean accepted proposals/round: {float(mean_acc):.2f} "
               f"of k={args.speculative_k} "
               f"(~{float(mean_acc) + 1:.2f} tokens per target read)")
-        show(np.asarray(out)[0].tolist())
+        show_batch(np.asarray(out))
     elif args.beam > 0:
         bs = make_beam_search_fn(
             mc, cfg, beam_size=args.beam, max_len=args.max_len,
@@ -320,13 +323,7 @@ def main():
             quantized=args.int8)
         out = gen(params, prompt, key=jax.random.PRNGKey(args.seed),
                   prompt_lens=prompt_lens)
-        out_np = np.asarray(out)
-        if prompt_lens is not None:
-            for b in range(out_np.shape[0]):
-                start = prompt.shape[1] - int(prompt_lens[b])
-                show(out_np[b, start:].tolist(), label=f"row {b}")
-        else:
-            show(out_np[0].tolist())
+        show_batch(np.asarray(out))
     return out
 
 

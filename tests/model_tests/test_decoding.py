@@ -781,6 +781,157 @@ class TestSpeculative:
         with pytest.raises(ValueError, match="temperature"):
             make_speculative_generate_fn(one, cfg, cfg,
                                          temperature=-1.0)
+        # filters truncate SAMPLING — greedy spec must reject them
+        with pytest.raises(ValueError, match="top_k/top_p"):
+            make_speculative_generate_fn(one, cfg, cfg, top_k=4)
+        with pytest.raises(ValueError, match="eos_id"):
+            make_speculative_generate_fn(one, cfg, cfg, eos_id=VOCAB)
+
+    def test_eos_matches_generate_eos(self):
+        """eos early stop composes with greedy speculation: output
+        token-identical to make_generate_fn's eos run (first eos kept,
+        tail padded), with a draft bad enough that the corrective path
+        runs across the freeze boundary."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = self._trained_host(cfg, 0)
+        d_host = self._trained_host(d_cfg, 9)
+        p = prompt(seed=18, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        d_params = shard_params(one, d_cfg, d_host)
+        plain = self._target_greedy(cfg, host, p, T)
+        eos, PAD = int(plain[0, 6]), 7    # row 0 emits eos mid-run
+        ref = np.asarray(make_generate_fn(
+            one, cfg, max_len=T, eos_id=eos, pad_id=PAD)(params, p))
+        assert (ref[0] == PAD).any()      # the freeze actually fires
+        got = np.asarray(make_speculative_generate_fn(
+            one, cfg, d_cfg, k=3, max_len=T, eos_id=eos, pad_id=PAD)(
+            params, d_params, p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_eos_sharded_mesh_matches(self):
+        """Rows freeze at different times across data shards: the
+        pmax'd stop flag and the frozen rows' forced-k acceptance must
+        keep every shard in lockstep to the global last row."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = self._trained_host(cfg, 0)
+        d_host = self._trained_host(d_cfg, 9)
+        p = prompt(seed=18, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        plain = self._target_greedy(cfg, host, p, T)
+        eos, PAD = int(plain[0, 6]), 7
+        ref = np.asarray(make_generate_fn(
+            one, cfg, max_len=T, eos_id=eos, pad_id=PAD)(
+            shard_params(one, cfg, host), p))
+        mc = MeshConfig(data=2, model=2, devices=jax.devices()[:4])
+        got = np.asarray(make_speculative_generate_fn(
+            mc, cfg, d_cfg, k=3, max_len=T, eos_id=eos, pad_id=PAD)(
+            shard_params(mc, cfg, host),
+            shard_params(mc, d_cfg, d_host), p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_padded_prompts_match_generate_padded(self):
+        """Variable-length prompts ride through the draft steps and
+        verify chunks: token-identical to make_generate_fn's padded
+        greedy run on the same rows."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4, pos_embedding="rope")
+        d_cfg = tiny_cfg(n_layers=2, pos_embedding="rope")
+        host = self._trained_host(cfg, 0)
+        d_host = self._trained_host(d_cfg, 9)
+        P_len = 4
+        lens = np.asarray([4, 3, 2, 4])
+        rng = np.random.RandomState(33)
+        padded = np.full((B, P_len), 63, np.int32)
+        for b, n in enumerate(lens):
+            padded[b, P_len - n:] = rng.randint(0, VOCAB, (n,))
+        padded = jnp.asarray(padded)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        d_params = shard_params(one, d_cfg, d_host)
+        ref = np.asarray(make_generate_fn(one, cfg, max_len=T)(
+            params, padded, prompt_lens=lens))
+        got = np.asarray(make_speculative_generate_fn(
+            one, cfg, d_cfg, k=3, max_len=T)(
+            params, d_params, padded, prompt_lens=lens))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_eos_and_padded_compose(self):
+        """The full serving shape at once: ragged prompts AND eos early
+        stop, still token-identical to the plain generator."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4, pos_embedding="rope")
+        d_cfg = tiny_cfg(n_layers=2, pos_embedding="rope")
+        host = self._trained_host(cfg, 0)
+        d_host = self._trained_host(d_cfg, 9)
+        P_len = 4
+        lens = np.asarray([4, 3, 2, 4])
+        rng = np.random.RandomState(34)
+        padded = np.full((B, P_len), 63, np.int32)
+        for b, n in enumerate(lens):
+            padded[b, P_len - n:] = rng.randint(0, VOCAB, (n,))
+        padded = jnp.asarray(padded)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        d_params = shard_params(one, d_cfg, d_host)
+        plain = np.asarray(make_generate_fn(one, cfg, max_len=T)(
+            params, padded, prompt_lens=lens))
+        eos, PAD = int(plain[0, 6]), 7
+        ref = np.asarray(make_generate_fn(
+            one, cfg, max_len=T, eos_id=eos, pad_id=PAD)(
+            params, padded, prompt_lens=lens))
+        got = np.asarray(make_speculative_generate_fn(
+            one, cfg, d_cfg, k=3, max_len=T, eos_id=eos, pad_id=PAD)(
+            params, d_params, padded, prompt_lens=lens))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_sampling_filters_distribution_matches_target(self):
+        """Speculative sampling with top-k/top-p must match sampling
+        the target directly WITH the same filters (truncate both
+        p_draft and p_target, renormalize, exact residual) — same
+        statistical design as the unfiltered test."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+        from chainermn_tpu.models.decoding import _filter_logits
+
+        cfg = tiny_cfg(n_layers=2)
+        d_cfg = tiny_cfg(n_layers=1)
+        host = self._trained_host(cfg, 0)
+        d_host = self._trained_host(d_cfg, 9)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        d_params = shard_params(one, d_cfg, d_host)
+        row = np.random.RandomState(50).randint(0, VOCAB, 4)
+        p = jnp.asarray(np.tile(row, (B, 1)), jnp.int32)
+        TEMP, TOPK, TOPP, CALLS = 1.5, 12, 0.9, 250
+
+        fwd = make_forward_fn(one, cfg)
+        full = jnp.asarray(np.pad(np.asarray(p), ((0, 0), (0, T - 4))))
+        logits = np.asarray(fwd(params, full))[0, 3][None] / TEMP
+        true_p = np.asarray(jax.nn.softmax(
+            _filter_logits(jnp.asarray(logits), TOPK, TOPP)))[0]
+        spec = make_speculative_generate_fn(
+            one, cfg, d_cfg, k=2, max_len=5, temperature=TEMP,
+            top_k=TOPK, top_p=TOPP)
+        h = np.zeros(VOCAB)
+        for i in range(CALLS):
+            out = np.asarray(
+                spec(params, d_params, p, key=jax.random.PRNGKey(i)))
+            for b in range(B):
+                h[out[b, 4]] += 1
+        n = CALLS * B
+        # every sample must live inside the target's truncated support
+        assert h[true_p <= 0].sum() == 0, "sample outside the nucleus"
+        tv = 0.5 * np.abs(h / n - true_p).sum()
+        noise = 0.5 * np.sqrt(2 * true_p / (np.pi * n)).sum()
+        assert tv < 1.6 * noise + 0.02, (tv, noise)
 
 
 class TestLookupDecoding:
@@ -875,12 +1026,84 @@ class TestLookupDecoding:
             make_lookup_generate_fn(one, cfg, k=0)
         with pytest.raises(ValueError, match="seq"):
             make_lookup_generate_fn(MeshConfig(seq=2, data=4), cfg)
+        with pytest.raises(ValueError, match="eos_id"):
+            make_lookup_generate_fn(one, cfg, eos_id=VOCAB)
         # prompt shorter than the ngram window fails at trace time
         gen = make_lookup_generate_fn(one, cfg, k=2, ngram=4, max_len=T)
         params = shard_params(
             one, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
         with pytest.raises(ValueError, match="ngram"):
             gen(params, prompt(length=2))
+
+    def test_eos_matches_generate_eos(self):
+        """eos early stop composes with lookup decoding: output
+        token-identical to make_generate_fn's eos run."""
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        host = self._trained(cfg, 1)
+        p = prompt(seed=44, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        plain = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(params, p))
+        eos, PAD = int(plain[0, 6]), 7
+        ref = np.asarray(make_generate_fn(
+            one, cfg, max_len=T, eos_id=eos, pad_id=PAD)(params, p))
+        assert (ref[0] == PAD).any()
+        got = np.asarray(make_lookup_generate_fn(
+            one, cfg, k=3, ngram=2, max_len=T, eos_id=eos,
+            pad_id=PAD)(params, p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_padded_prompts_match_generate_padded(self):
+        """Ragged prompts through the lookup matcher: windows touching
+        pad slots propose garbage, verification keeps the output
+        token-identical to the plain padded generator."""
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg(n_layers=4, pos_embedding="rope")
+        host = self._trained(cfg, 1)
+        P_len = 4
+        lens = np.asarray([4, 3, 2, 4])
+        rng = np.random.RandomState(35)
+        padded = np.full((B, P_len), 63, np.int32)
+        for b, n in enumerate(lens):
+            padded[b, P_len - n:] = rng.randint(0, VOCAB, (n,))
+        padded = jnp.asarray(padded)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        ref = np.asarray(make_generate_fn(one, cfg, max_len=T)(
+            params, padded, prompt_lens=lens))
+        got = np.asarray(make_lookup_generate_fn(
+            one, cfg, k=3, ngram=2, max_len=T)(
+            params, padded, prompt_lens=lens))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_eos_and_padded_compose(self):
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg(n_layers=4, pos_embedding="rope")
+        host = self._trained(cfg, 1)
+        P_len = 4
+        lens = np.asarray([4, 3, 2, 4])
+        rng = np.random.RandomState(36)
+        padded = np.full((B, P_len), 63, np.int32)
+        for b, n in enumerate(lens):
+            padded[b, P_len - n:] = rng.randint(0, VOCAB, (n,))
+        padded = jnp.asarray(padded)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        plain = np.asarray(make_generate_fn(one, cfg, max_len=T)(
+            params, padded, prompt_lens=lens))
+        eos, PAD = int(plain[0, 6]), 7
+        ref = np.asarray(make_generate_fn(
+            one, cfg, max_len=T, eos_id=eos, pad_id=PAD)(
+            params, padded, prompt_lens=lens))
+        got = np.asarray(make_lookup_generate_fn(
+            one, cfg, k=3, ngram=2, max_len=T, eos_id=eos,
+            pad_id=PAD)(params, padded, prompt_lens=lens))
+        np.testing.assert_array_equal(got, ref)
 
 
 def test_virtual_pipe_packed_params_decode():

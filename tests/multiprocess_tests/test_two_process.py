@@ -103,8 +103,14 @@ class TestTwoProcess:
 
     def test_lookup_decode(self, mp_run):
         # the draft-free proposer: row-local n-gram matching, shared
-        # acceptance pmin and verify chunk across the boundary
+        # acceptance pmin and verify chunk across the boundary; plus
+        # the padded+eos composition phase
         mp_run("lookup_decode", timeout=300)
+
+    def test_beam_search(self, mp_run):
+        # the per-step cache-reorder gather over batch-sharded ragged
+        # rows; tokens AND scores equal the local oracle
+        mp_run("beam_search", timeout=300)
 
     def test_shuffle_datablock(self, mp_run):
         mp_run("shuffle_datablock")
