@@ -96,12 +96,22 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
     prompt2 = jnp.asarray(
         np.random.RandomState(1).randint(0, cfg.vocab_size,
                                          (batch, p2)), jnp.int32)
-    dt2 = timed(lambda: gen(params, prompt2))
-    prefill_dt = dt2 / iters - gen_tail * per_tok_s
-    # timing noise can still push the difference non-positive at smoke
-    # scales — report null rather than a nonsense rate
-    prefill_tok_s = (batch * (p2 - 1) / prefill_dt
-                     if prefill_dt > 1e-6 else None)
+    # timing noise can push the subtraction non-positive at smoke
+    # scales; rather than silently dropping the metric, re-measure
+    # with more iterations until the difference resolves (VERDICT r4
+    # weak #7) — only then report null
+    prefill_tok_s = prefill_iters = None
+    for mult in (1, 4, 16):
+        n = iters * mult
+        # n_warm=1: prompt2's shape compiles on its first call — timing
+        # that would make the first attempt always "resolve" on compile
+        # time and report a junk rate
+        dt2, _ = _timed(lambda: gen(params, prompt2), n, 1)
+        prefill_dt = dt2 / n - gen_tail * per_tok_s
+        if prefill_dt > 1e-6:
+            prefill_tok_s = batch * (p2 - 1) / prefill_dt
+            prefill_iters = n
+            break
 
     # speculative SELF-draft baseline: draft == target accepts every
     # proposal, so each round emits k+1 tokens for k draft steps + one
@@ -159,6 +169,7 @@ def run(batch=4, prompt_len=16, max_len=512, d_model=1024, n_layers=8,
         "prefill_len": p2 - 1,
         "prefill_tokens_per_sec":
             round(prefill_tok_s, 1) if prefill_tok_s else None,
+        "prefill_iters": prefill_iters,
         "speculative_selfdraft_k": spec_k,
         "speculative_selfdraft_tokens_per_sec": round(spec_tok_s, 1),
         "speculative_overhead_ratio": round(tok_s / spec_tok_s, 3),

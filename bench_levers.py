@@ -40,7 +40,7 @@ def _time_steps(step, carry, x, y, warmup, iters):
     return (time.perf_counter() - t0) / iters * 1e3
 
 
-def run(batch=256, image=224, warmup=2, iters=6):
+def run(batch=256, image=224, warmup=2, iters=6, dtype="bfloat16"):
     import jax
     import jax.numpy as jnp
     import optax
@@ -52,10 +52,11 @@ def run(batch=256, image=224, warmup=2, iters=6):
     )
 
     comm = cmn.create_communicator("tpu_xla")
-    cfg = ResNetConfig(depth=50, num_classes=1000, dtype="bfloat16")
+    cfg = ResNetConfig(depth=50, num_classes=1000, dtype=dtype)
 
     kx, ky = jax.random.split(jax.random.PRNGKey(1))
-    x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
+    x = jax.random.normal(kx, (batch, image, image, 3),
+                          jnp.dtype(dtype))
     y = jax.random.randint(ky, (batch,), 0, cfg.num_classes)
     sh = jax.sharding.NamedSharding(comm.mesh, P(comm.axis_name))
     x, y = jax.device_put(x, sh), jax.device_put(y, sh)
@@ -107,7 +108,8 @@ def run(batch=256, image=224, warmup=2, iters=6):
         "grad_bf16_ratio": round(results["grad_bf16"] / base, 4),
         "baseline_ms": round(base, 2),
         "device_kind": jax.devices()[0].device_kind,
-        "batch": batch, "image": image,
+        "n_devices": comm.size,
+        "batch": batch, "image": image, "dtype": dtype,
     }
 
 
@@ -119,26 +121,43 @@ def main(argv):
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--iters", type=int, default=6)
     p.add_argument("--platform", default=None)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--dp-devices", type=int, default=0,
+                   help="force an N-virtual-device mesh (CPU only): "
+                        "the communicator then spans N devices and the "
+                        "double-buffering row measures real DP overlap "
+                        "scheduling, not just single-chip overhead")
     p.add_argument("--timeouts", type=int, nargs="+", default=[600])
     args = p.parse_args(argv)
 
     if args.child:
+        if args.dp_devices > 1:
+            # must land before any backend init in this interpreter
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.dp_devices}")
         pin_platform(args.platform)
         print("BENCH_RESULT " + json.dumps(run(
             batch=args.batch, image=args.image, warmup=args.warmup,
-            iters=args.iters)))
+            iters=args.iters, dtype=args.dtype)))
         return 0
 
     here = os.path.abspath(__file__)
     cmd = [sys.executable, here, "--child",
            "--batch", str(args.batch), "--image", str(args.image),
-           "--warmup", str(args.warmup), "--iters", str(args.iters)]
+           "--warmup", str(args.warmup), "--iters", str(args.iters),
+           "--dtype", args.dtype]
+    if args.dp_devices:
+        cmd += ["--dp-devices", str(args.dp_devices)]
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(
         cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
-        use_cache=args.platform is None,
-        cache_match={"batch": args.batch, "image": args.image})
+        use_cache=args.platform is None and not args.dp_devices,
+        cache_match={"batch": args.batch, "image": args.image,
+                     "dtype": args.dtype},
+        cache_require=("dtype",))
 
 
 if __name__ == "__main__":

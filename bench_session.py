@@ -47,12 +47,21 @@ BATTERY = [
     # int8 weights + int8 KV cache: the full serving-quantisation stack
     (["python", "bench_decode.py", "--int8", "--kv-int8"], 1800),
     (["python", "bench_attention.py"], 1200),
+    # the bwd-block retune sweep (r5 kernel lever toward the >=50% MFU
+    # ask): best backward tiling vs the 1024/1024 default; the winning
+    # pair becomes the kernel default in a follow-up
+    (["python", "bench_attention.py", "--sweep"], 2400),
     (["python", "bench_seq2seq.py"], 1200),
     (["python", "bench_loader.py"], 600),
     # the quality bar: train the LM example on a book-scale corpus with
     # a BPE tokenizer to a held-out-ppl target, interruption + resume
     # included (the README results row)
     (["python", "bench_quality.py", "--full"], 3300),
+    # prompt-lookup acceptance on REAL prose (the repo's docs) through
+    # the full train->generate user flow — the feature's headline
+    # number on the workload it exists for (outer budget > the bench's
+    # own 4000s attempt so the parent never kills a healthy run)
+    (["python", "bench_lookup_real.py"], 4200),
 ]
 
 

@@ -297,22 +297,28 @@ def _fwd(q3, k3, v3, offs, scale, causal, window, block_q, block_k,
     return o, lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q3, k3, v3, offs, scale, causal, window, block_q, block_k,
-           interpret):
+           bwd_block_q, bwd_block_k, interpret):
     return _fwd(q3, k3, v3, offs, scale, causal, window, block_q,
                 block_k, interpret)
 
 
 def _flash_fwd(q3, k3, v3, offs, scale, causal, window, block_q, block_k,
-               interpret):
+               bwd_block_q, bwd_block_k, interpret):
     o, lse = _fwd(q3, k3, v3, offs, scale, causal, window, block_q,
                   block_k, interpret)
     return (o, lse), (q3, k3, v3, offs, o, lse)
 
 
-def _flash_bwd(scale, causal, window, block_q, block_k, interpret, res,
-               cts):
+def _flash_bwd(scale, causal, window, fwd_block_q, fwd_block_k,
+               block_q, block_k, interpret, res, cts):
+    # the backward kernels tile on their OWN block sizes: dq's q-outer
+    # grid and dkv's k-outer revisit pattern have different optimal
+    # shapes than the forward (the retune lever bench_attention.py
+    # --sweep measures); the fwd blocks arrive first in the nondiff
+    # tuple and are unused here
     q3, k3, v3, offs, o, lse = res
     do, dlse = cts
     BH, Tq, D = q3.shape
@@ -412,6 +418,8 @@ def flash_attention_supported(T_q: int, T_k: int, block_q: int = 1024,
 def flash_attention(q, k, v, *, causal: bool = False, window=None,
                     q_offset=0,
                     k_offset=0, block_q: int = 1024, block_k: int = 1024,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_k: Optional[int] = None,
                     return_lse: bool = False, interpret: bool = False):
     """Flash attention over ``(B, T, H, D)`` tensors.
 
@@ -429,6 +437,12 @@ def flash_attention(q, k, v, *, causal: bool = False, window=None,
 
     With ``return_lse=True`` returns ``(out, lse)`` where ``lse`` is
     ``(B, T, H)`` fp32 — both outputs are differentiable.
+
+    ``bwd_block_q``/``bwd_block_k`` tile the two backward kernels
+    independently of the forward (default: the forward blocks) — the
+    dq kernel's q-outer grid and the dkv kernel's k-outer revisit
+    pattern peak at different shapes, and gradients are exact for any
+    valid tiling (``bench_attention.py --sweep`` measures the retune).
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -438,7 +452,9 @@ def flash_attention(q, k, v, *, causal: bool = False, window=None,
     if window is not None and window < 1:
         raise ValueError(f"window {window} must be >= 1")
     bq, bk = _fit_block(Tq, block_q), _fit_block(Tk, block_k)
-    if bq is None or bk is None:
+    bwd_bq = _fit_block(Tq, bwd_block_q) if bwd_block_q else bq
+    bwd_bk = _fit_block(Tk, bwd_block_k) if bwd_block_k else bk
+    if bq is None or bk is None or bwd_bq is None or bwd_bk is None:
         raise ValueError(
             f"sequence lengths ({Tq}, {Tk}) unsupported: lengths must be "
             "multiples of 8 and either fit in one block or be tileable "
@@ -452,7 +468,7 @@ def flash_attention(q, k, v, *, causal: bool = False, window=None,
     to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
     o, lse = _flash(to3(q), to3(k), to3(v), offs, D ** -0.5, causal,
                     None if window is None else int(window),
-                    block_q, block_k, interpret)
+                    block_q, block_k, bwd_bq, bwd_bk, interpret)
     o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     if return_lse:
         return o, lse.reshape(B, H, Tq).transpose(0, 2, 1)

@@ -13,13 +13,48 @@ kill_pythons_matching() {
         [ "$comm" = "python" ] && kill "$pid" 2>/dev/null
     done
 }
+descends_from_babysitter() {
+    local pid=$1 i=0
+    while [ "$pid" -gt 1 ] && [ $i -lt 20 ]; do
+        grep -q 'bench_session\.py' "/proc/$pid/cmdline" 2>/dev/null \
+            && return 0
+        pid=$(awk '{print $4}' "/proc/$pid/stat" 2>/dev/null) || return 1
+        [ -n "$pid" ] || return 1
+        i=$((i + 1))
+    done
+    return 1
+}
+collect_babysitter_descendants() {
+    # battery children (bench_*.py) and hang_doctor probe children
+    # (python /tmp/tmpXXXX.py) — but ONLY those spawned by a
+    # babysitter: a blanket bench_* kill once took out the operator's
+    # own manual CPU measurement runs.  Collected BEFORE the parent
+    # dies: killing bench_session first would reparent its children to
+    # init and defeat the ancestry check.  Second clause: a child whose
+    # babysitter ALREADY died sits reparented under init and may still
+    # hold the axon relay grant, wedging the fresh session's first
+    # probe — reap those too, but spare CPU-pinned runs (the operator's
+    # manual measurements carry "cpu" on their command line and cannot
+    # hold the TPU).
+    for pid in $(pgrep -f "$1"); do
+        comm=$(cat "/proc/$pid/comm" 2>/dev/null)
+        [ "$comm" = "python" ] || continue
+        if descends_from_babysitter "$pid"; then
+            echo "$pid"
+        else
+            ppid=$(awk '{print $4}' "/proc/$pid/stat" 2>/dev/null)
+            if [ "$ppid" = "1" ] && \
+               ! tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null \
+                   | grep -q 'cpu'; then
+                echo "$pid"
+            fi
+        fi
+    done
+}
+DOOMED=$(collect_babysitter_descendants 'bench[_.]'
+         collect_babysitter_descendants '/tmp/tmp.*\.py')
 kill_pythons_matching 'bench_session.py'
-# probe + every battery child (bench.py, bench_transformer.py, ...) +
-# hang_doctor probe children (python /tmp/tmpXXXX.py) — an orphaned
-# one keeps holding the axon relay grant and contends with the fresh
-# session's first probe
-kill_pythons_matching 'bench[_.]'
-kill_pythons_matching '/tmp/tmp.*\.py'
+for pid in $DOOMED; do kill "$pid" 2>/dev/null; done
 sleep 1
 nohup python bench_session.py --max-hours "${1:-11}" >> bench_session.log 2>&1 &
 echo "babysitter pid $!"

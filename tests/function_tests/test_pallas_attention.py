@@ -52,6 +52,29 @@ def test_grads_match_oracle(causal):
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.parametrize("bwd_q,bwd_k", [(16, 32), (32, 16), (64, 64)])
+def test_bwd_block_retune_grads_exact(bwd_q, bwd_k):
+    """Backward kernels tiled independently of the forward must give
+    the same gradients for ANY valid tiling — the correctness side of
+    the bwd block retune lever (bench_attention.py --sweep measures
+    the perf side)."""
+    q, k, v = qkv(3)
+
+    def loss(bq, bk):
+        def f(q, k, v):
+            o = flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32,
+                bwd_block_q=bq, bwd_block_k=bk, interpret=True)
+            return jnp.sum(o * jnp.cos(o))
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_default = loss(None, None)
+    g_retuned = loss(bwd_q, bwd_k)
+    for a, b in zip(g_retuned, g_default):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
 def test_global_offsets_match_sliced_oracle():
     """Sequence-sharded callers pass global offsets: attending a local q
     block against a k block from elsewhere in the sequence must equal the

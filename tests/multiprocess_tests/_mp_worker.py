@@ -943,9 +943,11 @@ def scenario_speculative_sampling(comm):
     assert all(abs(x - accs[0]) < 1e-6 for x in accs), accs
 
     # --- top-k/top-p composition: the truncated draft/target pair's
-    # acceptance pmin crosses the boundary; every sampled token must
-    # live inside the target's top_k set (support check — the full
-    # distribution identity is pinned single-device)
+    # acceptance pmin crosses the boundary.  Checked here: same-key
+    # determinism, vocab-range sanity, and cross-process agreement on
+    # the acceptance statistic; the truncated-support and distribution
+    # identities are pinned by the single-device statistical test
+    # (test_sampling_filters_distribution_matches_target)
     TOPK = 6
     fspec = make_speculative_generate_fn(
         mc, cfg, d_cfg, k=2, max_len=8, temperature=1.0,
