@@ -36,6 +36,10 @@ BATTERY = [
     (["python", "bench_transformer.py"], 1500),
     # loss_chunk A/B: the SPEED.md candidate-#1 whole-step comparison
     (["python", "bench_transformer.py", "--loss-chunk", "512"], 1500),
+    # Adam first-moment bf16: attacks the 11 ms optimizer-state floor
+    # the r4 roofline itemised (9.2 GB/step of moments traffic)
+    (["python", "bench_transformer.py", "--mu-dtype", "bfloat16"],
+     1500),
     (["python", "bench_breakdown.py"], 2400),
     (["python", "bench_levers.py"], 1800),
     (["python", "bench_decode.py"], 1800),
@@ -60,8 +64,8 @@ BATTERY = [
     # prompt-lookup acceptance on REAL prose (the repo's docs) through
     # the full train->generate user flow — the feature's headline
     # number on the workload it exists for (outer budget > the bench's
-    # own 4000s attempt so the parent never kills a healthy run)
-    (["python", "bench_lookup_real.py"], 4200),
+    # own 5800s attempt so the parent never kills a healthy run)
+    (["python", "bench_lookup_real.py"], 6000),
 ]
 
 

@@ -15,6 +15,7 @@ timeout/retry pattern as bench.py (the TPU backend init can hang).
 
 import argparse
 import json
+import re
 import os
 import sys
 import time
@@ -27,7 +28,8 @@ UNIT = "tokens/sec/chip"
 
 def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         n_kv_heads=0, warmup=3, iters=10, attention="flash",
-        remat_policy="full", loss_chunk=0):
+        remat_policy="full", loss_chunk=0, bwd_blocks="",
+        mu_dtype=""):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -38,6 +40,8 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
     )
     from chainermn_tpu.parallel import MeshConfig
 
+    bwd_bq, bwd_bk = ((int(v) for v in bwd_blocks.split("x"))
+                      if bwd_blocks else (0, 0))
     cfg = TransformerConfig(
         vocab_size=32000, d_model=d_model, n_heads=n_heads,
         n_kv_heads=n_kv_heads, d_head=d_model // n_heads,
@@ -51,11 +55,16 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         remat=remat_policy != "none",
         remat_policy=remat_policy if remat_policy != "none" else "full",
         loss_chunk=loss_chunk,
+        # "QxK" adopts a bench_attention --sweep winner at step scale
+        flash_bwd_block_q=bwd_bq, flash_bwd_block_k=bwd_bk,
     )
     mc = MeshConfig(data=1, devices=jax.devices()[:1])
     params = shard_params(
         mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
-    opt = optax.adamw(3e-4)
+    # mu_dtype="bfloat16" halves the first-moment HBM traffic (the
+    # roofline puts Adam state at 9.2 GB/step = an 11 ms floor on
+    # v5e); the second moment stays fp32 (sqrt-precision-sensitive)
+    opt = optax.adamw(3e-4, mu_dtype=mu_dtype or None)
     opt_state = jax.jit(opt.init)(params)
     step = make_train_step(mc, cfg, opt)
 
@@ -102,6 +111,8 @@ def run(batch=8, seq=2048, d_model=1024, n_layers=24, n_heads=16,
         "n_kv_heads": n_kv_heads,
         "remat_policy": remat_policy,
         "loss_chunk": loss_chunk,
+        "bwd_blocks": bwd_blocks,
+        "mu_dtype": mu_dtype,
         "loss": round(float(loss), 3),
     }
 
@@ -113,7 +124,9 @@ def _child_main(args):
                  n_kv_heads=args.n_kv_heads, warmup=args.warmup,
                  iters=args.iters, attention=args.attention,
                  remat_policy=args.remat_policy,
-                 loss_chunk=args.loss_chunk)
+                 loss_chunk=args.loss_chunk,
+                 bwd_blocks=args.bwd_blocks,
+                 mu_dtype=args.mu_dtype)
     print("BENCH_RESULT " + json.dumps(result))
 
 
@@ -129,6 +142,10 @@ def _parent_main(args):
            "--attention", args.attention,
            "--remat-policy", args.remat_policy,
            "--loss-chunk", str(args.loss_chunk)]
+    if args.bwd_blocks:
+        cmd += ["--bwd-blocks", args.bwd_blocks]
+    if args.mu_dtype:
+        cmd += ["--mu-dtype", args.mu_dtype]
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(
@@ -137,10 +154,14 @@ def _parent_main(args):
         cache_match={"batch": args.batch, "seq": args.seq,
                      "d_model": args.d_model, "n_layers": args.n_layers,
                      "attention": args.attention,
-                     "loss_chunk": args.loss_chunk},
+                     "loss_chunk": args.loss_chunk,
+                     "bwd_blocks": args.bwd_blocks,
+                     "mu_dtype": args.mu_dtype},
         # a non-default chunk arm must never be served a legacy entry
         # that predates the loss_chunk field (= measured at 0)
-        cache_require=("loss_chunk",) if args.loss_chunk else ())
+        cache_require=(("loss_chunk",) if args.loss_chunk else ())
+        + (("bwd_blocks",) if args.bwd_blocks else ())
+        + (("mu_dtype",) if args.mu_dtype else ()))
 
 
 def _parse_args(argv):
@@ -156,6 +177,13 @@ def _parse_args(argv):
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--attention", default="flash",
                    choices=["flash", "local", "ring", "ulysses"])
+    p.add_argument("--mu-dtype", default="",
+                   help="optax mu_dtype override, e.g. bfloat16: "
+                        "halves Adam first-moment HBM traffic")
+    p.add_argument("--bwd-blocks", default="",
+                   help='"QxK" flash backward-kernel tiling override '
+                        "(adopt a bench_attention --sweep winner at "
+                        "full step scale)")
     p.add_argument("--loss-chunk", type=int, default=0,
                    help="chunked-vocab cross-entropy chunk size "
                         "(0 = whole-shard logits); A/B the SPEED.md "
@@ -164,7 +192,12 @@ def _parse_args(argv):
                    choices=["full", "dots", "none"])
     p.add_argument("--platform", default=None)
     p.add_argument("--timeouts", type=int, nargs="+", default=[480])
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.bwd_blocks and not re.fullmatch(r"\d+x\d+",
+                                            args.bwd_blocks):
+        p.error(f'--bwd-blocks must look like "512x1024", '
+                f'got {args.bwd_blocks!r}')
+    return args
 
 
 if __name__ == "__main__":

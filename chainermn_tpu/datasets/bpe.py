@@ -71,6 +71,13 @@ class BPETokenizer:
     def vocab_size(self) -> int:
         return 256 + len(self.merges)
 
+    # natural-language chunks (pre-tokenizer word pieces) are short and
+    # highly repetitive, so the memo stays tiny; the cap only matters
+    # for adversarial input (e.g. a stream of unique long chunks, where
+    # the O(len^2) merge scan below would otherwise also pin unbounded
+    # memory behind it)
+    _CACHE_CAP = 1 << 16
+
     def _encode_chunk(self, chunk: bytes) -> tuple[int, ...]:
         got = self._cache.get(chunk)
         if got is not None:
@@ -85,7 +92,8 @@ class BPETokenizer:
             if best_pair is None:
                 break
             word = _merge_pair(word, best_pair, 256 + best_rank)
-        self._cache[chunk] = word
+        if len(self._cache) < self._CACHE_CAP:
+            self._cache[chunk] = word
         return word
 
     def encode(self, text) -> list[int]:

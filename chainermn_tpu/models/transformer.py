@@ -100,6 +100,11 @@ class TransformerConfig:
     n_layers: int = 4          # total; must divide by mesh pipe size
     max_seq: int = 2048
     attention: str = "ring"    # "ring" | "ulysses" | "local" | "flash"
+    flash_bwd_block_q: int = 0  # 0 = kernel default; >0 retunes the
+    # flash BACKWARD kernels' tiling independently of the forward
+    # (gradients are tiling-exact; bench_attention.py --sweep picks
+    # the winning pair on hardware, this knob adopts it per-model)
+    flash_bwd_block_k: int = 0
     attention_window: int = 0  # 0 => full causal; W>0 => sliding causal
     # window (token t attends to (t-W, t]): Mistral-style local
     # attention; the flash kernel and the ring schedule skip fully
@@ -1000,6 +1005,8 @@ def _attention(cfg: TransformerConfig, h, blk):
         o = ring_attention(q, k, v, axis_name="seq", causal=True,
                            window=win,
                            remat=cfg.remat, use_flash=use_flash,
+                           bwd_block_q=cfg.flash_bwd_block_q or None,
+                           bwd_block_k=cfg.flash_bwd_block_k or None,
                            layout=cfg.seq_layout,
                            interpret=jax.default_backend() != "tpu")
     elif cfg.attention == "ulysses":
@@ -1010,6 +1017,8 @@ def _attention(cfg: TransformerConfig, h, blk):
         T_full = T * lax.axis_size("seq")
         if flash_attention_supported(T_full, T_full):
             fa = partial(flash_attention,
+                         bwd_block_q=cfg.flash_bwd_block_q or None,
+                         bwd_block_k=cfg.flash_bwd_block_k or None,
                          interpret=jax.default_backend() != "tpu")
             o = ulysses_attention(q, k, v, axis_name="seq", causal=True,
                                   window=win,
@@ -1041,6 +1050,8 @@ def _attention(cfg: TransformerConfig, h, blk):
             o = flash_attention(
                 q, k, v, causal=True,
                 window=win,
+                bwd_block_q=cfg.flash_bwd_block_q or None,
+                bwd_block_k=cfg.flash_bwd_block_k or None,
                 interpret=jax.default_backend() != "tpu")
     else:
         raise ValueError(cfg.attention)

@@ -183,7 +183,8 @@ def _block_positions(rr, T, S, layout):
 def ring_attention(q, k, v, *, axis_name: str = "seq",
                    causal: bool = False, window=None, remat: bool = True,
                    use_flash: bool = False, block_q: int = 1024,
-                   block_k: int = 1024, interpret: bool = False,
+                   block_k: int = 1024, bwd_block_q=None,
+                   bwd_block_k=None, interpret: bool = False,
                    layout: str = "contiguous"):
     """Blockwise ring attention.  Call INSIDE ``shard_map`` over
     ``axis_name`` with Q/K/V sequence-sharded: ``(B, T_blk, H, D)`` each.
@@ -242,6 +243,8 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
                            window=window,
                            remat=remat, block_q=block_q, block_k=block_k,
+                           bwd_block_q=bwd_block_q,
+                           bwd_block_k=bwd_block_k,
                            interpret=interpret, S=S, r=r, ring=ring,
                            layout=layout, n_steps=n_steps)
 
@@ -293,8 +296,8 @@ def _merge_lse(o, lse, o_i, lse_i):
 
 
 def _ring_flash(q, k, v, *, axis_name, causal, window, remat, block_q,
-                block_k, interpret, S, r, ring, layout="contiguous",
-                n_steps=None):
+                block_k, interpret, S, r, ring, bwd_block_q=None,
+                bwd_block_k=None, layout="contiguous", n_steps=None):
     """Ring schedule with the Pallas kernel as the per-pair compute.
 
     Every visiting K/V block is attended with the SAME kernel call,
@@ -339,7 +342,9 @@ def _ring_flash(q, k, v, *, axis_name, causal, window, remat, block_q,
             return flash_attention(
                 qq, kb, vb, causal=causal, window=window,
                 q_offset=q_off, k_offset=k_off,
-                block_q=block_q, block_k=block_k, return_lse=True,
+                block_q=block_q, block_k=block_k,
+                bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k,
+                return_lse=True,
                 interpret=False)
 
     def attend_block(k_blk, v_blk, src):

@@ -217,6 +217,38 @@ def test_flash_attention_matches_oracle():
         np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+def test_flash_bwd_block_override_train_step_exact():
+    """flash_bwd_block_q/k retune the backward kernels' tiling only:
+    a train step (loss AND updated params) must be bit-comparable to
+    the default tiling — adoption of a sweep winner is purely a perf
+    decision."""
+    import optax
+
+    from chainermn_tpu.models import make_train_step
+
+    toks = tokens()[:, :T + 1]
+
+    def one_step(cfg):
+        mc = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(
+            mc, cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+        opt = optax.sgd(1e-2)
+        st = jax.jit(opt.init)(params)
+        step = make_train_step(mc, cfg, opt)
+        params, st, loss = step(params, st, toks[:, :T], toks[:, 1:])
+        return jax.tree.map(np.asarray, params), float(loss)
+
+    p_a, l_a = one_step(tiny_cfg(attention="flash"))
+    p_b, l_b = one_step(tiny_cfg(attention="flash",
+                                 flash_bwd_block_q=16,
+                                 flash_bwd_block_k=32))
+    assert l_a == l_b
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-6,
+                                                atol=2e-7),
+        p_a, p_b)
+
+
 def test_zigzag_ring_matches_oracle():
     """seq_layout="zigzag": tokens fed through the zigzag permutation
     must yield (after un-permuting) the same logits as the contiguous

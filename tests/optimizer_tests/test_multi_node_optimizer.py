@@ -249,3 +249,47 @@ class TestGradientAccumulation:
         with pytest.raises(ValueError, match="accum_steps"):
             create_multi_node_optimizer(optax.sgd(0.1), comm,
                                         accum_steps=0)
+
+
+class TestMuDtypeBf16:
+    """optax ``mu_dtype="bfloat16"`` through the multi-node wrapper:
+    the first-moment traffic lever the r4 roofline itemised (9.2
+    GB/step of Adam state on the 300M config).  The second moment
+    stays fp32, so the update direction survives the cast — pinned
+    here by a short training trajectory staying close to the fp32-mu
+    run while the stored mu really is bf16."""
+
+    def test_trajectory_close_and_state_is_bf16(self, comm):
+        def train(mu_dtype):
+            opt = create_multi_node_optimizer(
+                optax.adam(1e-2, mu_dtype=mu_dtype), comm)
+            params = {"w": jnp.ones((4, 4)) * 0.5}
+            state = jax.jit(opt.init)(params)
+            x = jnp.asarray(
+                np.random.RandomState(0).randn(comm.size, 4, 4),
+                jnp.float32)
+
+            def loss_fn(p):
+                return jnp.mean((p["w"] - x[0]) ** 2)
+
+            grad = jax.jit(jax.grad(loss_fn))
+            update = jax.jit(jax.shard_map(
+                lambda gg, ss, pp: opt.update(gg, ss, pp),
+                mesh=comm.mesh, in_specs=(P(), P(), P()),
+                out_specs=P()))
+            losses = []
+            for _ in range(20):
+                losses.append(float(loss_fn(params)))
+                u, state = update(grad(params), state, params)
+                params = optax.apply_updates(params, u)
+            return losses, state
+
+        fp_losses, _ = train(None)
+        bf_losses, bf_state = train(jnp.bfloat16)
+        # the stored first moment really is bf16
+        mus = [l for l in jax.tree.leaves(bf_state)
+               if hasattr(l, "dtype") and l.dtype == jnp.bfloat16]
+        assert mus, "no bf16 moment found in the optimizer state"
+        # and the trajectory stays close to the fp32-mu run
+        np.testing.assert_allclose(bf_losses, fp_losses,
+                                   rtol=2e-2, atol=1e-4)
