@@ -452,15 +452,19 @@ def flash_attention(q, k, v, *, causal: bool = False, window=None,
     if window is not None and window < 1:
         raise ValueError(f"window {window} must be >= 1")
     bq, bk = _fit_block(Tq, block_q), _fit_block(Tk, block_k)
-    bwd_bq = _fit_block(Tq, bwd_block_q) if bwd_block_q else bq
-    bwd_bk = _fit_block(Tk, bwd_block_k) if bwd_block_k else bk
-    if bq is None or bk is None or bwd_bq is None or bwd_bk is None:
+    if bq is None or bk is None:
         raise ValueError(
             f"sequence lengths ({Tq}, {Tk}) unsupported: lengths must be "
             "multiples of 8 and either fit in one block or be tileable "
             "by a power-of-two block >= 128 — gate on "
             "flash_attention_supported() and fall back to "
             "local_attention")
+    # a bwd override that doesn't tile THIS shape falls back to the
+    # forward blocks rather than erroring: the knob is a perf hint
+    # (often adopted from a sweep at another sequence length) and must
+    # never turn a supported shape into a trace-time failure
+    bwd_bq = (_fit_block(Tq, bwd_block_q) or bq) if bwd_block_q else bq
+    bwd_bk = (_fit_block(Tk, bwd_block_k) or bk) if bwd_block_k else bk
     block_q, block_k = bq, bk
     offs = jnp.asarray(
         jnp.stack([jnp.asarray(q_offset, jnp.int32),

@@ -20,6 +20,10 @@ def test_bench_quality_smoke_end_to_end():
     for k in list(env):
         if k.startswith(("TPU_", "LIBTPU", "PJRT_", "JAX_")):
             env.pop(k)
+    # the suite's conftest pins an 8-virtual-device XLA_FLAGS for the
+    # in-process mesh tests; the bench's train children run --mesh
+    # data=1 and must see the plain host device config
+    env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench_quality.py"),

@@ -911,6 +911,47 @@ class TestSpeculative:
             params, d_params, padded, prompt_lens=lens))
         np.testing.assert_array_equal(got, ref)
 
+    def test_sampling_padded_eos_runs_and_freezes(self):
+        """Speculative SAMPLING × ragged prompts × eos: same-key
+        determinism, prompts preserved in place, and every token after
+        a row's first generated eos is pad (the distribution identity
+        itself is pinned by the statistical tests; this pins the
+        composition's bookkeeping)."""
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=2, pos_embedding="rope")
+        d_cfg = tiny_cfg(n_layers=1, pos_embedding="rope")
+        host = self._trained_host(cfg, 0)
+        d_host = self._trained_host(d_cfg, 9)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        d_params = shard_params(one, d_cfg, d_host)
+        P_len = 4
+        lens = np.asarray([4, 3, 2, 4])
+        rng = np.random.RandomState(37)
+        padded = np.full((B, P_len), 63, np.int32)
+        for b, n in enumerate(lens):
+            padded[b, P_len - n:] = rng.randint(0, VOCAB, (n,))
+        padded = jnp.asarray(padded)
+        EOS, PAD = 5, 7
+        spec = make_speculative_generate_fn(
+            one, cfg, d_cfg, k=2, max_len=T, temperature=1.0,
+            top_k=16, eos_id=EOS, pad_id=PAD)
+        a = np.asarray(spec(params, d_params, padded,
+                            key=jax.random.PRNGKey(3),
+                            prompt_lens=lens))
+        b2 = np.asarray(spec(params, d_params, padded,
+                             key=jax.random.PRNGKey(3),
+                             prompt_lens=lens))
+        np.testing.assert_array_equal(a, b2)
+        np.testing.assert_array_equal(a[:, :P_len], np.asarray(padded))
+        assert (a < VOCAB).all() and (a >= 0).all()
+        for b_i in range(B):
+            gen = a[b_i, P_len:]
+            hits = np.where(gen == EOS)[0]
+            if hits.size:
+                assert (gen[hits[0] + 1:] == PAD).all(), a[b_i]
+
     def test_sampling_filters_distribution_matches_target(self):
         """Speculative sampling with top-k/top-p must match sampling
         the target directly WITH the same filters (truncate both
