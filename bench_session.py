@@ -162,11 +162,18 @@ def diagnose(failures: int, done: set):
             recs = [hang_doctor.run_probe(
                 variants[failures % len(variants)], timeout=300)]
             phase = None
-        # a once-per-session phase is spent only if it actually met a
-        # hang: burning the single 2700s classification probe on a
-        # fail-fast streak (chip answering, bench.py failing for other
-        # reasons) would leave the real hang unclassified later
-        if phase and any(r["outcome"] == "timeout" for r in recs):
+        # a once-per-session phase is spent only if it actually met the
+        # failure it exists to characterize: a timeout, or a LONG
+        # terminal exit (the plugin's ~25-min claim-retry budget ending
+        # in UNAVAILABLE — re-running the 2700s probe against that
+        # would burn a full retry cycle per failure streak).  A FAST
+        # failure (chip answering, bench.py broken for other reasons)
+        # must not spend the phase.
+        if phase and any(
+                r["outcome"] == "timeout"
+                or (r["outcome"].startswith("exited")
+                    and r["duration_s"] > 1200)
+                for r in recs):
             done.add(phase)
         for rec in recs:
             log(f"doctor[{rec['variant']}]: {rec['outcome']} "

@@ -295,6 +295,8 @@ def summarize():
                                             r["timeout_s"])
             stage = (r.get("stages") or {}).get("wedged_in") or "unknown"
             v["wedged_stages"][stage] = v["wedged_stages"].get(stage, 0) + 1
+        else:
+            v["errors"] = v.get("errors", 0) + 1
     cutoff = time.time() - VERDICT_WINDOW_S
     recent = [r for r in recs if _ts_epoch(r.get("ts", "")) >= cutoff]
     longest = max((r["timeout_s"] for r in recent
@@ -339,6 +341,21 @@ def _verdict(recs, longest, total=None):
             s = (r.get("stages") or {}).get("wedged_in") or "unknown"
             stages[s] = stages.get(s, 0) + 1
     stage = max(stages, key=stages.get) if stages else "unknown"
+    # a probe that SURVIVED long past the usual budgets and then exited
+    # with an error is the terminal answer: the backend's internal
+    # retry budget ran out and it reported the failure itself — the
+    # resource is unavailable, not slow, and shorter probes merely read
+    # the retry window as a hang
+    terminal = [r for r in recs
+                if r["outcome"].startswith("exited")
+                and r["duration_s"] > 1200]
+    if terminal:
+        t = terminal[-1]
+        return (f"terminal: the backend gave up with an error after "
+                f"~{t['duration_s']:.0f}s of claim retries "
+                f"({t['outcome']}; see stderr_tail in the jsonl) — the "
+                f"TPU pool is UNAVAILABLE, and probes shorter than the "
+                f"plugin's internal retry budget read it as a hang")
     kind = ("hang (outlasted a >=30-min probe; not merely slow init)"
             if longest >= 1800 else
             "timeout<30min only - slow-init not yet excluded")

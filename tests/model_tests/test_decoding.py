@@ -630,6 +630,31 @@ class TestSpeculative:
                               shard_params(mc, d_cfg, d_host), p))
         np.testing.assert_array_equal(got, ref)
 
+    def test_vocab_parallel_mesh_matches_greedy(self):
+        """Speculative decode over Megatron vocab TP: the verify
+        chunk's (B, k+1, V/M) logits shards all-gather to full width
+        before the argmax compare — tokens equal the plain (non-vp)
+        greedy oracle exactly."""
+        import dataclasses
+
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        d_cfg = tiny_cfg(n_layers=2)
+        host = self._trained_host(cfg, 1)
+        d_host = self._trained_host(d_cfg, 8)
+        p = prompt(seed=14, length=4)
+        ref = self._target_greedy(cfg, host, p, T)
+
+        vp = dataclasses.replace(cfg, vocab_parallel=True)
+        d_vp = dataclasses.replace(d_cfg, vocab_parallel=True)
+        mc = MeshConfig(data=2, model=2, devices=jax.devices()[:4])
+        got = np.asarray(make_speculative_generate_fn(
+            mc, vp, d_vp, k=3, max_len=T)(
+            shard_params(mc, vp, host),
+            shard_params(mc, d_vp, d_host), p))
+        np.testing.assert_array_equal(got, ref)
+
     def test_pipe_mesh_matches_greedy(self):
         """PP-decode composes: the verify chunk rides the S-phase
         ppermute hand-off with stage-masked cache writes."""
@@ -676,6 +701,32 @@ class TestSpeculative:
             draft_quantized=True)
         got = np.asarray(spec(shard_params(one, cfg, host),
                               shard_params(one, d_cfg, d_host), p))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_int8_kv_cache_matches_int8_kv_greedy(self):
+        """Speculative decode over an int8 KV cache: the verify
+        chunk's writes quantize per-(token, head) exactly like the
+        per-token oracle's, and both read back dequantized — tokens
+        equal the int8-KV greedy run (that quantized run is the right
+        oracle; int8-KV changes the logits)."""
+        import dataclasses
+
+        from chainermn_tpu.models import make_speculative_generate_fn
+
+        cfg = tiny_cfg(n_layers=4, kv_cache_dtype="int8")
+        d_cfg = tiny_cfg(n_layers=2, kv_cache_dtype="int8")
+        host = self._trained_host(
+            dataclasses.replace(cfg, kv_cache_dtype=""), 3)
+        d_host = self._trained_host(
+            dataclasses.replace(d_cfg, kv_cache_dtype=""), 6)
+        p = prompt(seed=19, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        params = shard_params(one, cfg, host)
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(params, p))
+        got = np.asarray(make_speculative_generate_fn(
+            one, cfg, d_cfg, k=3, max_len=T)(
+            params, shard_params(one, d_cfg, d_host), p))
         np.testing.assert_array_equal(got, ref)
 
     def test_truncated_cheap_draft_speeds_and_matches(self):
@@ -1056,6 +1107,28 @@ class TestLookupDecoding:
             shard_params(mc, cfg, host), p))
         np.testing.assert_array_equal(got, ref)
 
+    def test_vocab_parallel_mesh_matches_greedy(self):
+        """Lookup decoding over Megatron vocab TP (shared
+        _verify_and_commit with speculative: the sharded verify
+        logits all-gather before the argmax compare)."""
+        import dataclasses
+
+        from chainermn_tpu.models import make_lookup_generate_fn
+
+        cfg = tiny_cfg(n_layers=4)
+        host = self._trained(cfg, 1)
+        p = prompt(seed=42, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(
+                shard_params(one, cfg, host), p))
+        vp = dataclasses.replace(cfg, vocab_parallel=True)
+        mc = MeshConfig(data=2, model=2, devices=jax.devices()[:4])
+        got = np.asarray(make_lookup_generate_fn(
+            mc, vp, k=3, ngram=2, max_len=T)(
+            shard_params(mc, vp, host), p))
+        np.testing.assert_array_equal(got, ref)
+
     def test_int8_weights_match_int8_greedy(self):
         """Lookup decoding over weight-only int8: exact vs the int8
         greedy oracle (int8 changes the logits, so the quantized run
@@ -1273,6 +1346,30 @@ class TestBeamSearch:
             mc, cfg, beam_size=2, max_len=10)(params, p)
         b, sb = make_beam_search_fn(
             one, cfg, beam_size=2, max_len=10)(params_one, p)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_int8_weights_mesh_matches_single(self):
+        """Beam search over weight-only int8 on a DP+TP mesh:
+        tokens+scores equal the single-device int8 beam run (int8
+        changes the logits, so the quantized single-device run is the
+        right oracle)."""
+        from chainermn_tpu.models import (
+            make_beam_search_fn, quantize_params_int8)
+
+        cfg = tiny_cfg()
+        host = quantize_params_int8(
+            cfg, init_transformer(jax.random.PRNGKey(0), cfg))
+        p = prompt(length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        b, sb = make_beam_search_fn(
+            one, cfg, beam_size=2, max_len=10, quantized=True)(
+            shard_params(one, cfg, host), p)
+        mc = MeshConfig(data=2, model=2, devices=jax.devices()[:4])
+        a, sa = make_beam_search_fn(
+            mc, cfg, beam_size=2, max_len=10, quantized=True)(
+            shard_params(mc, cfg, host), p)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
                                    rtol=1e-4, atol=1e-4)
