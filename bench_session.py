@@ -169,11 +169,9 @@ def diagnose(failures: int, done: set):
         # would burn a full retry cycle per failure streak).  A FAST
         # failure (chip answering, bench.py broken for other reasons)
         # must not spend the phase.
-        if phase and any(
-                r["outcome"] == "timeout"
-                or (r["outcome"].startswith("exited")
-                    and r["duration_s"] > 1200)
-                for r in recs):
+        if phase and any(r["outcome"] == "timeout"
+                         or hang_doctor.is_terminal_exit(r)
+                         for r in recs):
             done.add(phase)
         for rec in recs:
             log(f"doctor[{rec['variant']}]: {rec['outcome']} "

@@ -251,6 +251,15 @@ def run_probe(variant="default", timeout=420):
 
 
 VERDICT_WINDOW_S = 12 * 3600
+# a child that survived this long and then exited rc!=0 hit the
+# plugin's INTERNAL retry budget (~25 min observed) and reported the
+# failure itself — the terminal outcome, not a fast harness error
+TERMINAL_EXIT_MIN_S = 1200
+
+
+def is_terminal_exit(rec) -> bool:
+    return (rec["outcome"].startswith("exited")
+            and rec.get("duration_s", 0) > TERMINAL_EXIT_MIN_S)
 
 
 def _ts_epoch(ts: str) -> float:
@@ -346,9 +355,7 @@ def _verdict(recs, longest, total=None):
     # retry budget ran out and it reported the failure itself — the
     # resource is unavailable, not slow, and shorter probes merely read
     # the retry window as a hang
-    terminal = [r for r in recs
-                if r["outcome"].startswith("exited")
-                and r["duration_s"] > 1200]
+    terminal = [r for r in recs if is_terminal_exit(r)]
     if terminal:
         t = terminal[-1]
         return (f"terminal: the backend gave up with an error after "
