@@ -189,7 +189,10 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
     error = "; ".join(errors)[-1800:]
     cached = freshest_cached(metric, cache_match, require=cache_require) \
         if (use_cache and fallback) else None
-    diagnosis = _outage_diagnosis()
+    # only real-hardware attempts can fail BECAUSE of the outage: a
+    # CPU-pinned smoke run (use_cache=False) failing for its own
+    # reasons must not be stamped with a TPU diagnosis
+    diagnosis = _outage_diagnosis() if use_cache else None
     if cached is not None:
         out = dict(cached)
         out["cached"] = True
@@ -213,13 +216,22 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
 
 
 def _outage_diagnosis():
-    """The hang doctor's current verdict (its SUMMARY artifact), so a
+    """The hang doctor's CURRENT verdict (its SUMMARY artifact), so a
     cached-fallback bench record carries WHY the live attempt failed —
     the judge reads the bench artifact, and 'timed out' alone cannot
-    distinguish a dead pool from a slow one."""
+    distinguish a dead pool from a slow one.  A stale summary is not
+    attached: a verdict older than the doctor's own window could
+    misattribute an unrelated failure to a long-resolved outage."""
     try:
-        from hang_doctor import SUMMARY
+        import time
+
+        from hang_doctor import SUMMARY, VERDICT_WINDOW_S
         with open(SUMMARY) as f:
-            return json.load(f).get("verdict")
+            s = json.load(f)
+        gen = time.mktime(time.strptime(
+            s.get("generated", ""), "%Y-%m-%dT%H:%M:%S"))
+        if time.time() - gen > VERDICT_WINDOW_S:
+            return None
+        return s.get("verdict")
     except Exception:
         return None

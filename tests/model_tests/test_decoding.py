@@ -1129,6 +1129,27 @@ class TestLookupDecoding:
             shard_params(mc, vp, host), p))
         np.testing.assert_array_equal(got, ref)
 
+    def test_pipe_mesh_matches_greedy(self):
+        """Lookup decoding over pipe-parallel decode: the verify chunk
+        rides the S-phase ppermute hand-off with stage-masked cache
+        writes, the matcher stays host-side row-local."""
+        from chainermn_tpu.models import (
+            make_lookup_generate_fn, regroup_blocks)
+
+        cfg = tiny_cfg(n_layers=4)
+        host = self._trained(cfg, 2)
+        p = prompt(seed=45, length=4)
+        one = MeshConfig(data=1, devices=jax.devices()[:1])
+        ref = np.asarray(
+            make_generate_fn(one, cfg, max_len=T)(
+                shard_params(one, cfg, host), p))
+        mc = MeshConfig(pipe=2, data=2, devices=jax.devices()[:4])
+        got = np.asarray(make_lookup_generate_fn(
+            mc, cfg, k=3, ngram=2, max_len=T)(
+            shard_params(mc, cfg, dict(host, blocks=regroup_blocks(
+                host["blocks"], 1, 2))), p))
+        np.testing.assert_array_equal(got, ref)
+
     def test_int8_weights_match_int8_greedy(self):
         """Lookup decoding over weight-only int8: exact vs the int8
         greedy oracle (int8 changes the logits, so the quantized run
