@@ -290,6 +290,83 @@ def run_cheap_draft(batch=4, prompt_len=16, max_len=512, d_model=1024,
     }
 
 
+FLOOR_METRIC = "transformer_decode_hbm_floor_tokens_per_sec"
+
+
+def _heads(d_model: int) -> int:
+    """One derivation for the GQA head counts, shared by the measured
+    paths and the analytic floor so they always model the SAME
+    config."""
+    return max(1, d_model // 64)
+
+
+def _kv_heads(d_model: int) -> int:
+    return max(1, d_model // 256)
+
+
+def analyze(batch=4, max_len=512, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, int8=False, kv_int8=False, device_kind="v5e"):
+    """First-principles decode roofline (no hardware needed): each
+    generated step reads the full weights once (amortized over the
+    batch) plus every row's ALLOCATED cache (static shapes — the
+    per-token step scores max_len slots under a mask), so the HBM
+    floor is (weight_bytes + cache_bytes_per_step) / bandwidth.  The
+    number the measured tokens/sec row is judged against when the
+    chip answers — the decode twin of bench_breakdown --analyze-only.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench_breakdown import _hbm_gbps
+    from chainermn_tpu.models import TransformerConfig, init_transformer
+
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_heads=n_heads,
+        n_kv_heads=n_kv_heads, d_head=d_model // n_heads,
+        d_ff=4 * d_model, n_layers=n_layers, max_seq=max_len,
+        attention="local", pos_embedding="rope", dtype="bfloat16",
+        kv_cache_dtype="int8" if kv_int8 else "", remat=False)
+    # abstract key: eval_shape over a ShapeDtypeStruct never creates a
+    # concrete array, so this path touches NO backend — callable even
+    # while the TPU plugin is wedged
+    shapes = jax.eval_shape(
+        lambda k: init_transformer(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32))
+    n_params = sum(int(np.prod(s.shape))
+                   for s in jax.tree.leaves(shapes))
+    wbytes = n_params * (1 if int8 else 2)   # int8 vs bf16 storage
+    if int8:
+        # per-output-channel fp32 scales: one per matrix column —
+        # small next to the matrices; approximate via params/d_model
+        wbytes += 4 * (n_params // d_model)
+    kvh = cfg.kv_heads
+    val_b = 1 if kv_int8 else 2
+    cache_per_row = (n_layers * max_len * kvh * cfg.d_head * 2 * val_b
+                     + (n_layers * max_len * kvh * 2 * 4
+                        if kv_int8 else 0))   # fp32 scales
+    step_bytes = wbytes + batch * cache_per_row
+    bw = _hbm_gbps(device_kind) * 1e9
+    floor_tok_s = batch / (step_bytes / bw)
+    return {
+        "metric": FLOOR_METRIC,
+        "value": round(floor_tok_s, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "analytic": True,
+        "device_kind": device_kind,
+        "hbm_gbps": bw / 1e9,
+        "n_params": n_params,
+        "weight_bytes_gb": round(wbytes / 1e9, 3),
+        "cache_bytes_per_step_gb": round(
+            batch * cache_per_row / 1e9, 4),
+        "floor_ms_per_step": round(step_bytes / bw * 1e3, 3),
+        "batch": batch, "max_len": max_len,
+        "d_model": d_model, "n_layers": n_layers,
+        "int8": int8, "kv_int8": kv_int8,
+    }
+
+
 def main(argv):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--child", action="store_true")
@@ -314,6 +391,10 @@ def main(argv):
                         "acceptance is reported either way)")
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--iters", type=int, default=2)
+    p.add_argument("--analyze-only", action="store_true",
+                   help="print the analytic HBM decode floor for this "
+                        "config (and its int8/kv-int8 variants) "
+                        "without touching any device")
     p.add_argument("--platform", default=None)
     p.add_argument("--timeouts", type=int, nargs="+",
                    default=[1500])  # several decode-loop compiles
@@ -322,6 +403,21 @@ def main(argv):
         p.error("--cheap-draft measures the bf16 draft-vs-target "
                 "economics; run --int8/--kv-int8 separately (the "
                 "flags would be silently ignored otherwise)")
+    if args.analyze_only:
+        if args.cheap_draft or args.int8 or args.kv_int8:
+            p.error("--analyze-only prints ALL quantization arms' "
+                    "floors itself; drop --cheap-draft/--int8/"
+                    "--kv-int8 (they would be silently ignored)")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        for i8, kv8 in ((False, False), (True, False), (False, True),
+                        (True, True)):
+            print(json.dumps(analyze(
+                batch=args.batch, max_len=args.max_len,
+                d_model=args.d_model, n_layers=args.n_layers,
+                n_heads=_heads(args.d_model),
+                n_kv_heads=_kv_heads(args.d_model),
+                int8=i8, kv_int8=kv8)))
+        return 0
 
     if args.child:
         pin_platform(args.platform)
@@ -329,8 +425,8 @@ def main(argv):
             print("BENCH_RESULT " + json.dumps(run_cheap_draft(
                 batch=args.batch, max_len=args.max_len,
                 d_model=args.d_model, n_layers=args.n_layers,
-                n_heads=max(1, args.d_model // 64),
-                n_kv_heads=max(1, args.d_model // 256),
+                n_heads=_heads(args.d_model),
+                n_kv_heads=_kv_heads(args.d_model),
                 draft_layers=args.draft_layers, eps=args.eps,
                 warmup=args.warmup, iters=args.iters)))
         else:

@@ -961,6 +961,31 @@ def scenario_speculative_sampling(comm):
     faccs = comm.allgather_obj(float(facc))
     assert all(abs(x - faccs[0]) < 1e-6 for x in faccs), faccs
 
+    # --- ragged + eos composition under SAMPLING: per-row offsets and
+    # the done flags ride the cross-process while_loop with the key
+    # carry; same-key determinism and prompt preservation across the
+    # boundary (per-row content exactness is pinned single-device)
+    lens = np.asarray([3, 1, 2, 3])
+    padded = np.full((4, 3), 7, np.int32)
+    rng = np.random.RandomState(17)
+    for b, L in enumerate(lens):
+        padded[b, 3 - L:] = rng.randint(0, cfg.vocab_size, L)
+    pl = jax.device_put(jnp.asarray(padded), sh)
+    gl = jax.device_put(jnp.asarray(lens, jnp.int32), sh)
+    pspec = make_speculative_generate_fn(
+        mc, cfg, d_cfg, k=2, max_len=8, temperature=1.0,
+        eos_id=5, pad_id=0, with_stats=True)
+    p1, pacc = pspec(params, d_params, pl, key=jax.random.PRNGKey(6),
+                     prompt_lens=gl)
+    p2, _ = pspec(params, d_params, pl, key=jax.random.PRNGKey(6),
+                  prompt_lens=gl)
+    rp1, rp2 = (_gather_rows(comm, t) for t in (p1, p2))
+    np.testing.assert_array_equal(
+        rp1, rp2, err_msg="padded sampling not deterministic")
+    np.testing.assert_array_equal(rp1[:, :3], padded)
+    paccs = comm.allgather_obj(float(pacc))
+    assert all(abs(x - paccs[0]) < 1e-6 for x in paccs), paccs
+
 
 def scenario_lookup_decode(comm):
     """Prompt-lookup decoding ACROSS the process boundary: data=2 over

@@ -190,3 +190,39 @@ def test_breakdown_analyze_only_roofline():
         assert roof["mfu_ceiling"] == 1.0
         assert roof["step_floor_ms"] == roof["t_compute_ms"]
     assert rec["intensity_flops_per_byte"] > 1000
+
+
+def test_decode_analyze_only_hbm_floor():
+    """bench_decode --analyze-only: the analytic HBM decode floor
+    behind SERVING.md's lever yardsticks — four quantization arms,
+    int8 arms strictly faster (less HBM), parameter count matching
+    the real initialized model's (pinned against the measured run's
+    recorded n_params), and bytes consistent with the reported
+    floor."""
+    import json
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "bench_decode.py", "--analyze-only"],
+        capture_output=True, text=True, timeout=300, cwd=_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    assert len(recs) == 4
+    by = {(r["int8"], r["kv_int8"]): r for r in recs}
+    fp = by[(False, False)]
+    assert fp["metric"] == "transformer_decode_hbm_floor_tokens_per_sec"
+    # the eval_shape-derived parameter count equals the real model's
+    # (the value the measured bench rows record)
+    assert fp["n_params"] == 120_865_792
+    # quantization strictly raises the floor, weights > cache at this
+    # short context
+    assert by[(True, False)]["value"] > fp["value"]
+    assert by[(True, True)]["value"] > by[(True, False)]["value"]
+    assert by[(False, True)]["value"] > fp["value"]
+    assert fp["weight_bytes_gb"] > fp["cache_bytes_per_step_gb"]
+    # floor arithmetic self-consistent: tokens/s = batch / step time
+    step_s = (fp["weight_bytes_gb"] + fp["cache_bytes_per_step_gb"]) \
+        / fp["hbm_gbps"]
+    assert fp["value"] == pytest.approx(fp["batch"] / step_s, rel=0.01)
