@@ -50,6 +50,13 @@ BATTERY = [
     (["python", "bench_decode.py", "--int8"], 1800),
     # int8 weights + int8 KV cache: the full serving-quantisation stack
     (["python", "bench_decode.py", "--int8", "--kv-int8"], 1800),
+    # LONG context: at 4096 the cache bytes rival the weights and the
+    # int8-KV lever earns its keep (analytic floors: fp 8.7k -> full
+    # int8 17.0k tok/s, a 1.95x where cache is ~36% of step bytes).
+    # Inner attempt budget raised to match: ~8x the 512-context steps
+    # + larger compiles would exceed the 1500s default
+    (["python", "bench_decode.py", "--max-len", "4096",
+      "--int8", "--kv-int8", "--timeouts", "2100"], 2400),
     (["python", "bench_attention.py"], 1200),
     # the bwd-block retune sweep (r5 kernel lever toward the >=50% MFU
     # ask): best backward tiling vs the 1024/1024 default; the winning
