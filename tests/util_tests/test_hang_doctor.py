@@ -8,9 +8,14 @@ import json
 import sys
 import time
 
+# insert/import/pop, matching the sibling repo-root-importing tests:
+# leaving the root on sys.path would let later imports resolve
+# repo-root names (bench, examples, ...) collection-order-dependently
 sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
-
-import hang_doctor  # noqa: E402
+try:
+    import hang_doctor
+finally:
+    sys.path.pop(0)
 
 
 def _rec(**kw):
