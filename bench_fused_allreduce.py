@@ -1,0 +1,244 @@
+"""Fused bucketed gradient all-reduce benchmark: per-leaf vs fused vs
+hierarchical on a transformer-shaped grad pytree.
+
+The reference's headline perf lever (``PureNcclCommunicator``'s
+``batched_copy`` + fp16 allreduce) re-measured for the JAX port: the
+per-leaf baseline issues one ``pmean`` per parameter leaf (hundreds of
+small collectives per step), the fused arm packs the same pytree into
+flat ``bucket_bytes`` buckets (one collective each,
+``ops.fused_allreduce``), and the hierarchical arm additionally lowers
+each bucket as reduce-scatter(intra) → all-reduce(inter) →
+all-gather(intra) over a 2-D mesh — the multi-host shape.  Collective
+counts for every arm are cross-checked against the compiled HLO with
+``utils.comm_model`` so the speedup is attributable, not incidental.
+
+Workload note: fusion pays off in the latency-dominated regime — many
+small gradient leaves, where per-collective launch cost beats wire
+time.  That is where real distributed training sits on ICI (100 GB/s
+moves a ResNet's 100 MB of grads in ~1 ms, while hundreds of per-leaf
+launches cost multiples of that — the reference's whole motivation for
+``batched_copy``).  This host's 8-process virtual CPU mesh has ~1000×
+less effective bandwidth than ICI, so the default workload scales byte
+volume down (deep-narrow transformer, 500+ leaves, a few MB) to sit in
+the same latency-dominated regime; per-collective dispatch here is
+~0.2 ms, so the per-leaf baseline pays >100 ms of pure launch latency
+that the fused arm amortises into a handful of buckets.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}:
+value = per-leaf time / fused time (same-workload speedup, unit "x"),
+vs_baseline = the same ratio (per-leaf path == the pre-fusion baseline,
+1.0 = no win).  Arms are timed interleaved over several rounds taking
+each arm's best round (2-core container: min-of-rounds rejects
+scheduler noise that a single long window averages in).  Same hermetic
+child-process timeout/retry pattern as bench.py (the TPU backend init
+can hang).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from _bench_common import pin_platform, run_child_with_retries
+
+METRIC = "fused_allreduce_speedup_vs_per_leaf"
+UNIT = "x"
+
+
+def make_grad_tree(rng, n_devices, n_layers, d_model, vocab, dtype):
+    """World-stacked (n_devices, ...) transformer-shaped grad pytree:
+    per layer qkv/o/mlp/norm leaves, plus embedding — the leaf-count
+    and size mix the per-leaf path actually pays for."""
+    import numpy as np
+
+    def leaf(*shape):
+        return rng.randn(n_devices, *shape).astype(dtype)
+
+    tree = {"embed": leaf(vocab, d_model)}
+    for i in range(n_layers):
+        tree[f"layer_{i:02d}"] = {
+            "wq": leaf(d_model, d_model), "wk": leaf(d_model, d_model),
+            "wv": leaf(d_model, d_model), "wo": leaf(d_model, d_model),
+            "w1": leaf(d_model, 4 * d_model), "w2": leaf(4 * d_model, d_model),
+            "ln1": leaf(d_model), "ln2": leaf(d_model),
+        }
+    return tree
+
+
+def run(n_layers=64, d_model=32, vocab=4096, rounds=5, iters=3,
+        bucket_mb=2.0, wire_dtype=""):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from chainermn_tpu.ops import fused_allreduce
+    from chainermn_tpu.utils.comm_model import (
+        assert_fused_collectives, choose_bucket_bytes, collective_stats,
+        fused_collective_budget)
+
+    devices = jax.devices()
+    n = len(devices)
+    axis = "world"
+    mesh = Mesh(np.asarray(devices), (axis,))
+    rng = np.random.RandomState(0)
+    tree = make_grad_tree(rng, n, n_layers, d_model, vocab, np.float32)
+    leaves = jax.tree.leaves(tree)
+    n_leaves = len(leaves)
+    total_bytes = sum(l[0].size * l[0].dtype.itemsize for l in leaves)
+    wire = {"": None, "bf16": jnp.bfloat16,
+            "bfloat16": jnp.bfloat16}[wire_dtype]
+    # default 2 MiB: the bucket sweep winner on this harness (the CPU
+    # backend's collective cost turns superlinear past ~4 MiB);
+    # --bucket-mb 0 asks the latency-bandwidth model instead, fed this
+    # harness's measured constants (~0.2 ms dispatch, ~2.5 GB/s)
+    bucket = int(bucket_mb * 1024 * 1024) if bucket_mb else \
+        choose_bucket_bytes(total_bytes, n, latency_s=2e-4,
+                            bandwidth_bytes_per_s=2.5e9)
+
+    def stackmap(body):
+        def outer(g):
+            red = body(jax.tree.map(lambda a: a[0], g))
+            return jax.tree.map(lambda a: a[None], red)
+        return jax.jit(jax.shard_map(
+            outer, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+
+    arms = {
+        "per_leaf": stackmap(lambda g: jax.tree.map(
+            lambda a: jax.lax.pmean(a, axis), g)),
+        "fused": stackmap(lambda g: fused_allreduce(
+            g, axis, bucket_bytes=bucket, wire_dtype=wire)),
+    }
+    # hierarchical arm: factor the world 2 x (n/2) — the multi-host
+    # shape (inter = hosts) faked on one host, same as tests/conftest
+    hier_mesh = None
+    if n % 2 == 0 and n >= 4:
+        hier_mesh = Mesh(np.asarray(devices).reshape(2, n // 2),
+                         ("inter", axis))
+
+        def hier_outer(g):
+            red = fused_allreduce(
+                jax.tree.map(lambda a: a[0], g), axis,
+                bucket_bytes=bucket, wire_dtype=wire,
+                inter_axis_name="inter")
+            return jax.tree.map(lambda a: a[None], red)
+
+        arms["hierarchical"] = jax.jit(jax.shard_map(
+            hier_outer, mesh=hier_mesh,
+            in_specs=P(("inter", axis)), out_specs=P(("inter", axis))))
+
+    counts = {}
+    for name, fn in arms.items():
+        out = fn(tree)                       # compile + correctness probe
+        got = np.asarray(jax.tree.leaves(out)[0])[0]
+        want = np.asarray(leaves[0]).mean(0)
+        tol = 3e-2 if wire is not None else 1e-5
+        np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+        stats = collective_stats(fn.lower(tree).compile())
+        kinds = ("all-reduce", "all-gather", "reduce-scatter")
+        counts[name] = sum(s.count for k, s in stats.items() if k in kinds)
+        if name == "fused":
+            assert_fused_collectives(stats, total_bytes, bucket)
+
+    # interleaved rounds, best round per arm (noise-robust on 2 cores)
+    times = {name: float("inf") for name in arms}
+    for _ in range(rounds):
+        for name, fn in arms.items():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(tree)
+            jax.block_until_ready(out)
+            times[name] = min(times[name],
+                              (time.perf_counter() - t0) / iters * 1e3)
+
+    speedup = times["per_leaf"] / times["fused"]
+    rec = {
+        "metric": METRIC,
+        "value": round(speedup, 3),
+        "unit": UNIT,
+        "vs_baseline": round(speedup, 3),
+        "per_leaf_ms": round(times["per_leaf"], 3),
+        "fused_ms": round(times["fused"], 3),
+        "n_devices": n,
+        "n_leaves": n_leaves,
+        "total_mb": round(total_bytes / 2**20, 2),
+        "bucket_bytes": bucket,
+        "collectives_per_leaf": counts["per_leaf"],
+        "collectives_fused": counts["fused"],
+        "collective_budget": fused_collective_budget(total_bytes, bucket),
+        "wire_dtype": wire_dtype or "fp32",
+        "device_kind": devices[0].device_kind,
+    }
+    if "hierarchical" in times:
+        rec["hierarchical_ms"] = round(times["hierarchical"], 3)
+        rec["speedup_hierarchical"] = round(
+            times["per_leaf"] / times["hierarchical"], 3)
+        rec["collectives_hierarchical"] = counts["hierarchical"]
+    return rec
+
+
+def _child_main(args):
+    if args.platform == "cpu":
+        # fake the multi-chip world BEFORE backend init (same trick as
+        # tests/conftest.py) so the collectives are real, not size-1
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={args.devices}").strip()
+    pin_platform(args.platform)
+    result = run(n_layers=args.n_layers, d_model=args.d_model,
+                 vocab=args.vocab, rounds=args.rounds, iters=args.iters,
+                 bucket_mb=args.bucket_mb, wire_dtype=args.wire_dtype)
+    print("BENCH_RESULT " + json.dumps(result))
+
+
+def _parent_main(args):
+    here = os.path.abspath(__file__)
+    cmd = [sys.executable, here, "--child",
+           "--n-layers", str(args.n_layers),
+           "--d-model", str(args.d_model), "--vocab", str(args.vocab),
+           "--rounds", str(args.rounds), "--iters", str(args.iters),
+           "--devices", str(args.devices),
+           "--bucket-mb", str(args.bucket_mb)]
+    if args.wire_dtype:
+        cmd += ["--wire-dtype", args.wire_dtype]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    return run_child_with_retries(
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
+        use_cache=args.platform is None,
+        cache_match={"n_leaves_config": f"{args.n_layers}x{args.d_model}",
+                     "wire_dtype": args.wire_dtype or "fp32"})
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--child", action="store_true")
+    p.add_argument("--n-layers", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=32)
+    p.add_argument("--vocab", type=int, default=4096)
+    p.add_argument("--rounds", type=int, default=5,
+                   help="interleaved timing rounds (best round counts)")
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual device count for --platform cpu")
+    p.add_argument("--bucket-mb", type=float, default=2.0,
+                   help="bucket size in MiB (0 = choose_bucket_bytes "
+                        "from the latency-bandwidth model, fed this "
+                        "harness's measured dispatch/bandwidth)")
+    p.add_argument("--wire-dtype", default="",
+                   choices=["", "bf16", "bfloat16"],
+                   help="compressed wire dtype for the fused arms")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--timeouts", type=int, nargs="+", default=[480])
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    args = _parse_args(sys.argv[1:])
+    if args.child:
+        _child_main(args)
+    else:
+        sys.exit(_parent_main(args))

@@ -9,6 +9,7 @@ single-controller SPMD model: collectives lower to XLA ops over the ICI/DCN
 mesh instead of MPI/NCCL calls.
 """
 
+from chainermn_tpu.parallel import _compat  # noqa: F401  (jax shims first)
 from chainermn_tpu import (extensions, links, models, ops,
                            parallel, testing, utils)
 from chainermn_tpu.extensions import (

@@ -185,17 +185,28 @@ class CommunicatorBase(abc.ABC):
         holds identical values (ChainerMN's first-``update()`` weight sync)."""
 
     @abc.abstractmethod
-    def multi_node_mean_grad(self, grads, dtype=None):
+    def multi_node_mean_grad(self, grads, dtype=None, fused: bool = True,
+                             bucket_bytes=None):
         """Mean a world-stacked pytree of gradients across ranks.
 
         ``dtype`` mirrors ``allreduce_grad_dtype``: cast before the reduce
         (e.g. ``jnp.bfloat16``) and back after — the TPU analogue of
         ChainerMN's fp16 allreduce.
+
+        ``fused`` (default) packs the whole pytree into flat
+        dtype-grouped buckets of ``bucket_bytes`` and issues one
+        collective per bucket (:func:`chainermn_tpu.ops.fused_allreduce`)
+        instead of one per leaf; backends whose world spans multiple
+        hosts (``inter_size > 1``) additionally lower each bucket
+        hierarchically (reduce-scatter intra → all-reduce inter →
+        all-gather intra).  ``fused=False`` keeps the per-leaf path.
         """
 
     # alias, ChainerMN kept both names
-    def allreduce_grad(self, grads, dtype=None):
-        return self.multi_node_mean_grad(grads, dtype)
+    def allreduce_grad(self, grads, dtype=None, fused: bool = True,
+                       bucket_bytes=None):
+        return self.multi_node_mean_grad(grads, dtype, fused=fused,
+                                         bucket_bytes=bucket_bytes)
 
     # ------------------------------------------------------------------ #
     # conveniences

@@ -536,11 +536,23 @@ class TpuXlaCommunicator(CommunicatorBase):
             lambda a: jnp.copy(jax.device_put(jnp.asarray(a), repl)),
                             params)
 
-    def multi_node_mean_grad(self, grads, dtype=None):
+    def multi_node_mean_grad(self, grads, dtype=None, fused=True,
+                             bucket_bytes=None):
         """Mean world-stacked grads across ranks (eager path, for tests and
         host-driven loops).  The hot path is :func:`chainermn_tpu.ops.pmean`
-        inside the jitted train step — see optimizers.py."""
+        inside the jitted train step — see optimizers.py.
+
+        ``fused`` (default) compiles ONE program for the whole pytree
+        that reduces dtype-grouped flat buckets — ceil(bytes/bucket)
+        collectives instead of one per leaf — and, when this world spans
+        multiple hosts with equal per-host device counts, lowers each
+        bucket hierarchically over an (inter, intra) factorisation of
+        the mesh so the cross-host stage moves 1/intra_size of the
+        bytes.  ``fused=False`` keeps the historical per-leaf path.
+        """
         dtype = dtype or self._grad_dtype
+        if fused:
+            return self._fused_mean(grads, dtype, bucket_bytes)
         mean = self._jitted("mean")
 
         def one(g):
@@ -550,6 +562,57 @@ class TpuXlaCommunicator(CommunicatorBase):
             return mean(g)
 
         return jax.tree.map(one, grads)
+
+    def _hier_factors(self):
+        """(inter_axis_row_major device grid, intra size) when this
+        world spans >1 host with equal per-host device counts — the
+        layout the 2-stage bucket lowering reduces over; ``None`` when
+        the world is flat (single host, or ragged ownership)."""
+        by_proc: dict = {}
+        for d in self._devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        if len(by_proc) < 2:
+            return None
+        counts = {len(v) for v in by_proc.values()}
+        if len(counts) != 1:
+            return None
+        rows = [by_proc[p] for p in sorted(by_proc)]
+        return rows, counts.pop()
+
+    def _fused_mean(self, grads, dtype, bucket_bytes):
+        """One jitted shard_map over the whole grad pytree: fused
+        bucketed mean, hierarchical when the world factors over hosts."""
+        from chainermn_tpu.ops import fused as _fused
+
+        bucket = bucket_bytes or _fused.DEFAULT_BUCKET_BYTES
+        stacked = jax.tree.map(self._stacked, grads)
+        leaves, treedef = jax.tree.flatten(stacked)
+        key = ("fused_mean", str(dtype), bucket, treedef,
+               tuple((l.shape, str(l.dtype)) for l in leaves))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            ax = self._axis
+            hier = self._hier_factors()
+            if hier is not None:
+                rows, intra = hier
+                inter_ax = ax + "_inter"
+                mesh = Mesh(np.asarray(rows, dtype=object), (inter_ax, ax))
+                spec = P((inter_ax, ax))
+                inter_kw = dict(inter_axis_name=inter_ax)
+            else:
+                mesh, spec, inter_kw = self._mesh, P(ax), {}
+
+            def body(g):
+                local = jax.tree.map(lambda a: a[0], g)
+                red = _fused.fused_allreduce(
+                    local, ax, op="mean", bucket_bytes=bucket,
+                    wire_dtype=dtype, **inter_kw)
+                return jax.tree.map(lambda a: a[None], red)
+
+            fn = jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=spec, out_specs=spec))
+            self._jit_cache[key] = fn
+        return fn(stacked)
 
 
 def _tree_reduce(objs, op: str):

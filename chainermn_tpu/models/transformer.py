@@ -52,6 +52,7 @@ from chainermn_tpu.parallel.ring_attention import (
     ring_attention,
 )
 from chainermn_tpu.parallel._compat import (
+    HAS_VMA as _HAS_VMA,
     all_gather_invariant as _all_gather_invariant,
 )
 from chainermn_tpu.parallel.tensor import (
@@ -69,24 +70,6 @@ __all__ = [
     "make_forward_fn",
     "make_train_step",
 ]
-
-
-def _probe_vma_support() -> bool:
-    """Whether this jax exposes shard_map varying-axes (vma) typing.
-
-    ``_lm_head``'s custom VJP needs ``jax.typeof(...).vma`` to place the
-    embed-gradient psum; probing an abstract aval (never a concrete
-    array — that would trigger backend init at import time, which hangs
-    on this container's tunnelled TPU) lets the requirement surface at
-    config construction instead of deep inside the first backward.
-    """
-    try:
-        return hasattr(jax.core.ShapedArray((), jnp.float32), "vma")
-    except Exception:  # pragma: no cover - exotic jax internals change
-        return False
-
-
-_HAS_VMA = _probe_vma_support()
 
 
 @dataclass(frozen=True)

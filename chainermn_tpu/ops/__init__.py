@@ -7,6 +7,14 @@ reversed-direction backward passes the reference wrote by hand.
 """
 
 from .pallas_attention import flash_attention, flash_attention_supported
+from .fused import (
+    DEFAULT_BUCKET_BYTES,
+    flatten_buckets,
+    fused_allreduce,
+    fused_pmean,
+    hierarchical_allreduce,
+    unflatten_buckets,
+)
 from .collectives import (
     allgather,
     allreduce,
@@ -30,6 +38,8 @@ from .point_to_point import (
 
 __all__ = [
     "flash_attention", "flash_attention_supported",
+    "DEFAULT_BUCKET_BYTES", "flatten_buckets", "fused_allreduce",
+    "fused_pmean", "hierarchical_allreduce", "unflatten_buckets",
     "allgather", "allreduce", "alltoall", "bcast", "gather", "pmean",
     "psum", "reduce_scatter", "scatter",
     "ppermute", "pseudo_connect", "recv", "send", "send_recv",

@@ -96,23 +96,31 @@ def bcast(x, axis_name: str, root: int = 0):
 
 
 def gather(x, axis_name: str, root: int = 0, axis: int = 0):
-    """Gather every rank's ``x`` to ``root``.
+    """Gather every rank's ``x`` to ``root``; non-root ranks get zeros.
 
-    SPMD note: all ranks compute the gathered value (XLA all_gather); the
-    result is only *meaningful* at root if callers discard it elsewhere.
-    Backward matches the reference's ``Gather.backward`` (scatter of grads
-    from root).
+    SPMD note: every rank runs the same all_gather (there is no "do
+    nothing elsewhere" in one program), but the documented contract —
+    only root receives the data — is honoured by masking the result to
+    zeros off-root, so code that (wrongly) reads a non-root result gets
+    a loud all-zeros instead of silently using an allgather.  Want the
+    value everywhere?  That is :func:`allgather`.  The masking also
+    makes the backward exact ``Gather.backward`` semantics: grads flow
+    from *root's* output only (scatter of root's grads), other ranks'
+    output cotangents are discarded by the mask's transpose.
     """
-    del root
-    return lax.all_gather(x, axis_name, axis=axis, tiled=False)
+    full = lax.all_gather(x, axis_name, axis=axis, tiled=False)
+    idx = lax.axis_index(axis_name)
+    return jnp.where(idx == root, full, jnp.zeros_like(full))
 
 
 def scatter(x, axis_name: str, root: int = 0, axis: int = 0):
     """Rank ``i`` returns slice ``i`` (along ``axis``) of root's ``x``.
 
-    ``x`` must carry a leading world-sized dimension on every rank (only
-    root's is read).  Backward: root receives the allgather of output
-    grads — the reference's ``Scatter.backward``.
+    ``x`` must carry a world-sized dimension at ``axis`` on every rank
+    (only root's is read — the mirror of :func:`gather`'s root-only
+    output, e.g. ``scatter(gather(x, ax, root=r), ax, root=r) == x``).
+    Backward: root receives the gather of output grads — the
+    reference's ``Scatter.backward``.
     """
     full = bcast(x, axis_name, root=root)
     idx = lax.axis_index(axis_name)

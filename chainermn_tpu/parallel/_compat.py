@@ -1,13 +1,168 @@
 """Version-dependent jax imports, kept in ONE place.
 
-``all_gather_invariant`` is the shard_map primitive that gathers a
-varying value into an identical (vma-invariant) full array on every
-axis member — public from jax 0.9.x-nightlies on, private before.
+The package is written against the vma-typed ``shard_map`` era of jax
+(``jax.shard_map``, ``jax.lax.pcast``, ``jax.typeof(...).vma``,
+``lax.all_gather_invariant``).  Older jaxes (0.4.x) spell these
+differently or lack them entirely, so every version-sensitive symbol is
+resolved here once and — because ~70 call sites across the package and
+its tests use the modern ``jax.*`` spellings directly — the resolved
+fallbacks are also *installed* onto the ``jax``/``jax.lax`` namespaces
+when missing.  The install is idempotent, only ever fills absent
+attributes (a jax that already has the symbol is never touched), and
+runs at package import (``chainermn_tpu/__init__`` imports this module
+first).
+
+Fallback semantics on pre-vma jax:
+
+- ``shard_map``: ``jax.experimental.shard_map.shard_map`` — same
+  primitive, pre-promotion import path.
+- ``all_gather_invariant``: shimmed as a one-hot placement + ``psum``
+  (each member contributes its block at its own offset of a zero
+  buffer, the sum assembles the gather).  Values match
+  ``lax.all_gather``, but pre-vma ``check_rep`` types standard
+  collectives varying→varying while reductions type varying→replicated
+  — only the psum spelling lets the gathered result satisfy a
+  replicated ``out_specs`` (``P()``), which is the whole point of the
+  invariant gather.
+- ``axis_size``: ``lax.psum(1, axis_name)`` — a *static* int under
+  tracing (psum of a concrete python scalar folds to the axis size).
+- ``pcast``: identity.  Pre-vma shard_map has no varying-axes types, so
+  "retype as varying" has nothing to do; the old ``check_rep`` machinery
+  inserts its own pbroadcasts where the data flow needs them.
+- ``typeof``: the abstract value with an empty ``vma`` set (pre-vma,
+  nothing is ever vma-typed).  Guarded callers that *require* real vma
+  typing still take their older-jax branch because the set is empty.
 """
+
+import jax as _jax
+from jax import lax as _lax
+
+# -- shard_map ---------------------------------------------------------- #
+
+try:  # public from jax 0.6.x on
+    from jax import shard_map
+except ImportError:  # pragma: no cover - version-dependent import path
+    from jax.experimental.shard_map import shard_map
+
+# -- all_gather_invariant ----------------------------------------------- #
+#
+# The shard_map primitive that gathers a varying value into an identical
+# (vma-invariant) full array on every axis member — public from jax
+# 0.9.x-nightlies on, private before, absent pre-vma.
 
 try:  # public from jax 0.9.x-nightlies on; same primitive either way
     from jax.lax import all_gather_invariant
 except ImportError:  # pragma: no cover - version-dependent import path
-    from jax._src.lax.parallel import all_gather_invariant
+    try:
+        from jax._src.lax.parallel import all_gather_invariant
+    except ImportError:
+        import jax.numpy as _jnp
 
-__all__ = ["all_gather_invariant"]
+        def all_gather_invariant(x, axis_name, *, axis=0, tiled=False):
+            """Pre-vma fallback: gather spelled as one-hot placement +
+            ``psum``.  Old ``check_rep`` types ``all_gather`` output as
+            still-varying, but types reductions replicated over their
+            axes — so this spelling (values identical to
+            ``lax.all_gather``) is what makes the result legal under a
+            replicated ``out_specs``, i.e. actually invariant."""
+            idx = _lax.axis_index(axis_name)
+            n = axis_size(axis_name)
+            if tiled:
+                block = x.shape[axis]
+                shape = list(x.shape)
+                shape[axis] = n * block
+                placed = _lax.dynamic_update_slice_in_dim(
+                    _jnp.zeros(shape, x.dtype), x, idx * block, axis)
+            else:
+                xs = _jnp.expand_dims(x, axis)
+                shape = list(xs.shape)
+                shape[axis] = n
+                placed = _lax.dynamic_update_slice_in_dim(
+                    _jnp.zeros(shape, x.dtype), xs, idx, axis)
+            return _lax.psum(placed, axis_name)
+
+# -- axis_size ---------------------------------------------------------- #
+
+if hasattr(_lax, "axis_size"):
+    axis_size = _lax.axis_size
+else:  # pragma: no cover - version-dependent
+    def axis_size(axis_name):
+        """``lax.psum`` of a concrete scalar folds statically to the
+        bound axis size (also the product over a tuple of names)."""
+        return _lax.psum(1, axis_name)
+
+# -- pcast -------------------------------------------------------------- #
+
+if hasattr(_lax, "pcast"):
+    pcast = _lax.pcast
+else:  # pragma: no cover - version-dependent
+    def pcast(x, axis_name, *, to):
+        """Pre-vma fallback: no varying-axes types exist, so retyping is
+        the identity (old check_rep inserts pbroadcasts itself)."""
+        del axis_name, to
+        return x
+
+# -- typeof ------------------------------------------------------------- #
+
+if hasattr(_jax, "typeof"):
+    typeof = _jax.typeof
+else:  # pragma: no cover - version-dependent
+    class _PreVmaAval:
+        """Aval view whose ``vma`` is always empty (pre-vma jax)."""
+
+        __slots__ = ("_aval",)
+        vma = frozenset()
+
+        def __init__(self, aval):
+            self._aval = aval
+
+        def __getattr__(self, name):
+            return getattr(self._aval, name)
+
+    def typeof(x):
+        import jax.core
+
+        return _PreVmaAval(jax.core.get_aval(x))
+
+# -- HAS_VMA ------------------------------------------------------------ #
+#
+# Whether shard_map varying-axes typing exists at all.  Code whose
+# SEMANTICS (not just spelling) need vma — custom VJPs that read
+# ``typeof(x).vma`` to place psums, grads of replicated outputs inside
+# shard_map (pre-vma AD over-counts them by the axis size), replicated
+# ``out_specs`` inference through gathers, scan carries that gain
+# replication — must gate on this and refuse or skip on older jax.
+# Probed on an abstract aval, never a concrete array (backend init at
+# import time hangs on tunnelled-TPU containers).
+
+def _probe_vma() -> bool:
+    try:
+        import jax.numpy as _jnp_probe
+
+        return hasattr(_jax.core.ShapedArray((), _jnp_probe.float32), "vma")
+    except Exception:  # pragma: no cover - exotic jax internals change
+        return False
+
+
+HAS_VMA = _probe_vma()
+
+# -- namespace install (older jax only; never overwrites) --------------- #
+
+for _mod, _name, _val in (
+    (_jax, "shard_map", shard_map),
+    (_jax, "typeof", typeof),
+    (_lax, "axis_size", axis_size),
+    (_lax, "pcast", pcast),
+):
+    if not hasattr(_mod, _name):  # pragma: no cover - version-dependent
+        setattr(_mod, _name, _val)
+del _mod, _name, _val
+
+__all__ = [
+    "HAS_VMA",
+    "all_gather_invariant",
+    "axis_size",
+    "pcast",
+    "shard_map",
+    "typeof",
+]

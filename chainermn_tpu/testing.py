@@ -20,7 +20,8 @@ import subprocess
 import sys
 from typing import Optional, Sequence
 
-__all__ = ["ensure_virtual_pod", "run_multiprocess", "free_port"]
+__all__ = ["ensure_virtual_pod", "run_multiprocess", "free_port",
+           "requires_vma"]
 
 
 def ensure_virtual_pod(n_devices: int = 8) -> None:
@@ -140,3 +141,20 @@ def run_multiprocess(
                 f"--- worker {i} rc={codes[i]} ---\n{outputs[i]}"
                 for i in range(nprocs)))
     return outputs
+
+
+def requires_vma(reason: str = "requires vma-typed shard_map"):
+    """``pytest.mark.skipif`` for tests whose SEMANTICS need vma-typed
+    shard_map (``parallel._compat.HAS_VMA`` documents which those are:
+    custom VJPs reading ``typeof(x).vma``, grads of replicated outputs,
+    rep-gaining scan carries, ...).  One definition instead of a
+    copy-pasted skipif block per test file; lazy pytest import so the
+    package itself never depends on pytest.  Use as::
+
+        pytestmark = cmn.testing.requires_vma()
+    """
+    import pytest
+
+    from chainermn_tpu.parallel._compat import HAS_VMA
+
+    return pytest.mark.skipif(not HAS_VMA, reason=reason)

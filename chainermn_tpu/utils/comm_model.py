@@ -34,7 +34,18 @@ __all__ = [
     "stablehlo_collective_stats",
     "wire_bytes_per_device",
     "axis_collective_report",
+    "choose_bucket_bytes",
+    "fused_collective_budget",
+    "assert_fused_collectives",
 ]
+
+# Interconnect defaults for choose_bucket_bytes: per-collective launch
+# latency and per-device ring bandwidth.  ICI-flavoured (TPU v4/v5
+# publish ~100 GB/s per link; a few microseconds to get a collective
+# onto the wire) — pass measured values for other fabrics (DCN: ~25 us,
+# ~12.5 GB/s per NIC).
+_DEFAULT_LATENCY_S = 2e-6
+_DEFAULT_BANDWIDTH = 90e9
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "f8e4m3": 1, "f8e5m2": 1,
@@ -239,6 +250,75 @@ def stablehlo_collective_stats(lowered_text: str) \
             st.group_size = gsize if st.group_size in (None, gsize) \
                 else -1
     return out
+
+
+def choose_bucket_bytes(
+    total_bytes: float,
+    axis_size: int,
+    latency_s: float = _DEFAULT_LATENCY_S,
+    bandwidth_bytes_per_s: float = _DEFAULT_BANDWIDTH,
+    min_bucket: int = 256 * 1024,
+) -> int:
+    """Principled fused-allreduce bucket size from the latency-bandwidth
+    model — the ``allreduce_grad_dtype``-era tuning knob made analytic.
+
+    With ``k = ceil(G/b)`` buckets over ``G`` total gradient bytes, the
+    exposed cost the bucket size controls is
+
+        ``T(b) = (G/b) * alpha  +  2 b (n-1)/(n * beta)``
+
+    — every bucket pays launch latency ``alpha``, while only the *last*
+    bucket's ring time ``2b(n-1)/(n*beta)`` is exposed once buckets
+    pipeline against compute/each other (one big bucket maximally delays
+    the first byte; per-leaf buckets pay latency hundreds of times —
+    exactly the regime this subsystem replaces).  Minimising T gives
+
+        ``b* = sqrt( G * alpha * n * beta / (2 (n-1)) )``
+
+    clamped to ``[min_bucket, G]``.  Defaults model ICI; pass measured
+    ``latency_s``/``bandwidth_bytes_per_s`` for other interconnects.
+    """
+    if total_bytes <= 0:
+        return min_bucket
+    if axis_size <= 1:
+        return max(min_bucket, int(total_bytes))
+    frac = 2.0 * (axis_size - 1) / axis_size
+    b_star = (total_bytes * latency_s * bandwidth_bytes_per_s / frac) ** 0.5
+    return int(min(max(b_star, min_bucket), total_bytes))
+
+
+def fused_collective_budget(total_bytes: int, bucket_bytes: int,
+                            n_dtype_groups: int = 1) -> int:
+    """Upper bound on collectives the fused lowering may emit for
+    ``total_bytes`` of gradients in ``n_dtype_groups`` dtype groups:
+    each group independently emits ``ceil(group_bytes/bucket)``, and
+    splitting ``total_bytes`` over ``g`` groups adds at most ``g - 1``
+    ragged buckets over the single-group ``ceil(total/bucket)``."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes {bucket_bytes} must be positive")
+    return -(-int(total_bytes) // int(bucket_bytes)) \
+        + max(0, n_dtype_groups - 1)
+
+
+def assert_fused_collectives(stats: Dict[str, "CollectiveStats"],
+                             total_bytes: int, bucket_bytes: int,
+                             n_dtype_groups: int = 1,
+                             kinds=("all-reduce",)) -> int:
+    """Assert a compiled program's collective stats respect the fused
+    budget: across ``kinds``, at most
+    :func:`fused_collective_budget` call sites (the per-leaf baseline
+    emits one per leaf — hundreds for a transformer grad tree).
+    Returns the observed count."""
+    budget = fused_collective_budget(total_bytes, bucket_bytes,
+                                     n_dtype_groups)
+    count = sum(stats[k].count for k in kinds if k in stats)
+    if count > budget:
+        raise AssertionError(
+            f"fused lowering emitted {count} {'+'.join(kinds)} "
+            f"collectives, budget is {budget} "
+            f"(= ceil({total_bytes}/{bucket_bytes}) + "
+            f"{max(0, n_dtype_groups - 1)} ragged group buckets)")
+    return count
 
 
 def axis_collective_report(build_step, axes_sizes, n_devices=8):

@@ -186,9 +186,12 @@ def trace(logdir: str, *, host_tracer_level: int = 2):
         with profiling.trace("/tmp/trace"):
             train_some_steps()
     """
-    opts = jax.profiler.ProfileOptions()
-    opts.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(logdir, profiler_options=opts)
+    if hasattr(jax.profiler, "ProfileOptions"):
+        opts = jax.profiler.ProfileOptions()
+        opts.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=opts)
+    else:  # older jax: no per-trace options; default tracer levels
+        jax.profiler.start_trace(logdir)
     try:
         yield
     finally:

@@ -82,6 +82,33 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(out)[:, :, 0],
                                    x[:, :, 0].T)
 
+    def test_gather_masks_to_root(self, mesh):
+        """gather honours ``root``: only root receives the gathered
+        stack; every other rank gets loud zeros, not a silent
+        allgather."""
+        n = mesh.devices.size
+        x = world(mesh, shape=(3,))
+        for root in (0, n - 1):
+            out = smap(mesh,
+                       lambda s, r=root: ops.gather(s, AX, root=r)[None])(x)
+            got = np.asarray(out)  # (rank, gathered_rank, 1, 3)
+            np.testing.assert_allclose(got[root][:, 0], x, rtol=1e-6)
+            mask = np.ones(n, bool); mask[root] = False
+            np.testing.assert_allclose(got[mask], 0.0)
+
+    def test_scatter_of_gather_roundtrips(self, mesh):
+        """The documented inverse pair: scatter(gather(x)) == x even
+        though non-root gather outputs are masked (scatter only reads
+        root's buffer)."""
+        x = world(mesh, shape=(2,), seed=9)
+
+        def inner(s):
+            g = ops.gather(s, AX, root=1)
+            return ops.scatter(g, AX, root=1)
+
+        out = smap(mesh, inner)(x)
+        np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+
     def test_scatter(self, mesh):
         n = mesh.devices.size
         x = np.zeros((n, n, 2), np.float32)
@@ -134,6 +161,25 @@ class TestBackward:
         np.testing.assert_allclose(g[root], expect_root, rtol=1e-5)
         mask = np.ones(n, bool); mask[root] = False
         np.testing.assert_allclose(g[mask], 0.0)
+
+    def test_gather_grad_flows_from_root_only(self, mesh):
+        """Gather.backward semantics: only root's output cotangent
+        reaches the inputs (the mask's transpose discards the rest) —
+        every rank's input grad is root's weight, nothing else."""
+        n = mesh.devices.size
+        x = world(mesh, shape=(1,))
+        root = 2
+
+        def loss(xs):
+            def inner(s):
+                y = ops.gather(s, AX, root=root)  # (n, 1), zeros off-root
+                w = (jax.lax.axis_index(AX) + 1.0).astype(y.dtype)
+                return jnp.sum(y * w)[None]
+            return smap(mesh, inner)(xs).sum()
+
+        g = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+        # loss = w_root * sum_i x_i, so d/dx_i = w_root for every i
+        np.testing.assert_allclose(g, root + 1.0, rtol=1e-5)
 
     def test_allgather_grad_is_reduce_scatter(self, mesh):
         n = mesh.devices.size

@@ -154,7 +154,11 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
     ("bench_levers.py",
      ["--batch", "4", "--image", "32", "--warmup", "0",
       "--iters", "1"], "x"),
-], ids=["transformer", "decode", "attention", "seq2seq", "levers"])
+    ("bench_fused_allreduce.py",
+     ["--n-layers", "4", "--d-model", "16", "--vocab", "256",
+      "--rounds", "1", "--iters", "1"], "x"),
+], ids=["transformer", "decode", "attention", "seq2seq", "levers",
+        "fused_allreduce"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

@@ -1,0 +1,36 @@
+"""Collection gate: the whole test tree must COLLECT cleanly.
+
+The failure mode this pins: an import-time error in one shared module
+(``parallel/_compat.py``'s ``all_gather_invariant`` import, which had no
+fallback for the installed jax) silently took 35 of 158 test files out
+of the suite *at collection* — the run stayed green-looking while a
+fifth of the coverage never executed.  ``--continue-on-collection-errors``
+in the tier-1 command keeps the run alive but hides the rot; this gate
+makes any collection error a test failure in its own right.
+
+Kept fast (a couple of seconds): collection imports modules but runs
+nothing.
+"""
+
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def test_whole_suite_collects_without_errors():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_ROOT + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "--collect-only",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, timeout=240, cwd=_ROOT, env=env)
+    tail = (proc.stdout + proc.stderr)[-4000:]
+    assert proc.returncode == 0, f"collection failed:\n{tail}"
+    assert "error" not in proc.stdout.lower().splitlines()[-1], tail
+    # belt and braces: pytest prints "N errors" in the summary line when
+    # --continue-on-collection-errors style runs hit import rot
+    assert "errors" not in proc.stdout.splitlines()[-1], tail

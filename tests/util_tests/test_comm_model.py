@@ -19,6 +19,14 @@ from chainermn_tpu.utils import (
     wire_bytes_per_device,
 )
 
+from chainermn_tpu.testing import requires_vma as _requires_vma
+
+# These two compile real model steps (bench.py's ResNet DP step, the
+# flagship decode program); both need vma-typed shard_map — pre-vma
+# check_rep can't infer their replicated out_specs / the transformer
+# refuses to construct.
+requires_vma = _requires_vma("compiled step requires vma-typed shard_map")
+
 
 def _compile(fn, mesh, in_specs, out_specs, *args):
     return jax.jit(jax.shard_map(
@@ -133,6 +141,7 @@ def test_wire_formulas():
         wire_bytes_per_device("broadcast", 1, 2)
 
 
+@requires_vma
 def test_bench_resnet_dp_step_single_reduce():
     """Regression pin for the SCALING.md finding: bench.py's DP step
     must all-reduce each gradient ONCE.  The pre-fix step pmean'd grads
@@ -208,6 +217,7 @@ def test_axis_report_attributes_dp_gradient_allreduce():
         2 * n_params * 4 * 7 / 8
 
 
+@requires_vma
 def test_decode_program_parses_per_token_slices():
     """The decode factories expose their jitted program (`._jitted`) and
     the parser recovers the per-token collective slices the SCALING.md
