@@ -32,7 +32,10 @@ from chainermn_tpu.datasets import (
     shuffle_data_blocks,
 )
 from chainermn_tpu.iterators import (
+    DeviceWindow,
+    PrefetchIterator,
     SerialIterator,
+    StagingConverter,
     create_multi_node_iterator,
     create_synchronized_iterator,
 )
@@ -55,11 +58,14 @@ __version__ = "0.1.0"
 __all__ = [
     "CommunicatorBase",
     "DataSizeError",
+    "DeviceWindow",
     "Evaluator",
     "LogReport",
     "LoopbackCommunicator",
+    "PrefetchIterator",
     "PrintReport",
     "SerialIterator",
+    "StagingConverter",
     "StandardUpdater",
     "TpuXlaCommunicator",
     "Trainer",

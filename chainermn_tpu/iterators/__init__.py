@@ -23,15 +23,50 @@ from typing import Optional
 
 import numpy as np
 
+from chainermn_tpu.iterators.prefetch import (
+    DeviceWindow,
+    PrefetchIterator,
+    StagingConverter,
+)
+
 __all__ = [
+    "DeviceWindow",
+    "PrefetchIterator",
     "SerialIterator",
+    "StagingConverter",
     "create_multi_node_iterator",
     "create_synchronized_iterator",
 ]
 
 
+def _array_columns(dataset):
+    """Fast-path detection: a numpy-array dataset (rows = examples), or
+    a TUPLE of numpy field arrays sharing their leading dim (a list of
+    arrays stays on the generic path — lists hold examples, tuples hold
+    columns, the same rule ``default_converter`` applies to batches).
+    Returns the column tuple or None (generic per-element path)."""
+    if isinstance(dataset, np.ndarray):
+        return (dataset,)
+    if isinstance(dataset, tuple) and dataset and all(
+            isinstance(a, np.ndarray) and a.ndim >= 1 for a in dataset):
+        n = len(dataset[0])
+        if all(len(a) == n for a in dataset):
+            return tuple(dataset)
+    return None
+
+
 class SerialIterator:
-    """Sequential batch iterator with epoch bookkeeping."""
+    """Sequential batch iterator with epoch bookkeeping.
+
+    Generic datasets (anything indexable) yield LISTS of examples, the
+    Chainer protocol.  Numpy-array datasets — one array (rows =
+    examples) or a tuple of field arrays sharing their leading dim —
+    take a fancy-indexing fast path: the batch is gathered with ONE
+    ``dataset[order[start:stop]]`` per field instead of a per-element
+    Python loop, and yielded already stacked (an ``np.ndarray``, or a
+    tuple of them) — ``default_converter`` passes such batches through
+    without re-stacking.
+    """
 
     def __init__(self, dataset, batch_size: int, repeat: bool = True,
                  shuffle: bool = False, seed: Optional[int] = None):
@@ -42,12 +77,23 @@ class SerialIterator:
         self._rng = np.random.RandomState(seed)
         self.reset()
 
+    @property
+    def dataset_length(self) -> int:
+        """Number of examples (≠ ``len(dataset)`` for tuple-of-field-
+        arrays datasets, where that counts fields)."""
+        return self._len
+
     def reset(self):
+        # re-derive from self.dataset: callers may swap the dataset
+        # attribute and reset() (the resize-on-resume pattern)
+        self._columns = _array_columns(self.dataset)
+        self._len = (len(self._columns[0]) if self._columns is not None
+                     else len(self.dataset))
         self.epoch = 0
         self.is_new_epoch = False
         self._pos = 0
         self._exhausted = False
-        self._order = np.arange(len(self.dataset))
+        self._order = np.arange(self._len)
         if self._shuffle:
             self._rng.shuffle(self._order)
 
@@ -57,7 +103,7 @@ class SerialIterator:
 
     @property
     def epoch_detail(self) -> float:
-        return self.epoch + self._pos / max(len(self.dataset), 1)
+        return self.epoch + self._pos / max(self._len, 1)
 
     def __iter__(self):
         return self
@@ -65,10 +111,17 @@ class SerialIterator:
     def __next__(self):
         if self._exhausted:
             raise StopIteration
-        n = len(self.dataset)
+        n = self._len
         start = self._pos
         stop = min(start + self.batch_size, n)
-        batch = [self.dataset[int(i)] for i in self._order[start:stop]]
+        if self._columns is not None:
+            idx = self._order[start:stop]
+            cols = tuple(a[idx] for a in self._columns)
+            batch = cols[0] if isinstance(self.dataset, np.ndarray) \
+                else cols
+        else:
+            batch = [self.dataset[int(i)]
+                     for i in self._order[start:stop]]
         self._pos = stop
         if self._pos >= n:
             # epoch completes WITH this batch (Chainer contract: ``epoch``

@@ -218,6 +218,14 @@ class NativeBatchIterator:
     def repeat(self) -> bool:
         return self._repeat
 
+    def owns_buffers(self, arrays) -> bool:
+        """True in native mode: returned batches are views into recycled
+        slots, so a consumer that defers the host→device copy (sharded
+        ``jax.device_put`` — see ``iterators.prefetch.put_window``) must
+        copy them first.  The numpy fallback returns fresh fancy-index
+        copies, which nobody rewrites."""
+        return self._handle is not None
+
     @property
     def epoch_detail(self) -> float:
         return self._popped / self._bpe

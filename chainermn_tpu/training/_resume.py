@@ -64,8 +64,13 @@ def restore_train_state(extra: Optional[dict], updater,
         saved = extra["iterator"]
         order = saved.get("order")
         ds = getattr(it, "dataset", None)
-        if (order is not None and ds is not None
-                and len(order) != len(ds)):
+        # example count, not len(dataset): for tuple-of-field-arrays
+        # fast-path datasets len() counts fields
+        n_examples = getattr(it, "dataset_length", None)
+        if n_examples is None and ds is not None:
+            n_examples = len(ds)
+        if order is not None and n_examples is not None \
+                and len(order) != n_examples:
             # resize-safe path (multi_node_snapshot at a different world
             # size): the saved shuffle order indexes the WRITER's dataset
             # shard — restoring it onto a differently-sized shard would

@@ -113,6 +113,12 @@ class Trainer:
                 fin = getattr(e.ext, "finalize", None)
                 if fin:
                     fin(self)
+            # release the updater's feed (joins a prefetching
+            # iterator's worker thread; restarts transparently if
+            # run() is called again)
+            up_fin = getattr(self.updater, "finalize", None)
+            if up_fin:
+                up_fin()
 
 
 class LogReport:

@@ -16,6 +16,7 @@ world-stacked and sharded over the axis; the ``multi-node optimizer``'s
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Callable, Optional
 
@@ -24,6 +25,16 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.iterators.prefetch import (
+    PrefetchIterator,
+    StagingConverter,
+    apply_batch_policy,
+    assemble_window,
+    default_converter,
+    put_window,
+)
+from chainermn_tpu.utils.profiling import get_profiler
 
 __all__ = ["StandardUpdater", "default_converter", "fuse_steps"]
 
@@ -69,17 +80,6 @@ def fuse_steps(step_fn, n_steps: int, *, scan_batches: bool = False,
     return fused
 
 
-def default_converter(batch):
-    """List of tuples → tuple of stacked arrays (Chainer's concat_examples)."""
-    if not batch:
-        raise ValueError("empty batch")
-    first = batch[0]
-    if isinstance(first, (tuple, list)):
-        cols = list(zip(*batch))
-        return tuple(np.stack([np.asarray(v) for v in col]) for col in cols)
-    return (np.stack([np.asarray(b) for b in batch]),)
-
-
 class StandardUpdater:
     """Drives ``iterator → converter → jitted sharded step``.
 
@@ -102,6 +102,34 @@ class StandardUpdater:
         stacks them, and runs the whole window on device, amortising
         per-dispatch latency.  ``iteration`` advances by the window
         size; ``main/loss`` reports the window mean.
+      prefetch: overlap host assembly with device compute — wrap the
+        iterator in a :class:`~chainermn_tpu.PrefetchIterator` of this
+        slot depth (``True`` → depth 2), whose background worker pulls,
+        converts, stacks AND ``device_put``s the next window while the
+        current one computes.  ``self.iterator`` becomes the prefetcher
+        (its ``state_dict`` drains in-flight slots, so checkpointing is
+        unchanged).  0/False (default) keeps the serial feed.  See
+        ``utils.comm_model.choose_prefetch_depth`` and
+        ``docs/PIPELINE.md``.
+      max_inflight: dispatched-but-unretired step-window cap.  Each
+        ``update()`` dispatches without blocking, then retires the
+        OLDEST outstanding window(s) until at most this many remain —
+        donation recycles the carry buffers, so memory stays bounded
+        while dispatch runs ahead of the device.  Defaults to 2 with
+        ``prefetch`` (one computing + one dispatched behind it), else 1
+        (each update waits for its predecessor — the natural async-
+        dispatch overlap, now measured instead of destroyed).
+
+    Timing observations (``utils.profiling`` names in parentheses):
+    ``main/host_time`` (``updater/host_time``) is iterator pull +
+    convert + stack + ``device_put`` — for a prefetched feed, the
+    residual wait for the next ready window; ``main/device_time``
+    (``updater/device_time``) is the exposed wait retiring windows past
+    ``max_inflight``, i.e. blocking on the PREVIOUS window's result so
+    steady-state timing stays overlapped; ``main/step_time`` is their
+    per-iteration sum (the old value timed only the async dispatch
+    call — it measured neither).
+
     ZeRO-1 optimizers (``create_multi_node_optimizer(..., zero1=True)``)
     are detected from the transformation's type: their state is
     initialised per-shard via ``zero1_init`` and carried WORLD-STACKED
@@ -120,8 +148,9 @@ class StandardUpdater:
         drop_remainder: bool = True,
         state=None,
         steps_per_execution: int = 1,
+        prefetch: int = 0,
+        max_inflight: Optional[int] = None,
     ):
-        self.iterator = iterator
         self.optimizer = optimizer
         self.comm = comm
         self.converter = converter
@@ -130,6 +159,56 @@ class StandardUpdater:
         if steps_per_execution < 1:
             raise ValueError("steps_per_execution must be >= 1")
         self.steps_per_execution = steps_per_execution
+
+        self.prefetch = 2 if prefetch is True else int(prefetch or 0)
+        if self.prefetch < 0:
+            raise ValueError("prefetch depth must be >= 0")
+        if isinstance(iterator, PrefetchIterator) and not self.prefetch:
+            # a pre-built prefetcher implies prefetch mode — adopting it
+            # beats the opaque crash of feeding DeviceWindows to the
+            # serial converter path
+            self.prefetch = iterator.depth
+        if isinstance(converter, StagingConverter) and \
+                converter._n_buffers < steps_per_execution + 1:
+            raise ValueError(
+                f"StagingConverter(n_buffers={converter._n_buffers}) "
+                f"cannot hold a steps_per_execution="
+                f"{steps_per_execution} window (needs >= "
+                f"steps_per_execution + 1 buffers)")
+        if max_inflight is None:
+            max_inflight = 2 if self.prefetch else 1
+        if max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        self.max_inflight = max_inflight
+        self._inflight: collections.deque = collections.deque()
+        if self.prefetch:
+            if isinstance(iterator, PrefetchIterator):
+                # a pre-built prefetcher must agree with this updater's
+                # window contract, or training silently runs a different
+                # schedule than the constructor arguments claim
+                if iterator._n_steps != steps_per_execution:
+                    raise ValueError(
+                        f"PrefetchIterator was built with steps_per_"
+                        f"execution={iterator._n_steps}, updater wants "
+                        f"{steps_per_execution}")
+                if iterator._drop_remainder != drop_remainder:
+                    raise ValueError(
+                        "PrefetchIterator and updater disagree on "
+                        "drop_remainder")
+                self.prefetch = iterator.depth
+                self.iterator = iterator
+            else:
+                self.iterator = PrefetchIterator(
+                    iterator, comm,
+                    # the default converter upgrades to a StagingConverter
+                    # sized for the ring; an explicit converter is kept
+                    converter=(None if converter is default_converter
+                               else converter),
+                    steps_per_execution=steps_per_execution,
+                    depth=self.prefetch,
+                    drop_remainder=drop_remainder)
+        else:
+            self.iterator = iterator
 
         # first-update weight broadcast of the reference, done at init
         self.params = comm.bcast_data(params)
@@ -147,6 +226,7 @@ class StandardUpdater:
         self.epoch_detail = 0.0
         self.previous_epoch_detail = 0.0
         self.observation = {}
+        self._last_retired = None
 
         self._step_cache = {}
         self._batch_sharding = NamedSharding(comm.mesh, P(comm.axis_name))
@@ -220,60 +300,51 @@ class StandardUpdater:
     def epoch(self) -> int:
         return getattr(self.iterator, "epoch", 0)
 
+    def finalize(self):
+        """Release the feed: joins a prefetching iterator's worker and
+        returns its unconsumed lookahead to the base iterator.  The
+        trainer calls this when ``run()`` exits; safe to call more than
+        once, and the feed restarts transparently if training resumes.
+        Only the updater-owned prefetch wrap is closed — a user-supplied
+        iterator's own ``close`` (a file handle, a stream) is not the
+        updater's to call."""
+        if isinstance(self.iterator, PrefetchIterator):
+            self.iterator.close()
+
     def _next_arrays(self):
         """Pull one batch, convert, apply the divisibility policy."""
-        batch = next(self.iterator)
-        arrays = self.converter(batch)
-        n = self.comm.size
-        if arrays[0].shape[0] % n:
-            if not self.drop_remainder:
-                raise ValueError(
-                    f"global batch {arrays[0].shape[0]} not divisible by "
-                    f"world size {n}")
-            keep = (arrays[0].shape[0] // n) * n
-            if keep == 0:
-                raise ValueError(
-                    f"batch of {arrays[0].shape[0]} examples cannot be "
-                    f"sharded over {n} devices — raise batch_size to at "
-                    f"least the world size")
-            arrays = tuple(a[:keep] for a in arrays)
-        return arrays
+        arrays = self.converter(next(self.iterator))
+        return apply_batch_policy(arrays, self.comm.size,
+                                  self.drop_remainder)
+
+    def _assemble_host_window(self):
+        """The serial feed: pull, convert, stack and ``device_put`` the
+        next fused window on the calling thread, via the SAME
+        ``assemble_window``/``put_window`` helpers the prefetch worker
+        runs — one window contract, so the prefetch-on/off bitwise
+        parity cannot drift.  Returns ``(arrays, k, tail)`` in exactly
+        the layout :class:`PrefetchIterator` delivers ready-made."""
+        window, pending = assemble_window(
+            self._next_arrays, self.steps_per_execution)
+        return put_window(window, pending, self._batch_sharding,
+                          self._stacked_sharding, converter=self.converter,
+                          source=self.iterator)
 
     def update(self):
-        first = self._next_arrays()
-        window = [first]
-        pending = None
-        # Fill the fused window; stop early on iterator exhaustion or a
-        # ragged (end-of-epoch partial) batch, which can't stack — the
-        # ragged batch then runs as its own single step below.
-        while len(window) < self.steps_per_execution:
-            try:
-                nxt = self._next_arrays()
-            except StopIteration:
-                break
-            if any(a.shape != b.shape for a, b in zip(nxt, first)):
-                pending = nxt
-                break
-            window.append(nxt)
-
-        k = len(window)
-        if k == 1:
-            arrays = tuple(
-                jax.device_put(a, self._batch_sharding)
-                for a in window[0])
-        else:
-            arrays = tuple(
-                jax.device_put(
-                    np.stack(cols), self._stacked_sharding)
-                for cols in zip(*window))
-        # step_time times the device step dispatch only (not the host-side
-        # iterator pull / stacking), matching the unfused metric's meaning
+        # -- host phase: obtain the next device-resident window -------- #
         t0 = time.perf_counter()
+        if self.prefetch:
+            rec = next(self.iterator)       # DeviceWindow, pre-transferred
+            arrays, k, tail = rec.arrays, rec.k, rec.tail
+        else:
+            arrays, k, tail = self._assemble_host_window()
+        host_time = time.perf_counter() - t0
+
+        # -- dispatch (non-blocking under JAX async dispatch) ----------- #
         carry = (self.params, self.state, self.opt_state)
         carry, loss = self._get_step(len(arrays), k)(carry, *arrays)
-        self.params, self.state, self.opt_state = carry
-        step_time = time.perf_counter() - t0
-        if pending is not None:
+        n_iters = k
+        if tail is not None:
             # Ragged tail batch runs as a plain single step.  Its batch
             # shape differs from the steady-state one, so jit compiles
             # ONE extra executable the first time each distinct tail
@@ -281,22 +352,44 @@ class StandardUpdater:
             # the tail instead would need a mask threaded through every
             # user loss_fn.  Only non-repeating epoch ends produce
             # ragged tails; steady training never pays this.
-            arrays = tuple(
-                jax.device_put(a, self._batch_sharding) for a in pending)
-            t0 = time.perf_counter()
-            carry = (self.params, self.state, self.opt_state)
-            carry, tail_loss = self._get_step(len(arrays), 1)(
-                carry, *arrays)
-            self.params, self.state, self.opt_state = carry
-            step_time += time.perf_counter() - t0
+            carry, tail_loss = self._get_step(len(tail), 1)(carry, *tail)
             loss = jnp.concatenate(
                 [jnp.atleast_1d(loss), jnp.atleast_1d(tail_loss)])
-            k += 1
-        self.iteration += k
+            n_iters += 1
+        self.params, self.state, self.opt_state = carry
+
+        # -- retire: block on the oldest window(s) past max_inflight ---- #
+        # (the PREVIOUS window in steady state — never the one just
+        # dispatched — so the measured device wait is the exposed cost,
+        # not the full step latency, and the pipeline stays overlapped;
+        # donated carries bound memory to max_inflight windows)
+        self._inflight.append(loss)
+        t0 = time.perf_counter()
+        while len(self._inflight) > self.max_inflight:
+            retired = self._inflight.popleft()
+            jax.block_until_ready(retired)
+            self._last_retired = retired
+        device_time = time.perf_counter() - t0
+
+        self.iteration += n_iters
         self.previous_epoch_detail = self.epoch_detail
         self.epoch_detail = getattr(
             self.iterator, "epoch_detail", self.iteration)
+        prof = get_profiler()
+        prof.record("updater/host_time", host_time)
+        prof.record("updater/device_time", device_time)
+        if self.max_inflight > 1 and self._last_retired is not None:
+            # pipelined: report the RETIRED window's loss (already
+            # materialised) so a float()-per-iteration consumer —
+            # LogReport.observe, PrintReport — never stalls the
+            # pipeline on the in-flight window.  Lags by max_inflight
+            # updates; the serial path keeps the current (async) loss.
+            obs_loss = jnp.mean(self._last_retired)
+        else:
+            obs_loss = jnp.mean(loss) if n_iters > 1 else loss
         self.observation = {
-            "main/loss": jnp.mean(loss) if k > 1 else loss,
-            "main/step_time": step_time / k,
+            "main/loss": obs_loss,
+            "main/host_time": host_time / n_iters,
+            "main/device_time": device_time / n_iters,
+            "main/step_time": (host_time + device_time) / n_iters,
         }

@@ -3,6 +3,8 @@
 from chainermn_tpu.utils.comm_model import (
     CollectiveStats,
     axis_collective_report,
+    choose_bucket_bytes,
+    choose_prefetch_depth,
     collective_stats,
     stablehlo_collective_stats,
     wire_bytes_per_device,
@@ -21,6 +23,8 @@ __all__ = [
     "ProfileReport",
     "Profiler",
     "axis_collective_report",
+    "choose_bucket_bytes",
+    "choose_prefetch_depth",
     "collective_stats",
     "get_profiler",
     "load_state",

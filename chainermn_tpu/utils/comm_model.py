@@ -35,6 +35,7 @@ __all__ = [
     "wire_bytes_per_device",
     "axis_collective_report",
     "choose_bucket_bytes",
+    "choose_prefetch_depth",
     "fused_collective_budget",
     "assert_fused_collectives",
 ]
@@ -285,6 +286,44 @@ def choose_bucket_bytes(
     frac = 2.0 * (axis_size - 1) / axis_size
     b_star = (total_bytes * latency_s * bandwidth_bytes_per_s / frac) ** 0.5
     return int(min(max(b_star, min_bucket), total_bytes))
+
+
+def choose_prefetch_depth(host_time_s: float, device_time_s: float,
+                          jitter: float = 0.5, min_depth: int = 2,
+                          max_depth: int = 8) -> int:
+    """Slot count for the prefetch ring (``PrefetchIterator(depth=...)``)
+    from the measured host-assembly vs device-step times (the updater's
+    ``main/host_time`` / ``main/device_time``, or the ``updater/*``
+    profiler rows).
+
+    The pipeline model: one background worker assembles windows at rate
+    ``1/h`` while the device consumes at ``1/d``.  With ``rho = h/d``:
+
+    - **device-bound** (``rho <= 1``): the worker outruns the consumer,
+      so two slots — one being consumed, one staged — already hide ALL
+      host work; extra depth only adds host memory.  Depth stays at
+      ``min_depth`` (= 2, classic double buffering).
+    - **host-bound** (``rho > 1``): no depth makes a single worker
+      faster — the pipe throughput is pinned at ``1/h`` — but depth
+      absorbs *burstiness*: a slow pull (page-cache miss, decode spike)
+      up to ``depth - 1`` windows long passes without stalling the
+      device, as long as the mean keeps up.  Budget ``ceil(rho)`` slots
+      of steady-state lag plus ``jitter`` × that for variance, clamped
+      to ``max_depth`` (each slot pins a full device-put batch).
+
+    Returns an int in ``[min_depth, max_depth]``.
+    """
+    if host_time_s < 0 or device_time_s <= 0:
+        raise ValueError(
+            f"need host_time_s >= 0 and device_time_s > 0, got "
+            f"{host_time_s} / {device_time_s}")
+    if min_depth < 1 or max_depth < min_depth:
+        raise ValueError(f"bad depth bounds [{min_depth}, {max_depth}]")
+    rho = host_time_s / device_time_s
+    if rho <= 1.0 + 1e-9:          # tolerance: fp noise must not flip regimes
+        return min_depth
+    depth = -(-int(rho * (1.0 + jitter) * 1000) // 1000)  # ceil, fp-safe
+    return max(min_depth, min(depth + 1, max_depth))
 
 
 def fused_collective_budget(total_bytes: int, bucket_bytes: int,

@@ -3,9 +3,11 @@
 import numpy as np
 import pytest
 
-from chainermn_tpu import (SerialIterator, create_communicator,
+from chainermn_tpu import (SerialIterator, StagingConverter,
+                           create_communicator,
                            create_multi_node_iterator,
                            create_synchronized_iterator)
+from chainermn_tpu.training import default_converter
 
 
 @pytest.fixture()
@@ -47,6 +49,112 @@ class TestSerialIterator:
         next(it); next(it); next(it)
         it.reset()
         assert it.epoch == 0 and it.epoch_detail == 0.0
+
+
+class TestSerialIteratorArrayFastPath:
+    """Numpy datasets gather batches with ONE fancy index per field and
+    yield pre-stacked arrays the converter passes through untouched."""
+
+    def test_ndarray_dataset_matches_list_path(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(20, 5).astype(np.float32)
+        fast = SerialIterator(X, 6, shuffle=True, seed=1)
+        slow = SerialIterator([X[i] for i in range(20)], 6,
+                              shuffle=True, seed=1)
+        for _ in range(5):       # crosses the epoch boundary
+            bf, bs = next(fast), next(slow)
+            assert isinstance(bf, np.ndarray)
+            assert isinstance(bs, list)
+            np.testing.assert_array_equal(bf, np.stack(bs))
+        assert fast.epoch == slow.epoch
+        assert fast.epoch_detail == slow.epoch_detail
+
+    def test_tuple_of_field_arrays(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(20, 5).astype(np.float32)
+        Y = np.arange(20, dtype=np.int32)
+        it = SerialIterator((X, Y), 6, shuffle=True, seed=1)
+        assert it.dataset_length == 20          # examples, not fields
+        assert it.epoch_detail == 0.0
+        bx, by = next(it)
+        assert bx.shape == (6, 5) and by.shape == (6,)
+        np.testing.assert_array_equal(X[by], bx)   # rows stay aligned
+        assert it.epoch_detail == 6 / 20
+
+    def test_list_of_arrays_is_not_columns(self):
+        # a LIST of arrays holds examples (generic path), even when the
+        # leading dims happen to agree — only tuples declare columns
+        rows = [np.full(4, i, np.float32) for i in range(4)]
+        it = SerialIterator(rows, 2)
+        batch = next(it)
+        assert isinstance(batch, list) and len(batch) == 2
+        np.testing.assert_array_equal(batch[0], rows[0])
+
+    def test_fast_path_state_dict_round_trip(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(20, 5).astype(np.float32)
+        a = SerialIterator((X,), 6, shuffle=True, seed=1)
+        next(a)
+        st = a.state_dict()
+        b = SerialIterator((X,), 6, shuffle=True, seed=9)
+        b.load_state_dict(st)
+        np.testing.assert_array_equal(next(a)[0], next(b)[0])
+
+
+class TestConverters:
+    def test_default_converter_passthrough(self):
+        X = np.zeros((4, 3), np.float32)
+        assert default_converter(X)[0] is X
+        out = default_converter((X, np.arange(4)))
+        assert out[0] is X
+
+    def test_default_converter_tuple_of_example_tuples(self):
+        # a TUPLE batch of example tuples is examples, not columns —
+        # only all-ndarray tuples are pre-stacked fields
+        batch = tuple((np.full(3, i, np.float32), np.int32(i))
+                      for i in range(4))
+        x, y = default_converter(batch)
+        assert x.shape == (4, 3) and y.shape == (4,)
+        np.testing.assert_array_equal(y, np.arange(4))
+        for got, want in zip(StagingConverter()(batch),
+                             default_converter(batch)):
+            np.testing.assert_array_equal(got, want)
+
+    def test_default_converter_stacks_examples(self):
+        batch = [(np.full(3, i, np.float32), np.int32(i))
+                 for i in range(4)]
+        x, y = default_converter(batch)
+        assert x.shape == (4, 3) and y.shape == (4,)
+        np.testing.assert_array_equal(y, np.arange(4))
+        with pytest.raises(ValueError):
+            default_converter([])
+        with pytest.raises(ValueError):
+            default_converter(())
+
+    def test_staging_converter_matches_default(self):
+        batch = [(np.full(3, i, np.float32), np.int32(i))
+                 for i in range(4)]
+        sc = StagingConverter(n_buffers=2)
+        for got, want in zip(sc(batch), default_converter(batch)):
+            np.testing.assert_array_equal(got, want)
+            assert got.dtype == want.dtype
+
+    def test_staging_converter_reuses_buffers(self):
+        batch = [np.full(3, i, np.float32) for i in range(4)]
+        sc = StagingConverter(n_buffers=2)
+        a1, a2, a3 = sc(batch)[0], sc(batch)[0], sc(batch)[0]
+        assert a1 is not a2          # previous batch stays valid
+        assert a1 is a3              # ring of 2 rotates back
+        # shape change (ragged tail) allocates its own buffer
+        tail = sc(batch[:3])[0]
+        assert tail.shape == (3, 3)
+        np.testing.assert_array_equal(sc(batch)[0], a2)
+
+    def test_staging_converter_validates(self):
+        with pytest.raises(ValueError):
+            StagingConverter(n_buffers=1)
+        with pytest.raises(ValueError):
+            StagingConverter()([])
 
 
 class TestMultiNodeIterator:

@@ -1,0 +1,416 @@
+"""Prefetching input pipeline — the overlap must be invisible to
+semantics: bitwise-identical training with prefetch on vs off (ragged
+tails, exhaustion, resume included), worker failures surfacing on the
+consumer thread, and clean shutdown."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models import init_mlp, mlp_apply, softmax_cross_entropy
+from chainermn_tpu.training import default_converter
+from chainermn_tpu.training._resume import (collect_train_state,
+                                            restore_train_state)
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla")
+
+
+def _dataset(n=96, dim=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32), np.int32(i % classes))
+            for i in range(n)]
+
+
+def _make_updater(comm, prefetch, steps_per_execution=3, repeat=True,
+                  n=96, batch_size=16, seed=7):
+    it = cmn.SerialIterator(_dataset(n=n), batch_size, repeat=repeat,
+                            shuffle=True, seed=seed)
+    params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    return cmn.StandardUpdater(
+        it, opt, loss_fn, params, comm,
+        steps_per_execution=steps_per_execution, prefetch=prefetch)
+
+
+def _assert_params_bitwise(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)), a, b)
+
+
+def _no_prefetch_threads():
+    return not any(t.name.startswith("PrefetchIterator")
+                   for t in threading.enumerate())
+
+
+class TestPrefetchParity:
+    def test_params_bitwise_identical_fp32(self, comm):
+        plain = _make_updater(comm, prefetch=0)
+        pre = _make_updater(comm, prefetch=2)
+        for _ in range(6):
+            plain.update()
+            pre.update()
+        assert plain.iteration == pre.iteration == 18
+        _assert_params_bitwise(plain.params, pre.params)
+        assert plain.epoch == pre.epoch
+        assert plain.epoch_detail == pre.epoch_detail
+        pre.iterator.close()
+        assert _no_prefetch_threads()
+
+    def test_ragged_tail_and_stop_iteration(self, comm):
+        # 40/16 -> 16, 16, 8: the ragged tail rides the first update as
+        # its own step; the second update must raise StopIteration —
+        # in BOTH feeds, with identical params
+        plain = _make_updater(comm, prefetch=0, steps_per_execution=4,
+                              repeat=False, n=40)
+        pre = _make_updater(comm, prefetch=3, steps_per_execution=4,
+                            repeat=False, n=40)
+        plain.update()
+        pre.update()
+        assert plain.iteration == pre.iteration == 3
+        _assert_params_bitwise(plain.params, pre.params)
+        with pytest.raises(StopIteration):
+            plain.update()
+        with pytest.raises(StopIteration):
+            pre.update()
+        # exhaustion is sticky, like the serial iterator's
+        with pytest.raises(StopIteration):
+            pre.update()
+
+    def test_window_larger_than_ring_stays_bitwise(self, comm):
+        # steps_per_execution well past the prefetch depth: the staging
+        # ring must cover the whole unstacked window (a too-small ring
+        # silently recycles buffers still referenced IN the window —
+        # duplicated batches, no error)
+        plain = _make_updater(comm, prefetch=0, steps_per_execution=8,
+                              n=256, batch_size=16)
+        pre = _make_updater(comm, prefetch=2, steps_per_execution=8,
+                            n=256, batch_size=16)
+        for _ in range(3):
+            plain.update()
+            pre.update()
+        assert plain.iteration == pre.iteration == 24
+        _assert_params_bitwise(plain.params, pre.params)
+        pre.iterator.close()
+
+    def test_timing_observations_present(self, comm):
+        upd = _make_updater(comm, prefetch=2)
+        upd.update()
+        obs = upd.observation
+        for key in ("main/loss", "main/host_time", "main/device_time",
+                    "main/step_time"):
+            assert key in obs
+        assert float(obs["main/loss"]) > 0
+        assert obs["main/step_time"] == pytest.approx(
+            obs["main/host_time"] + obs["main/device_time"])
+        upd.iterator.close()
+
+
+class TestPrefetchIterator:
+    def test_worker_exception_propagates(self, comm):
+        class Boom:
+            def __init__(self):
+                self.calls = 0
+                self.epoch, self.is_new_epoch = 0, False
+                self.epoch_detail = 0.0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                self.calls += 1
+                if self.calls > 2:
+                    raise ValueError("bad example")
+                return [(np.zeros(4, np.float32), np.int32(0))] * 8
+
+        it = cmn.PrefetchIterator(Boom(), comm, depth=2)
+        next(it)
+        next(it)
+        with pytest.raises(ValueError, match="bad example"):
+            next(it)
+        # the error is sticky — no half-dead pipeline
+        with pytest.raises(ValueError, match="bad example"):
+            next(it)
+        it.close()
+        assert _no_prefetch_threads()
+
+    def test_state_dict_with_buffered_error_keeps_it_sticky(self, comm):
+        class Boom:
+            def __init__(self):
+                self.calls = 0
+                self.epoch, self.is_new_epoch = 0, False
+                self.epoch_detail = 0.0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                self.calls += 1
+                if self.calls > 1:
+                    raise ValueError("bad example")
+                return [(np.zeros(4, np.float32), np.int32(0))] * 8
+
+            def state_dict(self):
+                return {"calls": self.calls}
+
+            def load_state_dict(self, st):
+                self.calls = int(st["calls"])
+
+        it = cmn.PrefetchIterator(Boom(), comm, depth=2)
+        next(it)
+        deadline = time.monotonic() + 5.0
+        while it._thread is not None and it._thread.is_alive() \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)       # worker hits the error and exits
+        st = it.state_dict()       # drains the buffered error sentinel
+        assert isinstance(st, dict)
+        with pytest.raises(ValueError, match="bad example"):
+            next(it)               # the failure is NOT silently dropped
+        it.close()
+
+    def test_shutdown_no_leaked_threads(self, comm):
+        for _ in range(3):
+            base = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=1)
+            it = cmn.PrefetchIterator(base, comm, depth=3)
+            next(it)
+            it.close()
+        assert _no_prefetch_threads()
+        # context-manager form
+        with cmn.PrefetchIterator(
+                cmn.SerialIterator(_dataset(), 16), comm, depth=2) as it:
+            next(it)
+        assert _no_prefetch_threads()
+
+    def test_close_rewinds_unconsumed_lookahead(self, comm):
+        base = cmn.SerialIterator(_dataset(n=64), 16, shuffle=True, seed=2)
+        it = cmn.PrefetchIterator(base, comm, depth=3)
+        first = next(it)
+        time.sleep(0.2)         # let the worker race ahead
+        it.close()
+        # the base iterator stands exactly one batch in: a serial
+        # consumer sees batch 2 next, not wherever the ring had raced
+        ref = cmn.SerialIterator(_dataset(n=64), 16, shuffle=True, seed=2)
+        next(ref)
+        np.testing.assert_array_equal(
+            default_converter(next(base))[0],
+            default_converter(next(ref))[0])
+        assert first.k == 1
+
+    def test_mid_epoch_state_dict_resume(self, comm):
+        base = cmn.SerialIterator(_dataset(n=80), 16, shuffle=True, seed=5)
+        it = cmn.PrefetchIterator(base, comm, depth=3)
+        consumed = [next(it) for _ in range(3)]
+        st = it.state_dict()               # drains + rewinds in-flight
+        assert it.epoch_detail == pytest.approx(3 * 16 / 80)
+        assert st["pos"] == 48
+
+        # restoring into a FRESH serial iterator continues the stream
+        ref = cmn.SerialIterator(_dataset(n=80), 16, shuffle=True, seed=99)
+        ref.load_state_dict(st)
+        want = default_converter(next(ref))[0]
+
+        # ... and the prefetcher itself replays identically after the
+        # state_dict (the rewind + restored RNG make it transparent)
+        got = np.asarray(
+            jax.device_get(next(it).arrays[0]))
+        np.testing.assert_array_equal(got, want)
+        assert len(consumed) == 3
+        it.close()
+
+    def test_load_state_dict_round_trip(self, comm):
+        a_base = cmn.SerialIterator(_dataset(n=80), 16, shuffle=True,
+                                    seed=5)
+        a = cmn.PrefetchIterator(a_base, comm, depth=2)
+        for _ in range(2):
+            next(a)
+        st = a.state_dict()
+
+        b_base = cmn.SerialIterator(_dataset(n=80), 16, shuffle=True,
+                                    seed=123)
+        b = cmn.PrefetchIterator(b_base, comm, depth=2)
+        b.load_state_dict(st)
+        wa = np.asarray(jax.device_get(next(a).arrays[0]))
+        wb = np.asarray(jax.device_get(next(b).arrays[0]))
+        np.testing.assert_array_equal(wa, wb)
+        a.close()
+        b.close()
+
+    def test_non_rewindable_base_keeps_stream_after_state_dict(self, comm):
+        # generator-backed loader with no resume protocol: state_dict
+        # can only say "non_resumable", but the CURRENT run must not
+        # skip the already-prefetched windows
+        class Counting:
+            def __init__(self):
+                self.n = 0
+                self.epoch, self.is_new_epoch = 0, False
+                self.epoch_detail = 0.0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                self.n += 1
+                return [(np.full(4, self.n, np.float32), np.int32(0))] * 8
+
+        it = cmn.PrefetchIterator(Counting(), comm, depth=3)
+
+        def val(rec):
+            return float(np.asarray(jax.device_get(rec.arrays[0]))[0, 0])
+
+        got = [val(next(it))]
+        deadline = time.monotonic() + 5.0
+        while it.buffered < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = it.state_dict()
+        assert st == {"non_resumable": True}
+        got.append(val(next(it)))   # restarts the worker
+        # let the restarted worker wrap the staging ring PAST the still-
+        # buffered windows before they are read — pins the deferred-
+        # sharded-transfer aliasing bug (a recycled staging buffer must
+        # never rewrite a window already handed downstream)
+        time.sleep(0.5)
+        for _ in range(4):
+            got.append(val(next(it)))
+        assert got == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]  # nothing skipped
+        it.close()
+
+    def test_attribute_writes_reach_base(self, comm):
+        # the blessed mutate-then-reset patterns must work THROUGH the
+        # wrapper: synchronized-iterator reseeding and dataset swap
+        base = cmn.SerialIterator(_dataset(n=64), 16, shuffle=True, seed=1)
+        it = cmn.PrefetchIterator(base, comm, depth=2)
+        it._rng = np.random.RandomState(42)
+        assert base._rng is it._rng
+        it.dataset = _dataset(n=32, seed=9)
+        assert base.dataset is it.dataset
+        it.reset()
+        assert base.dataset_length == 32
+        rec = next(it)
+        assert rec.arrays[0].shape[0] == 16
+        it.close()
+
+    def test_updater_rejects_mismatched_prebuilt_prefetcher(self, comm):
+        base = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=7)
+        pf = cmn.PrefetchIterator(base, comm, steps_per_execution=1,
+                                  depth=2)
+        params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        with pytest.raises(ValueError, match="steps_per_execution"):
+            cmn.StandardUpdater(pf, opt, loss_fn, params, comm,
+                                steps_per_execution=4, prefetch=2)
+        # prefetch=0 (default) with a pre-built prefetcher adopts it
+        # instead of feeding DeviceWindows to the serial converter
+        upd = cmn.StandardUpdater(pf, opt, loss_fn, params, comm)
+        assert upd.prefetch == 2 and upd.iterator is pf
+        upd.update()
+        pf.close()
+
+    def test_undersized_staging_ring_rejected(self, comm):
+        base = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=7)
+        with pytest.raises(ValueError, match="n_buffers"):
+            cmn.PrefetchIterator(base, comm, steps_per_execution=8,
+                                 converter=cmn.StagingConverter())
+        params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        with pytest.raises(ValueError, match="n_buffers"):
+            cmn.StandardUpdater(
+                base, opt, loss_fn, params, comm,
+                steps_per_execution=8,
+                converter=cmn.StagingConverter(n_buffers=4))
+
+    def test_trainer_run_finalizes_prefetch_worker(self, comm):
+        upd = _make_updater(comm, prefetch=2, steps_per_execution=2)
+        trainer = cmn.Trainer(upd, (2, "epoch"))
+        trainer.run()
+        assert _no_prefetch_threads()       # no manual close() needed
+        assert upd.epoch == 2
+        # the feed restarts transparently for a continued run
+        upd.update()
+        upd.iterator.close()
+        assert _no_prefetch_threads()
+
+    def test_halt_times_out_on_blocked_base(self, comm):
+        release = threading.Event()
+
+        class Blocking:
+            epoch, is_new_epoch, epoch_detail = 0, False, 0.0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                release.wait()     # a streaming source with no data
+                return [(np.zeros(4, np.float32), np.int32(0))] * 8
+
+        it = cmn.PrefetchIterator(Blocking(), comm, depth=2,
+                                  join_timeout=0.3)
+        it._ensure_worker()
+        with pytest.raises(RuntimeError, match="did not stop"):
+            it.state_dict()
+        with pytest.warns(RuntimeWarning, match="did not stop"):
+            it.close()             # shutdown warns instead of hanging
+        release.set()              # unblock; the worker exits on its own
+        deadline = time.monotonic() + 5.0
+        while not _no_prefetch_threads() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert _no_prefetch_threads()
+
+    def test_buffered_diagnostic(self, comm):
+        base = cmn.SerialIterator(_dataset(n=96), 8, shuffle=True, seed=1)
+        it = cmn.PrefetchIterator(base, comm, depth=3)
+        next(it)
+        deadline = time.monotonic() + 5.0
+        while it.buffered < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)   # tiny batches: the worker fills the ring
+        assert it.buffered == 3
+        it.close()
+        assert it.buffered == 0
+
+
+class TestUpdaterResumeWithPrefetch:
+    def test_full_train_state_resume_matches_serial(self, comm):
+        # uninterrupted serial reference
+        ref = _make_updater(comm, prefetch=0, steps_per_execution=2)
+        for _ in range(6):
+            ref.update()
+
+        # prefetch run, checkpointed mid-epoch at update 2, restored
+        # into a FRESH prefetch updater that finishes the schedule
+        first = _make_updater(comm, prefetch=2, steps_per_execution=2)
+        for _ in range(2):
+            first.update()
+        extra = collect_train_state(first)
+        saved_params = jax.device_get(first.params)
+        first.iterator.close()
+
+        second = _make_updater(comm, prefetch=2, steps_per_execution=2,
+                               seed=31337)  # seed overwritten by restore
+        second.params = jax.device_put(saved_params)
+        second.iteration = first.iteration
+        restore_train_state(extra, second)
+        for _ in range(4):
+            second.update()
+        assert second.iteration == ref.iteration
+        _assert_params_bitwise(ref.params, second.params)
+        second.iterator.close()
+        assert _no_prefetch_threads()

@@ -157,8 +157,12 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
     ("bench_fused_allreduce.py",
      ["--n-layers", "4", "--d-model", "16", "--vocab", "256",
       "--rounds", "1", "--iters", "1"], "x"),
+    ("bench_pipeline.py",
+     ["--batch", "64", "--dim", "32", "--hidden", "64",
+      "--host-delay-ms", "3", "--depth", "2", "--warmup", "1",
+      "--iters", "4", "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
-        "fused_allreduce"])
+        "fused_allreduce", "pipeline"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

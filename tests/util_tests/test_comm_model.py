@@ -14,6 +14,7 @@ from jax.sharding import PartitionSpec as P
 from chainermn_tpu.parallel import MeshConfig
 from chainermn_tpu.utils import (
     axis_collective_report,
+    choose_prefetch_depth,
     collective_stats,
     stablehlo_collective_stats,
     wire_bytes_per_device,
@@ -130,6 +131,30 @@ def test_iota_replica_groups_and_unknown_size():
     with pytest.raises(ValueError, match="group size unknown"):
         st.wire_bytes()
     assert st.wire_bytes(axis_size=4) == 150.0
+
+
+def test_choose_prefetch_depth():
+    # device-bound: double buffering suffices no matter how cheap the
+    # host is — extra depth is pure memory
+    assert choose_prefetch_depth(0.0, 0.010) == 2
+    assert choose_prefetch_depth(0.010, 0.010) == 2
+    assert choose_prefetch_depth(0.002, 0.010) == 2
+    # host-bound: budget ceil(rho * (1 + jitter)) + 1 slots of
+    # burstiness absorption, clamped
+    d3 = choose_prefetch_depth(0.015, 0.010)          # rho 1.5
+    d6 = choose_prefetch_depth(0.030, 0.010)          # rho 3
+    assert 2 < d3 <= d6 <= 8
+    assert choose_prefetch_depth(1.0, 0.001) == 8     # clamps at max
+    assert choose_prefetch_depth(
+        1.0, 0.001, max_depth=16) == 16
+    # fp-noise around the boundary must not flip regimes
+    assert choose_prefetch_depth(0.010 + 1e-12, 0.010) == 2
+    with pytest.raises(ValueError):
+        choose_prefetch_depth(0.01, 0.0)
+    with pytest.raises(ValueError):
+        choose_prefetch_depth(-0.01, 0.01)
+    with pytest.raises(ValueError):
+        choose_prefetch_depth(0.01, 0.01, min_depth=4, max_depth=2)
 
 
 def test_wire_formulas():
