@@ -40,7 +40,10 @@ def run(batch=4, seq=8192, heads=8, d_head=128, iters=20, warmup=3):
         loss = lambda q, k, v: jnp.sum(
             fn(q, k, v).astype(jnp.float32) ** 2)
         step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        for _ in range(warmup):
+        # one unconditional warmup step: ``g`` must exist for the sync
+        # below even at warmup=0 (compile cost lands here either way)
+        g = step(q, k, v)
+        for _ in range(max(0, warmup - 1)):
             g = step(q, k, v)
         float(jnp.sum(g[0][0, 0, 0]))  # device->host sync (axon quirk)
         t0 = time.perf_counter()
@@ -98,7 +101,8 @@ def run_sweep(batch=4, seq=8192, heads=8, d_head=128, iters=10,
         loss = lambda q, k, v: jnp.sum(
             fn(q, k, v).astype(jnp.float32) ** 2)
         step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        for _ in range(warmup):
+        g = step(q, k, v)  # unconditional: warmup=0 must not NameError
+        for _ in range(max(0, warmup - 1)):
             g = step(q, k, v)
         float(jnp.sum(g[0][0, 0, 0]))
         t0 = time.perf_counter()

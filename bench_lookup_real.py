@@ -139,6 +139,12 @@ def run(steps=800, tok_vocab=512, d_model=128, n_layers=4, seq=128,
         train_s = time.perf_counter() - t0
         ids_line = next((ln for ln in out_t.splitlines()
                          if ln.startswith("trained BPE:")), "")
+        if not ids_line:
+            raise RuntimeError(
+                "train_lm output is missing the 'trained BPE: <n> ids' "
+                "telemetry line the bench parses its vocab size from — "
+                "the training child changed its logging or died before "
+                f"tokenizer training; output tail:\n{out_t[-1500:]}")
         vocab = int(ids_line.split(":")[1].split("ids")[0])
 
         max_len = seq + new_tokens

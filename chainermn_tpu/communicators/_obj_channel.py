@@ -17,6 +17,11 @@ implements MPI-ordered p2p object send/recv on top of it:
   blocked on the metadata key never observes a partial message.
 - Keys are deleted after receipt, so the store does not grow with
   traffic.
+- Transient coordination-service errors (connection reset, UNAVAILABLE)
+  are absorbed by bounded exponential-backoff retries (``KV_RETRIES``);
+  timeouts keep one-shot semantics and the per-lane sequence counters
+  only advance after a message is known to exist, so a retried verb can
+  never desynchronise the lane.
 
 This is a *control-plane* channel (datasets, checkpoint agreement,
 user-level ``send_obj``), not a tensor path — tensors ride XLA
@@ -26,6 +31,7 @@ collectives over ICI/DCN.
 from __future__ import annotations
 
 import pickle
+import time
 from typing import Any
 
 
@@ -46,6 +52,87 @@ FRAME_BYTES = 2 * 1024 * 1024
 # Hard cap on a single p2p object (MPI-parity: 2**31).  Larger payloads
 # should go through the chunked *_obj collectives or dataset sharding.
 MAX_OBJ_BYTES = 2**31
+
+# Bounded retry-with-exponential-backoff for TRANSIENT coordination-
+# service failures (the service is gRPC-backed: a brief coordinator
+# restart or connection reset must not kill a long training job mid-
+# checkpoint-agreement).  Only errors matching these markers retry —
+# a deadline/timeout expiry keeps its one-shot semantics (callers size
+# timeout_ms for deadlock detection, retrying would silently multiply
+# it), and anything unrecognised is a real bug that should surface.
+KV_RETRIES = 4
+KV_BACKOFF_BASE_S = 0.05
+KV_BACKOFF_MAX_S = 2.0
+_TRANSIENT_MARKERS = ("unavailable", "resource_exhausted", "socket closed",
+                      "connection reset", "failed to connect",
+                      "broken pipe", "goaway")
+
+
+def _is_transient(exc: BaseException) -> bool:
+    msg = str(exc).lower()
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def _kv_set(setter, key: str, value) -> None:
+    """Retrying set that survives a first attempt which LANDED
+    server-side before the transient error was reported: retried with
+    ``allow_overwrite`` (same key, same value — idempotent), falling
+    back to tolerating an already-exists rejection on clients whose
+    signature predates the flag.
+
+    Known bounded residue: if the first attempt landed AND the receiver
+    consumed-and-deleted the key during the backoff window, the retry
+    re-creates it and nothing deletes it again — a leaked key per such
+    double-fault, not a correctness error (lane sequence counters only
+    move forward, and communicator incarnations use fresh tags, so a
+    resurrected key is never read as a live message by this channel
+    instance).  Fixing it outright needs a compare-and-swap the
+    coordination service does not expose."""
+    def once():
+        try:
+            setter(key, value, allow_overwrite=True)
+        except TypeError:
+            try:
+                setter(key, value)
+            except Exception as e:
+                if "already exists" in str(e).lower():
+                    return
+                raise
+
+    _kv_retry(once, "key set")
+
+
+def _kv_delete(client, key: str) -> None:
+    """Retrying delete that also tolerates "already gone": a transient
+    failure whose first attempt DID land server-side must not turn the
+    retry into a spurious not-found error (lazy GC only needs the key
+    absent)."""
+    def once():
+        try:
+            client.key_value_delete(key)
+        except Exception as e:
+            if "not found" in str(e).lower():
+                return
+            raise
+
+    _kv_retry(once, "key delete")
+
+
+def _kv_retry(fn, what: str):
+    """Call ``fn()`` retrying transient failures up to ``KV_RETRIES``
+    times with exponential backoff; non-transient errors propagate
+    immediately.  Safe for every KV verb used here: set/delete are
+    idempotent (same key, same value / absent-ok), and a retried GET
+    re-reads an immutable published value."""
+    delay = KV_BACKOFF_BASE_S
+    for attempt in range(KV_RETRIES + 1):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt >= KV_RETRIES or not _is_transient(e):
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, KV_BACKOFF_MAX_S)
 
 
 class KVObjectChannel:
@@ -87,10 +174,10 @@ class KVObjectChannel:
         client = self._client
         nframes = max(1, -(-len(payload) // FRAME_BYTES))
         for k in range(nframes):
-            client.key_value_set_bytes(
-                keyfn(f"c{k}"),
-                payload[k * FRAME_BYTES : (k + 1) * FRAME_BYTES])
-        client.key_value_set(keyfn("meta"), f"{nframes},{len(payload)}")
+            _kv_set(client.key_value_set_bytes, keyfn(f"c{k}"),
+                    payload[k * FRAME_BYTES : (k + 1) * FRAME_BYTES])
+        _kv_set(client.key_value_set, keyfn("meta"),
+                f"{nframes},{len(payload)}")
         return nframes
 
     def _collect(self, keyfn, what: str, meta: str = None) -> Any:
@@ -99,13 +186,15 @@ class KVObjectChannel:
         (recv's retry-safe existence check) to save a KV round-trip."""
         client = self._client
         if meta is None:
-            meta = client.blocking_key_value_get(
-                keyfn("meta"), self._timeout_ms)
+            meta = _kv_retry(lambda: client.blocking_key_value_get(
+                keyfn("meta"), self._timeout_ms), f"{what} meta get")
         nframes, total = (int(v) for v in meta.split(","))
         buf = bytearray()
         for k in range(nframes):
-            buf += client.blocking_key_value_get_bytes(
-                keyfn(f"c{k}"), self._timeout_ms)
+            key = keyfn(f"c{k}")
+            buf += _kv_retry(
+                lambda key=key: client.blocking_key_value_get_bytes(
+                    key, self._timeout_ms), f"{what} frame get")
         if len(buf) != total:
             raise RuntimeError(
                 f"{what} corruption: expected {total} bytes, "
@@ -142,8 +231,8 @@ class KVObjectChannel:
         old = self._ag_frames.pop(s - 2, None)
         if old is not None:
             for k in range(old):
-                client.key_value_delete(self._key(me, -1, s - 2, f"gc{k}"))
-            client.key_value_delete(self._key(me, -1, s - 2, "gmeta"))
+                _kv_delete(client, self._key(me, -1, s - 2, f"gc{k}"))
+            _kv_delete(client, self._key(me, -1, s - 2, "gmeta"))
 
         def keyfn(p):
             return lambda part: self._key(
@@ -160,16 +249,19 @@ class KVObjectChannel:
         """Receive the next in-order object on the (src, dst) lane."""
         client = self._client
         seq = self._recv_seq.get((src, dst), 0)
-        meta = client.blocking_key_value_get(
-            self._key(src, dst, seq, "meta"), self._timeout_ms)
+        meta = _kv_retry(lambda: client.blocking_key_value_get(
+            self._key(src, dst, seq, "meta"), self._timeout_ms),
+            "obj channel meta get")
         # advance the lane only once the message is known to exist, so a
         # timed-out recv can be retried without desynchronising sequences
+        # (the retry wrapper above only re-reads on TRANSIENT transport
+        # errors — a timeout still propagates before this line runs)
         self._recv_seq[(src, dst)] = seq + 1
         nframes = int(meta.split(",")[0])
         obj = self._collect(
             lambda part: self._key(src, dst, seq, part), "obj channel",
             meta=meta)
         for k in range(nframes):
-            client.key_value_delete(self._key(src, dst, seq, f"c{k}"))
-        client.key_value_delete(self._key(src, dst, seq, "meta"))
+            _kv_delete(client, self._key(src, dst, seq, f"c{k}"))
+        _kv_delete(client, self._key(src, dst, seq, "meta"))
         return obj

@@ -75,7 +75,11 @@ class BPETokenizer:
     # highly repetitive, so the memo stays tiny; the cap only matters
     # for adversarial input (e.g. a stream of unique long chunks, where
     # the O(len^2) merge scan below would otherwise also pin unbounded
-    # memory behind it)
+    # memory behind it).  At the cap the OLDEST entry is evicted (dict
+    # preserves insertion order) instead of freezing insertion forever:
+    # after an adversarial flood of unique chunks passes, steady-state
+    # hot chunks re-enter the cache rather than paying the merge scan
+    # on every encode for the rest of the process's life.
     _CACHE_CAP = 1 << 16
 
     def _encode_chunk(self, chunk: bytes) -> tuple[int, ...]:
@@ -92,8 +96,9 @@ class BPETokenizer:
             if best_pair is None:
                 break
             word = _merge_pair(word, best_pair, 256 + best_rank)
-        if len(self._cache) < self._CACHE_CAP:
-            self._cache[chunk] = word
+        if len(self._cache) >= self._CACHE_CAP:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[chunk] = word
         return word
 
     def encode(self, text) -> list[int]:

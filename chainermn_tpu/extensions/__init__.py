@@ -14,6 +14,10 @@
   the whole job instead of deadlocking the collective.
 - :class:`PreemptionCheckpointer` — checkpoint + clean stop on the TPU
   preemption SIGTERM notice (beyond reference; see module docstring).
+- :class:`TrainingWatchdog` — monitor thread fed step-boundary
+  heartbeats (+ optional cross-process KV heartbeats): on stall it dumps
+  all-thread stacks, writes a structured stall report, and optionally
+  escalates crash-don't-deadlock (beyond reference; docs/RESILIENCE.md).
 """
 
 from chainermn_tpu.extensions.allreduce_persistent import (
@@ -32,6 +36,7 @@ from chainermn_tpu.extensions.observation_aggregator import (
 )
 from chainermn_tpu.extensions.preemption import PreemptionCheckpointer
 from chainermn_tpu.extensions.snapshot import multi_node_snapshot
+from chainermn_tpu.extensions.watchdog import TrainingWatchdog
 
 __all__ = [
     "AllreducePersistentValues",
@@ -39,6 +44,7 @@ __all__ = [
     "MultiNodeCheckpointer",
     "ObservationAggregator",
     "PreemptionCheckpointer",
+    "TrainingWatchdog",
     "add_global_except_hook",
     "create_multi_node_checkpointer",
     "multi_node_snapshot",

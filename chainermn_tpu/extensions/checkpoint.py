@@ -7,9 +7,14 @@ mount empty, see SURVEY.md §3.5).  Semantics preserved:
 - every process writes its own shard file per trigger, named with the
   iteration and the process rank (``snapshot_iter_{it}.{rank}``);
 - resume loads the **latest iteration for which every process possesses a
-  shard** — agreement reached by allgathering the locally-visible iteration
-  sets (processes may see different files on node-local disks; shared
-  filesystems degenerate to the same answer);
+  shard that passes its integrity check** — candidates are tried
+  newest-first: each process attempts the CRC-checked load of its own
+  shard and the verdicts ride an agreement allgather (processes may see
+  different files on node-local disks; shared filesystems degenerate to
+  the same answer).  A shard whose CRC32s fail is QUARANTINED — renamed
+  ``*.corrupt`` for post-mortem, never deleted by GC — and resume falls
+  back to the newest set that loads clean everywhere, logging what was
+  skipped (fallback resume; docs/RESILIENCE.md);
 - superseded snapshot sets are garbage-collected after a successful save;
 - world size must match at restart (checked, like the reference's implicit
   contract).
@@ -23,11 +28,18 @@ restart restores host-local state without any cross-host traffic).
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 from typing import List, Optional, Set
 
-from chainermn_tpu.utils.serialization import load_state, save_state
+from chainermn_tpu.utils.serialization import (
+    SnapshotCorruptError,
+    load_state,
+    save_state,
+)
+
+_LOG = logging.getLogger(__name__)
 
 __all__ = ["MultiNodeCheckpointer", "create_multi_node_checkpointer"]
 
@@ -54,11 +66,16 @@ class MultiNodeCheckpointer:
     priority = 30
 
     def __init__(self, comm, path: str, name: str = "snapshot",
-                 async_write: bool = False):
+                 async_write: bool = False, history: int = 1):
         self.comm = comm
         self.path = path
         self.name = name
         self.async_write = async_write
+        # newest sets GC retains.  1 = the reference's keep-only-latest;
+        # 2+ buys fallback-resume headroom: a corrupted newest set can
+        # only fall back if an older complete set still exists
+        # (docs/RESILIENCE.md recommends 2 for production jobs).
+        self.history = max(int(history), 1)
         self._saved_iterations: Set[int] = set()
         self._pending = None  # (thread, iteration, error_box)
 
@@ -82,6 +99,50 @@ class MultiNodeCheckpointer:
         all_sets = self.comm.allgather_obj(self._local_iterations())
         common = set.intersection(*all_sets) if all_sets else set()
         return sorted(common)
+
+    # ------------------------------------------------------------------ #
+    # integrity: verification + quarantine
+    # ------------------------------------------------------------------ #
+
+    def _quarantine(self, path: str) -> str:
+        """Rename a damaged shard out of the inventory (``*.corrupt``).
+        Quarantined files no longer match the snapshot name pattern, so
+        GC never touches them — the bytes stay on disk for diagnosis."""
+        q = path + ".corrupt"
+        n = 0
+        while os.path.exists(q):
+            n += 1
+            q = f"{path}.corrupt{n}"
+        os.replace(path, q)
+        return q
+
+    def _checked_local_load(self, it: int):
+        """Load THIS rank's shard of iteration ``it`` through the
+        CRC-checked read path; quarantine + return ``None`` on
+        corruption, return ``None`` (no quarantine) when the file
+        vanished underneath us (a peer's concurrent GC on a shared
+        filesystem — "gone" is not "damaged").  The checked load IS the
+        verification, so each candidate set is read at most once."""
+        fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
+        path = os.path.join(self.path, fn)
+        try:
+            return load_state(path)
+        except SnapshotCorruptError as e:
+            try:
+                where = os.path.basename(self._quarantine(path))
+            except OSError as qe:
+                # a failing rename (EROFS, EACCES, disk error) must not
+                # unwind out of the agreement protocol — peers are
+                # blocked in the verdict allgather; vote False and let
+                # the caller's local exclusion retire the candidate
+                where = f"<quarantine failed: {qe}>"
+            _LOG.warning(
+                "rank %d: shard %s failed its integrity check and was "
+                "quarantined as %s: %s", self.comm.inter_rank, fn,
+                where, e)
+            return None
+        except FileNotFoundError:
+            return None
 
     # ------------------------------------------------------------------ #
     # save (extension __call__)
@@ -186,9 +247,32 @@ class MultiNodeCheckpointer:
         """Remove every superseded shard of THIS rank — including orphans
         from before a crash (the disk inventory, not just this process's
         in-memory save set: a shard written by a dead run is equally
-        superseded once a newer complete set exists)."""
-        for it in self._local_iterations() | self._saved_iterations:
-            if it == keep:
+        superseded once a newer complete set exists).
+
+        With ``history > 1`` the protected set is AGREED, not derived
+        per-rank: after a quarantine/fallback event local inventories
+        diverge (the quarantining rank lost an iteration its peers
+        still hold), and per-rank protection would evict *different*
+        iterations on different ranks — leaving no older set complete
+        anywhere, exactly the headroom ``history`` exists to provide.
+        Every caller reaches ``_cleanup`` in lockstep (post-barrier
+        save, join-then-GC), so the agreement allgather is
+        collective-safe here; ``history == 1`` skips it (keep-only-
+        latest needs no agreement).  Iterations NEWER than ``keep`` are
+        orphans of a dead run that got further than this one's resume
+        point — never agreed complete, never protected.  Quarantined
+        ``*.corrupt`` files never match the shard name pattern and are
+        never touched."""
+        inventory = self._local_iterations() | self._saved_iterations
+        if self.history > 1:
+            candidates = [i for i in self._common_iterations()
+                          if i <= keep]
+        else:
+            candidates = [keep]
+        protected = set(sorted(candidates, reverse=True)[: self.history])
+        protected.add(keep)
+        for it in inventory:
+            if it in protected:
                 continue
             fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
             try:
@@ -202,22 +286,63 @@ class MultiNodeCheckpointer:
     # ------------------------------------------------------------------ #
 
     def maybe_load(self, updater, trainer=None) -> Optional[int]:
-        """Restore the newest globally-complete snapshot into ``updater``
-        (and, when given, ``trainer``: iterator position/epoch/RNG,
-        extension state like the LogReport history, and the wall clock —
-        the reference serialized the whole trainer object graph).
+        """Restore the newest globally-complete AND globally-verified
+        snapshot into ``updater`` (and, when given, ``trainer``: iterator
+        position/epoch/RNG, extension state like the LogReport history,
+        and the wall clock — the reference serialized the whole trainer
+        object graph).
+
+        Fallback resume: candidates are tried newest-first.  For each,
+        every process attempts the CRC-checked load of its own shard
+        (corrupt files are quarantined as ``*.corrupt``), and the
+        verdicts ride an agreement allgather — the restored iteration is
+        the newest one whose shard LOADED CLEAN on every process.  A
+        corrupted latest set therefore falls back to the previous
+        complete set instead of crashing resume with an opaque
+        npz/pickle error; skipped iterations are logged.  Each shard
+        file is read at most once (the checked load doubles as the
+        verification), and sets older than the elected one are never
+        read at all.
 
         Returns the resumed iteration, or ``None`` when nothing to resume
         (fresh start — the reference's behaviour on first launch).
         """
         from chainermn_tpu.training._resume import restore_train_state
         self._join_pending(barrier_and_gc=True)
-        common = self._common_iterations()
-        if not common:
-            return None
-        it = common[-1]
-        fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
-        state = load_state(os.path.join(self.path, fn))
+        skipped = []
+        rejected: Set[int] = set()
+        while True:
+            # each round allgathers this rank's ELIGIBLE set (inventory
+            # minus everything it already voted down): quarantine
+            # normally removes a bad shard from the inventory, but the
+            # explicit exclusion keeps every rank's candidate sequence
+            # identical — and the loop strictly descending — even when
+            # a quarantine rename itself fails (read-only filesystem)
+            mine = self._local_iterations() - rejected
+            rows = self.comm.allgather_obj(mine)
+            common = sorted(set.intersection(*rows)) if rows else []
+            if not common:
+                if skipped:
+                    _LOG.warning(
+                        "no snapshot set is loadable on every process "
+                        "(candidates %s all had a corrupt or vanished "
+                        "shard somewhere) — starting fresh; quarantined "
+                        "files kept as *.corrupt", skipped)
+                return None
+            it = common[-1]
+            state = self._checked_local_load(it)
+            if state is None:
+                rejected.add(it)
+            verdicts = self.comm.allgather_obj(state is not None)
+            if all(verdicts):
+                break
+            skipped.append(it)
+        if skipped:
+            _LOG.warning(
+                "fallback resume: snapshot iteration(s) %s had corrupt "
+                "shard(s) on at least one process — restoring iteration "
+                "%d instead (bad files quarantined as *.corrupt)",
+                skipped, it)
         saved_world = int(state.get("world_size", self.comm.inter_size))
         if saved_world != self.comm.inter_size:
             # same-world-size restart contract (the reference's implicit
@@ -251,7 +376,7 @@ class MultiNodeCheckpointer:
 
 def create_multi_node_checkpointer(
     comm, path: str, name: str = "snapshot",
-    async_write: bool = False,
+    async_write: bool = False, history: int = 1,
 ) -> MultiNodeCheckpointer:
     """Factory with the reference's exact name and signature shape.
 
@@ -259,6 +384,11 @@ def create_multi_node_checkpointer(
     (the device→host copy stays synchronous; pickling + disk IO move to
     a writer thread, joined at the next save/resume/finalize).  Beyond
     the reference, which blocked the training loop for the full write.
+
+    ``history`` (default 1 — the reference's keep-only-latest GC) sets
+    how many of the newest complete sets survive garbage collection;
+    use 2+ so a corrupted newest set has an older verified set for
+    fallback resume to land on (docs/RESILIENCE.md).
     """
     return MultiNodeCheckpointer(comm, path, name,
-                                 async_write=async_write)
+                                 async_write=async_write, history=history)

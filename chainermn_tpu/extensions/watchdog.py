@@ -1,0 +1,368 @@
+"""Runtime watchdog — surface a hung collective or dead peer in seconds.
+
+The reference had NO failure detection (SURVEY §5: fault tolerance was
+checkpoint + full restart); a rank wedged inside a collective stalled
+the whole job silently until an operator noticed.  This repo has already
+paid that cost for real: the PJRT-plugin hang diagnosed in VERDICT r5
+sat in a ~1,505 s internal retry budget with nothing at runtime to say
+*where* it was stuck — ``hang_doctor.py`` reconstructs such hangs
+post-mortem, offline.  :class:`TrainingWatchdog` is the runtime
+subsystem: a daemon monitor thread fed step-boundary heartbeats that, on
+a stall longer than the threshold,
+
+1. dumps ALL thread stacks via :mod:`faulthandler` (the C-level-safe
+   dump — works even when the main thread is wedged inside a collective
+   that never returns to the interpreter),
+2. writes a structured JSON **stall report** (rank, iteration, seconds
+   stalled, per-thread Python stacks from ``sys._current_frames``, peer
+   heartbeat ages) next to the trainer output,
+3. optionally escalates crash-don't-deadlock: drops the coordination
+   heartbeat (``jax.distributed.shutdown``) so peers fail fast, then
+   ``os._exit`` — the same abort semantics as
+   :func:`~chainermn_tpu.extensions.add_global_except_hook`.
+
+Cross-process detection: with ``comm=`` given on a multi-process job,
+every heartbeat also publishes a ``watchdog/hb/<rank>`` key to the JAX
+coordination-service KV store (overwritten in place — O(world) keys
+total), and the monitor reads ALL ranks' keys each check.  A peer whose
+key stops advancing past the threshold is reported as stalled/dead in
+the local report even when THIS process is healthy — survivors learn of
+a dead rank in seconds instead of blocking forever in the next
+collective.
+
+The monitor thread never takes the GIL hostage: it sleeps in
+``threading.Event.wait`` and wakes at ``check_interval`` (default
+``stall_timeout / 4``, so a stall is caught within one check interval
+of crossing the threshold).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+__all__ = ["TrainingWatchdog"]
+
+_KV_PREFIX = "watchdog/hb"
+
+
+def _thread_stacks() -> dict:
+    """Python-level stacks of every live thread, keyed by thread name —
+    the structured half of the stall report (faulthandler's dump is the
+    unstructured, crash-safe half)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}-{ident}"
+        out[label] = traceback.format_stack(frame)
+    return out
+
+
+class TrainingWatchdog:
+    """Trainer extension: stall detection with stack-dump reports.
+
+    Args:
+      stall_timeout: seconds without a step-boundary heartbeat before
+        the stall machinery fires.  Budget it above the slowest healthy
+        step (first-step compiles count — the watchdog only arms at the
+        FIRST heartbeat, so compile-before-step-1 never false-fires).
+      check_interval: monitor wake period; default ``stall_timeout / 4``
+        (a stall is reported within one interval of crossing the
+        threshold).
+      comm: optional communicator.  On a multi-process job its presence
+        turns on the cross-process KV heartbeats described in the
+        module docstring; single-process worlds skip the KV traffic.
+      escalate: after reporting, abort the process (crash-don't-
+        deadlock): ``jax.distributed.shutdown()`` best-effort, then
+        ``os._exit(exit_code)``.  Default False — report-only, because
+        a stalled *peer* is the peer's problem to die of; set True on
+        jobs where a silent wedge is worse than a restart.
+      on_stall: callback ``fn(report_dict)`` invoked after the report is
+        written (tests, metrics push, custom escalation).  Exceptions
+        from it are swallowed — the watchdog must never be the thing
+        that crashes a healthy job.
+      report_path: where the JSON stall report lands; default
+        ``<trainer.out>/stall_report.json`` (or CWD when used without a
+        trainer).
+      exit_code: the ``os._exit`` status used by escalation.
+
+    Use::
+
+        wd = TrainingWatchdog(stall_timeout=300, comm=comm)
+        trainer.extend(wd)          # heartbeats every iteration
+
+    or drive it manually around any loop: ``wd.start()`` /
+    ``wd.heartbeat()`` / ``wd.stop()``.
+    """
+
+    trigger = (1, "iteration")
+    # runs FIRST on its tick: the heartbeat must mark the step boundary
+    # before heavyweight extensions (evaluators, checkpoint writes) eat
+    # wall clock that a tight threshold would misread as a stall
+    priority = 1000
+
+    def __init__(self, stall_timeout: float = 300.0,
+                 check_interval: Optional[float] = None,
+                 comm=None, escalate: bool = False,
+                 on_stall: Optional[Callable[[dict], None]] = None,
+                 report_path: Optional[str] = None,
+                 exit_code: int = 42):
+        if stall_timeout <= 0:
+            raise ValueError("stall_timeout must be > 0")
+        self.stall_timeout = float(stall_timeout)
+        self.check_interval = (float(check_interval) if check_interval
+                               else self.stall_timeout / 4.0)
+        if self.check_interval <= 0:
+            raise ValueError("check_interval must be > 0")
+        self.comm = comm
+        self.escalate = escalate
+        self.on_stall = on_stall
+        self.report_path = report_path
+        self.exit_code = exit_code
+        self.stall_count = 0          # reports fired (monotonic)
+        self.last_report: Optional[dict] = None
+        self._beats = 0
+        self._last_beat: Optional[float] = None   # armed at first beat
+        self._iteration = None
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reported_current_stall = False
+        self._reported_peers: set = set()
+        self._peer_seen: dict = {}  # rank -> (beats, reader-monotonic)
+        self._started_m = None      # monitor start (never-published age)
+
+    # ------------------------------------------------------------------ #
+    # KV heartbeat plumbing (cross-process)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def _kv(self):
+        """The coordination-service client, or None outside a
+        multi-process distributed world (single-process jobs need no
+        cross-process heartbeats)."""
+        if self.comm is None or getattr(self.comm, "inter_size", 1) <= 1:
+            return None
+        from jax._src import distributed
+
+        return distributed.global_state.client
+
+    def _publish_beat(self) -> None:
+        kv = self._kv
+        if kv is None:
+            return
+        key = f"{_KV_PREFIX}/{self.comm.inter_rank}"
+        value = f"{self._beats},{time.time()}"
+        # ONE attempt, no retry/backoff: this runs on the training main
+        # thread every iteration, so a flaky coordination service must
+        # cost one failed RPC, never retry sleeps.  The legacy-client
+        # fallback is delete+set — NOT already-exists tolerance, which
+        # for this overwrite-in-place key would silently freeze the
+        # counter and make healthy ranks read as dead peers.
+        try:
+            try:
+                kv.key_value_set(key, value, allow_overwrite=True)
+            except TypeError:  # client predates allow_overwrite
+                try:
+                    kv.key_value_delete(key)
+                except Exception:
+                    pass
+                kv.key_value_set(key, value)
+        except Exception:
+            # best-effort: a dropped beat degrades detection quality by
+            # one interval, it must never kill training
+            pass
+
+    def _peer_ages(self) -> dict:
+        """``{rank: seconds_since_the_READER_last_saw_its_beat_counter
+        _advance}`` for every rank that has published, read non-blocking
+        from the KV directory.
+
+        Ages are measured on THIS process's monotonic clock from the
+        moment the peer's published beat count last CHANGED — never by
+        differencing the publisher's wall clock against ours, so
+        cross-host clock skew cannot fabricate (or mask) a stalled
+        peer.  First sight of a rank counts as an advance: a peer dead
+        on arrival is reported one threshold after we first see it.
+
+        A rank that has NEVER published is aged from the moment this
+        monitor started: the motivating hang class (PJRT/plugin init
+        wedging before step 1) never reaches a first heartbeat, and a
+        peer invisible to the detector would be exactly the silent
+        stall the watchdog exists to surface.
+
+        Returns ``None`` (distinct from "no peers") when the KV read
+        itself failed — the caller must keep its episode state rather
+        than mistake a transport blip for every peer recovering."""
+        kv = self._kv
+        if kv is None:
+            return {}
+        try:
+            entries = kv.key_value_dir_get(_KV_PREFIX)
+        except Exception:
+            return None
+        now_m = time.monotonic()
+        ages = {}
+        for key, value in entries:
+            try:
+                rank = int(str(key).rsplit("/", 1)[-1])
+                beats = int(str(value).split(",")[0])
+            except (ValueError, IndexError):
+                continue
+            seen = self._peer_seen.get(rank)
+            if seen is None or seen[0] != beats:
+                self._peer_seen[rank] = (beats, now_m)
+                ages[rank] = 0.0
+            else:
+                ages[rank] = round(now_m - seen[1], 3)
+        if self._started_m is not None:
+            for rank in range(getattr(self.comm, "inter_size", 0)):
+                if rank not in ages and rank != self.comm.inter_rank:
+                    ages[rank] = round(now_m - self._started_m, 3)
+        return ages
+
+    # ------------------------------------------------------------------ #
+    # heartbeat + monitor
+    # ------------------------------------------------------------------ #
+
+    def heartbeat(self, iteration=None) -> None:
+        """Mark a step boundary; arms the watchdog on the first call."""
+        self._beats += 1
+        self._iteration = iteration
+        self._last_beat = time.monotonic()
+        self._reported_current_stall = False
+        self._publish_beat()
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        if self._started_m is None:
+            self._started_m = time.monotonic()
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._monitor, name="training-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=self.check_interval + 5)
+        self._thread = None
+
+    def _monitor(self) -> None:
+        while not self._stop_evt.wait(self.check_interval):
+            last = self._last_beat
+            if last is None:        # not armed yet (still compiling)
+                continue
+            stalled_s = time.monotonic() - last
+            peer_ages = self._peer_ages()
+            if peer_ages is None:
+                # KV read blip: keep per-peer episode state untouched
+                # (clearing it would re-report every still-dead peer on
+                # the next successful read), detect local stalls only
+                peer_ages, stalled_peers, new_peers = {}, {}, {}
+            else:
+                stalled_peers = {
+                    r: a for r, a in peer_ages.items()
+                    if a > self.stall_timeout
+                    and (self.comm is None or r != self.comm.inter_rank)}
+                # one report per stall EPISODE, locally and per peer: a
+                # permanently dead peer must not re-dump stacks and
+                # rewrite the report every check interval for the rest
+                # of the job
+                self._reported_peers &= set(stalled_peers)  # re-arm
+                new_peers = {r: a for r, a in stalled_peers.items()
+                             if r not in self._reported_peers}
+            local_stall = stalled_s > self.stall_timeout
+            local_to_report = local_stall \
+                and not self._reported_current_stall
+            if not local_to_report and not new_peers:
+                continue
+            self._reported_peers |= set(new_peers)
+            self._fire(local_stall, stalled_s, peer_ages, new_peers)
+
+    # ------------------------------------------------------------------ #
+    # stall handling
+    # ------------------------------------------------------------------ #
+
+    def _fire(self, local_stall, stalled_s, peer_ages, stalled_peers):
+        if local_stall:
+            # peer-only reports must not consume the local episode: a
+            # local stall beginning later (no beat in between) still
+            # deserves its own report
+            self._reported_current_stall = True
+        self.stall_count += 1
+        rank = getattr(self.comm, "inter_rank", 0) if self.comm else 0
+        report = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "rank": rank,
+            "kind": "local-stall" if local_stall else "peer-stall",
+            "seconds_since_heartbeat": round(stalled_s, 3),
+            "stall_timeout_s": self.stall_timeout,
+            "iteration": self._iteration,
+            "beats": self._beats,
+            "peer_heartbeat_ages_s": peer_ages,
+            "stalled_peers": stalled_peers,
+            "threads": _thread_stacks(),
+            "escalating": bool(self.escalate and local_stall),
+        }
+        self.last_report = report
+        path = self.report_path or "stall_report.json"
+        try:
+            with open(path, "w") as f:
+                json.dump(report, f, indent=1)
+        except OSError:
+            pass
+        # the crash-safe dump: C-level faulthandler walks every thread
+        # even if the interpreter state is wedged mid-collective
+        sys.stderr.write(
+            f"\n[chainermn_tpu watchdog] rank {rank}: "
+            f"{report['kind']} — no step-boundary heartbeat for "
+            f"{stalled_s:.1f}s (threshold {self.stall_timeout}s, "
+            f"iteration {self._iteration}); report at {path}\n")
+        try:
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            pass
+        sys.stderr.flush()
+        if self.on_stall is not None:
+            try:
+                self.on_stall(report)
+            except Exception:
+                pass
+        if self.escalate and local_stall:
+            self._abort()
+
+    def _abort(self) -> None:
+        """Crash-don't-deadlock: mirror the global except hook's MPI_Abort
+        analogue so surviving peers fail fast instead of blocking."""
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                jax.distributed.shutdown()
+        except Exception:
+            pass
+        os._exit(self.exit_code)
+
+    # ------------------------------------------------------------------ #
+    # trainer extension protocol
+    # ------------------------------------------------------------------ #
+
+    def initialize(self, trainer) -> None:
+        if self.report_path is None:
+            self.report_path = os.path.join(
+                getattr(trainer, "out", "."), "stall_report.json")
+        self.start()
+
+    def __call__(self, trainer) -> None:
+        self.heartbeat(iteration=trainer.updater.iteration)
+
+    def finalize(self, trainer=None) -> None:
+        self.stop()

@@ -9,19 +9,30 @@ packages the two tricks this repo's own suite runs on:
 - :func:`run_multiprocess` — real multi-process JAX clusters on
   localhost, the TPU-native ``mpiexec -n N`` for the code paths that
   only exist across processes (object transport, checkpoint agreement,
-  preemption flag reduce).
+  preemption flag reduce);
+- :class:`FaultPlan` / :class:`FaultInjector` / :func:`corrupt_file` —
+  the deterministic fault-injection harness: every recovery path the
+  resilience layer promises (kill→resume, corrupted-latest fallback,
+  watchdog stall detection, NaN abort) is exercised under an INJECTED
+  fault scripted by iteration number, not by luck (docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import os
+import random
+import signal as _signal
 import socket
 import subprocess
 import sys
+import time
 from typing import Optional, Sequence
 
-__all__ = ["ensure_virtual_pod", "run_multiprocess", "free_port",
-           "requires_vma"]
+__all__ = ["FaultInjector", "FaultPlan", "corrupt_file",
+           "ensure_virtual_pod", "free_port", "requires_vma",
+           "run_multiprocess"]
 
 
 def ensure_virtual_pod(n_devices: int = 8) -> None:
@@ -141,6 +152,130 @@ def run_multiprocess(
                 f"--- worker {i} rc={codes[i]} ---\n{outputs[i]}"
                 for i in range(nprocs)))
     return outputs
+
+
+def corrupt_file(path: str, n_bytes: int = 8, offset: Optional[int] = None,
+                 seed: int = 0) -> list:
+    """Deterministically flip ``n_bytes`` bytes of ``path`` in place.
+
+    The corrupt-shard fault: XORs each chosen byte with a non-zero mask
+    drawn from ``random.Random(seed)``, so the damage is reproducible
+    and guaranteed to change the bytes (an XOR with 0 would be a no-op
+    "corruption" that CRCs rightly ignore).  With ``offset=None`` the
+    positions land in the middle half of the file — inside payload data
+    for an uncompressed npz, past the zip local headers — which is
+    exactly the damage ``verify_state`` must catch.  Returns the list of
+    flipped offsets (for assertions/logging).
+    """
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path} is empty — nothing to corrupt")
+    rng = random.Random(seed)
+    if offset is not None:
+        positions = [min(offset + i, size - 1) for i in range(n_bytes)]
+    else:
+        lo, hi = size // 4, max(size // 4 + 1, 3 * size // 4)
+        positions = sorted(rng.randrange(lo, hi) for _ in range(n_bytes))
+    with open(path, "r+b") as f:
+        for pos in positions:
+            f.seek(pos)
+            old = f.read(1)
+            f.seek(pos)
+            f.write(bytes([old[0] ^ rng.randrange(1, 256)]))
+    return positions
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A deterministic fault script, keyed by iteration number.
+
+    Every field is a plain scalar so a plan serialises through
+    :meth:`to_json` / :meth:`from_json` and can be handed to a child
+    process on its command line — the kill→resume drills run the faulty
+    phase in a real subprocess and compare its resumed continuation
+    against an uninterrupted run bitwise.
+
+    Faults (all optional; fire at the step boundary AFTER the named
+    iteration completes, where train state is consistent):
+
+    - ``kill_at_iteration`` — ``SIGKILL`` self: the hard crash (spot
+      reclamation without notice, OOM killer).  Nothing flushes.
+    - ``sigterm_at_iteration`` — ``SIGTERM`` self: the preemption
+      notice; with an async checkpointer on the same tick the signal
+      lands MID-write, exercising the join-on-crash path.
+    - ``corrupt_at_iteration`` + ``corrupt_path`` — flip
+      ``corrupt_n_bytes`` bytes of that file (:func:`corrupt_file`).
+    - ``delay_at_iteration`` + ``delay_rank`` + ``delay_seconds`` —
+      stall ONE rank past a watchdog threshold.
+    - ``nan_at_iteration`` — poison the updater's params with NaN so
+      the NEXT step's loss is non-finite (drives ``FailOnNonNumber``).
+    """
+
+    kill_at_iteration: Optional[int] = None
+    sigterm_at_iteration: Optional[int] = None
+    corrupt_at_iteration: Optional[int] = None
+    corrupt_path: Optional[str] = None
+    corrupt_n_bytes: int = 8
+    delay_at_iteration: Optional[int] = None
+    delay_rank: int = 0
+    delay_seconds: float = 0.0
+    nan_at_iteration: Optional[int] = None
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "FaultPlan":
+        return cls(**json.loads(payload))
+
+
+class FaultInjector:
+    """Trainer extension applying a :class:`FaultPlan`.
+
+    LOWEST priority (runs last on its tick, after log writers and the
+    checkpointer): a kill fires only once everything that tick promised
+    to persist has at least STARTED persisting — which for an async
+    checkpoint write means the signal really lands mid-write.
+    """
+
+    trigger = (1, "iteration")
+    priority = 1
+
+    def __init__(self, plan: FaultPlan, comm=None):
+        self.plan = plan
+        self.comm = comm
+        self.fired: list = []
+
+    def _rank(self) -> int:
+        return getattr(self.comm, "inter_rank", 0) if self.comm else 0
+
+    def __call__(self, trainer) -> None:
+        plan = self.plan
+        it = trainer.updater.iteration
+        if plan.nan_at_iteration == it:
+            import jax
+            import jax.numpy as jnp
+
+            trainer.updater.params = jax.tree.map(
+                lambda a: a * jnp.nan, trainer.updater.params)
+            self.fired.append(("nan", it))
+        if (plan.delay_at_iteration == it
+                and self._rank() == plan.delay_rank):
+            self.fired.append(("delay", it))
+            time.sleep(plan.delay_seconds)
+        if plan.corrupt_at_iteration == it and plan.corrupt_path:
+            corrupt_file(plan.corrupt_path, plan.corrupt_n_bytes,
+                         seed=plan.seed)
+            self.fired.append(("corrupt", it))
+        if plan.sigterm_at_iteration == it:
+            self.fired.append(("sigterm", it))
+            os.kill(os.getpid(), _signal.SIGTERM)
+        if plan.kill_at_iteration == it:
+            # flush stdio so the phase's progress log survives the kill
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.kill(os.getpid(), _signal.SIGKILL)
 
 
 def requires_vma(reason: str = "requires vma-typed shard_map"):

@@ -16,12 +16,18 @@ from chainermn_tpu.utils.profiling import (
     profiled_communicator,
     trace,
 )
-from chainermn_tpu.utils.serialization import load_state, save_state
+from chainermn_tpu.utils.serialization import (
+    SnapshotCorruptError,
+    load_state,
+    save_state,
+    verify_state,
+)
 
 __all__ = [
     "CollectiveStats",
     "ProfileReport",
     "Profiler",
+    "SnapshotCorruptError",
     "axis_collective_report",
     "choose_bucket_bytes",
     "choose_prefetch_depth",
@@ -32,5 +38,6 @@ __all__ = [
     "save_state",
     "stablehlo_collective_stats",
     "trace",
+    "verify_state",
     "wire_bytes_per_device",
 ]

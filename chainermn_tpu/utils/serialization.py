@@ -8,17 +8,36 @@ Format: one ``.npz`` per snapshot holding every leaf as a named array
 Device arrays are pulled to host with ``jax.device_get`` so saving works
 for sharded/replicated params alike (each process saves its addressable
 view — the per-process *shard* file of the multi-node checkpointer).
+
+Integrity: every payload (each leaf's raw bytes and the meta record
+itself) carries a CRC32 recorded inside ``__meta__``, so a torn write
+the atomic rename could not prevent (disk-full, power cut mid-fsync) or
+silent bit rot is DETECTED at load instead of surfacing as an opaque
+npz/pickle error deep inside resume.  :func:`verify_state` probes a file
+without unpickling leaf data into a tree; :func:`load_state` checks the
+same CRCs on its real read path.  Corruption raises the typed
+:class:`SnapshotCorruptError` — the checkpointer's fallback-resume path
+catches exactly that (docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import zlib
 
 import jax
 import numpy as np
 
-__all__ = ["save_state", "load_state"]
+__all__ = ["SnapshotCorruptError", "load_state", "save_state",
+           "verify_state"]
+
+
+class SnapshotCorruptError(RuntimeError):
+    """A snapshot file failed its integrity check (bad CRC, missing
+    leaf, undecodable meta, truncated archive).  Typed so recovery code
+    (``MultiNodeCheckpointer.maybe_load`` fallback) can distinguish
+    "this file is damaged" from programming errors."""
 
 
 def _host_view(x):
@@ -43,6 +62,12 @@ def _host_view(x):
     return x
 
 
+def _leaf_crc(arr: np.ndarray) -> int:
+    # C-contiguous view so the CRC covers the logical values, not an
+    # arbitrary stride pattern (npz round-trips contiguous data anyway)
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
 def save_state(path: str, pytree) -> None:
     """Atomically write ``pytree`` (arrays / numeric scalars) to ``path``."""
     leaves, treedef = jax.tree.flatten(
@@ -51,8 +76,16 @@ def save_state(path: str, pytree) -> None:
     # npz keeps only stock numpy dtypes; ml_dtypes leaves (bfloat16, fp8)
     # come back as raw void records — record true dtypes to view-cast back.
     dtypes = [str(np.asarray(v).dtype) for v in leaves]
-    payload["__meta__"] = np.frombuffer(
-        pickle.dumps({"treedef": treedef, "dtypes": dtypes}), dtype=np.uint8)
+    crcs = [_leaf_crc(payload[f"leaf_{i:05d}"]) for i in range(len(leaves))]
+    meta_bytes = pickle.dumps(
+        {"treedef": treedef, "dtypes": dtypes, "crcs": crcs,
+         "meta_crc_excluded": True})
+    # the meta record guards itself too: its own CRC rides in a separate
+    # tiny array, so a flipped bit inside the pickle is a typed error,
+    # not an unpickling crash
+    payload["__meta__"] = np.frombuffer(meta_bytes, dtype=np.uint8)
+    payload["__meta_crc__"] = np.asarray(
+        [zlib.crc32(meta_bytes) & 0xFFFFFFFF], dtype=np.uint64)
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(tmp, "wb") as f:
@@ -60,16 +93,96 @@ def save_state(path: str, pytree) -> None:
     os.replace(tmp, path)  # atomic on POSIX — no torn snapshots
 
 
+def _read_meta(z, path: str) -> dict:
+    """Decode + integrity-check the ``__meta__`` record of an open npz."""
+    try:
+        meta_arr = z["__meta__"]
+    except Exception as e:
+        raise SnapshotCorruptError(
+            f"{path}: snapshot has no readable __meta__ record "
+            f"({type(e).__name__}: {e})") from e
+    meta_bytes = meta_arr.tobytes()
+    if "__meta_crc__" in getattr(z, "files", ()):
+        want = int(z["__meta_crc__"][0])
+        got = zlib.crc32(meta_bytes) & 0xFFFFFFFF
+        if got != want:
+            raise SnapshotCorruptError(
+                f"{path}: __meta__ CRC mismatch "
+                f"(recorded {want:#010x}, computed {got:#010x})")
+    try:
+        return pickle.loads(meta_bytes)
+    except Exception as e:
+        raise SnapshotCorruptError(
+            f"{path}: __meta__ record does not unpickle "
+            f"({type(e).__name__}: {e})") from e
+
+
+def _checked_leaves(z, meta: dict, path: str):
+    """Yield ``(index, array)`` for every leaf, CRC-checked when the
+    snapshot recorded checksums (older files without ``crcs`` load
+    unchecked — forward-compatible resume)."""
+    crcs = meta.get("crcs")
+    for i in range(len(meta["dtypes"])):
+        key = f"leaf_{i:05d}"
+        try:
+            arr = z[key]
+        except Exception as e:
+            raise SnapshotCorruptError(
+                f"{path}: leaf {i} ({key}) unreadable "
+                f"({type(e).__name__}: {e})") from e
+        if crcs is not None:
+            got = _leaf_crc(arr)
+            if got != crcs[i]:
+                raise SnapshotCorruptError(
+                    f"{path}: leaf {i} CRC mismatch (recorded "
+                    f"{crcs[i]:#010x}, computed {got:#010x}) — "
+                    "shard bytes were corrupted on disk")
+        yield i, arr
+
+
+def verify_state(path: str) -> None:
+    """Integrity probe: raise :class:`SnapshotCorruptError` if ``path``
+    is not a complete, checksum-clean snapshot; return ``None`` when it
+    is.  Reads every payload (same CRC walk as :func:`load_state`) but
+    never unflattens a tree, so it is safe to run on snapshots written
+    by a different model version.
+
+    A MISSING file propagates as ``FileNotFoundError``, not as
+    corruption — callers racing a concurrent GC (the checkpointer's
+    verify pass on a shared filesystem) distinguish "gone" from
+    "damaged": the first is skipped, only the second is quarantined."""
+    try:
+        z = np.load(path, allow_pickle=False)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise SnapshotCorruptError(
+            f"{path}: not a readable npz archive "
+            f"({type(e).__name__}: {e})") from e
+    with z:
+        meta = _read_meta(z, path)
+        for _ in _checked_leaves(z, meta, path):
+            pass
+
+
 def load_state(path: str):
-    """Inverse of :func:`save_state`; returns the restored pytree."""
+    """Inverse of :func:`save_state`; returns the restored pytree.
+    Raises :class:`SnapshotCorruptError` on any integrity failure."""
     import ml_dtypes  # noqa: F401  (registers bfloat16/fp8 with numpy)
 
-    with np.load(path, allow_pickle=False) as z:
-        meta = pickle.loads(z["__meta__"].tobytes())
+    try:
+        z = np.load(path, allow_pickle=False)
+    except FileNotFoundError:
+        raise  # "gone" is not "damaged" — see verify_state
+    except Exception as e:
+        raise SnapshotCorruptError(
+            f"{path}: not a readable npz archive "
+            f"({type(e).__name__}: {e})") from e
+    with z:
+        meta = _read_meta(z, path)
         leaves = []
-        for i, dt in enumerate(meta["dtypes"]):
-            arr = z[f"leaf_{i:05d}"]
-            want = np.dtype(dt)
+        for i, arr in _checked_leaves(z, meta, path):
+            want = np.dtype(meta["dtypes"][i])
             if arr.dtype != want:
                 arr = arr.view(want)
             leaves.append(arr)

@@ -195,7 +195,11 @@ def run_probe(variant="default", timeout=420):
     """One staged init probe under `variant` env; returns the record."""
     env = dict(os.environ)
     env.update(VARIANTS[variant])
-    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+    # the distinctive prefix is the relaunch_babysitter.sh orphan-reap
+    # marker: only init-reparented pythons whose script path carries it
+    # are ever signaled (never unrelated /tmp/tmp*.py on a shared host)
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False,
+                                     prefix="hang_doctor_probe_") as f:
         f.write(_CHILD)
         child_path = f.name
     rec = {"ts": _now(), "variant": variant, "timeout_s": timeout,
@@ -207,29 +211,36 @@ def run_probe(variant="default", timeout=420):
     proc = None
     try:
         # errors="replace": the verbose variant makes the C++ backend
-        # chatty and a stray non-UTF-8 byte must not abort the probe
-        proc = subprocess.Popen([sys.executable, child_path],
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE,
-                                text=True, errors="replace", env=env)
+        # chatty and a stray non-UTF-8 byte must not abort the probe.
+        # The spawn itself lives inside the try: a Popen failure (ENOENT
+        # interpreter, fork EAGAIN) records a spawn-error outcome in the
+        # JSONL instead of crashing without any record (ADVICE r5).
         try:
-            out, err = proc.communicate(timeout=timeout)
-            rec["outcome"] = "ok" if "PROBE_OK" in out else \
-                f"exited rc={proc.returncode}"
-        except subprocess.TimeoutExpired:
-            rec["outcome"] = "timeout"
-            # capture state while the child is still wedged, then kill
-            rec["threads_at_kill"] = _proc_stacks(proc.pid)
-            proc.send_signal(signal.SIGTERM)
+            proc = subprocess.Popen([sys.executable, child_path],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE,
+                                    text=True, errors="replace", env=env)
+        except OSError as e:
+            rec["outcome"] = f"spawn-error {type(e).__name__}: {e}"
+        else:
             try:
-                out, err = proc.communicate(timeout=15)
+                out, err = proc.communicate(timeout=timeout)
+                rec["outcome"] = "ok" if "PROBE_OK" in out else \
+                    f"exited rc={proc.returncode}"
             except subprocess.TimeoutExpired:
-                proc.kill()
-                out, err = proc.communicate()
-        except Exception as e:
-            # still record the probe, and never leak a wedged child
-            # that would keep holding the relay grant
-            rec["outcome"] = f"probe-error {type(e).__name__}: {e}"
+                rec["outcome"] = "timeout"
+                # capture state while the child is still wedged, then kill
+                rec["threads_at_kill"] = _proc_stacks(proc.pid)
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    out, err = proc.communicate(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    out, err = proc.communicate()
+            except Exception as e:
+                # still record the probe, and never leak a wedged child
+                # that would keep holding the relay grant
+                rec["outcome"] = f"probe-error {type(e).__name__}: {e}"
     finally:
         if proc is not None and proc.poll() is None:
             proc.kill()

@@ -26,16 +26,22 @@ descends_from_babysitter() {
 }
 collect_babysitter_descendants() {
     # battery children (bench_*.py) and hang_doctor probe children
-    # (python /tmp/tmpXXXX.py) — but ONLY those spawned by a
-    # babysitter: a blanket bench_* kill once took out the operator's
+    # (python /tmp/hang_doctor_probe_*.py) — but ONLY those spawned by
+    # a babysitter: a blanket bench_* kill once took out the operator's
     # own manual CPU measurement runs.  Collected BEFORE the parent
     # dies: killing bench_session first would reparent its children to
     # init and defeat the ancestry check.  Second clause: a child whose
     # babysitter ALREADY died sits reparented under init and may still
     # hold the axon relay grant, wedging the fresh session's first
-    # probe — reap those too, but spare CPU-pinned runs (the operator's
-    # manual measurements carry "cpu" on their command line and cannot
-    # hold the TPU).
+    # probe — reap those too, but ONLY when the command line carries
+    # this repo's marker: the hang_doctor_probe_ script prefix, this
+    # repo's own battery scripts (bench_session spawns them by bare
+    # name, `python bench_X.py`), or a path inside this repo.  A bare
+    # /tmp/tmp*.py match once risked signaling unrelated Pythons on a
+    # shared host.  CPU-pinned runs stay spared (the operator's manual
+    # measurements carry "cpu" on their command line and cannot hold
+    # the TPU).
+    marker="hang_doctor_probe_|(^|[ /])(bench_[a-z0-9_]*|bench)\.py|$(pwd)/"
     for pid in $(pgrep -f "$1"); do
         comm=$(cat "/proc/$pid/comm" 2>/dev/null)
         [ "$comm" = "python" ] || continue
@@ -43,16 +49,17 @@ collect_babysitter_descendants() {
             echo "$pid"
         else
             ppid=$(awk '{print $4}' "/proc/$pid/stat" 2>/dev/null)
+            cmdline=$(tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null)
             if [ "$ppid" = "1" ] && \
-               ! tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null \
-                   | grep -q 'cpu'; then
+               echo "$cmdline" | grep -Eq "$marker" && \
+               ! echo "$cmdline" | grep -q 'cpu'; then
                 echo "$pid"
             fi
         fi
     done
 }
 DOOMED=$(collect_babysitter_descendants 'bench[_.]'
-         collect_babysitter_descendants '/tmp/tmp.*\.py')
+         collect_babysitter_descendants 'hang_doctor_probe_.*\.py')
 kill_pythons_matching 'bench_session.py'
 for pid in $DOOMED; do kill "$pid" 2>/dev/null; done
 sleep 1

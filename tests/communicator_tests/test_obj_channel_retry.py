@@ -1,0 +1,175 @@
+"""KVObjectChannel transient-error resilience: bounded exponential-
+backoff retries absorb coordination-service flakes, while timeouts keep
+one-shot semantics and sequence counters never desynchronise."""
+
+import pytest
+
+from chainermn_tpu.communicators import _obj_channel
+from chainermn_tpu.communicators._obj_channel import (
+    KVObjectChannel,
+    _is_transient,
+    _kv_delete,
+    _kv_retry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setattr(_obj_channel, "KV_BACKOFF_BASE_S", 0.001)
+    monkeypatch.setattr(_obj_channel, "KV_BACKOFF_MAX_S", 0.002)
+
+
+class _FlakyClient:
+    """In-memory KV store whose verbs fail transiently N times.
+
+    Mirrors the real coordination service's contract: a set on an
+    existing key WITHOUT ``allow_overwrite`` is rejected — so a retried
+    publish whose first attempt landed server-side before the error
+    was reported is exercised honestly, and ``lost_acks`` simulates
+    exactly that (the set is applied, then the transient error is
+    raised anyway)."""
+
+    def __init__(self, fail_first=0, lost_acks=0,
+                 error="UNAVAILABLE: connection reset by peer"):
+        self.store = {}
+        self.fail_first = fail_first
+        self.lost_acks = lost_acks
+        self.calls = 0
+        self.error = error
+
+    def _maybe_fail(self):
+        self.calls += 1
+        if self.calls <= self.fail_first:
+            raise RuntimeError(self.error)
+
+    def _set(self, key, value, allow_overwrite):
+        if key in self.store and not allow_overwrite:
+            raise RuntimeError(f"ALREADY_EXISTS: key {key} already exists")
+        self.store[key] = value
+        if self.lost_acks > 0:
+            self.lost_acks -= 1
+            raise RuntimeError(self.error)
+
+    def key_value_set_bytes(self, key, value, allow_overwrite=False):
+        self._maybe_fail()
+        self._set(key, value, allow_overwrite)
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self._maybe_fail()
+        self._set(key, value, allow_overwrite)
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        self._maybe_fail()
+        if key not in self.store:
+            raise RuntimeError("Deadline Exceeded waiting for key")
+        return self.store[key]
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        self._maybe_fail()
+        if key not in self.store:
+            raise RuntimeError("Deadline Exceeded waiting for key")
+        return self.store[key]
+
+    def key_value_delete(self, key):
+        self._maybe_fail()
+        if key not in self.store:
+            raise RuntimeError(f"NOT_FOUND: key {key} not found")
+        del self.store[key]
+
+
+def _channel(client, monkeypatch):
+    chan = KVObjectChannel(tag="t")
+    monkeypatch.setattr(KVObjectChannel, "_client",
+                        property(lambda self: client))
+    return chan
+
+
+class TestRetryHelpers:
+    def test_transient_markers(self):
+        assert _is_transient(RuntimeError("UNAVAILABLE: try again"))
+        assert _is_transient(RuntimeError("connection reset by peer"))
+        assert not _is_transient(RuntimeError("Deadline Exceeded"))
+        assert not _is_transient(ValueError("bad payload"))
+
+    def test_retry_succeeds_after_transient_failures(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("UNAVAILABLE")
+            return "ok"
+
+        assert _kv_retry(fn, "test") == "ok"
+        assert len(calls) == 3
+
+    def test_retry_bounded(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise RuntimeError("UNAVAILABLE forever")
+
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            _kv_retry(fn, "test")
+        assert len(calls) == _obj_channel.KV_RETRIES + 1
+
+    def test_non_transient_raises_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise RuntimeError("Deadline Exceeded: 120000ms")
+
+        with pytest.raises(RuntimeError, match="Deadline"):
+            _kv_retry(fn, "test")
+        assert len(calls) == 1  # a timeout is NOT multiplied by retries
+
+    def test_delete_tolerates_already_gone(self):
+        client = _FlakyClient()
+        _kv_delete(client, "missing-key")  # must not raise
+
+
+class TestChannelUnderFlakes:
+    def test_send_recv_survives_transient_flakes(self, monkeypatch):
+        client = _FlakyClient(fail_first=2)
+        chan = _channel(client, monkeypatch)
+        chan.send({"x": 41}, src=0, dst=1)
+        # receiving side: same store, fresh flake budget
+        client.fail_first = client.calls + 2
+        assert chan.recv(src=0, dst=1) == {"x": 41}
+        # lane counters advanced exactly once each
+        assert chan._send_seq[(0, 1)] == 1
+        assert chan._recv_seq[(0, 1)] == 1
+        # consumed keys deleted
+        assert not [k for k in client.store if k.startswith("t/0.1.0/")]
+
+    def test_recv_timeout_does_not_advance_lane(self, monkeypatch):
+        client = _FlakyClient()
+        chan = _channel(client, monkeypatch)
+        with pytest.raises(RuntimeError, match="Deadline"):
+            chan.recv(src=0, dst=1)  # nothing published
+        assert chan._recv_seq.get((0, 1), 0) == 0
+        # the retried send still pairs with the retried recv in order
+        chan.send("late", src=0, dst=1)
+        assert chan.recv(src=0, dst=1) == "late"
+
+    def test_publish_whose_first_attempt_landed_still_succeeds(
+            self, monkeypatch):
+        """A set applied server-side before the transient error reaches
+        the client must not turn the retry into a fatal already-exists
+        rejection — the retried write overwrites its own identical
+        value."""
+        client = _FlakyClient(lost_acks=1)
+        chan = _channel(client, monkeypatch)
+        chan.send({"x": 1}, src=0, dst=1)
+        assert chan.recv(src=0, dst=1) == {"x": 1}
+
+    def test_multi_frame_publish_retries(self, monkeypatch):
+        monkeypatch.setattr(_obj_channel, "FRAME_BYTES", 64)
+        client = _FlakyClient(fail_first=3)
+        chan = _channel(client, monkeypatch)
+        payload = list(range(200))  # several 64-byte frames
+        chan.send(payload, src=2, dst=0)
+        client.fail_first = client.calls + 3
+        assert chan.recv(src=2, dst=0) == payload

@@ -79,3 +79,24 @@ def test_validation():
     with pytest.raises(ValueError, match="creation order"):
         BPETokenizer([(999, 1000)])
     assert train_bpe(b"", 300).vocab_size == 256
+
+
+def test_cache_evicts_at_cap_instead_of_freezing():
+    """An adversarial flood of unique chunks must not freeze the merge
+    cache forever: at the cap the oldest entry is evicted, so hot
+    steady-state chunks re-enter the cache after the flood passes."""
+    tok = train_bpe(CORPUS, 300)
+    tok._CACHE_CAP = 8  # instance override: tiny cap for the drill
+    tok._cache.clear()
+    # flood with unique chunks well past the cap
+    for i in range(50):
+        tok.encode(f"unique{i:04d}".encode())
+    assert len(tok._cache) <= 8
+    # a hot chunk used AFTER the flood still gets cached...
+    hot = b"the"
+    before = tok.encode(hot)
+    assert any(hot in k for k in tok._cache), "hot chunk not cached"
+    # ...and repeated encodes hit the memo with identical output
+    assert tok.encode(hot) == before
+    # the eviction preserved correctness for evicted chunks too
+    assert tok.decode(tok.encode(b"unique0001")) == b"unique0001"

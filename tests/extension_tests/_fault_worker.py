@@ -1,0 +1,101 @@
+"""Worker for the kill→resume fault drills: one deterministic training
+job per invocation, driven by a FaultPlan JSON.
+
+    python _fault_worker.py <phase> <workdir> <plan_json>
+
+Phases:
+  ref    — run 6 epochs uninterrupted, write final params to ref.npz
+  train  — run with the fault plan armed (a kill plan means this process
+           dies mid-run; the parent asserts the SIGKILL exit)
+  resume — maybe_load from the checkpoint, finish the 6 epochs, write
+           final params to resumed.npz
+
+``ref`` and ``resume`` must be BITWISE identical — the resilience
+layer's whole claim (docs/RESILIENCE.md).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from chainermn_tpu.testing import ensure_virtual_pod  # noqa: E402
+
+ensure_virtual_pod(8)  # the drill runs on the same mesh as the suite
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import chainermn_tpu as cmn  # noqa: E402
+from chainermn_tpu.extensions import (  # noqa: E402
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.testing import FaultInjector, FaultPlan  # noqa: E402
+from chainermn_tpu.training import LogReport  # noqa: E402
+from chainermn_tpu.utils import save_state  # noqa: E402
+
+
+def _dataset(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = rng.randn(4).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    return [(x[i], y[i]) for i in range(n)]
+
+
+def _loss_fn(params, x, y):
+    import jax.numpy as jnp
+
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _build(comm, workdir):
+    import jax.numpy as jnp
+
+    it = cmn.SerialIterator(_dataset(), batch_size=16, shuffle=True,
+                            seed=5)
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    up = cmn.StandardUpdater(it, opt, _loss_fn, params, comm)
+    trainer = cmn.Trainer(up, stop_trigger=(6, "epoch"),
+                          out=os.path.join(workdir, "out"))
+    log = LogReport(trigger=(1, "epoch"))
+    trainer.extend(log)
+    # sync writes: a SIGKILL one iteration after a save must find that
+    # save durable (async overlap would race the kill — its join-on-
+    # crash path is drilled separately by the SIGTERM-mid-write test).
+    # history=2: the corrupted-latest drill needs the previous complete
+    # set still on disk to fall back to.
+    cp = create_multi_node_checkpointer(
+        comm, os.path.join(workdir, "ckpt"), async_write=False,
+        history=2)
+    # save every 3 iterations — NOT aligned with the 4-iteration epoch,
+    # so the kill lands mid-epoch, mid-shuffle
+    trainer.extend(cp, trigger=(3, "iteration"))
+    return trainer, up, cp, log
+
+
+def main():
+    phase, workdir, plan_json = sys.argv[1], sys.argv[2], sys.argv[3]
+    comm = cmn.create_communicator("tpu_xla")
+    trainer, up, cp, log = _build(comm, workdir)
+    if phase == "train":
+        plan = FaultPlan.from_json(plan_json)
+        trainer.extend(FaultInjector(plan, comm))
+    elif phase == "resume":
+        resumed = cp.maybe_load(up, trainer)
+        print(f"RESUMED_AT {resumed}", flush=True)
+    trainer.run()
+    final = {"params": up.params, "iteration": up.iteration,
+             "log_losses": np.asarray(
+                 [e["main/loss"] for e in log.log], np.float64)}
+    name = {"ref": "ref.npz", "resume": "resumed.npz",
+            "train": "train.npz"}[phase]
+    save_state(os.path.join(workdir, name), final)
+    print(f"PHASE_OK {phase} iter={up.iteration}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

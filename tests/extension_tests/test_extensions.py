@@ -109,7 +109,9 @@ class TestCheckpointer:
                     "params": up.params, "opt_state": up.opt_state})
         loader = create_multi_node_checkpointer(comm, str(tmp_path))
         assert loader._local_iterations() == {9, 99}
-        # simulate a peer that only holds iteration 9
+        # simulate a peer that only holds iteration 9 (presence rides a
+        # set; the later load-verdict allgather is a bool and passes
+        # through the one-element fallback)
         monkeypatch.setattr(
             loader.comm, "allgather_obj",
             lambda obj: [obj, {9}] if isinstance(obj, set) else [obj])

@@ -1,0 +1,203 @@
+"""Fallback resume: a corrupted shard in the newest snapshot set must
+send ``maybe_load`` to the previous complete+verified set, quarantine the
+damaged file as ``*.corrupt`` (never GC-delete it), and log what was
+skipped — on the 8-device CPU mesh (tests/conftest.py)."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.extensions import create_multi_node_checkpointer
+from chainermn_tpu.testing import corrupt_file
+
+
+class FakeUpdater:
+    def __init__(self):
+        self.iteration = 0
+        self.params = {"w": np.zeros(3)}
+        self.opt_state = {"m": np.zeros(3)}
+        self.state = None
+
+
+@pytest.fixture()
+def ckpt(comm, tmp_path):
+    cp = create_multi_node_checkpointer(comm, str(tmp_path))
+    cp._cleanup = lambda keep: None  # keep every set alive for the drills
+    up = FakeUpdater()
+    for it in (5, 10, 15):
+        up.iteration = it
+        up.params = {"w": np.full(3, float(it))}
+        cp.save(up)
+    return cp, tmp_path
+
+
+class TestFallbackResume:
+    def test_corrupt_latest_falls_back(self, comm, ckpt, caplog):
+        _, path = ckpt
+        corrupt_file(str(path / "snapshot_iter_15.0"), seed=1)
+        fresh = FakeUpdater()
+        cp2 = create_multi_node_checkpointer(comm, str(path))
+        with caplog.at_level(logging.WARNING,
+                             "chainermn_tpu.extensions.checkpoint"):
+            assert cp2.maybe_load(fresh) == 10
+        np.testing.assert_allclose(fresh.params["w"], 10.0)
+        # quarantined, not deleted: the bytes stay for diagnosis
+        assert (path / "snapshot_iter_15.0.corrupt").exists()
+        assert not (path / "snapshot_iter_15.0").exists()
+        # and the skip is logged by iteration number
+        assert any("15" in r.message and "fallback" in r.message
+                   for r in caplog.records)
+
+    def test_two_corrupt_sets_fall_back_twice(self, comm, ckpt):
+        _, path = ckpt
+        corrupt_file(str(path / "snapshot_iter_15.0"), seed=1)
+        corrupt_file(str(path / "snapshot_iter_10.0"), seed=2)
+        fresh = FakeUpdater()
+        cp2 = create_multi_node_checkpointer(comm, str(path))
+        assert cp2.maybe_load(fresh) == 5
+        np.testing.assert_allclose(fresh.params["w"], 5.0)
+        assert (path / "snapshot_iter_15.0.corrupt").exists()
+        assert (path / "snapshot_iter_10.0.corrupt").exists()
+
+    def test_all_corrupt_resumes_fresh(self, comm, ckpt, caplog):
+        _, path = ckpt
+        for it in (5, 10, 15):
+            corrupt_file(str(path / f"snapshot_iter_{it}.0"), seed=it)
+        fresh = FakeUpdater()
+        cp2 = create_multi_node_checkpointer(comm, str(path))
+        with caplog.at_level(logging.WARNING,
+                             "chainermn_tpu.extensions.checkpoint"):
+            assert cp2.maybe_load(fresh) is None
+        assert fresh.iteration == 0  # untouched — a true fresh start
+        assert len([f for f in os.listdir(path)
+                    if ".corrupt" in f]) == 3
+        assert any("starting fresh" in r.message for r in caplog.records)
+
+    def test_gc_never_touches_quarantined_files(self, comm, ckpt):
+        cp, path = ckpt
+        corrupt_file(str(path / "snapshot_iter_15.0"), seed=1)
+        fresh = FakeUpdater()
+        cp2 = create_multi_node_checkpointer(comm, str(path))
+        assert cp2.maybe_load(fresh) == 10
+        # next save runs REAL GC (no stub): superseded good shards go,
+        # the quarantined file stays
+        fresh.iteration = 20
+        fresh.params = {"w": np.full(3, 20.0)}
+        cp2.save(fresh)
+        names = sorted(os.listdir(path))
+        assert "snapshot_iter_15.0.corrupt" in names
+        assert "snapshot_iter_10.0" not in names
+        assert "snapshot_iter_20.0" in names
+
+    def test_quarantine_name_collision_gets_suffix(self, comm, tmp_path):
+        cp = create_multi_node_checkpointer(comm, str(tmp_path))
+        up = FakeUpdater()
+        up.iteration = 3
+        cp.save(up)
+        target = tmp_path / "snapshot_iter_3.0"
+        (tmp_path / "snapshot_iter_3.0.corrupt").write_bytes(b"older")
+        q = cp._quarantine(str(target))
+        assert q.endswith(".corrupt1")
+        assert (tmp_path / "snapshot_iter_3.0.corrupt1").exists()
+
+    def test_history_gc_keeps_n_newest_sets(self, comm, tmp_path):
+        """``history=2`` retains the two newest complete sets (the
+        fallback headroom knob); ``history=1`` is the old keep-latest."""
+        cp = create_multi_node_checkpointer(comm, str(tmp_path),
+                                            history=2)
+        up = FakeUpdater()
+        for it in (3, 6, 9):
+            up.iteration = it
+            up.params = {"w": np.full(3, float(it))}
+            cp.save(up)
+        names = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("snapshot"))
+        assert names == ["snapshot_iter_6.0", "snapshot_iter_9.0"]
+
+    def test_history_gc_protects_agreed_sets_not_local_inventory(
+            self, comm, tmp_path, monkeypatch):
+        """With ``history=2`` the protected iterations come from the
+        cross-rank AGREEMENT: a set a (simulated) peer no longer holds —
+        e.g. it quarantined its shard — must not consume a protection
+        slot here, or the ranks would each keep a different pair and no
+        older set would stay complete anywhere."""
+        cp = create_multi_node_checkpointer(comm, str(tmp_path),
+                                            history=2)
+        stash, cp._cleanup = cp._cleanup, lambda keep: None
+        up = FakeUpdater()
+        for it in (5, 10):
+            up.iteration = it
+            cp.save(up)
+        cp._cleanup = stash
+        # simulate a peer whose iteration-10 shard was quarantined: the
+        # presence agreement excludes 10, so protection must fall on
+        # {15, 5} — NOT this rank's local {15, 10}
+        monkeypatch.setattr(
+            cp.comm, "allgather_obj",
+            lambda obj: ([obj, obj - {10}] if isinstance(obj, set)
+                         else [obj]))
+        up.iteration = 15
+        cp.save(up)
+        names = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("snapshot"))
+        assert names == ["snapshot_iter_15.0", "snapshot_iter_5.0"]
+
+    def test_history_gc_never_protects_orphans_newer_than_keep(
+            self, comm, tmp_path):
+        """An orphan shard NEWER than the agreed-complete set (a dead
+        run that got further than this run's resume point) must not
+        consume a history slot — protecting it would evict an older
+        COMPLETE set and destroy the fallback headroom."""
+        cp = create_multi_node_checkpointer(comm, str(tmp_path),
+                                            history=2)
+        up = FakeUpdater()
+        for it in (3, 6):
+            up.iteration = it
+            cp.save(up)
+        # forge a newer orphan (bypassing save), then save the real 9
+        from chainermn_tpu.utils import save_state
+
+        save_state(str(tmp_path / "snapshot_iter_99.0"),
+                   {"iteration": 99, "world_size": 1,
+                    "params": up.params, "opt_state": up.opt_state})
+        up.iteration = 9
+        cp.save(up)
+        names = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("snapshot"))
+        # 99 reaped (never agreed complete), 6 and 9 protected
+        assert names == ["snapshot_iter_6.0", "snapshot_iter_9.0"]
+
+    def test_racing_deletion_falls_back_without_quarantine(
+            self, comm, ckpt, monkeypatch):
+        """A shard that vanishes between the inventory listing and its
+        checked load (a peer's concurrent GC on a shared filesystem) is
+        treated as unavailable — resume falls back, and nothing is
+        misread as corruption (no ``*.corrupt`` appears)."""
+        import chainermn_tpu.extensions.checkpoint as ckpt_mod
+
+        _, path = ckpt
+        cp2 = create_multi_node_checkpointer(comm, str(path))
+        real_load = ckpt_mod.load_state
+
+        def racing_load(p):
+            if p.endswith("snapshot_iter_15.0"):
+                os.remove(p)  # the race: file disappears underneath us
+            return real_load(p)
+
+        monkeypatch.setattr(ckpt_mod, "load_state", racing_load)
+        fresh = FakeUpdater()
+        assert cp2.maybe_load(fresh) == 10
+        np.testing.assert_allclose(fresh.params["w"], 10.0)
+        assert not any(".corrupt" in f for f in os.listdir(path))
+
+    def test_clean_sets_resume_unchanged(self, comm, ckpt):
+        """No corruption → identical behaviour to the old presence-only
+        agreement (newest set restores)."""
+        _, path = ckpt
+        fresh = FakeUpdater()
+        cp2 = create_multi_node_checkpointer(comm, str(path))
+        assert cp2.maybe_load(fresh) == 15
+        np.testing.assert_allclose(fresh.params["w"], 15.0)

@@ -1,0 +1,193 @@
+"""Deterministic fault-injection drills (chainermn_tpu.testing.FaultPlan):
+
+- SIGKILL at iteration N in a REAL subprocess, then resume — the
+  continued run must be bitwise-identical to an uninterrupted one
+  (params AND the per-epoch loss log);
+- kill + corrupt-the-latest-shard composed: resume falls back to the
+  previous verified set and STILL finishes bitwise-identical;
+- SIGTERM mid-async-write rides the PreemptionCheckpointer (in-process);
+- NaN injection drives FailOnNonNumber;
+- delay-rank drives the watchdog.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.testing import FaultInjector, FaultPlan
+from chainermn_tpu.utils import load_state
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_fault_worker.py")
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _run_phase(phase, workdir, plan=None, expect_kill=False, timeout=240):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("TPU_", "LIBTPU", "PJRT_", "JAX_", "XLA_")):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    plan_json = (plan or FaultPlan()).to_json()
+    proc = subprocess.run(
+        [sys.executable, _WORKER, phase, str(workdir), plan_json],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO_ROOT)
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL death, got rc={proc.returncode}\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    else:
+        assert proc.returncode == 0, (
+            f"phase {phase} failed rc={proc.returncode}\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return proc
+
+
+def _final_state(workdir, name):
+    st = load_state(os.path.join(str(workdir), name))
+    return st
+
+
+@pytest.mark.slow
+class TestKillResumeBitwise:
+    def test_kill_then_resume_matches_uninterrupted(self, tmp_path):
+        ref_dir, kill_dir = tmp_path / "ref", tmp_path / "kill"
+        ref_dir.mkdir(), kill_dir.mkdir()
+        _run_phase("ref", ref_dir)
+        # epoch = 4 iterations, checkpoints at 3,6,9,...; kill at 10 →
+        # resume restores iteration 9, mid-epoch and mid-shuffle
+        proc = _run_phase("train", kill_dir,
+                          FaultPlan(kill_at_iteration=10),
+                          expect_kill=True)
+        assert "PHASE_OK" not in proc.stdout  # really died mid-run
+        out = _run_phase("resume", kill_dir)
+        assert "RESUMED_AT 9" in out.stdout
+        ref = _final_state(ref_dir, "ref.npz")
+        got = _final_state(kill_dir, "resumed.npz")
+        assert int(got["iteration"]) == int(ref["iteration"]) == 24
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(
+                np.asarray(got["params"][k]), np.asarray(ref["params"][k]),
+                err_msg=f"resumed {k} differs from uninterrupted run")
+        np.testing.assert_array_equal(
+            np.asarray(got["log_losses"]), np.asarray(ref["log_losses"]),
+            err_msg="resumed loss log differs bitwise")
+
+    def test_kill_plus_corrupt_latest_falls_back_and_matches(
+            self, tmp_path):
+        """The full corruption drill: kill at 10 (checkpoint 9 is the
+        newest set), flip bytes in that newest shard, resume — fallback
+        restores iteration 6 and the finished run is STILL bitwise-equal
+        to the uninterrupted one."""
+        from chainermn_tpu.testing import corrupt_file
+
+        ref_dir, kill_dir = tmp_path / "ref", tmp_path / "kill"
+        ref_dir.mkdir(), kill_dir.mkdir()
+        _run_phase("ref", ref_dir)
+        _run_phase("train", kill_dir, FaultPlan(kill_at_iteration=10),
+                   expect_kill=True)
+        newest = kill_dir / "ckpt" / "snapshot_iter_9.0"
+        assert newest.exists()
+        corrupt_file(str(newest), seed=4)
+        out = _run_phase("resume", kill_dir)
+        assert "RESUMED_AT 6" in out.stdout
+        assert (kill_dir / "ckpt" / "snapshot_iter_9.0.corrupt").exists()
+        ref = _final_state(ref_dir, "ref.npz")
+        got = _final_state(kill_dir, "resumed.npz")
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(
+                np.asarray(got["params"][k]), np.asarray(ref["params"][k]))
+        np.testing.assert_array_equal(
+            np.asarray(got["log_losses"]), np.asarray(ref["log_losses"]))
+
+
+class TestInProcessFaults:
+    def _make_trainer(self, comm, out, epochs=50):
+        import jax
+        import optax
+
+        from chainermn_tpu.models import (init_mlp, mlp_apply,
+                                          softmax_cross_entropy)
+
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(6).astype(np.float32), np.int32(i % 3))
+                for i in range(64)]
+        it = cmn.SerialIterator(data, 16, shuffle=True, seed=3)
+        params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        upd = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+        return cmn.Trainer(upd, (epochs, "epoch"), out=str(out))
+
+    def test_sigterm_mid_async_write_checkpoints_cleanly(self, comm,
+                                                         tmp_path):
+        """FaultPlan.sigterm_at_iteration composes with the preemption
+        path: the injector (lowest priority) fires AFTER the async
+        checkpointer started its write, the trapped SIGTERM sets the
+        preemption flag, and the job stops with a complete, loadable
+        snapshot."""
+        from chainermn_tpu.extensions import (
+            PreemptionCheckpointer,
+            create_multi_node_checkpointer,
+        )
+
+        trainer = self._make_trainer(comm, tmp_path)
+        cp = create_multi_node_checkpointer(comm, str(tmp_path),
+                                            async_write=True)
+        pre = PreemptionCheckpointer(cp, comm, signals=(signal.SIGTERM,))
+        trainer.extend(cp, trigger=(4, "iteration"))
+        trainer.extend(pre)
+        inj = FaultInjector(FaultPlan(sigterm_at_iteration=4), comm)
+        trainer.extend(inj)
+        trainer.run()
+        assert ("sigterm", 4) in inj.fired
+        assert "preemption" in trainer.stop_reason
+        # the shard is complete and loadable NOW (writer joined)
+        cp2 = create_multi_node_checkpointer(comm, str(tmp_path))
+        t2 = self._make_trainer(comm, tmp_path)
+        assert cp2.maybe_load(t2.updater, t2) in (4, 5)
+
+    def test_nan_injection_trips_fail_on_non_number(self, comm, tmp_path):
+        from chainermn_tpu.extensions import FailOnNonNumber
+
+        trainer = self._make_trainer(comm, tmp_path)
+        trainer.extend(FailOnNonNumber())
+        trainer.extend(FaultInjector(FaultPlan(nan_at_iteration=3), comm))
+        with pytest.raises(RuntimeError, match="non-finite"):
+            trainer.run()
+        assert trainer.updater.iteration == 4  # NaN surfaced next step
+
+    def test_delay_rank_trips_watchdog(self, comm, tmp_path):
+        """The watchdog drill: one injected stall past the threshold
+        produces a stall report within one check interval."""
+        from chainermn_tpu.extensions import TrainingWatchdog
+
+        trainer = self._make_trainer(comm, tmp_path, epochs=3)
+        reports = []
+        wd = TrainingWatchdog(stall_timeout=0.3, check_interval=0.1,
+                              comm=comm, on_stall=reports.append)
+        trainer.extend(wd)
+        inj = FaultInjector(
+            FaultPlan(delay_at_iteration=5, delay_rank=0,
+                      delay_seconds=0.8), comm)
+        trainer.extend(inj)
+        t0 = time.monotonic()
+        trainer.run()
+        assert ("delay", 5) in inj.fired
+        assert wd.stall_count >= 1
+        assert reports[0]["kind"] == "local-stall"
+        assert reports[0]["iteration"] == 5
+        # fired DURING the stall (within one interval of the threshold),
+        # not after the run ended
+        assert time.monotonic() - t0 > 0.8

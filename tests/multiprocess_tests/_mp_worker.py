@@ -192,6 +192,108 @@ def scenario_checkpoint(comm):
     comm.barrier()
 
 
+def scenario_fallback_resume(comm):
+    """Corruption drill across REAL processes: flip bytes in ONE rank's
+    newest shard — the verified-set agreement must fall back to the
+    previous complete set on EVERY process, and the damaged file must be
+    quarantined (``*.corrupt``), not deleted."""
+    from chainermn_tpu import create_multi_node_checkpointer
+    from chainermn_tpu.testing import corrupt_file
+
+    class FakeUpdater:
+        def __init__(self):
+            self.iteration = 0
+            self.params = {"w": np.zeros(3)}
+            self.opt_state = {"m": np.zeros(3)}
+            self.state = None
+
+    path = comm.bcast_obj(
+        tempfile.mkdtemp(prefix="cmn_fbck_") if comm.inter_rank == 0
+        else None, root=0)
+    cp = create_multi_node_checkpointer(comm, path, history=2)
+    up = FakeUpdater()
+    for it in (5, 10):
+        up.iteration = it
+        up.params = {"w": np.full(3, float(it))}
+        cp.save(up)
+    # wreck iteration 10's BYTES on process 1 only (the file still
+    # exists — presence-based agreement alone would wrongly pick 10)
+    if comm.inter_rank == 1:
+        corrupt_file(os.path.join(path, "snapshot_iter_10.1"), seed=7)
+    comm.barrier()
+    fresh = FakeUpdater()
+    cp2 = create_multi_node_checkpointer(comm, path, history=2)
+    resumed = cp2.maybe_load(fresh)
+    assert resumed == 5, f"expected fallback to 5, got {resumed}"
+    np.testing.assert_allclose(fresh.params["w"], 5.0)
+    if comm.inter_rank == 1:
+        assert os.path.exists(
+            os.path.join(path, "snapshot_iter_10.1.corrupt"))
+        assert not os.path.exists(
+            os.path.join(path, "snapshot_iter_10.1"))
+    else:
+        # the healthy rank keeps its (verified) iteration-10 shard
+        assert os.path.exists(
+            os.path.join(path, f"snapshot_iter_10.{comm.inter_rank}"))
+    comm.barrier()
+
+
+def _kv_barrier(comm, channel):
+    """Coordination-service barrier: works wherever the JAX distributed
+    runtime does, including hosts whose CPU backend cannot run
+    cross-process XLA collectives (which is also why the watchdog's own
+    heartbeats ride the KV store, not a collective)."""
+    channel.allgather(None, list(range(comm.inter_size)),
+                      comm.inter_rank)
+
+
+def scenario_watchdog_stall(comm):
+    """Watchdog drill across REAL processes: rank 1 stalls past the
+    threshold.  Its OWN monitor fires a local-stall report (stack dump +
+    JSON) within one check interval, and the SURVIVOR (rank 0) detects
+    the dead peer through the cross-process KV heartbeats.  Deliberately
+    touches NO XLA collectives — failure detection must keep working
+    exactly when the data plane is wedged."""
+    import time
+
+    from chainermn_tpu.communicators._obj_channel import KVObjectChannel
+    from chainermn_tpu.extensions import TrainingWatchdog
+
+    chan = KVObjectChannel(tag="wdtest")
+    r = comm.inter_rank
+    reports = []
+    wd = TrainingWatchdog(
+        stall_timeout=1.0, check_interval=0.25, comm=comm,
+        on_stall=reports.append,
+        report_path=os.path.join(tempfile.mkdtemp(), "stall.json"))
+    wd.start()
+    for i in range(4):          # healthy phase: everyone beats
+        wd.heartbeat(iteration=i)
+        time.sleep(0.15)
+    assert not reports, f"false positive during healthy phase: {reports}"
+    _kv_barrier(comm, chan)
+    t0 = time.monotonic()
+    if r == 1:
+        time.sleep(2.6)         # the stalled rank: beats stop
+    else:
+        while time.monotonic() - t0 < 2.6:
+            wd.heartbeat(iteration=99)
+            time.sleep(0.15)
+    wd.stop()
+    if r == 1:
+        local = [rep for rep in reports if rep["kind"] == "local-stall"]
+        assert local, f"stalled rank never self-reported: {reports}"
+        assert local[0]["seconds_since_heartbeat"] > 1.0
+        assert local[0]["threads"], "report carries no thread stacks"
+        assert os.path.exists(wd.report_path)
+    else:
+        peer = [rep for rep in reports if 1 in rep["stalled_peers"]]
+        assert peer, (
+            f"survivor never detected the stalled peer: {reports}")
+        assert peer[0]["peer_heartbeat_ages_s"][1] > 1.0
+    _kv_barrier(comm, chan)
+
+
 def scenario_checkpoint_async(comm):
     """Async checkpointer across real processes: overlapped writes, the
     join-then-barrier GC ordering, and resume agreement."""

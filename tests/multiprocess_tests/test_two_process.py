@@ -28,6 +28,16 @@ class TestTwoProcess:
     def test_checkpoint_async(self, mp_run):
         mp_run("checkpoint_async")
 
+    def test_fallback_resume(self, mp_run):
+        # one rank's shard bytes flipped -> every process falls back to
+        # the previous verified set; damaged file quarantined
+        mp_run("fallback_resume")
+
+    def test_watchdog_stall(self, mp_run):
+        # rank 1 stalls past the threshold: self-report + survivor
+        # detection through the cross-process KV heartbeats
+        mp_run("watchdog_stall", timeout=240)
+
     def test_evaluator_averaging(self, mp_run):
         mp_run("evaluator")
 

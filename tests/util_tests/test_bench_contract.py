@@ -161,8 +161,11 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
      ["--batch", "64", "--dim", "32", "--hidden", "64",
       "--host-delay-ms", "3", "--depth", "2", "--warmup", "1",
       "--iters", "4", "--rounds", "1"], "x"),
+    ("bench_resilience.py",
+     ["--batch", "64", "--dim", "32", "--hidden", "64", "--warmup", "1",
+      "--iters", "4", "--rounds", "1"], "%"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
-        "fused_allreduce", "pipeline"])
+        "fused_allreduce", "pipeline", "resilience"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

@@ -88,6 +88,56 @@ def test_verdict_precedence():
     assert "older probes" in hang_doctor._verdict([], 0, total=5)
 
 
+def test_spawn_failure_records_spawn_error(tmp_path, monkeypatch):
+    """A Popen failure (ENOENT interpreter, fork EAGAIN) must still
+    append a JSONL record with a spawn-error outcome instead of crashing
+    run_probe without any trace (ADVICE r5)."""
+    import os
+    import subprocess
+
+    jsonl = tmp_path / "d.jsonl"
+    monkeypatch.setattr(hang_doctor, "JSONL", str(jsonl))
+    monkeypatch.setattr(hang_doctor, "tcp_precheck", lambda: {})
+
+    spawned = {}
+
+    def boom(cmd, *a, **k):
+        spawned["child"] = cmd[-1]
+        raise FileNotFoundError(2, "No such file or directory",
+                                "definitely-not-python")
+
+    monkeypatch.setattr(subprocess, "Popen", boom)
+    rec = hang_doctor.run_probe("default", timeout=5)
+    assert rec["outcome"].startswith("spawn-error FileNotFoundError")
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert len(lines) == 1 and lines[0]["outcome"] == rec["outcome"]
+    # THIS probe's temp child script was still cleaned up (only ours —
+    # a concurrent real probe's script may legitimately exist in /tmp)
+    assert not os.path.exists(spawned["child"]), spawned
+
+
+def test_probe_child_script_carries_reaper_marker(tmp_path, monkeypatch):
+    """The probe's temp script name carries the distinctive marker
+    relaunch_babysitter.sh keys its orphan reaping on — a bare
+    /tmp/tmp*.py must never be the only identity."""
+    import subprocess
+
+    monkeypatch.setattr(hang_doctor, "JSONL", str(tmp_path / "d.jsonl"))
+    monkeypatch.setattr(hang_doctor, "tcp_precheck", lambda: {})
+    seen = {}
+
+    def fake_popen(cmd, **k):
+        seen["script"] = cmd[-1]
+        raise FileNotFoundError(2, "stop here")
+
+    monkeypatch.setattr(subprocess, "Popen", fake_popen)
+    hang_doctor.run_probe("default", timeout=5)
+    assert "hang_doctor_probe_" in seen["script"]
+    # and the babysitter greps for exactly that marker
+    sh = open(hang_doctor.REPO + "/relaunch_babysitter.sh").read()
+    assert "hang_doctor_probe_" in sh
+
+
 def test_summarize_window_and_malformed_lines(tmp_path, monkeypatch):
     jsonl = tmp_path / "d.jsonl"
     summary = tmp_path / "d.json"
