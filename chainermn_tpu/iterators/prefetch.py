@@ -320,7 +320,10 @@ class PrefetchIterator:
         sharding placement and the divisibility policy.
       converter: batch → tuple of host arrays; default a
         :class:`StagingConverter` with ``depth + 3`` buffers.
-      steps_per_execution: fused window size (matches the updater's).
+      steps_per_execution: fused window size — the updater wires its
+        FULL dispatch window here, ``steps_per_execution ×
+        accum_steps`` when gradient accumulation is on (the feed is
+        agnostic to how the window splits into optimiser updates).
       depth: slot-ring length — windows prefetched ahead.  See
         ``utils.comm_model.choose_prefetch_depth``.
       drop_remainder: the divisibility policy switch.

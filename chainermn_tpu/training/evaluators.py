@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -26,7 +27,16 @@ __all__ = ["Evaluator", "create_multi_node_evaluator",
 
 class Evaluator:
     """Runs ``metrics_fn(params, *batch) -> dict`` over a non-repeating
-    iterator and averages per-batch metric dicts (weighted by batch size)."""
+    iterator and averages per-batch metric dicts (weighted by batch
+    size).
+
+    Contract: each metric scalar must be the unweighted MEAN over the
+    batch rows.  Both the cross-batch weighting here and the padded
+    remainder step's real-row recovery (``_get_remainder_step``) are
+    exact only under that linearity; a metric that weights rows
+    internally (e.g. token-count-normalised loss over ragged rows)
+    needs its numerator and denominator reported as separate mean
+    metrics and combined after ``evaluate``."""
 
     trigger = (1, "epoch")
     priority = 80
@@ -42,6 +52,9 @@ class Evaluator:
         self._metrics_fn = metrics_fn
         self._step_cache = {}
         self._batch_sharding = NamedSharding(comm.mesh, P(comm.axis_name))
+        # remainder rows (b mod world) never exceed world - 1: one fixed
+        # bucket shape covers every possible tail
+        self._rem_bucket = max(comm.size - 1, 1)
 
     def _get_eval_step(self, n_batch_args: int):
         if n_batch_args in self._step_cache:
@@ -64,16 +77,57 @@ class Evaluator:
 
     def _get_remainder_step(self, n_batch_args: int):
         """Unsharded eval step for batch rows that don't divide the world
-        size — evaluated replicated on one logical device so that every
-        validation example contributes (the reference evaluated all
-        examples; dropping the remainder would make metrics a function of
-        batch divisibility)."""
+        size — evaluated replicated so that every validation example
+        contributes (the reference evaluated all examples; dropping the
+        remainder would make metrics a function of batch divisibility).
+
+        The tail arrives PADDED to the fixed ``world - 1`` bucket (pad
+        rows are copies of row 0), so every possible remainder length
+        shares ONE executable — the bare ``jit(metrics_fn)`` it replaces
+        retraced for each distinct tail length, a fresh XLA compile per
+        epoch-end shape (evaluation now compiles at most twice per batch
+        arity: the sharded main step plus this bucket).  The real-row
+        weighting recovers the unpadded means exactly: ``metrics_fn``
+        returns batch-MEAN scalars (the contract ``evaluate`` already
+        leans on when it weights per-batch dicts by batch size), so with
+        ``r`` real rows in a bucket of ``T``,
+
+            ``m_real = (T·m_padded − (T−r)·m_row0) / r``
+
+        where ``m_row0`` — the metrics of a bucket filled with row 0,
+        exactly the padding's contribution — comes from a second call of
+        the SAME shape inside the jitted step (no extra executable).
+        """
         key = ("rem", n_batch_args)
         if key in self._step_cache:
             return self._step_cache[key]
-        fn = jax.jit(self._metrics_fn)
+        metrics_fn = self._metrics_fn
+
+        def padded_metrics(params, n_real, *batch):
+            total = batch[0].shape[0]
+            m_pad = metrics_fn(params, *batch)
+            row0 = tuple(jnp.broadcast_to(a[:1], a.shape) for a in batch)
+            m_row0 = metrics_fn(params, *row0)
+            n_fill = total - n_real
+            return {k: (total * m_pad[k] - n_fill * m_row0[k]) / n_real
+                    for k in m_pad}
+
+        fn = jax.jit(padded_metrics)
         self._step_cache[key] = fn
         return fn
+
+    def _pad_remainder(self, rem):
+        """Pad tail columns to the fixed bucket with copies of row 0."""
+        bucket = self._rem_bucket
+        r = rem[0].shape[0]
+        if r == bucket:
+            return rem
+        return tuple(
+            np.concatenate(
+                [np.asarray(a),
+                 np.broadcast_to(np.asarray(a[:1]),
+                                 (bucket - r,) + tuple(a.shape[1:]))])
+            for a in rem)
 
     def evaluate(self, params) -> Dict[str, float]:
         if getattr(self.iterator, "repeat", False):
@@ -97,8 +151,9 @@ class Evaluator:
                     totals[k] = totals.get(k, 0.0) + float(v) * keep
                 weight += keep
             if keep < b:
-                rem = tuple(a[keep:] for a in arrays)
-                m = self._get_remainder_step(len(rem))(params, *rem)
+                rem = self._pad_remainder(tuple(a[keep:] for a in arrays))
+                m = self._get_remainder_step(len(rem))(
+                    params, np.float32(b - keep), *rem)
                 for k, v in m.items():
                     totals[k] = totals.get(k, 0.0) + float(v) * (b - keep)
                 weight += b - keep

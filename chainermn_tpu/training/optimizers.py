@@ -131,7 +131,11 @@ def _grad_accumulation(
     zero1 shards), so the accumulator is replication-typed (or
     shard-width); the value of accumulation is the ``every``×-larger
     global batch under fixed HBM — the cross-replica collectives still
-    run per micro-step.
+    run per micro-step.  For the window-fused variant that also cuts
+    collectives (and wire bytes) by ``every``×, use
+    ``StandardUpdater(accum_steps=...)`` instead: the updater scans
+    LOCAL microbatch gradients and lets this optimizer stack's reducer
+    fire once per window.
     """
 
     def init(params):
@@ -451,7 +455,12 @@ def create_multi_node_optimizer(
         so it holds *reduced* (replication-typed) grads — carryable with
         plain replicated out_specs in every regime — and, under zero1,
         1/world-width shards.  Double buffering composes at the emit
-        level (staleness counts real updates, not micro-steps).
+        level (staleness counts real updates, not micro-steps).  NOTE:
+        the collectives still fire per micro-step here; prefer
+        ``StandardUpdater(accum_steps=...)`` (window-fused exchange,
+        M→1 collectives per window) unless grads really do arrive one
+        external call at a time.  Don't stack both: each would divide
+        by its own window.
       allreduce_grad_dtype: wire dtype for the mean (bf16 recommended).
       fused: pack the grad pytree into flat dtype-grouped buckets and
         reduce one bucket per collective

@@ -119,6 +119,39 @@ class StandardUpdater:
         ``prefetch`` (one computing + one dispatched behind it), else 1
         (each update waits for its predecessor — the natural async-
         dispatch overlap, now measured instead of destroyed).
+      accum_steps: microbatched gradient accumulation with a
+        window-fused exchange.  Each optimiser update consumes
+        ``accum_steps`` microbatches inside ONE jitted donated-carry
+        scan: every microbatch runs forward/backward on its *local*
+        shard only (no per-microbatch cross-replica traffic — the mean
+        moves OUT of the differentiated loss), local gradients
+        accumulate in ``accum_dtype``, and the single window-end
+        exchange happens inside the multi-node optimiser —
+        ``cross_replica_mean``'s fused bucketed all-reduce (bf16 wire /
+        hierarchical 2-stage exactly as configured there), or ZeRO-1's
+        reduce-scatter/all-gather pair — so collective launches and
+        wire bytes drop by ``accum_steps``× while the effective global
+        batch grows by the same factor under fixed HBM.
+        Correctness-equivalent to a single ``accum_steps``×-larger
+        batch (equal-sized microbatches; mean of means).  The optimizer
+        MUST be a multi-node one (``create_multi_node_optimizer``): in
+        this mode its reducer is the ONLY gradient exchange, not a
+        safety net.  ``iteration`` keeps counting microbatches (epoch
+        arithmetic is the iterator's), so triggers fire on data
+        consumed; parameters move once per ``accum_steps`` iterations.
+        Composes multiplicatively with ``steps_per_execution``: one
+        dispatch carries ``steps_per_execution × accum_steps``
+        microbatches (``steps_per_execution`` optimiser updates).  A
+        stateful ``loss_fn`` still updates (and, per its contract,
+        cross-replica reduces) model state every microbatch.
+        ``utils.comm_model.choose_accum_steps`` picks a principled M;
+        ``utils.comm_model.assert_accum_collectives`` proves the M→1
+        collective count from the compiled HLO.  See docs/PIPELINE.md.
+      accum_dtype: gradient accumulator dtype (default float32 — wider
+        than bf16 params so M summed microbatch grads don't lose
+        mantissa).  The accumulated mean is cast back to each param
+        leaf's dtype before the exchange, so the wire format is
+        unchanged.
 
     Timing observations (``utils.profiling`` names in parentheses):
     ``main/host_time`` (``updater/host_time``) is iterator pull +
@@ -150,6 +183,8 @@ class StandardUpdater:
         steps_per_execution: int = 1,
         prefetch: int = 0,
         max_inflight: Optional[int] = None,
+        accum_steps: int = 1,
+        accum_dtype=None,
     ):
         self.optimizer = optimizer
         self.comm = comm
@@ -159,6 +194,15 @@ class StandardUpdater:
         if steps_per_execution < 1:
             raise ValueError("steps_per_execution must be >= 1")
         self.steps_per_execution = steps_per_execution
+        if accum_steps < 1:
+            raise ValueError("accum_steps must be >= 1")
+        self.accum_steps = accum_steps
+        self.accum_dtype = jnp.dtype(
+            accum_dtype if accum_dtype is not None else jnp.float32)
+        # one dispatch = steps_per_execution optimizer updates, each
+        # consuming accum_steps microbatches: the window the feed
+        # (serial or prefetched) assembles and stacks
+        self.window_steps = steps_per_execution * accum_steps
 
         self.prefetch = 2 if prefetch is True else int(prefetch or 0)
         if self.prefetch < 0:
@@ -169,12 +213,12 @@ class StandardUpdater:
             # serial converter path
             self.prefetch = iterator.depth
         if isinstance(converter, StagingConverter) and \
-                converter._n_buffers < steps_per_execution + 1:
+                converter._n_buffers < self.window_steps + 1:
             raise ValueError(
                 f"StagingConverter(n_buffers={converter._n_buffers}) "
-                f"cannot hold a steps_per_execution="
-                f"{steps_per_execution} window (needs >= "
-                f"steps_per_execution + 1 buffers)")
+                f"cannot hold a steps_per_execution × accum_steps = "
+                f"{self.window_steps} window (needs >= window + 1 "
+                f"buffers)")
         if max_inflight is None:
             max_inflight = 2 if self.prefetch else 1
         if max_inflight < 1:
@@ -186,11 +230,12 @@ class StandardUpdater:
                 # a pre-built prefetcher must agree with this updater's
                 # window contract, or training silently runs a different
                 # schedule than the constructor arguments claim
-                if iterator._n_steps != steps_per_execution:
+                if iterator._n_steps != self.window_steps:
                     raise ValueError(
                         f"PrefetchIterator was built with steps_per_"
                         f"execution={iterator._n_steps}, updater wants "
-                        f"{steps_per_execution}")
+                        f"a {self.window_steps}-deep window "
+                        f"(steps_per_execution × accum_steps)")
                 if iterator._drop_remainder != drop_remainder:
                     raise ValueError(
                         "PrefetchIterator and updater disagree on "
@@ -204,7 +249,7 @@ class StandardUpdater:
                     # sized for the ring; an explicit converter is kept
                     converter=(None if converter is default_converter
                                else converter),
-                    steps_per_execution=steps_per_execution,
+                    steps_per_execution=self.window_steps,
                     depth=self.prefetch,
                     drop_remainder=drop_remainder)
         else:
@@ -234,11 +279,13 @@ class StandardUpdater:
         self._stacked_sharding = NamedSharding(
             comm.mesh, P(None, comm.axis_name))
 
-    def _get_step(self, n_batch_args: int, n_steps: int = 1):
-        """Jitted SPMD step, built per batch arity (x,) vs (x, y) vs ...
-        and per fused window size ``n_steps`` (see ``steps_per_execution``;
-        batch arrays then carry a leading ``n_steps`` axis)."""
-        key = (n_batch_args, n_steps)
+    def _get_step(self, n_batch_args: int, n_steps: int = 1,
+                  accum: int = 1):
+        """Jitted SPMD step, built per batch arity (x,) vs (x, y) vs ...,
+        per fused window size ``n_steps`` (see ``steps_per_execution``)
+        and per accumulation depth ``accum`` (see ``accum_steps``; batch
+        arrays then carry a leading ``n_steps * accum`` axis)."""
+        key = (n_batch_args, n_steps, accum)
         if key in self._step_cache:
             return self._step_cache[key]
         ax = self.comm.axis_name
@@ -246,6 +293,8 @@ class StandardUpdater:
 
         stateful = self.state is not None
         zero1 = self.zero1
+        accum_dtype = self.accum_dtype
+        from chainermn_tpu.parallel._compat import pcast as _pcast
 
         def step(carry, *batch):
             params, state, opt_state = carry
@@ -255,19 +304,69 @@ class StandardUpdater:
                 # update, restack for the carry (zero1_init convention)
                 opt_state = jax.tree.map(lambda s: s[0], opt_state)
 
-            def global_loss(p):
-                # pmean INSIDE the differentiated function: with replicated
-                # params, shard_map's AD already psums cotangents across the
-                # axis, so differentiating the pmean'd loss yields exactly
-                # the global-mean gradient (no separate grad allreduce op —
-                # this is where ChainerMN's multi_node_mean_grad went).
-                if stateful:
-                    loss, new_model_state = loss_fn(p, state, *batch)
-                    return jax.lax.pmean(loss, ax), new_model_state
-                return jax.lax.pmean(loss_fn(p, *batch), ax), state
+            if accum == 1:
+                def global_loss(p):
+                    # pmean INSIDE the differentiated function: the
+                    # reported loss is the global mean, and on vma-typed
+                    # jax shard_map's AD psums the cotangents of the
+                    # replicated params so grads leave as the global
+                    # mean too.  On pre-vma jax grads leave device-local
+                    # instead — either way the multi-node optimizer's
+                    # idempotent cross_replica_mean / ZeRO
+                    # reduce-scatter settles the exchange (this is where
+                    # ChainerMN's multi_node_mean_grad went).
+                    if stateful:
+                        loss, new_model_state = loss_fn(p, state, *batch)
+                        return jax.lax.pmean(loss, ax), new_model_state
+                    return jax.lax.pmean(loss_fn(p, *batch), ax), state
 
-            (loss, new_model_state), grads = jax.value_and_grad(
-                global_loss, has_aux=True)(params)
+                (loss, new_model_state), grads = jax.value_and_grad(
+                    global_loss, has_aux=True)(params)
+            else:
+                # Microbatch accumulation: a donated-carry scan of LOCAL
+                # forward/backward passes — no collective of any kind
+                # inside the loop body (assert_accum_collectives pins
+                # this on the compiled HLO).  Differentiating the raw
+                # local loss (the mean moved OUT of the differentiated
+                # function) keeps cotangents device-local; on vma-typed
+                # jax the pcast makes that explicit by differentiating
+                # w.r.t. the varying retype of params (identity pre-vma).
+                p_local = jax.tree.map(
+                    lambda x: _pcast(x, ax, to="varying"), params)
+
+                def micro(mcarry, mb):
+                    acc, st = mcarry
+
+                    def local_loss(p):
+                        if stateful:
+                            loss, new_st = loss_fn(p, st, *mb)
+                            return loss, new_st
+                        return loss_fn(p, *mb), st
+
+                    (mloss, new_st), g = jax.value_and_grad(
+                        local_loss, has_aux=True)(p_local)
+                    # accumulate in accum_dtype (fp32 default): M summed
+                    # bf16 microbatch grads would lose low-order bits
+                    acc = jax.tree.map(
+                        lambda a, gg: a + gg.astype(a.dtype), acc, g)
+                    return (acc, new_st), mloss
+
+                acc0 = jax.tree.map(
+                    lambda p: _pcast(jnp.zeros(p.shape, accum_dtype),
+                                     ax, to="varying"), params)
+                (acc, new_model_state), micro_losses = jax.lax.scan(
+                    micro, (acc0, state), batch)
+                # local mean over the window, cast back to wire dtype;
+                # STILL device-local — the optimizer's reducer performs
+                # the single window-end cross-replica mean (fused
+                # buckets / bf16 wire / hierarchical 2-stage for
+                # cross_replica_mean, reduce-scatter for ZeRO-1)
+                grads = jax.tree.map(
+                    lambda a, p: (a / accum).astype(p.dtype), acc, params)
+                # one scalar pmean per WINDOW for the reported loss (4
+                # wire bytes — the `extra` assert_accum_collectives
+                # allows); sits after the scan, never inside it
+                loss = jax.lax.pmean(jnp.mean(micro_losses), ax)
             updates, new_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             if zero1:
@@ -278,8 +377,20 @@ class StandardUpdater:
 
         fused = step if n_steps == 1 else fuse_steps(
             step, n_steps, scan_batches=True)
-        # batch specs: the fused window's leading n_steps axis is a scan
-        # axis, not a sharded one — only the per-example axis splits.
+        if accum > 1 and n_steps > 1:
+            # the feed stacks a flat (n_steps * accum)-deep window; the
+            # outer fused-step scan consumes one accum-deep microbatch
+            # block per optimiser update
+            inner = fused
+
+            def fused(carry, *batch):  # noqa: F811 — deliberate re-wrap
+                return inner(carry, *(
+                    b.reshape((n_steps, accum) + b.shape[1:])
+                    for b in batch))
+
+        window = n_steps * accum
+        # batch specs: the window's leading scan axis is a scan axis,
+        # not a sharded one — only the per-example axis splits.
         # ZeRO-1 state is world-stacked: its leading member axis shards
         # over the data axis (each member holds its own 1/N slice).
         opt_spec = P(ax) if self.zero1 else P()
@@ -288,7 +399,7 @@ class StandardUpdater:
                 fused,
                 mesh=self.comm.mesh,
                 in_specs=((P(), P(), opt_spec),) + (P(*(
-                    (None, ax) if n_steps > 1 else (ax,))),) * n_batch_args,
+                    (None, ax) if window > 1 else (ax,))),) * n_batch_args,
                 out_specs=((P(), P(), opt_spec), P()),
             ),
             donate_argnums=(0,),
@@ -325,10 +436,56 @@ class StandardUpdater:
         parity cannot drift.  Returns ``(arrays, k, tail)`` in exactly
         the layout :class:`PrefetchIterator` delivers ready-made."""
         window, pending = assemble_window(
-            self._next_arrays, self.steps_per_execution)
+            self._next_arrays, self.window_steps)
         return put_window(window, pending, self._batch_sharding,
                           self._stacked_sharding, converter=self.converter,
                           source=self.iterator)
+
+    def _dispatch_window(self, carry, arrays, k):
+        """Run a ``k``-microbatch window through CACHED programs only.
+
+        The steady window (``k == window_steps``) runs the one fused/
+        accumulating executable.  A shorter tail-of-epoch window is
+        FLUSHED through the ``n_steps=1`` programs instead — full
+        ``accum_steps`` groups through the single-update accumulating
+        program, leftovers as plain single steps — so a partial window
+        never compiles a one-off ``(k, ...)`` shape (the first epoch
+        end used to pay a fresh steady-state-sized XLA compile for a
+        shape that recurs at most once per epoch).  Returns
+        ``(carry, losses, weights, n_updates)`` — ``weights`` holds the
+        microbatch count behind each loss element, so the observed
+        window loss can stay an unbiased per-microbatch mean when
+        M-deep window means mix with single-step losses.
+        """
+        M, n_args = self.accum_steps, len(arrays)
+        if k == self.window_steps and k > 1:
+            carry, loss = self._get_step(
+                n_args, self.steps_per_execution, M)(carry, *arrays)
+            return (carry, [jnp.atleast_1d(loss)],
+                    [M] * self.steps_per_execution,
+                    self.steps_per_execution)
+        if k == 1:
+            # put_window delivers a lone microbatch unstacked
+            carry, loss = self._get_step(n_args, 1, 1)(carry, *arrays)
+            return carry, [jnp.atleast_1d(loss)], [1], 1
+        losses, weights, n_updates = [], [], 0
+        q = k // M if M > 1 else 0
+        for i in range(q):
+            seg = tuple(a[i * M:(i + 1) * M] for a in arrays)
+            carry, loss = self._get_step(n_args, 1, M)(carry, *seg)
+            losses.append(jnp.atleast_1d(loss))
+            weights.append(M)
+            n_updates += 1
+        for j in range(q * M, k):
+            # leftover microbatches (including the whole window when
+            # accum is off) run as plain single steps: each is a full
+            # optimizer update, exactly what an unfused updater would do
+            seg = tuple(a[j] for a in arrays)
+            carry, loss = self._get_step(n_args, 1, 1)(carry, *seg)
+            losses.append(jnp.atleast_1d(loss))
+            weights.append(1)
+            n_updates += 1
+        return carry, losses, weights, n_updates
 
     def update(self):
         # -- host phase: obtain the next device-resident window -------- #
@@ -342,7 +499,8 @@ class StandardUpdater:
 
         # -- dispatch (non-blocking under JAX async dispatch) ----------- #
         carry = (self.params, self.state, self.opt_state)
-        carry, loss = self._get_step(len(arrays), k)(carry, *arrays)
+        carry, losses, weights, n_updates = self._dispatch_window(
+            carry, arrays, k)
         n_iters = k
         if tail is not None:
             # Ragged tail batch runs as a plain single step.  Its batch
@@ -353,9 +511,21 @@ class StandardUpdater:
             # user loss_fn.  Only non-repeating epoch ends produce
             # ragged tails; steady training never pays this.
             carry, tail_loss = self._get_step(len(tail), 1)(carry, *tail)
-            loss = jnp.concatenate(
-                [jnp.atleast_1d(loss), jnp.atleast_1d(tail_loss)])
+            losses.append(jnp.atleast_1d(tail_loss))
+            weights.append(1)
             n_iters += 1
+            n_updates += 1
+        loss = losses[0] if len(losses) == 1 else jnp.concatenate(losses)
+        if loss.size == 1 or len(set(weights)) == 1:
+            # equal weights (the steady state): plain mean is unbiased
+            window_loss = jnp.mean(loss)
+        else:
+            # mixed M-deep window means and single-step losses (epoch
+            # tails under accumulation): weight each element by the
+            # microbatches behind it so the reported loss stays the
+            # per-microbatch mean the unfused path would log
+            w = jnp.asarray(weights, loss.dtype)
+            window_loss = jnp.dot(loss, w) / w.sum()
         self.params, self.state, self.opt_state = carry
 
         # -- retire: block on the oldest window(s) past max_inflight ---- #
@@ -363,7 +533,9 @@ class StandardUpdater:
         # dispatched — so the measured device wait is the exposed cost,
         # not the full step latency, and the pipeline stays overlapped;
         # donated carries bound memory to max_inflight windows)
-        self._inflight.append(loss)
+        # the weighted window loss derives from every dispatched
+        # program's output, so blocking on it retires the whole window
+        self._inflight.append(window_loss)
         t0 = time.perf_counter()
         while len(self._inflight) > self.max_inflight:
             retired = self._inflight.popleft()
@@ -384,12 +556,20 @@ class StandardUpdater:
             # LogReport.observe, PrintReport — never stalls the
             # pipeline on the in-flight window.  Lags by max_inflight
             # updates; the serial path keeps the current (async) loss.
-            obs_loss = jnp.mean(self._last_retired)
+            obs_loss = self._last_retired
         else:
-            obs_loss = jnp.mean(loss) if n_iters > 1 else loss
+            obs_loss = window_loss
         self.observation = {
             "main/loss": obs_loss,
             "main/host_time": host_time / n_iters,
             "main/device_time": device_time / n_iters,
             "main/step_time": (host_time + device_time) / n_iters,
         }
+        if self.accum_steps > 1:
+            # wall time per OPTIMIZER update (the window), vs step_time's
+            # per-microbatch denominator — the pair makes the
+            # amortisation visible (accum_time ≈ accum_steps × step_time
+            # means the exchange really left the microbatch loop)
+            accum_time = (host_time + device_time) / max(n_updates, 1)
+            prof.record("updater/accum_time", accum_time)
+            self.observation["main/accum_time"] = accum_time

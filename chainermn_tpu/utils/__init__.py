@@ -2,7 +2,9 @@
 
 from chainermn_tpu.utils.comm_model import (
     CollectiveStats,
+    assert_accum_collectives,
     axis_collective_report,
+    choose_accum_steps,
     choose_bucket_bytes,
     choose_prefetch_depth,
     collective_stats,
@@ -28,7 +30,9 @@ __all__ = [
     "ProfileReport",
     "Profiler",
     "SnapshotCorruptError",
+    "assert_accum_collectives",
     "axis_collective_report",
+    "choose_accum_steps",
     "choose_bucket_bytes",
     "choose_prefetch_depth",
     "collective_stats",

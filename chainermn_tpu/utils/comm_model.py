@@ -24,6 +24,7 @@ real topologies; ring is the bandwidth-optimal baseline the model uses.
 
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass
 from typing import Dict, Optional
@@ -34,10 +35,12 @@ __all__ = [
     "stablehlo_collective_stats",
     "wire_bytes_per_device",
     "axis_collective_report",
+    "choose_accum_steps",
     "choose_bucket_bytes",
     "choose_prefetch_depth",
     "fused_collective_budget",
     "assert_fused_collectives",
+    "assert_accum_collectives",
 ]
 
 # Interconnect defaults for choose_bucket_bytes: per-collective launch
@@ -112,6 +115,10 @@ class CollectiveStats:
     count: int = 0
     bytes: int = 0              # summed tensor bytes across call sites
     group_size: Optional[int] = None   # replica-group size (if uniform)
+    looped: int = 0             # call sites inside a while-loop body:
+    #                             they run once PER TRIP, so a per-window
+    #                             count must treat them separately (the
+    #                             accumulation proof hinges on this)
 
     def wire_bytes(self, axis_size: Optional[int] = None) -> float:
         n = axis_size or self.group_size
@@ -144,6 +151,68 @@ def wire_bytes_per_device(kind: str, tensor_bytes: float, n: int) -> float:
     raise ValueError(f"unknown collective kind {kind!r}")
 
 
+# computation header: "%name (params) -> type {" (possibly "ENTRY %...")
+# — instruction lines carry "name = " before the first "(", headers
+# never do, which is how the two are told apart
+_COMP_HEADER_RE = re.compile(r"^\s*(?:ENTRY\s+)?%([\w.\-]+)\s*\(")
+# computations an instruction hands control to (while bodies/conditions,
+# fusions, reducers, conditionals, async wrappers)
+_COMP_REF_RE = re.compile(
+    r"(?:body|condition|to_apply|calls|true_computation|"
+    r"false_computation)=%?([\w.\-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+# body AND condition both execute once per trip (the condition once
+# more); a collective in either is a per-iteration collective
+_WHILE_PARTS_RE = re.compile(
+    r"=[^=]*\bwhile\(.*?(?:body|condition)=%?([\w.\-]+)"
+    r"(?:.*?(?:body|condition)=%?([\w.\-]+))?")
+
+
+def _split_computations(text: str) -> Dict[str, list]:
+    """HLO module text -> {computation name: [instruction lines]}.
+    Lines outside any recognised computation land under ``""``."""
+    comps: Dict[str, list] = {}
+    current = ""
+    for line in text.splitlines():
+        head = _COMP_HEADER_RE.match(line)
+        if head is not None and "=" not in line.split("(", 1)[0]:
+            current = head.group(1)
+            comps.setdefault(current, [])
+            continue
+        if line.strip().startswith("}"):
+            current = ""
+            continue
+        comps.setdefault(current, []).append(line)
+    return comps
+
+
+def _loop_body_computations(comps: Dict[str, list]) -> set:
+    """Names of computations reachable from any ``while`` body or
+    condition — a collective there executes once per trip, not once
+    per call."""
+    refs: Dict[str, set] = {}
+    bodies: set = set()
+    for name, lines in comps.items():
+        refs[name] = set()
+        for line in lines:
+            w = _WHILE_PARTS_RE.search(line)
+            if w:
+                bodies.update(g for g in w.groups() if g)
+            refs[name].update(_COMP_REF_RE.findall(line))
+            for blob in _BRANCHES_RE.findall(line):
+                refs[name].update(
+                    t.strip().lstrip("%") for t in blob.split(",")
+                    if t.strip())
+    reach, frontier = set(), list(bodies)
+    while frontier:
+        c = frontier.pop()
+        if c in reach:
+            continue
+        reach.add(c)
+        frontier.extend(refs.get(c, ()))
+    return reach
+
+
 def collective_stats(compiled) -> Dict[str, CollectiveStats]:
     """Parse a ``jax.stages.Compiled``'s HLO for collectives.
 
@@ -151,8 +220,11 @@ def collective_stats(compiled) -> Dict[str, CollectiveStats]:
     sizes at each call site (for all-gather that is the gathered size,
     matching the wire formulas' conventions); async ``-start``/``-done``
     pairs are counted once.  A collective inside a ``while`` body (e.g.
-    a pipeline scan) appears once in HLO but runs per iteration — scale
-    by the trip count at the call site if that matters.
+    a pipeline scan) appears once in HLO but runs per iteration — such
+    call sites are tallied in ``.looped`` (as well as ``.count``), so
+    callers can scale by the trip count, and
+    :func:`assert_accum_collectives` can prove a scan body exchanges
+    NOTHING.
     """
     try:
         texts = [m.to_string() for m in compiled.runtime_executable()
@@ -161,22 +233,28 @@ def collective_stats(compiled) -> Dict[str, CollectiveStats]:
         texts = [compiled.as_text()]
     out: Dict[str, CollectiveStats] = {}
     for text in texts:
-        for line in text.splitlines():
-            m = _INSTR_RE.search(line)
-            if not m:
-                continue
-            shape_str, kind = m.group(1), m.group(2)
-            g = _group_size(line)
-            if g == 1:
-                # singleton replica groups come from size-1 mesh axes
-                # (the one-code-path-for-every-mesh-shape discipline);
-                # they move zero wire bytes — skip, don't pollute
-                continue
-            st = out.setdefault(kind, CollectiveStats(kind))
-            st.count += 1
-            st.bytes += _shape_bytes(shape_str, is_start=bool(m.group(3)))
-            if g is not None:
-                st.group_size = g if st.group_size in (None, g) else -1
+        comps = _split_computations(text)
+        looped_comps = _loop_body_computations(comps)
+        for comp_name, lines in comps.items():
+            in_loop = comp_name in looped_comps
+            for line in lines:
+                m = _INSTR_RE.search(line)
+                if not m:
+                    continue
+                shape_str, kind = m.group(1), m.group(2)
+                g = _group_size(line)
+                if g == 1:
+                    # singleton replica groups come from size-1 mesh axes
+                    # (the one-code-path-for-every-mesh-shape discipline);
+                    # they move zero wire bytes — skip, don't pollute
+                    continue
+                st = out.setdefault(kind, CollectiveStats(kind))
+                st.count += 1
+                st.looped += int(in_loop)
+                st.bytes += _shape_bytes(shape_str,
+                                         is_start=bool(m.group(3)))
+                if g is not None:
+                    st.group_size = g if st.group_size in (None, g) else -1
     return out
 
 
@@ -196,6 +274,8 @@ _SHLO_DTYPE_BYTES = {
 }
 _SHLO_GROUPS_RE = re.compile(
     r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<([0-9]+)x([0-9]+)x")
+_SHLO_FUNC_RE = re.compile(r"func\.func\b[^@]*@([\w$.\-]+)\s*\(")
+_SHLO_CALL_RE = re.compile(r"\bcall\s+@([\w$.\-]+)")
 
 
 def stablehlo_collective_stats(lowered_text: str) \
@@ -210,6 +290,48 @@ def stablehlo_collective_stats(lowered_text: str) \
     """
     out: Dict[str, CollectiveStats] = {}
     lines = lowered_text.splitlines()
+    # Loop attribution needs TWO mechanisms in StableHLO: the while op
+    # carries cond/body as INLINE regions (a brace-depth interval — a
+    # stack of [depth-before-the-while, region-has-opened] entries,
+    # nesting-safe, opened-flag surviving the pretty form whose region
+    # braces open on later lines), but jax outlines scan bodies into
+    # private func.funcs the while region merely `call`s — so functions
+    # transitively reachable from any in-while call site are looped
+    # too.  Structural pre-pass; the collective pass below reads it.
+    depth = 0
+    while_stack: list = []
+    cur_fn = ""
+    line_ctx = []                     # (enclosing fn, inline-in-while)
+    fn_calls: Dict[str, set] = {}     # fn -> {callee}
+    looped_seed = set()               # callees called from a while
+    for line in lines:
+        fm = _SHLO_FUNC_RE.search(line)
+        if fm:
+            cur_fn = fm.group(1)
+            while_stack = []
+        in_while = bool(while_stack)
+        if "stablehlo.while" in line:
+            while_stack.append([depth, "{" in line])
+        depth += line.count("{") - line.count("}")
+        for entry in while_stack:
+            if depth > entry[0]:
+                entry[1] = True
+        while while_stack and while_stack[-1][1] \
+                and depth <= while_stack[-1][0]:
+            while_stack.pop()
+        cm = _SHLO_CALL_RE.search(line)
+        if cm:
+            fn_calls.setdefault(cur_fn, set()).add(cm.group(1))
+            if in_while:
+                looped_seed.add(cm.group(1))
+        line_ctx.append((cur_fn, in_while))
+    looped_fns, frontier = set(), list(looped_seed)
+    while frontier:
+        f = frontier.pop()
+        if f in looped_fns:
+            continue
+        looped_fns.add(f)
+        frontier.extend(fn_calls.get(f, ()))
     for i, line in enumerate(lines):
         m = _SHLO_RE.search(line)
         if not m:
@@ -244,8 +366,10 @@ def stablehlo_collective_stats(lowered_text: str) \
         for d in dims_s.split("x"):
             if d:
                 n *= int(d)
+        fn, inline_in_while = line_ctx[i]
         st = out.setdefault(kind, CollectiveStats(kind))
         st.count += 1
+        st.looped += int(inline_in_while or fn in looped_fns)
         st.bytes += n * _SHLO_DTYPE_BYTES[dtype]
         if gsize is not None:
             st.group_size = gsize if st.group_size in (None, gsize) \
@@ -313,17 +437,76 @@ def choose_prefetch_depth(host_time_s: float, device_time_s: float,
 
     Returns an int in ``[min_depth, max_depth]``.
     """
-    if host_time_s < 0 or device_time_s <= 0:
+    if host_time_s < 0 or device_time_s < 0:
         raise ValueError(
-            f"need host_time_s >= 0 and device_time_s > 0, got "
+            f"need host_time_s >= 0 and device_time_s >= 0, got "
             f"{host_time_s} / {device_time_s}")
     if min_depth < 1 or max_depth < min_depth:
         raise ValueError(f"bad depth bounds [{min_depth}, {max_depth}]")
+    if device_time_s == 0:
+        # a zero device time is real profiler output, not an error: a
+        # fully-overlapped pipeline measures ~0 exposed device wait, and
+        # a first-iteration probe may not have retired anything yet.
+        # host == 0 too -> no evidence either way, classic double
+        # buffering; host > 0 -> the host-bound limit (rho -> inf).
+        return min_depth if host_time_s == 0 else max_depth
     rho = host_time_s / device_time_s
     if rho <= 1.0 + 1e-9:          # tolerance: fp noise must not flip regimes
         return min_depth
     depth = -(-int(rho * (1.0 + jitter) * 1000) // 1000)  # ceil, fp-safe
     return max(min_depth, min(depth + 1, max_depth))
+
+
+def choose_accum_steps(
+    grad_bytes: float,
+    axis_size: int,
+    microbatch_time_s: float,
+    latency_s: float = _DEFAULT_LATENCY_S,
+    bandwidth_bytes_per_s: float = _DEFAULT_BANDWIDTH,
+    bucket_bytes: Optional[int] = None,
+    comm_fraction: float = 0.05,
+    max_accum: int = 64,
+) -> int:
+    """Accumulation window ``M`` for ``StandardUpdater(accum_steps=M)``
+    from the bytes/step-vs-interconnect model.
+
+    With window-fused accumulation the gradient exchange fires once per
+    ``M`` microbatches, so its amortised per-microbatch cost is
+    ``T_ex / M`` where (ring formula, fused buckets)
+
+        ``T_ex = ceil(G/b) * alpha + 2 G (n-1) / (n * beta)``
+
+    (``G`` gradient bytes, ``b`` bucket size, ``alpha`` launch latency,
+    ``beta`` per-device ring bandwidth, ``n`` axis size).  This picks
+    the smallest ``M`` that pushes the amortised exchange below
+    ``comm_fraction`` of the measured microbatch compute time
+    (``main/step_time`` with ``accum_steps=1``, or an estimate), clamped
+    to ``[1, max_accum]`` — past that point accumulation buys
+    vanishing wall-clock and only delays parameter updates (the
+    statistical large-batch trade-off is the user's call; see
+    ``docs/PIPELINE.md``).
+
+    Returns 1 when the axis doesn't span multiple members (nothing to
+    amortise) or there are no gradient bytes.
+    """
+    if grad_bytes < 0:
+        raise ValueError(f"grad_bytes {grad_bytes} must be >= 0")
+    if microbatch_time_s <= 0:
+        raise ValueError(
+            f"microbatch_time_s {microbatch_time_s} must be > 0")
+    if comm_fraction <= 0:
+        raise ValueError(f"comm_fraction {comm_fraction} must be > 0")
+    if max_accum < 1:
+        raise ValueError(f"max_accum {max_accum} must be >= 1")
+    if axis_size <= 1 or grad_bytes == 0:
+        return 1
+    b = bucket_bytes or choose_bucket_bytes(
+        grad_bytes, axis_size, latency_s, bandwidth_bytes_per_s)
+    n_buckets = fused_collective_budget(int(grad_bytes), int(b))
+    t_ex = n_buckets * latency_s + 2.0 * grad_bytes * (axis_size - 1) / (
+        axis_size * bandwidth_bytes_per_s)
+    m = math.ceil(t_ex / (comm_fraction * microbatch_time_s))
+    return max(1, min(m, max_accum))
 
 
 def fused_collective_budget(total_bytes: int, bucket_bytes: int,
@@ -357,6 +540,55 @@ def assert_fused_collectives(stats: Dict[str, "CollectiveStats"],
             f"collectives, budget is {budget} "
             f"(= ceil({total_bytes}/{bucket_bytes}) + "
             f"{max(0, n_dtype_groups - 1)} ragged group buckets)")
+    return count
+
+
+def assert_accum_collectives(
+    stats: Dict[str, "CollectiveStats"],
+    total_bytes: int,
+    bucket_bytes: int,
+    n_dtype_groups: int = 1,
+    kinds=("all-reduce", "reduce-scatter", "all-gather"),
+    extra: int = 1,
+) -> int:
+    """Assert a compiled accumulation step exchanges gradients ONCE per
+    window — the M→1 proof for ``StandardUpdater(accum_steps=M)``.
+
+    Two conditions, read off :func:`collective_stats` of the compiled
+    steady-state step:
+
+    - **no looped exchange**: zero ``kinds`` call sites inside a
+      ``while`` body.  The microbatch scan runs M trips per window; a
+      collective there fires M times — exactly the per-microbatch
+      regime accumulation exists to retire.
+    - **window budget**: total ``kinds`` call sites (all top-level, by
+      the first condition, hence once per window) stay within
+      :func:`fused_collective_budget` plus ``extra`` — ``extra``
+      defaults to 1 for the scalar loss mean the updater reports
+      (4 wire bytes; not a gradient exchange).
+
+    Returns the observed per-window count.  Apply to a
+    ``steps_per_execution == 1`` program: an outer fused-step scan
+    legitimately wraps the per-window exchange in a while body of its
+    own, which this check would (rightly, conservatively) reject.
+    """
+    looped = sum(stats[k].looped for k in kinds if k in stats)
+    if looped:
+        raise AssertionError(
+            f"accumulation scan still exchanges per microbatch: "
+            f"{looped} {'+'.join(kinds)} call site(s) inside a while "
+            f"body (want 0 — the window-end exchange must sit outside "
+            f"the scan)")
+    budget = fused_collective_budget(total_bytes, bucket_bytes,
+                                     n_dtype_groups) + extra
+    count = sum(stats[k].count for k in kinds if k in stats)
+    if count > budget:
+        raise AssertionError(
+            f"accumulation window emitted {count} {'+'.join(kinds)} "
+            f"collectives, budget is {budget} "
+            f"(= ceil({total_bytes}/{bucket_bytes}) + "
+            f"{max(0, n_dtype_groups - 1)} ragged group buckets + "
+            f"{extra} extra)")
     return count
 
 

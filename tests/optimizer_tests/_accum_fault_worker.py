@@ -1,0 +1,102 @@
+"""Worker for the accumulation kill→resume drill: one deterministic
+accum_steps=4 training job per invocation, driven by a FaultPlan JSON.
+
+    python _accum_fault_worker.py <phase> <workdir> <plan_json>
+
+Phases (mirrors tests/extension_tests/_fault_worker.py):
+  ref    — run 6 epochs uninterrupted, write final params to ref.npz
+  train  — run with the fault plan armed (a kill plan dies mid-run)
+  resume — maybe_load from the checkpoint, finish, write resumed.npz
+
+The accumulation-specific claim: the gradient accumulator lives INSIDE
+the jitted window step (no cross-window carry), so a checkpoint taken
+at any update boundary — which with accum_steps=4 is every 4th
+iteration, mid-epoch and mid-shuffle for the kill below — resumes
+BITWISE identical to the uninterrupted run, params and loss log both.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from chainermn_tpu.testing import ensure_virtual_pod  # noqa: E402
+
+ensure_virtual_pod(8)
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import chainermn_tpu as cmn  # noqa: E402
+from chainermn_tpu.extensions import (  # noqa: E402
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.testing import FaultInjector, FaultPlan  # noqa: E402
+from chainermn_tpu.training import LogReport  # noqa: E402
+from chainermn_tpu.utils import save_state  # noqa: E402
+
+ACCUM = 4
+
+
+def _dataset(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = rng.randn(4).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    return [(x[i], y[i]) for i in range(n)]
+
+
+def _loss_fn(params, x, y):
+    import jax.numpy as jnp
+
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _build(comm, workdir):
+    import jax.numpy as jnp
+
+    # 64 examples / batch 8 = 8 microbatches per epoch = 2 accumulation
+    # windows; iteration advances 4 per update
+    it = cmn.SerialIterator(_dataset(), batch_size=8, shuffle=True,
+                            seed=5)
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    up = cmn.StandardUpdater(it, opt, _loss_fn, params, comm,
+                             accum_steps=ACCUM)
+    trainer = cmn.Trainer(up, stop_trigger=(6, "epoch"),
+                          out=os.path.join(workdir, "out"))
+    log = LogReport(trigger=(1, "epoch"))
+    trainer.extend(log)
+    # sync writes (a kill right after a save must find it durable);
+    # trigger every 3 iterations — crossing semantics fire it at every
+    # 4-iteration window boundary, i.e. mid-epoch, mid-shuffle points
+    cp = create_multi_node_checkpointer(
+        comm, os.path.join(workdir, "ckpt"), async_write=False,
+        history=2)
+    trainer.extend(cp, trigger=(3, "iteration"))
+    return trainer, up, cp, log
+
+
+def main():
+    phase, workdir, plan_json = sys.argv[1], sys.argv[2], sys.argv[3]
+    comm = cmn.create_communicator("tpu_xla")
+    trainer, up, cp, log = _build(comm, workdir)
+    if phase == "train":
+        plan = FaultPlan.from_json(plan_json)
+        trainer.extend(FaultInjector(plan, comm))
+    elif phase == "resume":
+        resumed = cp.maybe_load(up, trainer)
+        print(f"RESUMED_AT {resumed}", flush=True)
+    trainer.run()
+    final = {"params": up.params, "iteration": up.iteration,
+             "log_losses": np.asarray(
+                 [e["main/loss"] for e in log.log], np.float64)}
+    name = {"ref": "ref.npz", "resume": "resumed.npz",
+            "train": "train.npz"}[phase]
+    save_state(os.path.join(workdir, name), final)
+    print(f"PHASE_OK {phase} iter={up.iteration}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -164,8 +164,12 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
     ("bench_resilience.py",
      ["--batch", "64", "--dim", "32", "--hidden", "64", "--warmup", "1",
       "--iters", "4", "--rounds", "1"], "%"),
+    ("bench_accum.py",
+     ["--batch", "8", "--dim", "64", "--hidden", "128",
+      "--accum-steps", "2", "--warmup", "1", "--iters", "3",
+      "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
-        "fused_allreduce", "pipeline", "resilience"])
+        "fused_allreduce", "pipeline", "resilience", "accum"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

@@ -149,12 +149,114 @@ def test_choose_prefetch_depth():
         1.0, 0.001, max_depth=16) == 16
     # fp-noise around the boundary must not flip regimes
     assert choose_prefetch_depth(0.010 + 1e-12, 0.010) == 2
-    with pytest.raises(ValueError):
-        choose_prefetch_depth(0.01, 0.0)
+    # zero device time is legitimate profiler output (fully-overlapped
+    # pipeline, first-probe iteration): host-bound limit, not a crash
+    assert choose_prefetch_depth(0.01, 0.0) == 8
+    assert choose_prefetch_depth(0.01, 0.0, max_depth=5) == 5
+    # both zero: no evidence either way -> classic double buffering
+    assert choose_prefetch_depth(0.0, 0.0) == 2
     with pytest.raises(ValueError):
         choose_prefetch_depth(-0.01, 0.01)
     with pytest.raises(ValueError):
+        choose_prefetch_depth(0.01, -0.01)
+    with pytest.raises(ValueError):
         choose_prefetch_depth(0.01, 0.01, min_depth=4, max_depth=2)
+    # bad bounds must raise even on the zero-guard path
+    with pytest.raises(ValueError):
+        choose_prefetch_depth(0.01, 0.0, min_depth=4, max_depth=2)
+
+
+def test_choose_accum_steps():
+    from chainermn_tpu.utils import choose_accum_steps
+
+    # nothing to amortise on a 1-member axis / an empty grad tree
+    assert choose_accum_steps(1 << 30, 1, 0.001) == 1
+    assert choose_accum_steps(0, 8, 0.001) == 1
+    # a fast interconnect against slow microbatches needs no window
+    assert choose_accum_steps(1 << 20, 8, 1.0) == 1
+    # monotone: more gradient bytes (or faster microbatches) -> deeper
+    # windows; always clamped to max_accum
+    m_small = choose_accum_steps(16 << 20, 8, 1e-4)
+    m_big = choose_accum_steps(256 << 20, 8, 1e-4)
+    assert 1 <= m_small <= m_big <= 64
+    assert m_big > 1
+    assert choose_accum_steps(1 << 34, 8, 1e-6) == 64       # clamps
+    assert choose_accum_steps(1 << 34, 8, 1e-6, max_accum=16) == 16
+    # the M the model picks must actually beat per-microbatch exchange:
+    # exchange time amortised over M is <= comm_fraction of compute
+    grad_bytes, n, t_micro = 64 << 20, 8, 1e-3
+    m = choose_accum_steps(grad_bytes, n, t_micro, comm_fraction=0.1)
+    t_ex = 2.0 * grad_bytes * (n - 1) / (n * 90e9)
+    assert m >= t_ex / (0.1 * t_micro) or m == 64
+    with pytest.raises(ValueError):
+        choose_accum_steps(-1, 8, 1e-3)
+    with pytest.raises(ValueError):
+        choose_accum_steps(1 << 20, 8, 0.0)
+    with pytest.raises(ValueError):
+        choose_accum_steps(1 << 20, 8, 1e-3, comm_fraction=0.0)
+    with pytest.raises(ValueError):
+        choose_accum_steps(1 << 20, 8, 1e-3, max_accum=0)
+
+
+def test_looped_collectives_and_accum_assert():
+    """A collective inside a lax.scan body must be tallied as looped;
+    one outside must not — and assert_accum_collectives must accept the
+    window-fused shape and reject the per-microbatch shape."""
+    from chainermn_tpu.utils import assert_accum_collectives
+
+    mc = MeshConfig(data=8)
+    xs = jnp.zeros((4, 8, 16), jnp.float32)     # (M, batch, dim)
+
+    def fused_shape(t):
+        # accumulate locally, exchange once AFTER the scan
+        acc, _ = lax.scan(lambda a, x: (a + jnp.sum(x, 0), 0.0),
+                          jnp.zeros((16,), jnp.float32), t)
+        return lax.pmean(acc, "data")
+
+    def per_micro_shape(t):
+        # exchange INSIDE the scan body: M collectives per window.  The
+        # carry init is psummed once OUTSIDE so its pre-vma replication
+        # type matches the in-loop psum's output (a rep-gaining carry
+        # refuses to compile on old check_rep); the loop placement is
+        # what the parser must see either way.
+        a0 = lax.psum(jnp.zeros((16,), jnp.float32), "data")
+
+        def body(a, x):
+            g = lax.psum(jnp.sum(x, 0), "data")
+            return a + g, 0.0
+        acc, _ = lax.scan(body, a0, t)
+        return acc
+
+    fused = collective_stats(_compile(
+        fused_shape, mc.mesh, P(None, "data"), P(), xs))
+    assert fused["all-reduce"].count == 1
+    assert fused["all-reduce"].looped == 0
+    assert assert_accum_collectives(fused, 16 * 4, 4 << 20, extra=0) == 1
+
+    micro = collective_stats(_compile(
+        per_micro_shape, mc.mesh, P(None, "data"), P(), xs))
+    assert micro["all-reduce"].count >= 1
+    assert micro["all-reduce"].looped >= 1
+    with pytest.raises(AssertionError, match="inside a while body"):
+        assert_accum_collectives(micro, 16 * 4, 4 << 20, extra=0)
+
+    # budget violation: a window that somehow exchanges more than the
+    # fused budget must trip even with zero looped sites
+    with pytest.raises(AssertionError, match="budget"):
+        assert_accum_collectives(fused, 16 * 4, 4 << 20, extra=-1)
+
+    # the StableHLO (pre-legalisation) parser must attribute loop
+    # placement the same way, so dtype-true stats can't silently pass
+    # the zero-looped check for a per-microbatch program
+    def lower_text(fn):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mc.mesh, in_specs=P(None, "data"), out_specs=P(),
+        )).lower(xs).as_text()
+
+    sh_fused = stablehlo_collective_stats(lower_text(fused_shape))
+    assert sh_fused["all-reduce"].looped == 0
+    sh_micro = stablehlo_collective_stats(lower_text(per_micro_shape))
+    assert sh_micro["all-reduce"].looped >= 1, sh_micro
 
 
 def test_wire_formulas():
