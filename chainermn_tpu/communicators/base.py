@@ -186,7 +186,7 @@ class CommunicatorBase(abc.ABC):
 
     @abc.abstractmethod
     def multi_node_mean_grad(self, grads, dtype=None, fused: bool = True,
-                             bucket_bytes=None):
+                             bucket_bytes=None, plan=None):
         """Mean a world-stacked pytree of gradients across ranks.
 
         ``dtype`` mirrors ``allreduce_grad_dtype``: cast before the reduce
@@ -200,13 +200,22 @@ class CommunicatorBase(abc.ABC):
         hosts (``inter_size > 1``) additionally lower each bucket
         hierarchically (reduce-scatter intra → all-reduce inter →
         all-gather intra).  ``fused=False`` keeps the per-leaf path.
+
+        ``plan`` supersedes the per-call kwargs with a MEASURED
+        exchange plan (``utils/autotune.py``): a
+        :class:`~chainermn_tpu.utils.autotune.Plan` (or its dict form)
+        executes directly; ``"auto"`` consults the persistent plan
+        cache for this (topology, payload) signature and tunes on a
+        miss — rank 0's winner is broadcast so every process compiles
+        the identical program.
         """
 
     # alias, ChainerMN kept both names
     def allreduce_grad(self, grads, dtype=None, fused: bool = True,
-                       bucket_bytes=None):
+                       bucket_bytes=None, plan=None):
         return self.multi_node_mean_grad(grads, dtype, fused=fused,
-                                         bucket_bytes=bucket_bytes)
+                                         bucket_bytes=bucket_bytes,
+                                         plan=plan)
 
     # ------------------------------------------------------------------ #
     # conveniences

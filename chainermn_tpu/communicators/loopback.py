@@ -109,6 +109,6 @@ class LoopbackCommunicator(CommunicatorBase):
             params)
 
     def multi_node_mean_grad(self, grads, dtype=None, fused=True,
-                             bucket_bytes=None):
-        # size-1 world: fused or not, the mean is the identity
+                             bucket_bytes=None, plan=None):
+        # size-1 world: fused, planned or not, the mean is the identity
         return jax.tree.map(self._chk, grads)

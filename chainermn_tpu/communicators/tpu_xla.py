@@ -537,7 +537,7 @@ class TpuXlaCommunicator(CommunicatorBase):
                             params)
 
     def multi_node_mean_grad(self, grads, dtype=None, fused=True,
-                             bucket_bytes=None):
+                             bucket_bytes=None, plan=None):
         """Mean world-stacked grads across ranks (eager path, for tests and
         host-driven loops).  The hot path is :func:`chainermn_tpu.ops.pmean`
         inside the jitted train step — see optimizers.py.
@@ -549,8 +549,16 @@ class TpuXlaCommunicator(CommunicatorBase):
         bucket hierarchically over an (inter, intra) factorisation of
         the mesh so the cross-host stage moves 1/intra_size of the
         bytes.  ``fused=False`` keeps the historical per-leaf path.
+
+        ``plan`` supersedes both: a tuned
+        :class:`~chainermn_tpu.utils.autotune.Plan` (or dict) executes
+        as compiled, and ``"auto"`` resolves one through the measured
+        autotuner — persistent-cache warm start, live probe search on a
+        miss, rank-0 decision broadcast over the object channel.
         """
         dtype = dtype or self._grad_dtype
+        if plan is not None:
+            return self._plan_mean(grads, plan)
         if fused:
             return self._fused_mean(grads, dtype, bucket_bytes)
         mean = self._jitted("mean")
@@ -611,6 +619,57 @@ class TpuXlaCommunicator(CommunicatorBase):
 
             fn = jax.jit(jax.shard_map(
                 body, mesh=mesh, in_specs=spec, out_specs=spec))
+            self._jit_cache[key] = fn
+        return fn(stacked)
+
+    def _plan_mean(self, grads, plan):
+        """Plan-driven fused mean: one jitted shard_map whose strategy ×
+        bucket × wire dtype come from a measured plan instead of
+        defaults.  ``plan="auto"`` resolves through the autotuner
+        (in-process memo → persistent cache → live probe search)."""
+        from chainermn_tpu.utils import autotune as _autotune
+
+        stacked = jax.tree.map(self._stacked, grads)
+        leaves, treedef = jax.tree.flatten(stacked)
+        shapes = tuple((l.shape, str(l.dtype)) for l in leaves)
+        if isinstance(plan, str):
+            if plan != "auto":
+                raise ValueError(
+                    f"plan={plan!r}: expected 'auto', a Plan, or a "
+                    f"plan dict")
+            # memo on the structural signature directly — no per-call
+            # leaf slicing (a device gather each) or digest hashing;
+            # the LOCAL tree is only materialised on the one tuning miss
+            memo_key = ("plan_auto", treedef, shapes)
+            plan = self._jit_cache.get(memo_key)
+            if plan is None:
+                local = jax.tree.map(lambda a: a[0], stacked)
+                plan = _autotune.autotune_plan(self, local)
+                self._jit_cache[memo_key] = plan
+        else:
+            plan = _autotune.Plan.from_any(plan)
+
+        key = ("plan_mean", plan.strategy, plan.bucket_bytes,
+               str(plan.wire_dtype), treedef, shapes)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            ax = self._axis
+            if plan.strategy == "hierarchical":
+                mesh, inter_ax = _autotune._resolve_hier(
+                    self, ax, None, None)
+                if mesh is None:
+                    raise ValueError(
+                        "hierarchical plan on a world with no "
+                        "(inter, intra) host factoring — the plan's "
+                        "mesh signature does not match this "
+                        "communicator")
+            else:
+                mesh, inter_ax = self._mesh, None
+            # the stacked-exchange harness is autotune's probe builder
+            # — ONE lowering shared by tuner, updater probe, and this
+            # eager path
+            fn = _autotune.build_exchange_fn(
+                mesh, ax, plan.to_dict(), inter_axis_name=inter_ax)
             self._jit_cache[key] = fn
         return fn(stacked)
 

@@ -52,16 +52,21 @@ from jax import lax
 from chainermn_tpu.parallel._compat import (
     all_gather_invariant as _all_gather_invariant,
     axis_size as _axis_size,
+    pcast as _pcast,
+    typeof as _typeof,
 )
 
 __all__ = [
     "DEFAULT_BUCKET_BYTES",
+    "PLAN_STRATEGIES",
     "FusedSpec",
     "flatten_buckets",
     "unflatten_buckets",
     "fused_allreduce",
     "fused_pmean",
     "hierarchical_allreduce",
+    "reduce_scatter_allgather",
+    "plan_allreduce",
 ]
 
 # 4 MiB: large enough that per-collective latency is noise against wire
@@ -135,7 +140,16 @@ def flatten_buckets(
     buckets: List[jax.Array] = []
     groups = []
     for dtype, idxs in by_dtype.items():
-        wire = jnp.dtype(wire_dtype) if wire_dtype is not None else dtype
+        # Wire compression applies to FLOAT groups only: an int32 or bool
+        # leaf round-tripped through bf16 is silently corrupted (bf16
+        # carries 8 mantissa bits — any int past 256 loses low-order
+        # bits, and the reduction itself runs in the wrong arithmetic).
+        # Non-float groups cross the wire in their native dtype.
+        if wire_dtype is not None and jnp.issubdtype(dtype, jnp.floating) \
+                and jnp.issubdtype(jnp.dtype(wire_dtype), jnp.floating):
+            wire = jnp.dtype(wire_dtype)
+        else:
+            wire = dtype
         per = _bucket_elems(bucket_bytes, wire.itemsize)
 
         def _wire(v):
@@ -213,6 +227,14 @@ def hierarchical_allreduce(
     if x.ndim != 1:
         raise ValueError(f"hierarchical_allreduce wants a flat bucket, "
                          f"got shape {x.shape}")
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        # non-float buckets (int/bool — the packer's wire exemption):
+        # psum_scatter rejects bool outright, and the shard-side
+        # true-divide would round ints through float32.  Route them
+        # through the same pmean/psum the per-leaf and fused-flat
+        # paths use, so every strategy agrees exactly on non-float data.
+        red = lax.pmean if op == "mean" else lax.psum
+        return red(x, (intra_axis_name, inter_axis_name))
     k = _axis_size(intra_axis_name)
     size = x.shape[0]
     pad = -size % k
@@ -278,3 +300,150 @@ def fused_pmean(grads, axis_name: str, **kwargs):
     """:func:`fused_allreduce` with ``op="mean"`` — the gradient
     hot-path spelling."""
     return fused_allreduce(grads, axis_name, op="mean", **kwargs)
+
+
+# --------------------------------------------------------------------- #
+# plan-driven execution (utils/autotune.py picks the strategy)
+# --------------------------------------------------------------------- #
+
+# The exchange-strategy space the measured autotuner searches.  Each
+# names ONE lowering of "mean a grad pytree over the axis":
+#   per_leaf        — one pmean per leaf (the historical baseline; wins
+#                     for tiny trees where packing costs more than it
+#                     amortises)
+#   fused_flat      — dtype-grouped flat buckets, one all-reduce each
+#   hierarchical    — fused buckets, each lowered reduce-scatter(intra)
+#                     → all-reduce(inter) → all-gather(intra) over a
+#                     2-D mesh (needs ``inter_axis_name``)
+#   reduce_scatter  — fused buckets, each lowered reduce-scatter →
+#                     all-gather over the ONE axis: same ring bytes as
+#                     an all-reduce but two launches per bucket, which
+#                     some fabrics/backends schedule better (and the
+#                     shard-side divide halves the divide work)
+PLAN_STRATEGIES = ("per_leaf", "fused_flat", "hierarchical",
+                   "reduce_scatter")
+
+
+def _ensure_varying(x, axis_name):
+    """Retype ``x`` varying over ``axis_name`` if the vma type system
+    considers it invariant: psum_scatter of N identical copies divided
+    by N is still the right mean, so both typings reduce correctly."""
+    try:
+        vma = _typeof(x).vma
+    except AttributeError:  # pragma: no cover - pre-vma jax
+        return x
+    if axis_name in vma:
+        return x
+    return _pcast(x, axis_name, to="varying")
+
+
+def reduce_scatter_allgather(
+    x: jax.Array,
+    axis_name: str,
+    op: str = "mean",
+) -> jax.Array:
+    """Reduce one flat bucket over a SINGLE axis as reduce-scatter →
+    all-gather — the two halves of a ring all-reduce issued explicitly.
+
+    Same per-device ring bytes as ``lax.pmean`` (``2s(n-1)/n``), but two
+    collective launches per bucket and the mean's divide runs on the
+    1/n shard.  Whether this beats the fused all-reduce is a backend
+    scheduling question — exactly what the measured autotuner settles.
+    """
+    if op not in ("sum", "mean"):
+        raise ValueError(f"unsupported reduce_scatter op {op!r}")
+    if x.ndim != 1:
+        raise ValueError(f"reduce_scatter_allgather wants a flat bucket, "
+                         f"got shape {x.shape}")
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        # non-float buckets: psum_scatter rejects bool, and the
+        # shard-side true-divide rounds ints through float32 — use the
+        # same pmean/psum as the per-leaf/fused paths (exact agreement)
+        red = lax.pmean if op == "mean" else lax.psum
+        return red(x, axis_name)
+    n = _axis_size(axis_name)
+    size = x.shape[0]
+    pad = -size % n
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    shard = lax.psum_scatter(_ensure_varying(x, axis_name), axis_name,
+                             tiled=True)
+    if op == "mean":
+        shard = shard / jnp.asarray(n, shard.dtype)
+    full = _all_gather_invariant(shard, axis_name, tiled=True)
+    return full[:size] if pad else full
+
+
+def _plan_fields(plan) -> Tuple[str, int, Optional[str]]:
+    """Normalise a plan carrier (``utils.autotune.Plan``, a plain dict,
+    or anything with the three attributes) to
+    ``(strategy, bucket_bytes, wire_dtype_name)``."""
+    if isinstance(plan, dict):
+        strategy = plan.get("strategy")
+        bucket = plan.get("bucket_bytes")
+        wire = plan.get("wire_dtype")
+    else:
+        strategy = getattr(plan, "strategy", None)
+        bucket = getattr(plan, "bucket_bytes", None)
+        wire = getattr(plan, "wire_dtype", None)
+    if strategy not in PLAN_STRATEGIES:
+        raise ValueError(
+            f"plan strategy {strategy!r} not one of {PLAN_STRATEGIES}")
+    return strategy, int(bucket or DEFAULT_BUCKET_BYTES), wire
+
+
+def plan_allreduce(
+    grads,
+    axis_name: str,
+    plan,
+    op: str = "mean",
+    inter_axis_name: Optional[str] = None,
+):
+    """Exchange a grad pytree according to a tuned plan — the execution
+    half of :mod:`chainermn_tpu.utils.autotune`.
+
+    ``plan`` carries ``(strategy, bucket_bytes, wire_dtype)`` — a
+    :class:`~chainermn_tpu.utils.autotune.Plan`, its ``to_dict()`` form,
+    or any object with those attributes.  ``strategy`` is one of
+    :data:`PLAN_STRATEGIES`; ``hierarchical`` requires
+    ``inter_axis_name`` to be bound by the enclosing ``shard_map``
+    (plans are keyed by mesh signature, so a hierarchical plan only ever
+    reaches a mesh that has the second axis).
+    """
+    strategy, bucket_bytes, wire_name = _plan_fields(plan)
+    wire = jnp.dtype(wire_name) if wire_name else None
+
+    if strategy == "per_leaf":
+        red = lax.pmean if op == "mean" else lax.psum
+
+        def one(g):
+            if g.size == 0:
+                return g
+            # same non-float exemption as the fused packer: ints/bools
+            # never cross the wire compressed
+            if wire is not None and jnp.issubdtype(g.dtype, jnp.floating):
+                return red(g.astype(wire), axis_name).astype(g.dtype)
+            return red(g, axis_name).astype(g.dtype)
+
+        return jax.tree.map(one, grads)
+
+    if strategy == "fused_flat":
+        return fused_allreduce(grads, axis_name, op=op,
+                               bucket_bytes=bucket_bytes, wire_dtype=wire)
+    if strategy == "hierarchical":
+        if inter_axis_name is None:
+            raise ValueError(
+                "plan strategy 'hierarchical' needs inter_axis_name (a "
+                "second mesh axis bound by the enclosing shard_map); "
+                "this plan was tuned for a 2-D mesh signature")
+        return fused_allreduce(grads, axis_name, op=op,
+                               bucket_bytes=bucket_bytes, wire_dtype=wire,
+                               inter_axis_name=inter_axis_name)
+
+    # reduce_scatter: fused buckets, each lowered rs -> ag over the axis
+    buckets, spec = flatten_buckets(grads, bucket_bytes, wire)
+    if not buckets:
+        return grads
+    reduced = [reduce_scatter_allgather(b, axis_name, op=op)
+               for b in buckets]
+    return unflatten_buckets(reduced, spec)

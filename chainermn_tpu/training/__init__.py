@@ -7,6 +7,7 @@ from .evaluators import (
     create_multi_node_evaluator,
 )
 from .optimizers import (
+    PlannedOptimizer,
     create_multi_node_optimizer,
     cross_replica_mean,
     shard_opt_state,
@@ -19,6 +20,7 @@ from .updater import StandardUpdater, default_converter, fuse_steps
 
 __all__ = [
     "Evaluator",
+    "PlannedOptimizer",
     "GenericMultiNodeEvaluator",
     "IntervalTrigger",
     "LogReport",

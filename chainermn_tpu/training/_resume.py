@@ -34,6 +34,12 @@ def collect_train_state(updater, trainer=None) -> dict:
     it = getattr(updater, "iterator", None)
     if it is not None and hasattr(it, "state_dict"):
         extra["iterator"] = it.state_dict()
+    cell = getattr(getattr(updater, "optimizer", None), "plan_cell", None)
+    if cell is not None and cell.plan is not None:
+        # the tuned exchange plan rides the snapshot: a resumed run must
+        # compile the IDENTICAL exchange program (bitwise resume), never
+        # re-tune into a different one because the plan cache moved
+        extra["exchange_plan"] = cell.plan.to_dict()
     if trainer is not None:
         exts = {}
         for entry in getattr(trainer, "_extensions", []):
@@ -79,6 +85,30 @@ def restore_train_state(extra: Optional[dict], updater,
             pass
         else:
             it.load_state_dict(saved)
+    if "exchange_plan" in extra:
+        cell = getattr(getattr(updater, "optimizer", None), "plan_cell",
+                       None)
+        if cell is not None:
+            from chainermn_tpu.utils.autotune import Plan
+
+            saved_plan = Plan.from_dict(extra["exchange_plan"])
+
+            def _exec_fields(p):
+                # only the fields plan_allreduce actually reads decide
+                # program identity; meta (timings, timestamps) differing
+                # must not force a pointless recompile of an execution-
+                # identical plan at resume
+                return (p.strategy, int(p.bucket_bytes), p.wire_dtype)
+
+            if cell.plan is None or \
+                    _exec_fields(cell.plan) != _exec_fields(saved_plan):
+                # adopt the WRITER's plan so the resumed run compiles
+                # the identical exchange program; programs that already
+                # baked the fresh-tuned plan in must recompile
+                cell.resolve(saved_plan)
+                cache = getattr(updater, "_step_cache", None)
+                if isinstance(cache, dict):
+                    cache.clear()
     if trainer is not None and "trainer" in extra:
         tr = extra["trainer"]
         trainer.elapsed_time = float(tr.get("elapsed_time", 0.0))

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import optax
 
 __all__ = [
+    "PlannedOptimizer",
     "Zero1Transformation",
     "cross_replica_mean",
     "create_multi_node_optimizer",
@@ -104,6 +105,56 @@ def cross_replica_mean(
             return jax.lax.pmean(g, axis_name)
 
         return jax.tree.map(reduce_one, grads), state
+
+    return optax.GradientTransformation(init, update)
+
+
+class PlannedOptimizer(NamedTuple):
+    """A multi-node optimizer whose gradient exchange follows a TUNED
+    plan (``utils/autotune.py``) instead of per-call kwargs.
+
+    Structurally an ``optax.GradientTransformation`` (``init`` /
+    ``update``); the extra ``plan_cell`` is the mutable
+    :class:`~chainermn_tpu.utils.autotune.PlanCell` consumers read —
+    ``StandardUpdater`` observes exchange times into it, the snapshot
+    machinery persists ``plan_cell.plan`` so a resumed run compiles
+    the identical exchange program (bitwise resume), never re-tunes
+    into a different one.
+    """
+
+    init: Callable
+    update: Callable
+    plan_cell: Any
+
+
+def _planned_mean(
+    axis_name: str,
+    cell,
+    inter_axis_name: Optional[str] = None,
+) -> optax.GradientTransformation:
+    """Optax transform: mean gradients across ``axis_name`` following
+    the resolved plan in ``cell`` (strategy × bucket size × wire dtype
+    picked by measurement, not defaults).  The plan must be resolved
+    BEFORE tracing — ``PlannedOptimizer.init`` does that eagerly."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(grads, state, params=None):
+        del params
+        plan = cell.plan
+        if plan is None:
+            raise RuntimeError(
+                "exchange plan unresolved — call the planned "
+                "optimizer's init(params) eagerly (outside jit) first; "
+                "plan='auto' tunes there, where real probe programs "
+                "can run")
+        from chainermn_tpu.ops import fused as _fused
+
+        return _fused.plan_allreduce(
+            grads, axis_name, plan,
+            inter_axis_name=inter_axis_name), state
 
     return optax.GradientTransformation(init, update)
 
@@ -433,6 +484,7 @@ def create_multi_node_optimizer(
     fused: bool = True,
     bucket_bytes: Optional[int] = None,
     inter_axis_name: Optional[str] = None,
+    plan=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimiser with cross-replica gradient averaging.
 
@@ -476,12 +528,32 @@ def create_multi_node_optimizer(
         hierarchical 2-stage bucket lowering; the step's ``shard_map``
         must bind both axes.  Typically wired by the communicator when
         ``comm.inter_size > 1``.
+      plan: drive the gradient exchange from a MEASURED plan
+        (``utils/autotune.py``) instead of the kwargs above.
+        ``"auto"`` tunes at ``init(params)`` time (eager, outside jit
+        — the ``StandardUpdater`` contract): cache warm-start when the
+        (mesh, payload, version) signature matches, otherwise a live
+        probe search whose winner rank 0 broadcasts; a
+        :class:`~chainermn_tpu.utils.autotune.Plan` (or its dict form,
+        e.g. restored from a snapshot) skips tuning entirely.  Returns
+        a :class:`PlannedOptimizer` carrying the ``plan_cell``; the
+        ``fused``/``bucket_bytes``/``allreduce_grad_dtype`` kwargs are
+        superseded by the plan's strategy/bucket/wire fields.
+        Hierarchical candidates enter the search only when
+        ``inter_axis_name`` is given (the step must bind the axis).
+        Incompatible with ``zero1`` (whose reduce-scatter/all-gather
+        pair is a different exchange family).
     """
     ax = axis_name or (comm.axis_name if comm is not None else None)
     if ax is None:
         raise ValueError("need comm or axis_name")
     if accum_steps < 1:
         raise ValueError(f"accum_steps {accum_steps} must be >= 1")
+    if plan is not None and zero1:
+        raise ValueError(
+            "plan= drives the cross_replica_mean exchange; ZeRO-1 "
+            "replaces that exchange with its reduce-scatter/all-gather "
+            "pair — the two cannot be combined")
     inner = actual_optimizer
     if double_buffering:
         inner = optax.chain(_double_buffer(), inner)
@@ -490,6 +562,43 @@ def create_multi_node_optimizer(
     if zero1:
         # accumulation INSIDE zero1: the accumulator holds 1/N shards
         return zero1_optimizer(inner, ax, wire_dtype=allreduce_grad_dtype)
+    if plan is not None:
+        from chainermn_tpu.utils import autotune as _autotune
+
+        if isinstance(plan, _autotune.PlanCell):
+            cell = plan
+        elif isinstance(plan, str):
+            if plan != "auto":
+                raise ValueError(
+                    f"plan={plan!r}: expected 'auto', a Plan, or a "
+                    f"plan dict")
+            if comm is None:
+                raise ValueError(
+                    "plan='auto' needs comm — the autotuner probes on "
+                    "its mesh and broadcasts the winner from rank 0")
+            cell = _autotune.PlanCell()
+        else:
+            cell = _autotune.PlanCell(_autotune.Plan.from_any(plan))
+        chained = optax.chain(
+            _planned_mean(ax, cell, inter_axis_name=inter_axis_name),
+            inner)
+
+        # the plan executes inside the USER's shard_map: hierarchical
+        # is only runnable when that program binds the second axis.
+        # Recorded on the cell so a later drift retune() tunes under
+        # the SAME constraint.
+        cell.tune_kwargs = dict(
+            inter_axis_name=inter_axis_name,
+            allow_hierarchical=(
+                None if inter_axis_name is not None else False))
+
+        def planned_init(params):
+            if cell.plan is None:
+                cell.resolve(_autotune.autotune_plan(
+                    comm, params, **cell.tune_kwargs))
+            return chained.init(params)
+
+        return PlannedOptimizer(planned_init, chained.update, cell)
     return optax.chain(
         cross_replica_mean(ax, allreduce_grad_dtype, fused=fused,
                            bucket_bytes=bucket_bytes,

@@ -152,6 +152,18 @@ class StandardUpdater:
         mantissa).  The accumulated mean is cast back to each param
         leaf's dtype before the exchange, so the wire format is
         unchanged.
+      exchange_probe_every: every this-many ``update()`` calls, re-time
+        the optimizer's tuned exchange program in isolation (one extra
+        exchange on a zeros grad tree, compiled once) and observe the
+        wall time as ``main/exchange_time`` (profiler row
+        ``updater/exchange_time``) — the window-end exchange cost the
+        in-step fusion otherwise hides.  The observation also feeds the
+        plan's drift guard (``plan_cell.observe``): when it departs
+        from the plan's tuned time by the cell's ``drift_factor``,
+        ``plan_cell.drifted`` flips and the owner may
+        ``plan_cell.retune`` (see ``docs/TUNING.md``).  Requires a
+        planned optimizer (``create_multi_node_optimizer(plan=...)``);
+        0 (default) disables the probe.
 
     Timing observations (``utils.profiling`` names in parentheses):
     ``main/host_time`` (``updater/host_time``) is iterator pull +
@@ -185,6 +197,7 @@ class StandardUpdater:
         max_inflight: Optional[int] = None,
         accum_steps: int = 1,
         accum_dtype=None,
+        exchange_probe_every: int = 0,
     ):
         self.optimizer = optimizer
         self.comm = comm
@@ -266,6 +279,25 @@ class StandardUpdater:
                 optimizer, self.params, comm.mesh, comm.axis_name)
         else:
             self.opt_state = optimizer.init(self.params)
+
+        if exchange_probe_every < 0:
+            raise ValueError("exchange_probe_every must be >= 0")
+        if exchange_probe_every and \
+                getattr(optimizer, "plan_cell", None) is None:
+            raise ValueError(
+                "exchange_probe_every needs a planned optimizer "
+                "(create_multi_node_optimizer(plan=...)): the probe "
+                "re-times the tuned exchange program, and the "
+                "observation feeds its drift guard")
+        self.exchange_probe_every = exchange_probe_every
+        self._exchange_probe = None     # (plan, warmed fn, data factory)
+        self._updates_done = 0
+        # plan-cell generation this updater's compiled steps were built
+        # against; update() compares and invalidates on change, so a
+        # drift retune (or restored snapshot) can never leave training
+        # silently running the old exchange program
+        cell = getattr(optimizer, "plan_cell", None)
+        self._plan_generation = None if cell is None else cell.generation
 
         self.iteration = 0
         self.epoch_detail = 0.0
@@ -487,7 +519,48 @@ class StandardUpdater:
             n_updates += 1
         return carry, losses, weights, n_updates
 
+    def _probe_exchange_time(self) -> float:
+        """Time one isolated execution of the tuned exchange program on
+        a zeros grad tree — the ``main/exchange_time`` observation.
+        The program is built (and warmed) once per plan; a plan change
+        (drift re-tune, snapshot restore) rebuilds it."""
+        from chainermn_tpu.utils import autotune as _autotune
+
+        cell = self.optimizer.plan_cell
+        plan = cell.plan
+        if plan is None:
+            raise RuntimeError(
+                "exchange probe with an unresolved plan — init ran?")
+        if self._exchange_probe is None \
+                or self._exchange_probe[0] is not plan:
+            fn, make_data = _autotune.build_plan_probe(
+                self.comm, plan, self.params)
+            self._exchange_probe = (plan, fn, make_data)
+        _, fn, make_data = self._exchange_probe
+        # the probe tree is rebuilt per probe (and dropped after), so
+        # no gradient-tree-sized buffer stays pinned between probes
+        data = make_data()
+        # drain in-flight training windows BEFORE the timer starts: the
+        # probe must measure the exchange in isolation, not the queued
+        # windows it would otherwise sit behind (a spuriously inflated
+        # observation would trip the drift guard every probe).  Blocks
+        # without popping, so the retire bookkeeping is untouched.
+        for pending in self._inflight:
+            jax.block_until_ready(pending)
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(data))
+        dt = time.perf_counter() - t0
+        cell.observe(dt)
+        return dt
+
     def update(self):
+        # -- plan-change barrier: recompile steps that baked in a now-
+        # replaced exchange plan (drift retune / snapshot restore) ---- #
+        cell = getattr(self.optimizer, "plan_cell", None)
+        if cell is not None and cell.generation != self._plan_generation:
+            self._step_cache.clear()
+            self._plan_generation = cell.generation
+
         # -- host phase: obtain the next device-resident window -------- #
         t0 = time.perf_counter()
         if self.prefetch:
@@ -573,3 +646,9 @@ class StandardUpdater:
             accum_time = (host_time + device_time) / max(n_updates, 1)
             prof.record("updater/accum_time", accum_time)
             self.observation["main/accum_time"] = accum_time
+        self._updates_done += 1
+        if self.exchange_probe_every and \
+                self._updates_done % self.exchange_probe_every == 0:
+            exchange_time = self._probe_exchange_time()
+            prof.record("updater/exchange_time", exchange_time)
+            self.observation["main/exchange_time"] = exchange_time

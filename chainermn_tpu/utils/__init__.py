@@ -1,7 +1,17 @@
-"""Utility subsystems: serialization, profiling/tracing, comm modelling."""
+"""Utility subsystems: serialization, profiling/tracing, comm modelling,
+and the measured exchange-plan autotuner."""
 
+from chainermn_tpu.utils.autotune import (
+    Plan,
+    PlanCell,
+    autotune_plan,
+    default_cache_path,
+    load_cached_plan,
+    store_plan,
+)
 from chainermn_tpu.utils.comm_model import (
     CollectiveStats,
+    LinkParams,
     assert_accum_collectives,
     axis_collective_report,
     choose_accum_steps,
@@ -27,11 +37,18 @@ from chainermn_tpu.utils.serialization import (
 
 __all__ = [
     "CollectiveStats",
+    "LinkParams",
+    "Plan",
+    "PlanCell",
     "ProfileReport",
     "Profiler",
     "SnapshotCorruptError",
     "assert_accum_collectives",
+    "autotune_plan",
     "axis_collective_report",
+    "default_cache_path",
+    "load_cached_plan",
+    "store_plan",
     "choose_accum_steps",
     "choose_bucket_bytes",
     "choose_prefetch_depth",

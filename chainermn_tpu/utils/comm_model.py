@@ -31,6 +31,7 @@ from typing import Dict, Optional
 
 __all__ = [
     "CollectiveStats",
+    "LinkParams",
     "collective_stats",
     "stablehlo_collective_stats",
     "wire_bytes_per_device",
@@ -50,6 +51,59 @@ __all__ = [
 # ~12.5 GB/s per NIC).
 _DEFAULT_LATENCY_S = 2e-6
 _DEFAULT_BANDWIDTH = 90e9
+
+
+@dataclass(frozen=True)
+class LinkParams:
+    """Interconnect constants the analytic models consume: per-collective
+    launch latency (seconds) and per-device ring bandwidth (bytes/s).
+
+    The defaults baked into :func:`choose_bucket_bytes` /
+    :func:`choose_accum_steps` are PUBLISHED ICI numbers; this carrier
+    exists so the measured autotuner (``utils/autotune.py``) can hand
+    those models constants fitted from its own probe timings on the
+    live machine — the plan then both picks the exchange strategy
+    empirically AND recalibrates every later analytic decision
+    (``choose_bucket_bytes``, ``choose_accum_steps``) to the real
+    fabric.
+    """
+
+    latency_s: float = _DEFAULT_LATENCY_S
+    bandwidth_bytes_per_s: float = _DEFAULT_BANDWIDTH
+
+    @classmethod
+    def from_probes(cls, samples) -> "LinkParams":
+        """Least-squares fit of ``t = launches * alpha + wire_bytes /
+        beta`` over probe timings.
+
+        ``samples`` is an iterable of ``(n_launches, wire_bytes,
+        seconds)`` rows — one per timed exchange candidate (the
+        autotuner knows each candidate's collective count and ring
+        bytes analytically, and measures its wall time).  Solves the
+        2-unknown normal equations for ``alpha`` (latency) and
+        ``1/beta`` (inverse bandwidth); a degenerate or unphysical fit
+        (fewer than 2 distinct rows, singular system, non-positive
+        constants) falls back to the published defaults — measured
+        constants must never be WORSE than no measurement.
+        """
+        rows = [(float(k), float(b), float(t)) for k, b, t in samples
+                if t > 0 and (k > 0 or b > 0)]
+        if len(rows) < 2:
+            return cls()
+        # normal equations for t ~ k*alpha + b*inv_beta
+        skk = sum(k * k for k, _, _ in rows)
+        sbb = sum(b * b for _, b, _ in rows)
+        skb = sum(k * b for k, b, _ in rows)
+        skt = sum(k * t for k, _, t in rows)
+        sbt = sum(b * t for _, b, t in rows)
+        det = skk * sbb - skb * skb
+        if abs(det) < 1e-30:
+            return cls()
+        alpha = (skt * sbb - sbt * skb) / det
+        inv_beta = (sbt * skk - skt * skb) / det
+        if alpha <= 0 or inv_beta <= 0:
+            return cls()
+        return cls(latency_s=alpha, bandwidth_bytes_per_s=1.0 / inv_beta)
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "f8e4m3": 1, "f8e5m2": 1,
@@ -383,6 +437,7 @@ def choose_bucket_bytes(
     latency_s: float = _DEFAULT_LATENCY_S,
     bandwidth_bytes_per_s: float = _DEFAULT_BANDWIDTH,
     min_bucket: int = 256 * 1024,
+    link: Optional[LinkParams] = None,
 ) -> int:
     """Principled fused-allreduce bucket size from the latency-bandwidth
     model — the ``allreduce_grad_dtype``-era tuning knob made analytic.
@@ -401,8 +456,13 @@ def choose_bucket_bytes(
         ``b* = sqrt( G * alpha * n * beta / (2 (n-1)) )``
 
     clamped to ``[min_bucket, G]``.  Defaults model ICI; pass measured
-    ``latency_s``/``bandwidth_bytes_per_s`` for other interconnects.
+    ``latency_s``/``bandwidth_bytes_per_s`` for other interconnects, or
+    a :class:`LinkParams` via ``link`` (e.g. ``plan.link`` from the
+    measured autotuner) which overrides both.
     """
+    if link is not None:
+        latency_s = link.latency_s
+        bandwidth_bytes_per_s = link.bandwidth_bytes_per_s
     if total_bytes <= 0:
         return min_bucket
     if axis_size <= 1:
@@ -466,6 +526,7 @@ def choose_accum_steps(
     bucket_bytes: Optional[int] = None,
     comm_fraction: float = 0.05,
     max_accum: int = 64,
+    link: Optional[LinkParams] = None,
 ) -> int:
     """Accumulation window ``M`` for ``StandardUpdater(accum_steps=M)``
     from the bytes/step-vs-interconnect model.
@@ -487,8 +548,13 @@ def choose_accum_steps(
     ``docs/PIPELINE.md``).
 
     Returns 1 when the axis doesn't span multiple members (nothing to
-    amortise) or there are no gradient bytes.
+    amortise) or there are no gradient bytes.  ``link`` (a
+    :class:`LinkParams`, e.g. from the measured autotuner) overrides
+    ``latency_s``/``bandwidth_bytes_per_s`` with measured constants.
     """
+    if link is not None:
+        latency_s = link.latency_s
+        bandwidth_bytes_per_s = link.bandwidth_bytes_per_s
     if grad_bytes < 0:
         raise ValueError(f"grad_bytes {grad_bytes} must be >= 0")
     if microbatch_time_s <= 0:

@@ -117,6 +117,36 @@ class TestPacking:
         out = fused.unflatten_buckets(buckets, spec)
         assert out["w"].dtype == jnp.float32
 
+    def test_nonfloat_groups_exempt_from_wire_cast(self):
+        """An int32/bool leaf round-tripped through bf16 is silently
+        corrupted (8 mantissa bits); non-float groups must cross the
+        wire in their NATIVE dtype even when wire_dtype is set."""
+        tree = {
+            "f32": jnp.ones((8,), jnp.float32),
+            # values far past bf16's 256-integer exactness range
+            "i32": jnp.asarray([1000003, -7654321, 1 << 20], jnp.int32),
+            "flags": jnp.asarray([True, False, True]),
+        }
+        buckets, spec = fused.flatten_buckets(
+            tree, bucket_bytes=1 << 20, wire_dtype=jnp.bfloat16)
+        assert {jnp.dtype(b.dtype) for b in buckets} == {
+            jnp.dtype(jnp.bfloat16),        # the float group, compressed
+            jnp.dtype(jnp.int32),           # exempt
+            jnp.dtype(jnp.bool_),           # exempt
+        }
+        out = fused.unflatten_buckets(buckets, spec)
+        # the exempt groups survive BIT-EXACT (bf16 would have mangled
+        # every one of these values)
+        np.testing.assert_array_equal(np.asarray(out["i32"]),
+                                      np.asarray(tree["i32"]))
+        np.testing.assert_array_equal(np.asarray(out["flags"]),
+                                      np.asarray(tree["flags"]))
+        # a non-float wire_dtype never casts anything
+        buckets, _ = fused.flatten_buckets(
+            {"f": jnp.ones((4,), jnp.float32)}, bucket_bytes=1 << 20,
+            wire_dtype=jnp.int16)
+        assert buckets[0].dtype == jnp.float32
+
 
 class TestParity:
     """fused_allreduce vs the per-leaf pmean it replaces, on the
@@ -182,6 +212,32 @@ class TestParity:
             np.asarray(out["bf16"], dtype=np.float32)[0],
             np.asarray(tree["bf16"], dtype=np.float32).mean(0),
             rtol=5e-2, atol=5e-2)
+
+    def test_mixed_dtype_wire_parity(self, mesh):
+        """The satellite's regression pin: a mixed f32/int32 tree under
+        a bf16 wire keeps ints EXACT through the collective (they used
+        to come back bf16-mangled) while floats carry the documented
+        wire tolerance."""
+        n = mesh.devices.size
+        rng = np.random.RandomState(13)
+        # rank-identical ints: the mean is the value itself, so any
+        # wire corruption shows as an exact-equality failure
+        ints = np.broadcast_to(
+            np.asarray([1000003, -999983, 1 << 22], np.int32),
+            (n, 3)).copy()
+        tree = {
+            "f32": rng.randn(n, 37).astype(np.float32),
+            "i32": ints,
+        }
+        out = stackmap(mesh, lambda g: fused.fused_allreduce(
+            g, AX, bucket_bytes=self.BUCKET,
+            wire_dtype=jnp.bfloat16))(tree)
+        assert out["i32"].dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(out["i32"])[0],
+                                      ints[0])
+        np.testing.assert_allclose(
+            np.asarray(out["f32"])[0], np.asarray(tree["f32"]).mean(0),
+            rtol=3e-2, atol=3e-2)
 
     def test_empty_tree_is_identity(self, mesh):
         tree = {"e": np.zeros((mesh.devices.size, 0), np.float32)}
@@ -285,6 +341,138 @@ class TestCollectiveBudget:
         with pytest.raises(AssertionError, match="budget"):
             # budget of 1 bucket can't cover a per-leaf lowering
             assert_fused_collectives(stats, total_bytes=1, bucket_bytes=1)
+
+
+class TestPlanDrivenExecution:
+    """``plan_allreduce`` — the autotuner's execution half: every
+    strategy must compute the same mean, from one plan carrier."""
+
+    def _run(self, mesh, tree, plan, **kw):
+        return stackmap(mesh, lambda g: fused.plan_allreduce(
+            g, AX, plan, **kw))(tree)
+
+    def test_reduce_scatter_allgather_matches_pmean(self, mesh):
+        n = mesh.devices.size
+        rng = np.random.RandomState(21)
+        # 13 % 8 != 0: exercises the pad/unpad around psum_scatter
+        x = rng.randn(n, 13).astype(np.float32)
+        out = smap(mesh, lambda s: fused.reduce_scatter_allgather(
+            s.reshape(-1), AX)[None])(x)
+        np.testing.assert_allclose(np.asarray(out)[0], x.mean(0),
+                                   rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError, match="flat bucket"):
+            fused.reduce_scatter_allgather(jnp.ones((2, 2)), AX)
+        with pytest.raises(ValueError, match="unsupported"):
+            fused.reduce_scatter_allgather(jnp.ones(4), AX, op="max")
+
+    @pytest.mark.parametrize("strategy", ["per_leaf", "fused_flat",
+                                          "reduce_scatter"])
+    def test_flat_strategies_match_reference(self, mesh, strategy):
+        tree = odd_tree(mesh.devices.size, seed=8)
+        plan = {"strategy": strategy, "bucket_bytes": 1024,
+                "wire_dtype": None}
+        out = self._run(mesh, tree, plan)
+        for got, ref in zip(jax.tree.leaves(out),
+                            jax.tree.leaves(ref_mean(tree))):
+            np.testing.assert_allclose(np.asarray(got)[0], ref,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_hierarchical_strategy_over_2d_mesh(self):
+        devs = np.asarray(jax.devices())
+        mesh2d = Mesh(devs.reshape(2, devs.size // 2), (INTER, AX))
+        tree = odd_tree(devs.size, seed=9)
+        plan = {"strategy": "hierarchical", "bucket_bytes": 1024,
+                "wire_dtype": None}
+
+        def outer(g):
+            red = fused.plan_allreduce(
+                jax.tree.map(lambda a: a[0], g), AX, plan,
+                inter_axis_name=INTER)
+            return jax.tree.map(lambda a: a[None], red)
+
+        out = jax.jit(jax.shard_map(
+            outer, mesh=mesh2d, in_specs=P((INTER, AX)),
+            out_specs=P((INTER, AX))))(tree)
+        for got, ref in zip(jax.tree.leaves(out),
+                            jax.tree.leaves(ref_mean(tree))):
+            np.testing.assert_allclose(np.asarray(got)[0], ref,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_rs_strategies_handle_nonfloat_leaves(self, mesh):
+        """Regression: the rs→ag lowering used to crash on bool buckets
+        (psum_scatter rejects them) and round int buckets through its
+        shard-side float divide.  Non-float buckets must route through
+        the same pmean the per-leaf path uses — exact agreement."""
+        n = mesh.devices.size
+        rng = np.random.RandomState(31)
+        ints = np.broadcast_to(
+            np.asarray([1000003, -999983], np.int32), (n, 2)).copy()
+        tree = {
+            "f32": rng.randn(n, 19).astype(np.float32),
+            "i32": ints,
+            "flags": np.ones((n, 3), bool),
+        }
+        plans = [
+            {"strategy": "reduce_scatter", "bucket_bytes": 64,
+             "wire_dtype": None},
+            {"strategy": "reduce_scatter", "bucket_bytes": 64,
+             "wire_dtype": "bfloat16"},
+        ]
+        for plan in plans:
+            out = self._run(mesh, tree, plan)
+            assert out["i32"].dtype == jnp.int32
+            assert out["flags"].dtype == jnp.bool_
+            np.testing.assert_array_equal(np.asarray(out["i32"])[0],
+                                          ints[0])
+            np.testing.assert_array_equal(
+                np.asarray(out["flags"])[0], np.ones(3, bool))
+        # the hierarchical lowering shares the exemption
+        devs = np.asarray(jax.devices())
+        mesh2d = Mesh(devs.reshape(2, n // 2), (INTER, AX))
+
+        def outer(g):
+            red = fused.plan_allreduce(
+                jax.tree.map(lambda a: a[0], g), AX,
+                {"strategy": "hierarchical", "bucket_bytes": 64,
+                 "wire_dtype": None}, inter_axis_name=INTER)
+            return jax.tree.map(lambda a: a[None], red)
+
+        out = jax.jit(jax.shard_map(
+            outer, mesh=mesh2d, in_specs=P((INTER, AX)),
+            out_specs=P((INTER, AX))))(tree)
+        np.testing.assert_array_equal(np.asarray(out["i32"])[0],
+                                      ints[0])
+        np.testing.assert_array_equal(np.asarray(out["flags"])[0],
+                                      np.ones(3, bool))
+
+    def test_plan_object_and_attrs_accepted(self, mesh):
+        """dict, Plan, and any strategy/bucket/wire-attributed object
+        are all valid carriers."""
+        from chainermn_tpu.utils.autotune import Plan
+
+        tree = {"w": np.random.RandomState(2).randn(
+            mesh.devices.size, 9).astype(np.float32)}
+        want = np.asarray(tree["w"]).mean(0)
+        for carrier in (
+                Plan(strategy="fused_flat", bucket_bytes=256),
+                {"strategy": "fused_flat", "bucket_bytes": 256,
+                 "wire_dtype": None}):
+            out = self._run(mesh, tree, carrier)
+            np.testing.assert_allclose(np.asarray(out["w"])[0], want,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_hierarchical_without_inter_axis_raises(self):
+        with pytest.raises(ValueError, match="inter_axis_name"):
+            fused.plan_allreduce(
+                {"w": jnp.ones(4)}, AX,
+                {"strategy": "hierarchical", "bucket_bytes": 64,
+                 "wire_dtype": None})
+
+    def test_unknown_strategy_raises(self):
+        with pytest.raises(ValueError, match="strategy"):
+            fused.plan_allreduce(
+                {"w": jnp.ones(4)}, AX,
+                {"strategy": "warp_drive", "bucket_bytes": 64})
 
 
 class TestChooseBucketBytes:

@@ -1,0 +1,544 @@
+"""Measured exchange-plan autotuner (``utils/autotune.py``): candidate
+space, cost-model pruning, live probing with parity, the persistent
+plan cache (round-trip + key invalidation), the rank-0 decision
+broadcast, and the drift guard.
+
+The cache-key discipline under test is the load-bearing part: a plan
+measured on one (topology, payload, software) triple must NEVER serve
+another — mesh shape, payload signature, and version changes each force
+a re-tune — while an exact match must serve with ZERO probe executions.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import chainermn_tpu as cmn
+from chainermn_tpu.ops import fused
+from chainermn_tpu.utils import autotune
+from chainermn_tpu.utils.comm_model import LinkParams
+
+AX = "world"
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla", axis_name=AX)
+
+
+def small_tree(seed=0, width=16, n_leaves=6):
+    rng = np.random.RandomState(seed)
+    return {f"w{i}": jnp.asarray(rng.randn(width, 4), jnp.float32)
+            for i in range(n_leaves)}
+
+
+def tune(comm, tree, cache, **kw):
+    kw.setdefault("trials", 1)
+    kw.setdefault("warmup", 1)
+    return autotune.autotune_plan(comm, tree, cache_path=cache, **kw)
+
+
+class TestSignaturesAndKeys:
+    def test_payload_signature_groups_and_digest(self):
+        tree = {"f": jnp.ones((4, 4), jnp.float32),
+                "i": jnp.ones((3,), jnp.int32),
+                "e": jnp.zeros((0, 2), jnp.float32)}
+        sig = autotune.payload_signature(tree)
+        assert sig["n_leaves"] == 3 and sig["n_nonempty"] == 2
+        assert sig["groups"] == {"float32": 64, "int32": 12}
+        assert sig["total_bytes"] == 76
+        # digest covers shapes: a reshape re-keys
+        sig2 = autotune.payload_signature(
+            {"f": jnp.ones((2, 8), jnp.float32),
+             "i": jnp.ones((3,), jnp.int32),
+             "e": jnp.zeros((0, 2), jnp.float32)})
+        assert sig2["digest"] != sig["digest"]
+
+    def test_plan_key_sensitivity(self, comm):
+        tree = small_tree()
+        sig = autotune.payload_signature(tree)
+        msig = autotune.mesh_signature(comm.mesh)
+        key = autotune.plan_key(msig, sig)
+        # payload change re-keys
+        assert autotune.plan_key(
+            msig, autotune.payload_signature(small_tree(width=32))) != key
+        # mesh/topology change re-keys (a hierarchical factoring IS a
+        # different topology)
+        assert autotune.plan_key(
+            autotune.mesh_signature(comm.mesh, hier_shape=(2, 4)),
+            sig) != key
+        # version change re-keys
+        msig_v = dict(msig, format_version=autotune.FORMAT_VERSION + 1)
+        assert autotune.plan_key(msig_v, sig) != key
+        msig_j = dict(msig, jax_version="0.0.0")
+        assert autotune.plan_key(msig_j, sig) != key
+
+
+class TestCandidatesAndModel:
+    def test_enumeration_shape(self):
+        sig = autotune.payload_signature(small_tree())
+        cands = autotune.enumerate_candidates(sig, 8)
+        assert cands[0].strategy == "per_leaf"
+        strategies = {c.strategy for c in cands}
+        assert strategies == {"per_leaf", "fused_flat", "reduce_scatter"}
+        hier = autotune.enumerate_candidates(sig, 8,
+                                             allow_hierarchical=True)
+        assert "hierarchical" in {c.strategy for c in hier}
+        # fp32 payload: bf16 wire variants present
+        assert any(c.wire_dtype == "bfloat16" for c in cands)
+        # pure-int payload: wire variants pruned (nothing compresses)
+        int_sig = autotune.payload_signature(
+            {"i": jnp.ones((64,), jnp.int32)})
+        assert all(c.wire_dtype is None
+                   for c in autotune.enumerate_candidates(int_sig, 8))
+
+    def test_model_cost_orders_sanely(self):
+        """The pruning model must encode the two first-order facts:
+        per-leaf pays launches, compression cuts wire time."""
+        rng = np.random.RandomState(0)
+        many = {f"p{i}": jnp.asarray(rng.randn(64), jnp.float32)
+                for i in range(200)}
+        sig = autotune.payload_signature(many)
+        link = LinkParams(latency_s=1e-4, bandwidth_bytes_per_s=1e9)
+        per_leaf = autotune.Candidate("per_leaf", sig["total_bytes"])
+        fused_c = autotune.Candidate("fused_flat", sig["total_bytes"])
+        assert autotune.model_cost(per_leaf, sig, 8, link=link) > \
+            autotune.model_cost(fused_c, sig, 8, link=link)
+        bf16 = autotune.Candidate("fused_flat", sig["total_bytes"],
+                                  "bfloat16")
+        slow = LinkParams(latency_s=1e-9, bandwidth_bytes_per_s=1e6)
+        assert autotune.model_cost(bf16, sig, 8, link=slow) < \
+            autotune.model_cost(fused_c, sig, 8, link=slow)
+
+    def test_wire_stats_respect_nonfloat_exemption(self):
+        sig = autotune.payload_signature(
+            {"f": jnp.ones((256,), jnp.float32),
+             "i": jnp.ones((256,), jnp.int32)})
+        cand = autotune.Candidate("fused_flat", 1 << 20, "bfloat16")
+        _, wire = autotune.candidate_wire_stats(cand, sig, 8)
+        # floats compress 1024 -> 512 bytes; ints stay 1024
+        assert wire == pytest.approx(2 * (512 + 1024) * 7 / 8)
+
+    def test_hierarchical_wire_stats_use_intra_size(self):
+        """n = k×m factoring: the intra halves ring over k members and
+        the inter stage runs on the 1/k shard — w/n there would
+        understate inter traffic by m× and flatter hierarchical
+        candidates in pruning and the LinkParams fit."""
+        sig = autotune.payload_signature(
+            {"f": jnp.ones((256,), jnp.float32)})   # w = 1024 bytes
+        cand = autotune.Candidate("hierarchical", 1 << 20)
+        launches, wire = autotune.candidate_wire_stats(
+            cand, sig, axis_size=8, inter_size=2)   # k=4, m=2
+        assert launches == 3    # rs + ar + ag on the single bucket
+        w = 1024
+        want = 2 * w * (3 / 4) + 2 * (w / 4) * (1 / 2)
+        assert wire == pytest.approx(want)
+
+
+class TestCacheRoundTrip:
+    def test_store_load_roundtrip(self, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        plan = autotune.Plan(strategy="fused_flat", bucket_bytes=4096,
+                             wire_dtype="bfloat16", measured_ms=1.25,
+                             key="k1", link={"latency_s": 1e-5,
+                                             "bandwidth_bytes_per_s": 1e9},
+                             meta={"note": "x"})
+        autotune.store_plan(plan, cache)
+        got = autotune.load_cached_plan("k1", cache)
+        assert got.to_dict() == plan.to_dict()
+        assert got.from_cache and got.n_probes == 0
+        assert got.link_params == LinkParams(1e-5, 1e9)
+        assert autotune.load_cached_plan("other", cache) is None
+
+    def test_corrupt_and_wrong_format_cache_files(self, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        with open(cache, "w") as f:
+            f.write("{not json")
+        assert autotune.load_cached_plan("k", cache) is None
+        with open(cache, "w") as f:
+            json.dump({"format": autotune.FORMAT_VERSION + 1,
+                       "plans": {"k": {"strategy": "fused_flat",
+                                       "bucket_bytes": 1}}}, f)
+        # wrong format version: treated as empty, never served
+        assert autotune.load_cached_plan("k", cache) is None
+
+    def test_env_override_of_default_path(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(autotune.PLAN_CACHE_ENV,
+                           str(tmp_path / "custom.json"))
+        assert autotune.default_cache_path() == \
+            str(tmp_path / "custom.json")
+
+
+class TestAutotuneEndToEnd:
+    """Live probe search on the 8-device CPU mesh."""
+
+    def test_tune_then_cache_hit(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        tree = small_tree()
+        plan = tune(comm, tree, cache)
+        assert not plan.from_cache and plan.n_probes > 0
+        assert plan.strategy in fused.PLAN_STRATEGIES
+        assert plan.measured_ms > 0
+        assert all(t["parity_ok"] for t in plan.meta["timings"])
+        # the cache file exists and the second call runs ZERO probes
+        assert os.path.exists(cache)
+        plan2 = tune(comm, tree, cache)
+        assert plan2.from_cache and plan2.n_probes == 0
+        assert plan2.to_dict() == plan.to_dict()
+
+    def test_key_invalidation_forces_retune(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        tune(comm, small_tree(), cache)
+        # different payload signature: re-tunes (probes run)
+        p = tune(comm, small_tree(width=32), cache)
+        assert not p.from_cache and p.n_probes > 0
+        # different topology (2-D hierarchical factoring): re-tunes
+        devs = np.asarray(jax.devices())
+        hm = Mesh(devs.reshape(2, 4), ("inter", AX))
+        p = tune(comm, small_tree(), cache, hier_mesh=hm)
+        assert not p.from_cache and p.n_probes > 0
+        # unchanged signature still hits
+        p = tune(comm, small_tree(), cache)
+        assert p.from_cache and p.n_probes == 0
+        # format-version bump: re-tunes even with everything else equal
+        # (and invalidates the whole cache file — old measurements are
+        # incomparable under new plan semantics)
+        import chainermn_tpu.utils.autotune as at
+        old = at.FORMAT_VERSION
+        try:
+            at.FORMAT_VERSION = old + 1
+            p = tune(comm, small_tree(), cache)
+            assert not p.from_cache and p.n_probes > 0
+        finally:
+            at.FORMAT_VERSION = old
+
+    def test_force_retunes_past_a_hit(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        tune(comm, small_tree(), cache)
+        p = tune(comm, small_tree(), cache, force=True)
+        assert not p.from_cache and p.n_probes > 0
+
+    def test_every_candidate_parity_vs_per_leaf(self, comm, tmp_path):
+        """allclose parity of EVERY candidate plan against the per-leaf
+        baseline — including hierarchical (2-D mesh) and the
+        reduce-scatter→all-gather lowering, native and bf16 wire."""
+        n = comm.size
+        rng = np.random.RandomState(3)
+        tree = {
+            "big": jnp.asarray(rng.randn(301, 7), jnp.float32),
+            "odd": jnp.asarray(rng.randn(17, 5), jnp.float32),
+            "tiny": jnp.asarray(rng.randn(3), jnp.float32),
+            "i32": jnp.full((5,), 1000003, jnp.int32),
+        }
+        sig = autotune.payload_signature(tree)
+        devs = np.asarray(jax.devices())
+        hm = Mesh(devs.reshape(2, n // 2), ("inter", AX))
+        data = autotune._probe_tree(tree, n, seed=1)
+        base_fn = autotune.build_exchange_fn(
+            comm.mesh, AX, {"strategy": "per_leaf", "bucket_bytes": 0,
+                            "wire_dtype": None})
+        want = base_fn(data)
+        cands = autotune.enumerate_candidates(sig, n,
+                                              allow_hierarchical=True,
+                                              grid=(0.25, 1.0))
+        assert len(cands) > 6
+        for cand in cands:
+            hier = cand.strategy == "hierarchical"
+            fn = autotune.build_exchange_fn(
+                hm if hier else comm.mesh, AX, cand.__dict__,
+                inter_axis_name="inter" if hier else None)
+            got = fn(data)
+            assert autotune._parity_ok(got, want, cand.wire_dtype), \
+                f"candidate {cand.label()} failed parity"
+
+    def test_rank0_broadcast_is_authoritative(self, comm, tmp_path):
+        """Every rank adopts ROOT's plan dict, not its own timings: a
+        communicator whose bcast_obj rewrites the payload (standing in
+        for a rank whose local winner differed) must see the rewritten
+        plan come back — and persist THAT one."""
+        cache = str(tmp_path / "plans.json")
+
+        class RootDecides:
+            def __init__(self, inner):
+                self._inner = inner
+                self.calls = 0
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def bcast_obj(self, obj, root=0):
+                assert root == 0
+                self.calls += 1
+                if obj is None:
+                    # the hit/miss agreement round on a cold cache:
+                    # root's verdict (miss) passes through
+                    return None
+                out = dict(obj)
+                out["strategy"] = "reduce_scatter"
+                out["meta"] = dict(out["meta"], root_override=True)
+                return out
+
+        wrapped = RootDecides(comm)
+        plan = tune(wrapped, small_tree(seed=5), cache)
+        # two collective rounds: the cache hit/miss agreement, then the
+        # winning-plan decision
+        assert wrapped.calls == 2
+        assert plan.strategy == "reduce_scatter"
+        assert plan.meta["root_override"] is True
+        # the broadcast winner is what landed in the cache
+        cached = autotune.load_cached_plan(plan.key, cache)
+        assert cached.strategy == "reduce_scatter"
+
+    def test_cache_hit_agreement_serves_cold_ranks(self, comm,
+                                                   tmp_path):
+        """The hit/miss decision is SPMD-agreed: a rank whose LOCAL
+        cache is cold must adopt root's cached plan (probing and the
+        winner broadcast are collective — divergent control flow there
+        is a multi-host deadlock), and warm its own file with it."""
+        cache = str(tmp_path / "plans.json")
+        plan = tune(comm, small_tree(seed=6), cache)
+
+        class RootServes:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def bcast_obj(self, obj, root=0):
+                # root's verdict: a hit — regardless of local state
+                return plan.to_dict()
+
+        cold = str(tmp_path / "cold_rank.json")
+        served = autotune.autotune_plan(
+            RootServes(comm), small_tree(seed=6), cache_path=cold,
+            trials=1, warmup=1)
+        assert served.from_cache and served.n_probes == 0
+        assert served.to_dict() == plan.to_dict()
+        assert autotune.load_cached_plan(plan.key, cold) is not None
+
+    def test_store_plan_merges_concurrent_keys(self, tmp_path):
+        """Two plans stored under different keys both survive — the
+        read-modify-write is merge-on-write, not last-writer-wins."""
+        cache = str(tmp_path / "plans.json")
+        a = autotune.Plan(strategy="fused_flat", bucket_bytes=1,
+                          key="ka")
+        b = autotune.Plan(strategy="per_leaf", bucket_bytes=2,
+                          key="kb")
+        autotune.store_plan(a, cache)
+        autotune.store_plan(b, cache)
+        assert autotune.load_cached_plan("ka", cache).bucket_bytes == 1
+        assert autotune.load_cached_plan("kb", cache).bucket_bytes == 2
+
+    def test_retune_keeps_cell_constraints(self, comm, tmp_path):
+        """A drift retune() re-applies the constraints the cell was
+        resolved under — it must never adopt a plan the consuming step
+        program cannot execute (e.g. hierarchical without the axis)."""
+        cache = str(tmp_path / "plans.json")
+        cell = autotune.PlanCell(autotune.Plan(
+            strategy="fused_flat", bucket_bytes=64, measured_ms=1.0,
+            key="k"))
+        seen = {}
+
+        def spy(comm_, params, **kw):
+            seen.update(kw)
+            return autotune.Plan(strategy="fused_flat",
+                                 bucket_bytes=128, key="k2")
+
+        cell.tune_kwargs = dict(allow_hierarchical=False,
+                                inter_axis_name=None)
+        import chainermn_tpu.utils.autotune as at
+        orig = at.autotune_plan
+        at.autotune_plan = spy
+        try:
+            cell.retune(comm, small_tree())
+        finally:
+            at.autotune_plan = orig
+        assert seen["allow_hierarchical"] is False
+        assert seen["inter_axis_name"] is None
+        assert cell.plan.bucket_bytes == 128
+
+    def test_tracer_guard(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+
+        def traced(x):
+            autotune.autotune_plan(comm, {"w": x}, cache_path=cache)
+            return x
+
+        with pytest.raises(RuntimeError, match="under tracing"):
+            jax.jit(traced)(jnp.ones(3))
+
+    def test_mesh_axis_required_without_comm(self):
+        with pytest.raises(ValueError, match="mesh"):
+            autotune.autotune_plan(None, {"w": jnp.ones(3)})
+
+
+class TestPlanCell:
+    def mkplan(self, measured_ms=10.0):
+        return autotune.Plan(strategy="fused_flat", bucket_bytes=4096,
+                             measured_ms=measured_ms, key="k")
+
+    def test_drift_flags_both_directions(self):
+        cell = autotune.PlanCell(self.mkplan(10.0), drift_factor=2.0)
+        assert not cell.drifted          # no observation yet
+        cell.observe(0.015)              # 1.5x: within band
+        assert not cell.drifted
+        cell.observe(0.025)              # 2.5x slower: drift
+        assert cell.drifted
+        cell.observe(0.003)              # 3.3x faster: drift too (the
+        assert cell.drifted              # plan is leaving perf on the table)
+
+    def test_should_retune_is_rank0_agreed(self):
+        """The collective-retune gate follows rank 0's verdict, not the
+        local one — hosts disagreeing about drift must still enter (or
+        skip) the collective together."""
+        cell = autotune.PlanCell(self.mkplan(10.0), drift_factor=2.0)
+        cell.observe(1.0)            # locally drifted
+        assert cell.drifted
+        assert cell.should_retune(None) is True    # no comm: local
+
+        class Root:
+            def __init__(self, verdict):
+                self.verdict = verdict
+
+            def bcast_obj(self, obj, root=0):
+                assert root == 0
+                return self.verdict   # rank 0's drifted, broadcast
+
+        # rank 0 says no drift: this (locally drifted) rank must NOT
+        # enter the collective retune
+        assert cell.should_retune(Root(False)) is False
+        assert cell.should_retune(Root(True)) is True
+
+    def test_resolve_clears_observation(self):
+        cell = autotune.PlanCell(self.mkplan(10.0))
+        cell.observe(1.0)
+        assert cell.drifted
+        cell.resolve(self.mkplan(1000.0))
+        assert cell.observed_s is None and not cell.drifted
+
+    def test_retune_adopts_fresh_plan(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        tree = small_tree(seed=9)
+        cell = autotune.PlanCell(self.mkplan(10.0))
+        plan = cell.retune(comm, tree, cache_path=cache, trials=1,
+                           warmup=1)
+        assert cell.plan is plan and plan.n_probes > 0
+
+    def test_bad_drift_factor(self):
+        with pytest.raises(ValueError, match="drift_factor"):
+            autotune.PlanCell(drift_factor=1.0)
+
+
+class TestCommunicatorPlanPath:
+    """``multi_node_mean_grad(plan=...)`` — the eager exchange driven by
+    a tuned plan instead of per-call kwargs."""
+
+    def test_explicit_plan_matches_numpy_mean(self, comm):
+        n = comm.size
+        rng = np.random.RandomState(4)
+        grads = {"a": rng.randn(n, 17).astype(np.float32),
+                 "b": rng.randn(n, 3, 3).astype(np.float32)}
+        for strategy in ("per_leaf", "fused_flat", "reduce_scatter"):
+            plan = autotune.Plan(strategy=strategy, bucket_bytes=256)
+            out = comm.multi_node_mean_grad(grads, plan=plan)
+            for k in grads:
+                np.testing.assert_allclose(
+                    np.asarray(out[k])[0], grads[k].mean(0),
+                    rtol=1e-5, atol=1e-6)
+
+    def test_auto_resolves_once_and_memoizes(self, comm, tmp_path,
+                                             monkeypatch):
+        monkeypatch.setenv(autotune.PLAN_CACHE_ENV,
+                           str(tmp_path / "plans.json"))
+        n = comm.size
+        grads = {"w": np.random.RandomState(5).randn(n, 33)
+                 .astype(np.float32)}
+        out = comm.multi_node_mean_grad(grads, plan="auto")
+        np.testing.assert_allclose(np.asarray(out["w"])[0],
+                                   grads["w"].mean(0),
+                                   rtol=3e-2, atol=3e-2)
+        # the resolved plan is memoized per payload signature — the
+        # second call neither re-tunes nor re-reads the cache file
+        memo = [k for k in comm._jit_cache if k[0] == "plan_auto"]
+        assert len(memo) == 1
+        resolved = comm._jit_cache[memo[0]]
+        comm.multi_node_mean_grad(grads, plan="auto")
+        assert comm._jit_cache[memo[0]] is resolved
+
+    def test_hierarchical_plan_on_flat_world_raises(self, comm):
+        plan = autotune.Plan(strategy="hierarchical", bucket_bytes=256)
+        with pytest.raises(ValueError, match="factoring"):
+            comm.multi_node_mean_grad(
+                {"w": np.ones((comm.size, 4), np.float32)}, plan=plan)
+
+    def test_bad_plan_string_raises(self, comm):
+        with pytest.raises(ValueError, match="auto"):
+            comm.multi_node_mean_grad(
+                {"w": np.ones((comm.size, 4), np.float32)},
+                plan="fastest")
+
+    def test_loopback_accepts_plan(self):
+        lb = cmn.create_communicator("loopback")
+        out = lb.multi_node_mean_grad(
+            {"w": np.ones((1, 4), np.float32)},
+            plan={"strategy": "fused_flat", "bucket_bytes": 64})
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.ones((1, 4), np.float32))
+
+    def test_allreduce_grad_alias_forwards_plan(self, comm):
+        n = comm.size
+        grads = {"w": np.random.RandomState(6).randn(n, 8)
+                 .astype(np.float32)}
+        plan = autotune.Plan(strategy="fused_flat", bucket_bytes=128)
+        out = comm.allreduce_grad(grads, plan=plan)
+        np.testing.assert_allclose(np.asarray(out["w"])[0],
+                                   grads["w"].mean(0), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestLinkParamsFit:
+    def test_recovers_synthetic_constants(self):
+        alpha, beta = 5e-5, 2.5e9
+        samples = [(k, b, k * alpha + b / beta)
+                   for k, b in [(1, 1e6), (10, 1e6), (4, 8e6),
+                                (200, 2e5), (50, 4e7)]]
+        fit = LinkParams.from_probes(samples)
+        assert fit.latency_s == pytest.approx(alpha, rel=1e-6)
+        assert fit.bandwidth_bytes_per_s == pytest.approx(beta, rel=1e-6)
+
+    def test_degenerate_fits_fall_back(self):
+        default = LinkParams()
+        assert LinkParams.from_probes([]) == default
+        assert LinkParams.from_probes([(1, 1e6, 0.001)]) == default
+        # collinear rows: singular normal equations
+        assert LinkParams.from_probes(
+            [(1, 1e6, 0.001), (2, 2e6, 0.002)]) == default
+        # unphysical (negative latency) fit rejected
+        assert LinkParams.from_probes(
+            [(1, 1e6, 0.001), (100, 1e6, 0.0001), (50, 2e6, 5e-4)]
+        ) == default
+
+    def test_choosers_accept_link(self):
+        from chainermn_tpu.utils import choose_accum_steps, \
+            choose_bucket_bytes
+
+        slow = LinkParams(latency_s=1e-3,
+                          bandwidth_bytes_per_s=1e9)
+        fast = LinkParams(latency_s=1e-7,
+                          bandwidth_bytes_per_s=1e9)
+        # slower launches -> bigger buckets, identical to passing the
+        # constants positionally
+        assert choose_bucket_bytes(1e9, 8, link=slow) == \
+            choose_bucket_bytes(1e9, 8, latency_s=1e-3,
+                                bandwidth_bytes_per_s=1e9)
+        assert choose_bucket_bytes(1e9, 8, link=slow) > \
+            choose_bucket_bytes(1e9, 8, link=fast)
+        # slower link -> larger accumulation window
+        assert choose_accum_steps(64 << 20, 8, 1e-3, link=slow) >= \
+            choose_accum_steps(64 << 20, 8, 1e-3, link=fast)

@@ -168,8 +168,13 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
      ["--batch", "8", "--dim", "64", "--hidden", "128",
       "--accum-steps", "2", "--warmup", "1", "--iters", "3",
       "--rounds", "1"], "x"),
+    ("bench_autotune.py",
+     ["--n-layers", "4", "--d-model", "16", "--vocab", "256",
+      "--trials", "1", "--rounds", "1", "--iters", "1",
+      "--top-k", "4"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
-        "fused_allreduce", "pipeline", "resilience", "accum"])
+        "fused_allreduce", "pipeline", "resilience", "accum",
+        "autotune"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
