@@ -16,8 +16,14 @@
   preemption SIGTERM notice (beyond reference; see module docstring).
 - :class:`TrainingWatchdog` — monitor thread fed step-boundary
   heartbeats (+ optional cross-process KV heartbeats): on stall it dumps
-  all-thread stacks, writes a structured stall report, and optionally
-  escalates crash-don't-deadlock (beyond reference; docs/RESILIENCE.md).
+  all-thread stacks, writes a structured stall report (with the flight
+  recorder's ring tail), and optionally escalates crash-don't-deadlock
+  (beyond reference; docs/RESILIENCE.md).
+- :class:`StragglerReport` / :class:`MetricsExport` — flight-recorder
+  extensions (cross-rank per-phase straggler attribution; JSONL metric
+  time series).  Defined in :mod:`chainermn_tpu.utils.telemetry`,
+  re-exported here because they plug into the trainer like the rest
+  (docs/OBSERVABILITY.md).
 """
 
 from chainermn_tpu.extensions.allreduce_persistent import (
@@ -37,13 +43,16 @@ from chainermn_tpu.extensions.observation_aggregator import (
 from chainermn_tpu.extensions.preemption import PreemptionCheckpointer
 from chainermn_tpu.extensions.snapshot import multi_node_snapshot
 from chainermn_tpu.extensions.watchdog import TrainingWatchdog
+from chainermn_tpu.utils.telemetry import MetricsExport, StragglerReport
 
 __all__ = [
     "AllreducePersistentValues",
     "FailOnNonNumber",
+    "MetricsExport",
     "MultiNodeCheckpointer",
     "ObservationAggregator",
     "PreemptionCheckpointer",
+    "StragglerReport",
     "TrainingWatchdog",
     "add_global_except_hook",
     "create_multi_node_checkpointer",

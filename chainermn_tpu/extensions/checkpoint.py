@@ -153,26 +153,31 @@ class MultiNodeCheckpointer:
 
     def save(self, updater, trainer=None) -> None:
         from chainermn_tpu.training._resume import collect_train_state
+        from chainermn_tpu.utils.telemetry import get_recorder
 
         it = updater.iteration
-        state = {
-            "iteration": it,
-            "world_size": self.comm.inter_size,
-            "params": updater.params,
-            "opt_state": updater.opt_state,
-            "train_state": collect_train_state(updater, trainer),
-        }
-        if getattr(updater, "state", None) is not None:
-            state["model_state"] = updater.state
-        fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
-        if self.async_write:
-            self._save_async(os.path.join(self.path, fn), state, it)
-            return
-        save_state(os.path.join(self.path, fn), state)
-        self._saved_iterations.add(it)
-        # all shards of this iteration exist before older sets are GC'd
-        self.comm.barrier()
-        self._cleanup(keep=it)
+        with get_recorder().span("checkpoint/save_shard",
+                                 cat="checkpoint", step=it,
+                                 async_write=self.async_write):
+            state = {
+                "iteration": it,
+                "world_size": self.comm.inter_size,
+                "params": updater.params,
+                "opt_state": updater.opt_state,
+                "train_state": collect_train_state(updater, trainer),
+            }
+            if getattr(updater, "state", None) is not None:
+                state["model_state"] = updater.state
+            fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
+            if self.async_write:
+                self._save_async(os.path.join(self.path, fn), state, it)
+                return
+            save_state(os.path.join(self.path, fn), state)
+            self._saved_iterations.add(it)
+            # all shards of this iteration exist before older sets are
+            # GC'd
+            self.comm.barrier()
+            self._cleanup(keep=it)
 
     # ------------------------------------------------------------------ #
     # async write path
@@ -308,6 +313,13 @@ class MultiNodeCheckpointer:
         (fresh start — the reference's behaviour on first launch).
         """
         from chainermn_tpu.training._resume import restore_train_state
+        from chainermn_tpu.utils.telemetry import get_recorder
+
+        with get_recorder().span("checkpoint/resume", cat="checkpoint"):
+            return self._maybe_load(updater, trainer, restore_train_state)
+
+    def _maybe_load(self, updater, trainer, restore_train_state
+                    ) -> Optional[int]:
         self._join_pending(barrier_and_gc=True)
         skipped = []
         rejected: Set[int] = set()

@@ -24,6 +24,29 @@ import traceback
 __all__ = ["add_global_except_hook"]
 
 _installed = False
+_trace_dir: str = "."
+
+
+def _dump_trace(rank: int) -> None:
+    """Best-effort flight-recorder dump next to the crash: the timeline
+    of the seconds before death rides with the traceback, so the
+    post-mortem starts with *what was happening*, not just where it
+    ended.  No-op when tracing is disabled or the ring is empty."""
+    try:
+        from chainermn_tpu.utils.telemetry import get_recorder
+
+        recorder = get_recorder()
+        if not recorder.enabled or not len(recorder):
+            return
+        out_dir = os.environ.get("CHAINERMN_TPU_TRACE_DIR", _trace_dir)
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f"trace_crash.rank{rank}.json")
+        recorder.export_chrome(path)
+        sys.stderr.write(
+            f"[chainermn_tpu] flight-recorder trace dumped to {path} "
+            f"(load at https://ui.perfetto.dev)\n")
+    except Exception:
+        pass  # the abort path must never be the thing that hangs
 
 
 def _make_hook(prev_hook):
@@ -40,6 +63,7 @@ def _make_hook(prev_hook):
                 "aborting the whole job (global except hook):\n")
             traceback.print_exception(
                 exc_type, exc_value, exc_traceback, file=sys.stderr)
+            _dump_trace(rank)
             sys.stderr.flush()
             if nprocs > 1:
                 try:
@@ -56,10 +80,17 @@ def _make_hook(prev_hook):
     return _global_except_hook
 
 
-def add_global_except_hook() -> None:
+def add_global_except_hook(trace_dir=None) -> None:
     """Idempotently install the hook (the reference auto-installed on
-    import; we keep it explicit so embedding applications stay in control)."""
-    global _installed
+    import; we keep it explicit so embedding applications stay in
+    control).  ``trace_dir`` is where an enabled flight recorder's
+    crash trace lands (``trace_crash.rank<r>.json``; default the CWD,
+    env ``CHAINERMN_TPU_TRACE_DIR`` overrides).  ``None`` leaves any
+    previously configured directory alone, so repeated no-arg calls
+    from other modules cannot clobber an explicit setting."""
+    global _installed, _trace_dir
+    if trace_dir is not None:
+        _trace_dir = trace_dir
     if _installed:
         return
     sys.excepthook = _make_hook(sys.excepthook)

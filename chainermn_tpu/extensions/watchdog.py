@@ -91,6 +91,13 @@ class TrainingWatchdog:
         ``<trainer.out>/stall_report.json`` (or CWD when used without a
         trainer).
       exit_code: the ``os._exit`` status used by escalation.
+      trace_tail_events: how many flight-recorder events the stall
+        report embeds (``trace_tail`` key) — the timeline of what this
+        process was doing in the seconds before it stopped beating,
+        alongside the stacks that show where it is stuck NOW.  Uses the
+        global :func:`chainermn_tpu.utils.telemetry.get_recorder`;
+        empty when tracing is disabled.  Heartbeats are also recorded
+        as instant events, so the trace itself shows the beat cadence.
 
     Use::
 
@@ -112,7 +119,8 @@ class TrainingWatchdog:
                  comm=None, escalate: bool = False,
                  on_stall: Optional[Callable[[dict], None]] = None,
                  report_path: Optional[str] = None,
-                 exit_code: int = 42):
+                 exit_code: int = 42,
+                 trace_tail_events: int = 64):
         if stall_timeout <= 0:
             raise ValueError("stall_timeout must be > 0")
         self.stall_timeout = float(stall_timeout)
@@ -125,6 +133,7 @@ class TrainingWatchdog:
         self.on_stall = on_stall
         self.report_path = report_path
         self.exit_code = exit_code
+        self.trace_tail_events = int(trace_tail_events)
         self.stall_count = 0          # reports fired (monotonic)
         self.last_report: Optional[dict] = None
         self._beats = 0
@@ -236,6 +245,10 @@ class TrainingWatchdog:
         self._iteration = iteration
         self._last_beat = time.monotonic()
         self._reported_current_stall = False
+        from chainermn_tpu.utils.telemetry import get_recorder
+
+        get_recorder().instant("watchdog/heartbeat", cat="watchdog",
+                               step=iteration, beats=self._beats)
         self._publish_beat()
 
     def start(self) -> None:
@@ -312,6 +325,18 @@ class TrainingWatchdog:
             "threads": _thread_stacks(),
             "escalating": bool(self.escalate and local_stall),
         }
+        # the flight recorder's ring tail: what this process was DOING
+        # in the seconds before the beats stopped — the timeline half of
+        # the post-mortem (the stacks above are the "stuck now" half)
+        try:
+            from chainermn_tpu.utils.telemetry import get_recorder
+
+            recorder = get_recorder()
+            report["trace_tail"] = recorder.tail(self.trace_tail_events)
+            report["trace_enabled"] = recorder.enabled
+        except Exception:
+            report["trace_tail"] = []
+            report["trace_enabled"] = False
         self.last_report = report
         path = self.report_path or "stall_report.json"
         try:

@@ -44,6 +44,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from chainermn_tpu.utils.telemetry import get_recorder
+
 __all__ = [
     "DeviceWindow",
     "PrefetchIterator",
@@ -424,14 +426,21 @@ class PrefetchIterator:
     def _worker(self):
         try:
             while not self._stop.is_set():
+                # re-resolved per window (like the consumer side): a
+                # set_recorder() swap mid-run must not strand this
+                # long-lived thread on the old recorder
+                tracer = get_recorder()
                 snap = self._snapshot()
                 try:
-                    window, pending = assemble_window(
-                        self._pull, self._n_steps)
+                    with tracer.span("prefetch/assemble", cat="input"):
+                        window, pending = assemble_window(
+                            self._pull, self._n_steps)
                 except StopIteration:
                     self._deliver(("stop", None, snap))
                     return
-                rec = self._to_device(window, pending)
+                with tracer.span("prefetch/put", cat="input",
+                                 k=len(window)):
+                    rec = self._to_device(window, pending)
                 if not self._deliver(("window", rec, snap)):
                     return
         except BaseException as e:  # noqa: BLE001 — propagate on next()
@@ -478,7 +487,13 @@ class PrefetchIterator:
         if self._finished:
             raise StopIteration
         self._ensure_worker()
-        kind, rec, _snap = self._take()
+        tracer = get_recorder()
+        with tracer.span("prefetch/slot_wait", cat="input"):
+            kind, rec, _snap = self._take()
+        # occupancy AFTER the take: ~depth when device-bound, ~0 when
+        # host-bound — the docs/PIPELINE.md diagnostic as a Perfetto
+        # counter track
+        tracer.counter("prefetch/occupancy", self.buffered)
         if kind == "error":
             self._error = rec
             self._join()

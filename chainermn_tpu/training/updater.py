@@ -35,6 +35,7 @@ from chainermn_tpu.iterators.prefetch import (
     put_window,
 )
 from chainermn_tpu.utils.profiling import get_profiler
+from chainermn_tpu.utils.telemetry import get_recorder
 
 __all__ = ["StandardUpdater", "default_converter", "fuse_steps"]
 
@@ -560,20 +561,32 @@ class StandardUpdater:
         if cell is not None and cell.generation != self._plan_generation:
             self._step_cache.clear()
             self._plan_generation = cell.generation
+            get_recorder().instant("step/plan_change", cat="step",
+                                   step=self.iteration,
+                                   generation=cell.generation)
+        tracer = get_recorder()
 
         # -- host phase: obtain the next device-resident window -------- #
         t0 = time.perf_counter()
-        if self.prefetch:
-            rec = next(self.iterator)       # DeviceWindow, pre-transferred
-            arrays, k, tail = rec.arrays, rec.k, rec.tail
-        else:
-            arrays, k, tail = self._assemble_host_window()
+        with tracer.span("step/host", cat="step", step=self.iteration,
+                         prefetch=bool(self.prefetch)):
+            if self.prefetch:
+                rec = next(self.iterator)   # DeviceWindow, pre-transferred
+                arrays, k, tail = rec.arrays, rec.k, rec.tail
+            else:
+                arrays, k, tail = self._assemble_host_window()
         host_time = time.perf_counter() - t0
 
         # -- dispatch (non-blocking under JAX async dispatch) ----------- #
+        # the accumulation window IS the dispatch when accum is on — the
+        # span name keeps the two regimes distinguishable in the trace
+        dispatch_span = ("step/accum_window" if self.accum_steps > 1
+                         else "step/dispatch")
         carry = (self.params, self.state, self.opt_state)
-        carry, losses, weights, n_updates = self._dispatch_window(
-            carry, arrays, k)
+        with tracer.span(dispatch_span, cat="step", step=self.iteration,
+                         k=k, accum_steps=self.accum_steps):
+            carry, losses, weights, n_updates = self._dispatch_window(
+                carry, arrays, k)
         n_iters = k
         if tail is not None:
             # Ragged tail batch runs as a plain single step.  Its batch
@@ -610,10 +623,12 @@ class StandardUpdater:
         # program's output, so blocking on it retires the whole window
         self._inflight.append(window_loss)
         t0 = time.perf_counter()
-        while len(self._inflight) > self.max_inflight:
-            retired = self._inflight.popleft()
-            jax.block_until_ready(retired)
-            self._last_retired = retired
+        with tracer.span("step/retire", cat="step", step=self.iteration,
+                         inflight=len(self._inflight)):
+            while len(self._inflight) > self.max_inflight:
+                retired = self._inflight.popleft()
+                jax.block_until_ready(retired)
+                self._last_retired = retired
         device_time = time.perf_counter() - t0
 
         self.iteration += n_iters
@@ -649,6 +664,11 @@ class StandardUpdater:
         self._updates_done += 1
         if self.exchange_probe_every and \
                 self._updates_done % self.exchange_probe_every == 0:
-            exchange_time = self._probe_exchange_time()
+            # span covers drain + isolated run; the isolated measurement
+            # itself rides the metadata
+            with tracer.span("step/exchange_probe", cat="step",
+                             step=self.iteration) as probe_span:
+                exchange_time = self._probe_exchange_time()
+                probe_span.set(exchange_s=round(exchange_time, 6))
             prof.record("updater/exchange_time", exchange_time)
             self.observation["main/exchange_time"] = exchange_time

@@ -1,5 +1,6 @@
-"""Utility subsystems: serialization, profiling/tracing, comm modelling,
-and the measured exchange-plan autotuner."""
+"""Utility subsystems: serialization, profiling/tracing, the flight
+recorder (telemetry), comm modelling, and the measured exchange-plan
+autotuner."""
 
 from chainermn_tpu.utils.autotune import (
     Plan,
@@ -34,8 +35,22 @@ from chainermn_tpu.utils.serialization import (
     save_state,
     verify_state,
 )
+from chainermn_tpu.utils.telemetry import (
+    MetricsExport,
+    StragglerReport,
+    TraceRecorder,
+    get_recorder,
+    merge_traces,
+    set_recorder,
+)
 
 __all__ = [
+    "MetricsExport",
+    "StragglerReport",
+    "TraceRecorder",
+    "get_recorder",
+    "merge_traces",
+    "set_recorder",
     "CollectiveStats",
     "LinkParams",
     "Plan",

@@ -766,6 +766,9 @@ def autotune_plan(
     raw = _probe_tree(params, n, seed)
     flat_data = _place(raw, flat_mesh, (axis_name,))
     hier_data = None
+    from chainermn_tpu.utils.telemetry import get_recorder
+
+    tracer = get_recorder()
     for cand in probed:
         use_hier = cand.strategy == "hierarchical"
         if use_hier and hier_data is None:
@@ -775,7 +778,15 @@ def autotune_plan(
                                axis_name, cand.__dict__,
                                inter_axis_name=inter_ax if use_hier
                                else None)
-        median_s, out = _time_candidate(fn, data, trials, warmup)
+        # span covers compile + warmup + trials; the elected median
+        # rides the metadata, so the trace shows both what tuning COST
+        # and what each candidate MEASURED
+        with tracer.span("autotune/probe", cat="autotune",
+                         strategy=cand.strategy,
+                         bucket_bytes=cand.bucket_bytes,
+                         wire_dtype=cand.wire_dtype) as probe_sp:
+            median_s, out = _time_candidate(fn, data, trials, warmup)
+            probe_sp.set(median_ms=round(median_s * 1e3, 4))
         n_probes += max(trials, 1) + max(warmup, 1)
         if cand.strategy == "per_leaf":
             ref_out = out

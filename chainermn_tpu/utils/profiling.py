@@ -70,7 +70,14 @@ class Profiler:
         """Time a block.  ``sync`` (optional callable or array) is invoked /
         materialised before the clock stops, so async-dispatched device
         work is actually included (block_until_ready alone can return
-        early on experimental backends — anchor on a host transfer)."""
+        early on experimental backends — anchor on a host transfer).
+
+        Disabled → truly zero-cost: no clock reads, and crucially no
+        ``device_get`` materialisation — a disabled profiler must never
+        collapse the async-dispatch overlap it exists to measure."""
+        if not self.enabled:
+            yield {}
+            return
         t0 = time.perf_counter()
         box = {}
         try:
@@ -80,9 +87,7 @@ class Profiler:
             if callable(out):
                 out()
             elif out is not None:
-                jax.tree.map(
-                    lambda a: np.asarray(jax.device_get(a))
-                    if hasattr(a, "dtype") else a, out)
+                _materialise(out)
             self.record(name, time.perf_counter() - t0, nbytes)
 
     def summary(self) -> str:
@@ -122,6 +127,15 @@ def _nbytes(x) -> int:
         return 0
 
 
+def _materialise(out) -> None:
+    """Force async-dispatched results to the host (the sync anchor
+    ``time_block``'s finally performs) — used when only the flight
+    recorder is timing, so its span still covers real completion."""
+    jax.tree.map(
+        lambda a: np.asarray(jax.device_get(a))
+        if hasattr(a, "dtype") else a, out)
+
+
 _COLLECTIVES = (
     "bcast", "allreduce", "allgather", "alltoall", "gather", "scatter",
     "reduce_scatter", "send", "bcast_obj", "allgather_obj", "gather_obj",
@@ -139,6 +153,10 @@ class _ProfiledCommunicator:
     The jitted in-step collectives (``ops.*`` inside shard_map) are NOT
     routed here — those belong to XLA's domain; use :func:`trace` to see
     them.  This matches what the reference could observe per NCCL call.
+
+    Every timed call is also recorded as a ``cat="comm"`` span into the
+    flight recorder (:mod:`chainermn_tpu.utils.telemetry`), so eager
+    collectives land on the same timeline as the step phases.
     """
 
     def __init__(self, comm, profiler: Optional[Profiler] = None,
@@ -152,14 +170,30 @@ class _ProfiledCommunicator:
         if name not in _COLLECTIVES or not callable(attr):
             return attr
         profiler, label = self._profiler, self._prefix + name
+        from chainermn_tpu.utils.telemetry import get_recorder
 
         def timed(*args, **kwargs):
+            recorder = get_recorder()
+            if not profiler.enabled and not recorder.enabled:
+                return attr(*args, **kwargs)   # zero accounting overhead
             nbytes = _nbytes(args)
-            with profiler.time_block(label, nbytes=nbytes) as box:
+            # recorder span OUTER: time_block materialises the output in
+            # its finally, so the inner exit must be the profiler's for
+            # both timers to cover the same (synced) interval
+            with recorder.span(label, cat="comm", nbytes=nbytes), \
+                    profiler.time_block(label, nbytes=nbytes) as box:
                 out = attr(*args, **kwargs)
                 box["out"] = out
+                if not profiler.enabled:
+                    # the disabled time_block skips its sync anchor; the
+                    # recorder span must still cover real completion
+                    _materialise(out)
             return out
 
+        # cache the wrapper on the instance: __getattr__ only fires for
+        # missing attributes, so every later access skips the closure
+        # rebuild (enabled-ness is re-checked inside per call)
+        self.__dict__[name] = timed
         return timed
 
     @property
@@ -199,20 +233,57 @@ def trace(logdir: str, *, host_tracer_level: int = 2):
 
 
 class ProfileReport:
-    """Trainer extension: print (rank 0) and reset the profiler table."""
+    """Trainer extension: print (rank 0) and reset the profiler table.
+
+    With ``comm`` given on a MULTI-process job, the table is aggregated
+    across processes first — count/total/bytes summed, max-of-max — via
+    ``allgather_obj``, so the printed stats reflect the WORLD, not rank
+    0's local view (processes may hold divergent name sets —
+    rank-0-only extensions — each name aggregates over the ranks that
+    reported it, the ObservationAggregator convention).  The allgather
+    is COLLECTIVE: every process must extend the trainer with this
+    report on the same trigger (the ObservationAggregator deployment
+    shape).  A report registered on rank 0 only must pass
+    ``aggregate=False`` to keep the old local-table-with-rank-0-print
+    behaviour; single-process worlds skip the collective entirely
+    either way.
+    """
 
     trigger = (1, "epoch")
     priority = 60
 
     def __init__(self, profiler: Optional[Profiler] = None, comm=None,
-                 reset: bool = True):
+                 reset: bool = True, aggregate: bool = True):
         self.profiler = profiler or get_profiler()
         self.comm = comm
         self.reset = reset
+        self.aggregate = aggregate
+
+    def _aggregate(self) -> Profiler:
+        """World-wide stats table (or the local one without a comm /
+        on a single process / with ``aggregate=False``)."""
+        if self.comm is None or not self.aggregate or \
+                getattr(self.comm, "inter_size", 1) <= 1:
+            return self.profiler
+        gathered = self.comm.allgather_obj({
+            name: (s.count, s.total, s.maximum, s.bytes)
+            for name, s in self.profiler.stats.items()})
+        agg = Profiler()
+        for d in gathered:
+            for name, (count, total, maximum, nbytes) in d.items():
+                st = agg.stats.setdefault(name, _Stat())
+                st.count += count
+                st.total += total
+                st.maximum = max(st.maximum, maximum)
+                st.bytes += nbytes
+        return agg
 
     def __call__(self, trainer) -> None:
+        table = self._aggregate()
         if self.comm is None or self.comm.rank == 0:
-            print(f"[profile @ iter {trainer.updater.iteration}]")
-            print(self.profiler.summary())
+            world = "" if self.comm is None else \
+                f", {getattr(self.comm, 'inter_size', 1)} process(es)"
+            print(f"[profile @ iter {trainer.updater.iteration}{world}]")
+            print(table.summary())
         if self.reset:
             self.profiler.reset()

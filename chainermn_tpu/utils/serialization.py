@@ -70,27 +70,36 @@ def _leaf_crc(arr: np.ndarray) -> int:
 
 def save_state(path: str, pytree) -> None:
     """Atomically write ``pytree`` (arrays / numeric scalars) to ``path``."""
-    leaves, treedef = jax.tree.flatten(
-        jax.device_get(jax.tree.map(_host_view, pytree)))
-    payload = {f"leaf_{i:05d}": np.asarray(v) for i, v in enumerate(leaves)}
-    # npz keeps only stock numpy dtypes; ml_dtypes leaves (bfloat16, fp8)
-    # come back as raw void records — record true dtypes to view-cast back.
-    dtypes = [str(np.asarray(v).dtype) for v in leaves]
-    crcs = [_leaf_crc(payload[f"leaf_{i:05d}"]) for i in range(len(leaves))]
-    meta_bytes = pickle.dumps(
-        {"treedef": treedef, "dtypes": dtypes, "crcs": crcs,
-         "meta_crc_excluded": True})
-    # the meta record guards itself too: its own CRC rides in a separate
-    # tiny array, so a flipped bit inside the pickle is a typed error,
-    # not an unpickling crash
-    payload["__meta__"] = np.frombuffer(meta_bytes, dtype=np.uint8)
-    payload["__meta_crc__"] = np.asarray(
-        [zlib.crc32(meta_bytes) & 0xFFFFFFFF], dtype=np.uint64)
-    tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-    os.replace(tmp, path)  # atomic on POSIX — no torn snapshots
+    from chainermn_tpu.utils.telemetry import get_recorder
+
+    with get_recorder().span("checkpoint/save", cat="checkpoint",
+                             path=os.path.basename(path)) as sp:
+        leaves, treedef = jax.tree.flatten(
+            jax.device_get(jax.tree.map(_host_view, pytree)))
+        payload = {f"leaf_{i:05d}": np.asarray(v)
+                   for i, v in enumerate(leaves)}
+        # npz keeps only stock numpy dtypes; ml_dtypes leaves (bfloat16,
+        # fp8) come back as raw void records — record true dtypes to
+        # view-cast back.
+        dtypes = [str(np.asarray(v).dtype) for v in leaves]
+        crcs = [_leaf_crc(payload[f"leaf_{i:05d}"])
+                for i in range(len(leaves))]
+        meta_bytes = pickle.dumps(
+            {"treedef": treedef, "dtypes": dtypes, "crcs": crcs,
+             "meta_crc_excluded": True})
+        # the meta record guards itself too: its own CRC rides in a
+        # separate tiny array, so a flipped bit inside the pickle is a
+        # typed error, not an unpickling crash
+        payload["__meta__"] = np.frombuffer(meta_bytes, dtype=np.uint8)
+        payload["__meta_crc__"] = np.asarray(
+            [zlib.crc32(meta_bytes) & 0xFFFFFFFF], dtype=np.uint64)
+        sp.set(n_leaves=len(leaves),
+               nbytes=int(sum(p.nbytes for p in payload.values())))
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)  # atomic on POSIX — no torn snapshots
 
 
 def _read_meta(z, path: str) -> dict:
@@ -151,6 +160,8 @@ def verify_state(path: str) -> None:
     corruption — callers racing a concurrent GC (the checkpointer's
     verify pass on a shared filesystem) distinguish "gone" from
     "damaged": the first is skipped, only the second is quarantined."""
+    from chainermn_tpu.utils.telemetry import get_recorder
+
     try:
         z = np.load(path, allow_pickle=False)
     except FileNotFoundError:
@@ -159,7 +170,8 @@ def verify_state(path: str) -> None:
         raise SnapshotCorruptError(
             f"{path}: not a readable npz archive "
             f"({type(e).__name__}: {e})") from e
-    with z:
+    with get_recorder().span("checkpoint/crc_walk", cat="checkpoint",
+                             path=os.path.basename(path)), z:
         meta = _read_meta(z, path)
         for _ in _checked_leaves(z, meta, path):
             pass
@@ -170,6 +182,8 @@ def load_state(path: str):
     Raises :class:`SnapshotCorruptError` on any integrity failure."""
     import ml_dtypes  # noqa: F401  (registers bfloat16/fp8 with numpy)
 
+    from chainermn_tpu.utils.telemetry import get_recorder
+
     try:
         z = np.load(path, allow_pickle=False)
     except FileNotFoundError:
@@ -178,7 +192,8 @@ def load_state(path: str):
         raise SnapshotCorruptError(
             f"{path}: not a readable npz archive "
             f"({type(e).__name__}: {e})") from e
-    with z:
+    with get_recorder().span("checkpoint/load", cat="checkpoint",
+                             path=os.path.basename(path)) as sp, z:
         meta = _read_meta(z, path)
         leaves = []
         for i, arr in _checked_leaves(z, meta, path):
@@ -186,4 +201,5 @@ def load_state(path: str):
             if arr.dtype != want:
                 arr = arr.view(want)
             leaves.append(arr)
+        sp.set(n_leaves=len(leaves))
     return jax.tree.unflatten(meta["treedef"], leaves)

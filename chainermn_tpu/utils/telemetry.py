@@ -1,0 +1,703 @@
+"""Flight recorder — structured span tracing for the whole training stack.
+
+The Profiler (:mod:`chainermn_tpu.utils.profiling`) answers *"how much
+time does phase X cost on average"*; it is a flat name→stats table with
+no ordering, no per-event timestamps, and no cross-rank story.  The
+ROADMAP's next levers (backward-overlapped exchange, elastic training)
+need the question it cannot answer: *"what was each rank doing, when,
+overlapped with what"* — a timeline.  HiCCL and the overlapping-
+allreduce literature (PAPERS.md 2408.05962 / 2508.13397) both assume
+exactly this per-collective, per-phase telemetry; SURVEY §5 names it as
+the capability the reference out-sourced to external tracers.
+
+Three layers:
+
+- :class:`TraceRecorder` — a bounded ring buffer of structured span
+  events (name, category, t0/duration, step, rank, thread, metadata).
+  Near-zero cost when disabled: ``span()`` returns a shared no-op
+  context manager (no allocation, one attribute read).  Exports:
+
+  * **Chrome trace-event JSON** (:meth:`export_chrome`) — load the file
+    at https://ui.perfetto.dev (or ``chrome://tracing``).  Ranks map to
+    pids, threads to tids, so a merged multi-process trace renders as
+    one timeline with a lane per rank; :func:`merge_traces` fuses
+    per-rank shard files into that single document.
+  * **streaming JSONL** (``stream_path=``) — every completed event is
+    appended as one JSON line the moment it retires, so a SIGKILL'd
+    process still leaves its timeline on disk up to the kill point
+    (:meth:`export_jsonl` dumps the ring after the fact).
+
+- :class:`StragglerReport` — a trainer extension that allgathers each
+  process's per-phase mean durations and reports, per phase, the
+  slowest rank and the skew ratio (slowest / mean) —
+  ``main/straggler_skew`` is the max skew over phases.  This is the
+  cross-rank attribution the overlap work needs before it can claim a
+  win: "step time is X" becomes "rank 3's host phase is 2.1× the mean".
+
+- :class:`MetricsExport` — a JSONL time-series appender for
+  ``trainer.observation``: one line per trigger with iteration, epoch,
+  wall clock and every float-valued observation, flushed per line so a
+  crash keeps the series.
+
+Failure-path integration (wired in the respective modules): the
+:class:`~chainermn_tpu.extensions.TrainingWatchdog` stall report embeds
+the recorder's ring tail (``trace_tail``), and
+:func:`~chainermn_tpu.extensions.add_global_except_hook` dumps the
+trace next to the crash — post-mortems come with a timeline of the
+seconds before death, not just stacks.
+
+The global recorder starts DISABLED.  Enable explicitly
+(``get_recorder().enable()``), or set ``CHAINERMN_TPU_TRACE=1`` in the
+environment (optionally ``CHAINERMN_TPU_TRACE_CAPACITY`` /
+``CHAINERMN_TPU_TRACE_STREAM=<path>``) before import.  See
+docs/OBSERVABILITY.md for the Perfetto workflow.
+
+This module must stay importable without jax (the rank lookup is lazy):
+it is imported by the iterator/prefetch layer, which keeps its imports
+light.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = [
+    "MetricsExport",
+    "SpanEvent",
+    "StragglerReport",
+    "TraceRecorder",
+    "get_recorder",
+    "merge_traces",
+    "set_recorder",
+]
+
+# Chrome trace-event phase codes used here: "X" complete (span with
+# duration), "i" instant, "C" counter, "M" metadata.
+_PH_SPAN, _PH_INSTANT, _PH_COUNTER = "X", "i", "C"
+
+
+def _default_rank() -> int:
+    """The process rank for the pid mapping — lazy so the module imports
+    without jax (and before distributed init)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class SpanEvent:
+    """One recorded event.  ``dur`` is seconds for spans, ``None`` for
+    instants, and carries the counter value for counter events."""
+
+    __slots__ = ("name", "cat", "ph", "t0", "dur", "step", "tid", "meta")
+
+    def __init__(self, name, cat, ph, t0, dur, step, tid, meta):
+        self.name = name
+        self.cat = cat
+        self.ph = ph
+        self.t0 = t0
+        self.dur = dur
+        self.step = step
+        self.tid = tid
+        self.meta = meta
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "cat": self.cat, "ph": self.ph,
+             "t0": self.t0}
+        if self.dur is not None:
+            d["dur"] = self.dur
+        if self.step is not None:
+            d["step"] = self.step
+        if self.tid is not None:
+            d["tid"] = self.tid
+        if self.meta:
+            d["meta"] = self.meta
+        return d
+
+
+class _NullSpan:
+    """The disabled-path context manager: ONE shared instance, so a
+    disabled recorder allocates nothing per span (pinned by test)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **meta):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_rec", "_name", "_cat", "_step", "_meta", "_t0")
+
+    def __init__(self, rec, name, cat, step, meta):
+        self._rec = rec
+        self._name = name
+        self._cat = cat
+        self._step = step
+        self._meta = meta
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def set(self, **meta):
+        """Attach metadata discovered inside the block (measured values,
+        outcome flags); merged into the event on exit."""
+        if self._meta is None:
+            self._meta = meta
+        else:
+            self._meta.update(meta)
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._rec._append(SpanEvent(
+            self._name, self._cat, _PH_SPAN, self._t0, t1 - self._t0,
+            self._step, threading.get_ident(), self._meta))
+        return False
+
+
+class TraceRecorder:
+    """Bounded flight recorder of structured span events.
+
+    Args:
+      capacity: ring length — oldest events drop when full.  65536
+        events ≈ a few MB; at ~6 spans per training step that is hours
+        of history.
+      enabled: start recording immediately (default False — the
+        instrumented hot paths pay one attribute read and nothing else
+        until :meth:`enable` is called).
+      rank: the pid this recorder's events map to in the Chrome export.
+        Default: ``jax.process_index()`` resolved lazily at export
+        time, so construction never touches jax.
+      stream_path: when set, every completed event is ALSO appended to
+        this file as one JSON line at record time (crash-durable
+        streaming export; the ring is unaffected).
+
+    Thread-safe: spans may open/close on any thread (the prefetch
+    worker, checkpoint writer and watchdog monitor all record); the
+    thread id rides each event and becomes the Chrome tid.
+    """
+
+    def __init__(self, capacity: int = 65536, enabled: bool = False,
+                 rank: Optional[int] = None,
+                 stream_path: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._rank = rank
+        self.stream_path = stream_path
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._stream_file = None
+        self._phase_acc: Dict[str, List[float]] = {}  # name -> [n, tot, mx]
+        self._thread_names: Dict[int, str] = {}
+        # wall-clock anchor: perf_counter is monotonic but arbitrary;
+        # the pair lets exports (and merge across processes) place
+        # events on the wall clock
+        self._anchor_wall = time.time()
+        self._anchor_perf = time.perf_counter()
+        self.dropped = 0          # events displaced by ring wrap
+
+    # ------------------------------------------------------------------ #
+    # recording
+    # ------------------------------------------------------------------ #
+
+    @property
+    def rank(self) -> int:
+        if self._rank is None:
+            self._rank = _default_rank()
+        return self._rank
+
+    @rank.setter
+    def rank(self, value: int) -> None:
+        self._rank = int(value)
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def span(self, name: str, cat: str = "default",
+             step: Optional[int] = None, **meta):
+        """Context manager timing a block into the ring.  Disabled →
+        returns the shared no-op singleton (zero allocation)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, cat, step, meta or None)
+
+    def record(self, name: str, duration: float, cat: str = "default",
+               step: Optional[int] = None, t0: Optional[float] = None,
+               **meta) -> None:
+        """Record an already-measured span (duration seconds; ``t0`` on
+        the ``time.perf_counter`` clock, default now-minus-duration)."""
+        if not self.enabled:
+            return
+        if t0 is None:
+            t0 = time.perf_counter() - duration
+        self._append(SpanEvent(name, cat, _PH_SPAN, t0, float(duration),
+                               step, threading.get_ident(), meta or None))
+
+    def instant(self, name: str, cat: str = "default",
+                step: Optional[int] = None, **meta) -> None:
+        """Zero-duration marker (heartbeats, plan changes, faults)."""
+        if not self.enabled:
+            return
+        self._append(SpanEvent(name, cat, _PH_INSTANT,
+                               time.perf_counter(), None, step,
+                               threading.get_ident(), meta or None))
+
+    def counter(self, name: str, value: float, cat: str = "counter",
+                step: Optional[int] = None) -> None:
+        """Sampled value rendered as a counter track in Perfetto
+        (prefetch occupancy, queue depths)."""
+        if not self.enabled:
+            return
+        self._append(SpanEvent(name, cat, _PH_COUNTER,
+                               time.perf_counter(), float(value), step,
+                               threading.get_ident(), None))
+
+    def _append(self, ev: SpanEvent) -> None:
+        tid = ev.tid
+        if tid is not None and tid not in self._thread_names:
+            self._thread_names[tid] = threading.current_thread().name
+        if len(self._ring) == self.capacity:
+            self.dropped += 1
+        self._ring.append(ev)      # deque.append is atomic
+        if ev.ph == _PH_SPAN:
+            with self._lock:
+                acc = self._phase_acc.get(ev.name)
+                if acc is None:
+                    self._phase_acc[ev.name] = [1, ev.dur, ev.dur]
+                else:
+                    acc[0] += 1
+                    acc[1] += ev.dur
+                    acc[2] = max(acc[2], ev.dur)
+        if self.stream_path is not None:
+            self._stream(ev)
+
+    def _stream(self, ev: SpanEvent) -> None:
+        with self._lock:
+            if self.stream_path is None:    # closed under our feet
+                return
+            try:
+                if self._stream_file is None:
+                    self._stream_file = open(self.stream_path, "a")
+                self._stream_file.write(
+                    json.dumps(ev.to_dict(), default=str) + "\n")
+                self._stream_file.flush()
+            except OSError:
+                # a full disk must degrade the stream, never training
+                if self._stream_file is not None:
+                    try:
+                        self._stream_file.close()
+                    except OSError:
+                        pass
+                self.stream_path = None
+                self._stream_file = None
+
+    def clear(self) -> None:
+        self._ring.clear()
+        with self._lock:
+            self._phase_acc.clear()
+        self.dropped = 0
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+
+    def tail(self, n: int = 64) -> List[dict]:
+        """The newest ``n`` events as JSON-safe dicts — what the
+        watchdog embeds in a stall report and the except hook dumps on
+        crash: the timeline of the seconds before things went wrong.
+        ``n <= 0`` means none (the opt-out, not the whole ring)."""
+        if n <= 0:
+            return []
+        return [ev.to_dict() for ev in list(self._ring)[-n:]]
+
+    def events(self) -> List[dict]:
+        # list(deque) is a C-atomic snapshot: concurrent appends from
+        # other threads (prefetch worker, watchdog monitor) must never
+        # fault an export with "deque mutated during iteration"
+        return [ev.to_dict() for ev in list(self._ring)]
+
+    def drain_phase_stats(self, names: Optional[Sequence[str]] = None
+                          ) -> Dict[str, dict]:
+        """Per-span-name ``{count, total_s, max_s}`` accumulated since
+        the last drain, then reset.  Survives ring wrap (accumulated at
+        record time), so interval statistics stay exact however small
+        the ring — this is :class:`StragglerReport`'s feed.
+
+        ``names`` drains ONLY those span names, leaving the rest
+        accumulating — so consumers with disjoint filters (two
+        StragglerReports on different phases/triggers) never steal each
+        other's intervals."""
+        with self._lock:
+            if names is None:
+                drained = self._phase_acc
+                self._phase_acc = {}
+            else:
+                drained = {}
+                for name in names:
+                    acc = self._phase_acc.pop(name, None)
+                    if acc is not None:
+                        drained[name] = acc
+        return {name: {"count": a[0], "total_s": a[1], "max_s": a[2]}
+                for name, a in drained.items()}
+
+    # ------------------------------------------------------------------ #
+    # export
+    # ------------------------------------------------------------------ #
+
+    def _ts_us(self, t0: float) -> float:
+        """perf_counter → wall-clock microseconds (the Chrome ``ts``
+        axis; wall-anchored so independently-exported per-rank shards
+        land on one comparable timeline, modulo host clock skew)."""
+        return (t0 - self._anchor_perf + self._anchor_wall) * 1e6
+
+    def chrome_events(self) -> List[dict]:
+        """The ring as Chrome trace-event dicts (rank → pid, thread →
+        tid), prefixed with the process/thread-name metadata events
+        Perfetto uses to label the lanes."""
+        pid = self.rank
+        events: List[dict] = [{
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": f"rank {pid}"},
+        }]
+        ring = list(self._ring)     # atomic snapshot (see events())
+        tids = sorted({ev.tid for ev in ring if ev.tid is not None})
+        tid_map = {ident: i for i, ident in enumerate(tids)}
+        for ident in tids:
+            events.append({
+                "ph": "M", "pid": pid, "tid": tid_map[ident],
+                "name": "thread_name",
+                "args": {"name": self._thread_names.get(
+                    ident, f"thread-{ident}")},
+            })
+        for ev in ring:
+            rec = {
+                "name": ev.name,
+                "cat": ev.cat,
+                "ph": ev.ph,
+                "pid": pid,
+                "tid": tid_map.get(ev.tid, 0),
+                "ts": self._ts_us(ev.t0),
+            }
+            if ev.ph == _PH_SPAN:
+                rec["dur"] = ev.dur * 1e6
+            args = dict(ev.meta) if ev.meta else {}
+            if ev.step is not None:
+                args["step"] = ev.step
+            if ev.ph == _PH_COUNTER:
+                args["value"] = ev.dur
+            if args:
+                rec["args"] = args
+            events.append(rec)
+        return events
+
+    def export_chrome(self, path: str) -> str:
+        """Write the Perfetto-loadable Chrome trace JSON document."""
+        doc = {
+            "traceEvents": self.chrome_events(),
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "rank": self.rank,
+                "capacity": self.capacity,
+                "dropped": self.dropped,
+                "anchor_wall_s": self._anchor_wall,
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+        return path
+
+    def export_jsonl(self, path: str) -> str:
+        """Dump the ring as JSON lines (one event per line) — the
+        after-the-fact form of the ``stream_path`` live export."""
+        with open(path, "w") as f:
+            for ev in list(self._ring):     # atomic snapshot
+                f.write(json.dumps(ev.to_dict(), default=str) + "\n")
+        return path
+
+    def close(self) -> None:
+        """End the streaming export: close the file AND clear
+        ``stream_path``, so a straggler thread recording afterwards
+        (prefetch worker, watchdog monitor) cannot silently reopen the
+        file a reader already treated as end-of-stream."""
+        with self._lock:
+            self.stream_path = None
+            if self._stream_file is not None:
+                try:
+                    self._stream_file.close()
+                except OSError:
+                    pass
+                self._stream_file = None
+
+
+def merge_traces(paths: Sequence[str], out: Optional[str] = None) -> dict:
+    """Fuse per-rank Chrome trace shards into ONE Perfetto document.
+
+    Each shard keeps its own pid lane (rank → pid).  If two shards
+    claim the same pid — e.g. single-process drills exporting twice —
+    the later shard's pids are shifted past every pid already taken,
+    so lanes never silently overlay.  Events merge in shard order;
+    Perfetto sorts by ``ts`` itself (shards are wall-clock anchored).
+
+    Returns the merged document; writes it to ``out`` when given.
+    """
+    merged: List[dict] = []
+    meta: List[dict] = []
+    used_pids: set = set()
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        # both standard Chrome forms: object with traceEvents, or a
+        # bare event array
+        events = (doc.get("traceEvents", []) if isinstance(doc, dict)
+                  else doc if isinstance(doc, list) else [])
+        shard_pids = {ev.get("pid", 0) for ev in events}
+        shift = 0
+        if shard_pids & used_pids:
+            shift = (max(used_pids) + 1) - min(shard_pids)
+        used_pids |= {p + shift for p in shard_pids}
+        for ev in events:
+            if shift:
+                ev = dict(ev)
+                ev["pid"] = ev.get("pid", 0) + shift
+            merged.append(ev)
+        meta.append({"path": os.path.basename(path),
+                     "pid_shift": shift,
+                     **({} if not isinstance(doc, dict) else
+                        {"rank": doc.get("metadata", {}).get("rank")})})
+    doc = {"traceEvents": merged, "displayTimeUnit": "ms",
+           "metadata": {"merged_from": meta}}
+    if out is not None:
+        with open(out, "w") as f:
+            json.dump(doc, f, default=str)
+    return doc
+
+
+# ---------------------------------------------------------------------- #
+# global recorder
+# ---------------------------------------------------------------------- #
+
+def _from_env() -> TraceRecorder:
+    enabled = os.environ.get("CHAINERMN_TPU_TRACE", "") not in ("", "0")
+    try:
+        capacity = int(os.environ.get(
+            "CHAINERMN_TPU_TRACE_CAPACITY", 65536))
+        if capacity < 1:
+            raise ValueError(capacity)
+    except ValueError:
+        # observability must never kill training: a typo'd env var
+        # (runs at package import) degrades to the default, not a crash
+        capacity = 65536
+    stream = os.environ.get("CHAINERMN_TPU_TRACE_STREAM") or None
+    return TraceRecorder(capacity=capacity, enabled=enabled,
+                         stream_path=stream)
+
+
+_GLOBAL = _from_env()
+
+
+def get_recorder() -> TraceRecorder:
+    """The process-global flight recorder every instrumented subsystem
+    records into (disabled by default — see module docstring)."""
+    return _GLOBAL
+
+
+def set_recorder(recorder: TraceRecorder) -> TraceRecorder:
+    """Swap the global recorder (tests, custom capacities); returns the
+    previous one so callers can restore it."""
+    global _GLOBAL
+    prev = _GLOBAL
+    _GLOBAL = recorder
+    return prev
+
+
+# ---------------------------------------------------------------------- #
+# trainer extensions
+# ---------------------------------------------------------------------- #
+
+class StragglerReport:
+    """Cross-rank straggler attribution from the flight recorder.
+
+    On each trigger: drain this process's per-phase duration stats
+    accumulated since the last fire, ``allgather_obj`` them, and for
+    every phase any rank reported compute the mean-of-means, the
+    slowest rank, and the skew ratio (slowest rank's mean / cross-rank
+    mean; 1.0 = perfectly balanced).  Processes may report divergent
+    phase sets (rank-0-only extensions, mid-epoch joins) — each phase
+    aggregates over the ranks that actually reported it, the
+    :class:`~chainermn_tpu.extensions.ObservationAggregator`
+    convention.
+
+    Observes ``main/straggler_skew`` — the max skew over phases — so
+    LogReport/PrintReport track it like any metric; the full per-phase
+    attribution lands in :attr:`last_report` and (rank 0, optional)
+    ``<out>/straggler.jsonl``.
+
+    Args:
+      comm: communicator (``allgather_obj`` + rank identity).
+      recorder: flight recorder to drain (default the global one).
+      phases: restrict attribution to these span names (default: every
+        span name recorded in the interval).
+      write: append each report as a JSON line to
+        ``<trainer.out>/straggler.jsonl`` on rank 0.
+    """
+
+    trigger = (1, "epoch")
+    priority = 85   # before LogReport (50): the observation must exist
+    # when the log entry for the same tick is assembled
+
+    def __init__(self, comm, recorder: Optional[TraceRecorder] = None,
+                 phases: Optional[Sequence[str]] = None,
+                 write: bool = True):
+        self.comm = comm
+        self.recorder = recorder
+        self.phases = None if phases is None else set(phases)
+        self.write = write
+        self.last_report: Optional[dict] = None
+
+    def _recorder(self) -> TraceRecorder:
+        return self.recorder if self.recorder is not None \
+            else get_recorder()
+
+    def __call__(self, trainer=None) -> None:
+        rec = self._recorder()
+        # a phase filter drains ONLY its names, so reports with
+        # disjoint filters on different triggers never steal each
+        # other's accumulated intervals
+        local = rec.drain_phase_stats(
+            None if self.phases is None else sorted(self.phases))
+        means = {name: s["total_s"] / max(s["count"], 1)
+                 for name, s in local.items()}
+        # collective: every process calls, even with an empty interval
+        gathered = self.comm.allgather_obj(means)
+        phases: Dict[str, dict] = {}
+        worst = 1.0
+        for name in sorted(set().union(*(d.keys() for d in gathered))
+                           if gathered else ()):
+            per_rank = {r: d[name] for r, d in enumerate(gathered)
+                        if name in d}
+            mean = sum(per_rank.values()) / len(per_rank)
+            slowest_rank = max(per_rank, key=per_rank.get)
+            skew = (per_rank[slowest_rank] / mean) if mean > 0 else 1.0
+            phases[name] = {
+                "mean_s": mean,
+                "slowest_rank": slowest_rank,
+                "slowest_s": per_rank[slowest_rank],
+                "skew": skew,
+                "ranks": len(per_rank),
+            }
+            worst = max(worst, skew)
+        self.last_report = {
+            "iteration": (trainer.updater.iteration
+                          if trainer is not None else None),
+            "phases": phases,
+            "max_skew": worst,
+        }
+        if trainer is not None:
+            trainer.observation["main/straggler_skew"] = worst
+        rec.instant("straggler/report", cat="telemetry",
+                    max_skew=round(worst, 4))
+        if (self.write and trainer is not None
+                and getattr(self.comm, "inter_rank", 0) == 0):
+            try:
+                path = os.path.join(getattr(trainer, "out", "."),
+                                    "straggler.jsonl")
+                with open(path, "a") as f:
+                    f.write(json.dumps(self.last_report, default=float)
+                            + "\n")
+            except OSError:
+                pass
+
+
+class MetricsExport:
+    """JSONL time-series appender for ``trainer.observation``.
+
+    Each trigger appends ONE line — iteration, epoch, elapsed wall
+    clock, wall timestamp, and every float-coercible observation
+    (optionally filtered by ``keys``) — to ``<trainer.out>/<filename>``,
+    flushed per line so the series survives a crash.  The structured,
+    machine-readable sibling of LogReport's interval-averaged ``log``
+    (which rewrites the whole file each fire): this one is append-only
+    and per-tick, the format scrapers and dashboards want.
+    """
+
+    trigger = (1, "iteration")
+    priority = 45   # after ObservationAggregator (90) and the straggler
+    # report (85) so aggregated/derived values are in the dict
+
+    def __init__(self, path: Optional[str] = None,
+                 filename: str = "metrics.jsonl",
+                 keys: Optional[Sequence[str]] = None):
+        self.path = path
+        self.filename = filename
+        self.keys = None if keys is None else list(keys)
+        self._file = None
+
+    def initialize(self, trainer) -> None:
+        if self.path is None:
+            self.path = os.path.join(
+                getattr(trainer, "out", "."), self.filename)
+
+    def _ensure_file(self):
+        if self._file is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._file = open(self.path, "a")
+        return self._file
+
+    def __call__(self, trainer) -> None:
+        if self.path is None:       # used without initialize()
+            self.initialize(trainer)
+        obs = trainer.observation
+        keys = self.keys if self.keys is not None else list(obs)
+        entry = {
+            "iteration": trainer.updater.iteration,
+            "epoch": trainer.updater.epoch,
+            "elapsed_time": trainer.elapsed_time,
+            "ts": time.time(),
+        }
+        for k in keys:
+            if k not in obs:
+                continue
+            try:
+                entry[k] = float(obs[k])
+            except (TypeError, ValueError):
+                continue
+        try:
+            f = self._ensure_file()
+            f.write(json.dumps(entry) + "\n")
+            f.flush()
+        except OSError:
+            pass                    # observability must never kill training
+
+    def finalize(self, trainer=None) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None

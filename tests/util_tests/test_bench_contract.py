@@ -172,9 +172,12 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
      ["--n-layers", "4", "--d-model", "16", "--vocab", "256",
       "--trials", "1", "--rounds", "1", "--iters", "1",
       "--top-k", "4"], "x"),
+    ("bench_telemetry.py",
+     ["--batch", "8", "--dim", "64", "--hidden", "128", "--warmup", "1",
+      "--iters", "4", "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
-        "autotune"])
+        "autotune", "telemetry"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

@@ -43,6 +43,63 @@ def test_disabled_profiler_records_nothing():
     assert not p.stats
 
 
+def test_disabled_time_block_is_zero_cost(monkeypatch):
+    """A disabled profiler must skip BOTH the sync materialisation
+    (jax.device_get would collapse async-dispatch overlap) and the
+    record — not just drop the stats row."""
+    import jax as _jax
+
+    def boom(*a, **k):
+        raise AssertionError("disabled time_block materialised output")
+
+    monkeypatch.setattr(_jax, "device_get", boom)
+    p = Profiler(enabled=False)
+    with p.time_block("block") as box:
+        box["out"] = jnp.ones((8,))
+    assert not p.stats
+
+    called = []
+    with p.time_block("fn", sync=lambda: called.append(1)):
+        pass
+    assert not called, "disabled time_block invoked its sync callable"
+
+
+def test_disabled_profiled_communicator_skips_byte_accounting(
+        comm, monkeypatch):
+    """With profiler AND recorder off, the proxy must not pay the
+    _nbytes tree walk (nor any timing) — the zero-overhead contract."""
+    from chainermn_tpu.utils import profiling as prof_mod
+    from chainermn_tpu.utils.telemetry import TraceRecorder, set_recorder
+
+    def boom(x):
+        raise AssertionError("_nbytes walked the tree while disabled")
+
+    monkeypatch.setattr(prof_mod, "_nbytes", boom)
+    prev = set_recorder(TraceRecorder(enabled=False))
+    try:
+        p = Profiler(enabled=False)
+        pc = profiled_communicator(comm, p)
+        assert pc.bcast_obj({"a": 1}) == {"a": 1}
+        assert not p.stats
+    finally:
+        set_recorder(prev)
+
+
+def test_profiled_communicator_caches_wrappers(comm):
+    p = Profiler()
+    pc = profiled_communicator(comm, p)
+    first = pc.allreduce
+    assert pc.allreduce is first, "per-name wrapper rebuilt on access"
+    # the cached wrapper still respects a later enabled flip
+    p.enabled = False
+    x = jnp.ones((comm.size, 2), jnp.float32)
+    first(x)
+    assert not p.stats
+    p.enabled = True
+    first(x)
+    assert p.stats["comm.allreduce"].count == 1
+
+
 def test_profiled_communicator_times_collectives(comm):
     p = Profiler()
     pc = profiled_communicator(comm, p)
@@ -77,6 +134,50 @@ def test_profile_report_prints_and_resets(comm, capsys):
     out = capsys.readouterr().out
     assert "comm.allreduce" in out and "iter 3" in out
     assert not p.stats  # reset=True
+
+
+def test_profile_report_aggregates_across_processes():
+    """With a comm, the printed table reflects the WORLD: counts/totals
+    summed, max-of-max, divergent name sets unioned (the
+    ObservationAggregator convention) — not rank 0's local view."""
+    p = Profiler()
+    p.record("comm.allreduce", 0.25, nbytes=100)
+
+    class FakeComm:
+        rank = 0
+        inter_size = 3
+
+        def allgather_obj(self, obj):
+            return [
+                obj,
+                {"comm.allreduce": (3, 0.75, 0.5, 300)},
+                {"rank2.only": (1, 1.0, 1.0, 0)},   # divergent key set
+            ]
+
+    # aggregate=False keeps the old local-table behaviour (a report
+    # registered on rank 0 only must not enter a collective)
+    assert ProfileReport(p, comm=FakeComm(),
+                         aggregate=False)._aggregate() is p
+
+    class OneProcComm(FakeComm):
+        inter_size = 1
+
+        def allgather_obj(self, obj):
+            raise AssertionError(
+                "single-process report entered the collective")
+
+    assert ProfileReport(p, comm=OneProcComm())._aggregate() is p
+
+    rep = ProfileReport(p, comm=FakeComm())
+    agg = rep._aggregate()
+    s = agg.stats["comm.allreduce"]
+    assert s.count == 4
+    assert s.total == pytest.approx(1.0)
+    assert s.maximum == pytest.approx(0.5)
+    assert s.bytes == 400
+    assert agg.stats["rank2.only"].count == 1
+    # the local profiler is untouched by aggregation
+    assert p.stats["comm.allreduce"].count == 1
 
 
 @pytest.mark.skipif(

@@ -1,0 +1,513 @@
+"""Flight recorder (utils/telemetry): ring-buffer bound, Chrome-trace
+schema round-trip, multi-shard merge, disabled-path zero cost, the
+trainer extensions (StragglerReport / MetricsExport), and the
+failure-path contract — a FaultPlan delay-rank drill must produce a
+stall report carrying the recorder's ring tail."""
+
+import json
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.extensions import TrainingWatchdog
+from chainermn_tpu.models import init_mlp, mlp_apply, softmax_cross_entropy
+from chainermn_tpu.testing import FaultInjector, FaultPlan
+from chainermn_tpu.utils.telemetry import (
+    MetricsExport,
+    StragglerReport,
+    TraceRecorder,
+    get_recorder,
+    merge_traces,
+    set_recorder,
+)
+
+
+@pytest.fixture()
+def recorder():
+    """Fresh enabled recorder installed as the global one (the
+    instrumented subsystems all record into get_recorder()); the
+    previous global is restored afterwards."""
+    rec = TraceRecorder(capacity=4096, enabled=True, rank=0)
+    prev = set_recorder(rec)
+    yield rec
+    set_recorder(prev)
+
+
+def _dataset(n=64, dim=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32), np.int32(i % classes))
+            for i in range(n)]
+
+
+def _make_trainer(comm, out, epochs=2, **updater_kw):
+    it = cmn.SerialIterator(_dataset(), 16, shuffle=True, seed=3)
+    params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    upd = cmn.StandardUpdater(it, opt, loss_fn, params, comm,
+                              **updater_kw)
+    return cmn.Trainer(upd, (epochs, "epoch"), out=str(out))
+
+
+# ---------------------------------------------------------------------- #
+# ring buffer
+# ---------------------------------------------------------------------- #
+
+class TestRing:
+    def test_bound_enforced_oldest_dropped(self):
+        rec = TraceRecorder(capacity=8, enabled=True, rank=0)
+        for i in range(30):
+            rec.record(f"ev{i}", 0.001)
+        assert len(rec) == 8
+        assert rec.dropped == 22
+        names = [e["name"] for e in rec.events()]
+        assert names == [f"ev{i}" for i in range(22, 30)]
+
+    def test_tail_returns_newest(self):
+        rec = TraceRecorder(capacity=100, enabled=True, rank=0)
+        for i in range(10):
+            rec.record(f"ev{i}", 0.001, step=i)
+        tail = rec.tail(3)
+        assert [e["name"] for e in tail] == ["ev7", "ev8", "ev9"]
+        assert tail[-1]["step"] == 9
+        # n <= 0 is the opt-out, not a whole-ring dump
+        assert rec.tail(0) == [] and rec.tail(-1) == []
+
+    def test_phase_stats_survive_ring_wrap(self):
+        rec = TraceRecorder(capacity=4, enabled=True, rank=0)
+        for _ in range(100):
+            rec.record("phase", 0.01)
+        stats = rec.drain_phase_stats()
+        assert stats["phase"]["count"] == 100
+        assert stats["phase"]["total_s"] == pytest.approx(1.0)
+        # drained: the next interval starts clean
+        assert rec.drain_phase_stats() == {}
+
+    def test_bad_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            TraceRecorder(capacity=0)
+
+    def test_malformed_env_capacity_degrades_not_crashes(self,
+                                                         monkeypatch):
+        """A typo'd CHAINERMN_TPU_TRACE_CAPACITY runs at package import
+        — it must fall back to the default, never break `import
+        chainermn_tpu`."""
+        from chainermn_tpu.utils import telemetry as T
+
+        monkeypatch.setenv("CHAINERMN_TPU_TRACE_CAPACITY", "64k")
+        assert T._from_env().capacity == 65536
+        monkeypatch.setenv("CHAINERMN_TPU_TRACE_CAPACITY", "0")
+        assert T._from_env().capacity == 65536
+        monkeypatch.setenv("CHAINERMN_TPU_TRACE_CAPACITY", "128")
+        assert T._from_env().capacity == 128
+
+
+# ---------------------------------------------------------------------- #
+# disabled path
+# ---------------------------------------------------------------------- #
+
+class TestDisabled:
+    def test_span_returns_shared_singleton(self):
+        """Zero allocation when disabled: every span() call hands back
+        the SAME no-op object, and nothing reaches the ring."""
+        rec = TraceRecorder(enabled=False)
+        a = rec.span("x", cat="step", step=1, k=2)
+        b = rec.span("y")
+        assert a is b
+        with a:
+            pass
+        rec.record("z", 1.0)
+        rec.instant("i")
+        rec.counter("c", 3)
+        assert len(rec) == 0
+        assert rec.drain_phase_stats() == {}
+
+    def test_enable_disable_toggle(self):
+        rec = TraceRecorder(enabled=False)
+        rec.enable()
+        with rec.span("x"):
+            pass
+        rec.disable()
+        with rec.span("y"):
+            pass
+        assert [e["name"] for e in rec.events()] == ["x"]
+
+
+# ---------------------------------------------------------------------- #
+# export: Chrome trace schema + merge
+# ---------------------------------------------------------------------- #
+
+class TestExport:
+    def test_chrome_schema_round_trip(self, tmp_path):
+        rec = TraceRecorder(enabled=True, rank=3)
+        with rec.span("step/host", cat="step", step=7, k=4):
+            time.sleep(0.002)
+        rec.instant("watchdog/heartbeat", cat="watchdog", step=7)
+        rec.counter("prefetch/occupancy", 2)
+        path = str(tmp_path / "trace.json")
+        rec.export_chrome(path)
+
+        doc = json.load(open(path))
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["metadata"]["rank"] == 3
+        events = doc["traceEvents"]
+        # lane labels: process_name metadata carries the rank mapping
+        meta = [e for e in events if e["ph"] == "M"]
+        assert any(e["name"] == "process_name"
+                   and e["args"]["name"] == "rank 3" for e in meta)
+        assert all(e["pid"] == 3 for e in events)
+        by_name = {e["name"]: e for e in events if e["ph"] != "M"}
+        span = by_name["step/host"]
+        assert span["ph"] == "X" and span["cat"] == "step"
+        assert span["dur"] >= 2e3          # microseconds
+        assert span["args"]["step"] == 7 and span["args"]["k"] == 4
+        assert by_name["watchdog/heartbeat"]["ph"] == "i"
+        counter = by_name["prefetch/occupancy"]
+        assert counter["ph"] == "C" and counter["args"]["value"] == 2.0
+        # a counter recorded with a step keeps it alongside the value
+        rec.counter("stepped", 5, step=9)
+        stepped = [e for e in rec.chrome_events()
+                   if e["name"] == "stepped"][0]
+        assert stepped["args"] == {"step": 9, "value": 5.0}
+        # ts is wall-anchored microseconds: recent, monotone-ish
+        assert span["ts"] == pytest.approx(time.time() * 1e6, rel=0.01)
+
+    def test_merge_traces_distinct_pids(self, tmp_path):
+        paths = []
+        for rank in range(3):
+            rec = TraceRecorder(enabled=True, rank=rank)
+            with rec.span("step/host", cat="step", step=1):
+                pass
+            p = str(tmp_path / f"trace.{rank}.json")
+            rec.export_chrome(p)
+            paths.append(p)
+        out = str(tmp_path / "merged.json")
+        doc = merge_traces(paths, out=out)
+        assert json.load(open(out)) == doc
+        pids = {e["pid"] for e in doc["traceEvents"]}
+        assert pids == {0, 1, 2}
+        # every rank's lane is labelled
+        labels = {e["args"]["name"] for e in doc["traceEvents"]
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+        assert labels == {"rank 0", "rank 1", "rank 2"}
+
+    def test_merge_accepts_bare_event_array_shard(self, tmp_path):
+        """The other standard Chrome form — a bare JSON event array
+        (external tracers emit it) — must merge, not AttributeError."""
+        rec = TraceRecorder(enabled=True, rank=0)
+        with rec.span("ours"):
+            pass
+        p0 = str(tmp_path / "ours.json")
+        rec.export_chrome(p0)
+        p1 = str(tmp_path / "bare.json")
+        with open(p1, "w") as f:
+            json.dump([{"name": "theirs", "ph": "X", "ts": 1.0,
+                        "dur": 2.0, "pid": 7, "tid": 0}], f)
+        doc = merge_traces([p0, p1])
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"ours", "theirs"} <= names
+        assert {e["pid"] for e in doc["traceEvents"]} == {0, 7}
+
+    def test_merge_shifts_colliding_pids(self, tmp_path):
+        paths = []
+        for i in range(2):                 # both shards claim pid 0
+            rec = TraceRecorder(enabled=True, rank=0)
+            with rec.span(f"shard{i}"):
+                pass
+            p = str(tmp_path / f"t{i}.json")
+            rec.export_chrome(p)
+            paths.append(p)
+        doc = merge_traces(paths)
+        pids = {e["pid"] for e in doc["traceEvents"]}
+        assert len(pids) == 2, "colliding shards must not overlay lanes"
+
+    def test_export_tolerates_concurrent_appends(self):
+        """Exports snapshot the ring: a recorder thread (prefetch
+        worker, watchdog monitor) appending mid-export must never fault
+        the export — the crash-dump path runs exactly while other
+        threads are still alive and recording."""
+        import threading
+
+        rec = TraceRecorder(capacity=512, enabled=True, rank=0)
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                rec.record("bg", 0.001)
+
+        th = threading.Thread(target=hammer, daemon=True)
+        th.start()
+        try:
+            for _ in range(200):
+                rec.chrome_events()
+                rec.events()
+                rec.tail(16)
+        finally:
+            stop.set()
+            th.join()
+
+    def test_jsonl_exports(self, tmp_path):
+        stream = str(tmp_path / "live.jsonl")
+        rec = TraceRecorder(enabled=True, rank=0, stream_path=stream)
+        with rec.span("a", cat="step"):
+            pass
+        rec.instant("b")
+        rec.close()
+        live = [json.loads(l) for l in open(stream)]
+        assert [e["name"] for e in live] == ["a", "b"]
+        dumped = str(tmp_path / "dump.jsonl")
+        rec.export_jsonl(dumped)
+        again = [json.loads(l) for l in open(dumped)]
+        assert [e["name"] for e in again] == ["a", "b"]
+        # close() ends the stream for good: a straggler thread's event
+        # after close must not silently reopen the file
+        rec.instant("after-close")
+        assert len(open(stream).readlines()) == 2
+
+
+# ---------------------------------------------------------------------- #
+# instrumentation: the stack records into the recorder
+# ---------------------------------------------------------------------- #
+
+class TestInstrumentation:
+    def test_updater_step_phases_recorded(self, comm, recorder,
+                                          tmp_path):
+        trainer = _make_trainer(comm, tmp_path, epochs=1)
+        trainer.run()
+        names = {e["name"] for e in recorder.events()}
+        assert {"step/host", "step/dispatch", "step/retire"} <= names
+        cats = {e["cat"] for e in recorder.events()}
+        assert "step" in cats
+
+    def test_prefetch_spans_and_occupancy(self, comm, recorder,
+                                          tmp_path):
+        trainer = _make_trainer(comm, tmp_path, epochs=1, prefetch=2)
+        trainer.run()
+        names = {e["name"] for e in recorder.events()}
+        assert {"prefetch/slot_wait", "prefetch/assemble",
+                "prefetch/put", "prefetch/occupancy"} <= names
+        # worker-side spans carry the worker's tid, consumer spans the
+        # main thread's — the trace separates the two lanes
+        tid_of = {}
+        for e in recorder.events():
+            tid_of.setdefault(e["name"], set()).add(e.get("tid"))
+        assert tid_of["prefetch/assemble"] != tid_of["prefetch/slot_wait"]
+
+    def test_checkpoint_spans_recorded(self, comm, recorder, tmp_path):
+        from chainermn_tpu.utils.serialization import (load_state,
+                                                       save_state)
+
+        path = str(tmp_path / "snap")
+        save_state(path, {"a": np.arange(8), "b": np.float32(3.0)})
+        load_state(path)
+        names = [e["name"] for e in recorder.events()]
+        assert "checkpoint/save" in names and "checkpoint/load" in names
+        save_ev = next(e for e in recorder.events()
+                       if e["name"] == "checkpoint/save")
+        assert save_ev["meta"]["n_leaves"] == 2
+        assert save_ev["meta"]["nbytes"] > 0
+
+    def test_profiled_communicator_records_comm_spans(self, comm,
+                                                      recorder):
+        from chainermn_tpu.utils.profiling import (Profiler,
+                                                   profiled_communicator)
+
+        pc = profiled_communicator(comm, Profiler())
+        pc.bcast_obj({"x": 1})
+        spans = [e for e in recorder.events() if e["cat"] == "comm"]
+        assert spans and spans[0]["name"] == "comm.bcast_obj"
+
+    def test_watchdog_heartbeat_instants(self, recorder):
+        wd = TrainingWatchdog(stall_timeout=60)
+        wd.heartbeat(iteration=5)
+        ev = recorder.events()[-1]
+        assert ev["name"] == "watchdog/heartbeat"
+        assert ev["ph"] == "i" and ev["step"] == 5
+
+
+# ---------------------------------------------------------------------- #
+# failure paths
+# ---------------------------------------------------------------------- #
+
+class TestFailurePaths:
+    def test_stall_report_embeds_ring_tail_under_delay_drill(
+            self, comm, recorder, tmp_path):
+        """The acceptance drill: a FaultPlan delay-rank stall past the
+        watchdog threshold must produce a stall report whose
+        ``trace_tail`` carries the flight recorder's timeline of the
+        steps leading up to the stall."""
+        trainer = _make_trainer(comm, tmp_path, epochs=2)
+        reports = []
+        wd = TrainingWatchdog(stall_timeout=0.3, check_interval=0.1,
+                              on_stall=reports.append)
+        trainer.extend(wd)
+        plan = FaultPlan(delay_at_iteration=3, delay_rank=0,
+                         delay_seconds=0.8)
+        injector = FaultInjector(plan, comm=comm)
+        trainer.extend(injector)
+        trainer.run()
+
+        assert ("delay", 3) in injector.fired
+        assert wd.stall_count >= 1
+        rep = reports[0]
+        assert rep["kind"] == "local-stall"
+        assert rep["trace_enabled"] is True
+        tail = rep["trace_tail"]
+        assert tail, "stall report carried no flight-recorder tail"
+        tail_names = {e["name"] for e in tail}
+        # the tail shows the step phases that ran BEFORE the stall —
+        # the timeline half of the post-mortem
+        assert {"step/host", "step/retire"} & tail_names
+        assert {"watchdog/heartbeat"} & tail_names
+        # and the on-disk report carries it too
+        on_disk = json.load(open(tmp_path / "stall_report.json"))
+        assert on_disk["trace_tail"]
+
+    def test_stall_report_tail_empty_when_disabled(self, tmp_path):
+        prev = set_recorder(TraceRecorder(enabled=False))
+        try:
+            reports = []
+            wd = TrainingWatchdog(stall_timeout=0.15, check_interval=0.05,
+                                  on_stall=reports.append,
+                                  report_path=str(tmp_path / "s.json"))
+            wd.start()
+            try:
+                wd.heartbeat(iteration=1)
+                deadline = time.monotonic() + 0.8
+                while not reports and time.monotonic() < deadline:
+                    time.sleep(0.02)
+            finally:
+                wd.stop()
+            assert reports and reports[0]["trace_tail"] == []
+            assert reports[0]["trace_enabled"] is False
+        finally:
+            set_recorder(prev)
+
+    def test_except_hook_dumps_trace(self, recorder, tmp_path,
+                                     monkeypatch):
+        from chainermn_tpu.extensions import global_except_hook as geh
+
+        with recorder.span("step/host", cat="step", step=1):
+            pass
+        # a not-yet-existing directory is created, not silently skipped
+        monkeypatch.setenv("CHAINERMN_TPU_TRACE_DIR",
+                           str(tmp_path / "made" / "later"))
+        geh._dump_trace(rank=0)
+        doc = json.load(
+            open(tmp_path / "made" / "later" / "trace_crash.rank0.json"))
+        assert any(e.get("name") == "step/host"
+                   for e in doc["traceEvents"])
+
+    def test_add_hook_preserves_trace_dir(self, monkeypatch):
+        from chainermn_tpu.extensions import global_except_hook as geh
+        from chainermn_tpu.extensions import add_global_except_hook
+
+        monkeypatch.setattr(geh, "_installed", True)  # don't touch sys
+        monkeypatch.setattr(geh, "_trace_dir", ".")
+        add_global_except_hook(trace_dir="/logs/traces")
+        assert geh._trace_dir == "/logs/traces"
+        add_global_except_hook()   # a later no-arg call must not clobber
+        assert geh._trace_dir == "/logs/traces"
+
+
+# ---------------------------------------------------------------------- #
+# trainer extensions
+# ---------------------------------------------------------------------- #
+
+class TestStragglerReport:
+    def test_trainer_run_observes_skew(self, comm, recorder, tmp_path):
+        trainer = _make_trainer(comm, tmp_path, epochs=1)
+        sr = StragglerReport(comm)
+        trainer.extend(sr, trigger=(1, "epoch"))
+        trainer.run()
+        assert sr.last_report is not None
+        assert sr.last_report["max_skew"] >= 1.0
+        assert "step/host" in sr.last_report["phases"]
+        # single process: perfectly balanced by construction
+        assert sr.last_report["max_skew"] == pytest.approx(1.0)
+        # rank 0 writes the jsonl attribution series
+        lines = open(tmp_path / "straggler.jsonl").read().splitlines()
+        assert json.loads(lines[-1])["phases"]
+
+    def test_cross_rank_attribution_math(self, recorder):
+        """Slowest rank + skew per phase, with divergent key sets (the
+        ObservationAggregator convention): aggregate over reporting
+        ranks only."""
+
+        class FakeComm:
+            inter_rank = 0
+
+            def allgather_obj(self, obj):
+                # rank 0 = obj (drained from the live recorder),
+                # rank 1 twice as slow, rank 2 missing one phase
+                return [
+                    {"step/host": 0.1, "step/retire": 0.2},
+                    {"step/host": 0.2, "step/retire": 0.2},
+                    {"step/retire": 0.2},
+                ]
+
+        sr = StragglerReport(FakeComm(), recorder=recorder, write=False)
+        sr()
+        host = sr.last_report["phases"]["step/host"]
+        assert host["slowest_rank"] == 1
+        assert host["skew"] == pytest.approx(0.2 / 0.15)
+        assert host["ranks"] == 2
+        retire = sr.last_report["phases"]["step/retire"]
+        assert retire["skew"] == pytest.approx(1.0)
+        assert retire["ranks"] == 3
+        assert sr.last_report["max_skew"] == pytest.approx(0.2 / 0.15)
+
+    def test_phase_filter_drains_only_its_names(self, recorder):
+        class FakeComm:
+            inter_rank = 0
+
+            def allgather_obj(self, obj):
+                return [obj]
+
+        recorder.record("step/host", 0.1)
+        recorder.record("prefetch/slot_wait", 0.5)
+        sr = StragglerReport(FakeComm(), recorder=recorder,
+                             phases=["step/host"], write=False)
+        sr()
+        assert list(sr.last_report["phases"]) == ["step/host"]
+        # the filtered-out phase still accumulates for OTHER consumers
+        # (a second report with a disjoint filter, a later drain)
+        left = recorder.drain_phase_stats()
+        assert "prefetch/slot_wait" in left
+        assert "step/host" not in left
+
+
+class TestMetricsExport:
+    def test_appends_jsonl_series(self, comm, tmp_path):
+        trainer = _make_trainer(comm, tmp_path, epochs=2)
+        trainer.extend(MetricsExport())
+        trainer.run()
+        lines = [json.loads(l)
+                 for l in open(tmp_path / "metrics.jsonl")]
+        assert len(lines) == trainer.updater.iteration
+        assert lines[-1]["iteration"] == trainer.updater.iteration
+        for entry in lines:
+            assert {"iteration", "epoch", "elapsed_time", "ts",
+                    "main/loss", "main/step_time"} <= set(entry)
+        # append-only across runs: a second trainer continues the file
+        trainer2 = _make_trainer(comm, tmp_path, epochs=1)
+        trainer2.extend(MetricsExport())
+        trainer2.run()
+        more = open(tmp_path / "metrics.jsonl").read().splitlines()
+        assert len(more) > len(lines)
+
+    def test_keys_filter(self, comm, tmp_path):
+        trainer = _make_trainer(comm, tmp_path, epochs=1)
+        trainer.extend(MetricsExport(keys=["main/loss"]))
+        trainer.run()
+        entry = json.loads(
+            open(tmp_path / "metrics.jsonl").readline())
+        assert "main/loss" in entry
+        assert "main/step_time" not in entry
