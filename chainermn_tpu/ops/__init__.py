@@ -10,10 +10,12 @@ from .pallas_attention import flash_attention, flash_attention_supported
 from .fused import (
     DEFAULT_BUCKET_BYTES,
     PLAN_STRATEGIES,
+    build_overlap_schedule,
     flatten_buckets,
     fused_allreduce,
     fused_pmean,
     hierarchical_allreduce,
+    overlap_exchange,
     plan_allreduce,
     reduce_scatter_allgather,
     unflatten_buckets,
@@ -41,9 +43,10 @@ from .point_to_point import (
 
 __all__ = [
     "flash_attention", "flash_attention_supported",
-    "DEFAULT_BUCKET_BYTES", "PLAN_STRATEGIES", "flatten_buckets",
-    "fused_allreduce", "fused_pmean", "hierarchical_allreduce",
-    "plan_allreduce", "reduce_scatter_allgather", "unflatten_buckets",
+    "DEFAULT_BUCKET_BYTES", "PLAN_STRATEGIES", "build_overlap_schedule",
+    "flatten_buckets", "fused_allreduce", "fused_pmean",
+    "hierarchical_allreduce", "overlap_exchange", "plan_allreduce",
+    "reduce_scatter_allgather", "unflatten_buckets",
     "allgather", "allreduce", "alltoall", "bcast", "gather", "pmean",
     "psum", "reduce_scatter", "scatter",
     "ppermute", "pseudo_connect", "recv", "send", "send_recv",

@@ -66,6 +66,8 @@ __all__ = [
     "fused_pmean",
     "hierarchical_allreduce",
     "reduce_scatter_allgather",
+    "build_overlap_schedule",
+    "overlap_exchange",
     "plan_allreduce",
 ]
 
@@ -114,6 +116,20 @@ def _member(leaves, i):
     return (i, tuple(leaves[i].shape), jnp.dtype(leaves[i].dtype))
 
 
+def _wire_dtype_for(dtype, wire_dtype):
+    """The dtype a leaf actually crosses the wire in — the ONE copy of
+    the non-float exemption rule: compression applies to FLOAT leaves
+    under a FLOAT wire dtype only (an int32 or bool round-tripped
+    through bf16's 8 mantissa bits is silently corrupted, and the
+    reduction itself would run in the wrong arithmetic); everything
+    else rides its native dtype."""
+    dtype = jnp.dtype(dtype)
+    if wire_dtype is not None and jnp.issubdtype(dtype, jnp.floating) \
+            and jnp.issubdtype(jnp.dtype(wire_dtype), jnp.floating):
+        return jnp.dtype(wire_dtype)
+    return dtype
+
+
 def flatten_buckets(
     grads,
     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
@@ -140,16 +156,7 @@ def flatten_buckets(
     buckets: List[jax.Array] = []
     groups = []
     for dtype, idxs in by_dtype.items():
-        # Wire compression applies to FLOAT groups only: an int32 or bool
-        # leaf round-tripped through bf16 is silently corrupted (bf16
-        # carries 8 mantissa bits — any int past 256 loses low-order
-        # bits, and the reduction itself runs in the wrong arithmetic).
-        # Non-float groups cross the wire in their native dtype.
-        if wire_dtype is not None and jnp.issubdtype(dtype, jnp.floating) \
-                and jnp.issubdtype(jnp.dtype(wire_dtype), jnp.floating):
-            wire = jnp.dtype(wire_dtype)
-        else:
-            wire = dtype
+        wire = _wire_dtype_for(dtype, wire_dtype)
         per = _bucket_elems(bucket_bytes, wire.itemsize)
 
         def _wire(v):
@@ -320,8 +327,13 @@ def fused_pmean(grads, axis_name: str, **kwargs):
 #                     an all-reduce but two launches per bucket, which
 #                     some fabrics/backends schedule better (and the
 #                     shard-side divide halves the divide work)
+#   overlap         — reverse-leaf-ordered CONTIGUOUS buckets, each
+#                     exchanged as soon as the backward pass produces
+#                     its gradients (:func:`overlap_exchange`): wire
+#                     time hides under the remaining backward compute
+#                     instead of running serially after it
 PLAN_STRATEGIES = ("per_leaf", "fused_flat", "hierarchical",
-                   "reduce_scatter")
+                   "reduce_scatter", "overlap")
 
 
 def _ensure_varying(x, axis_name):
@@ -374,6 +386,228 @@ def reduce_scatter_allgather(
     return full[:size] if pad else full
 
 
+# --------------------------------------------------------------------- #
+# backward-overlapped exchange (strategy "overlap")
+# --------------------------------------------------------------------- #
+#
+# The window-end lowerings above share one structural property that
+# kills compute/comm overlap: the arena concat (and, under accum, the
+# microbatch scan) JOINS every gradient leaf, so the first collective
+# cannot start until the LAST leaf of the backward pass exists — the
+# compiled schedule clusters all exchange collectives after the last
+# backward op.  The overlap lowering removes every cross-bucket join:
+# leaves are walked in REVERSE flatten order (backward produces the
+# last layer's gradients first, so reversed pytree order ≈ production
+# order), packed into contiguous runs of ~bucket_bytes, and each
+# bucket's reduce-scatter→all-gather (or all-reduce) depends ONLY on
+# that bucket's leaves.  The scheduler is then free — and, measured on
+# the compiled HLO (``assert_overlap_collectives``), actually does —
+# to start bucket k's collective while the backward is still producing
+# bucket k+1's gradients.
+#
+# Bucket-boundary anchors: each bucket's wire vector is threaded
+# through ``lax.optimization_barrier`` together with a 1-element token
+# of the PREVIOUS bucket's reduced output.  This pins the stream order
+# (bucket k's collective cannot be hoisted before bucket k-1's) and,
+# critically, stops XLA's collective combiner from re-fusing the
+# buckets into one window-end collective — which would silently
+# reintroduce the join this lowering exists to remove.
+
+
+def _normalize_schedule(schedule) -> Tuple[Tuple[int, str, str], ...]:
+    """Coerce a schedule carrier (dicts from a JSON plan, tuples, or
+    lists) to ``((n_leaves, mode, via), ...)`` and validate it."""
+    out = []
+    for entry in schedule:
+        if isinstance(entry, dict):
+            leaves = entry.get("leaves")
+            mode = entry.get("mode", "eager")
+            via = entry.get("via", "rs")
+        else:
+            seq = tuple(entry)
+            leaves = seq[0]
+            mode = seq[1] if len(seq) > 1 else "eager"
+            via = seq[2] if len(seq) > 2 else "rs"
+        if not isinstance(leaves, int) or leaves < 1:
+            raise ValueError(
+                f"schedule entry wants a positive leaf count, got "
+                f"{leaves!r}")
+        if mode not in ("eager", "deferred"):
+            raise ValueError(
+                f"schedule mode {mode!r} not one of ('eager', "
+                f"'deferred')")
+        if via not in ("rs", "ar"):
+            raise ValueError(
+                f"schedule via {via!r} not one of ('rs', 'ar')")
+        out.append((leaves, mode, via))
+    if not out:
+        raise ValueError("empty overlap schedule")
+    return tuple(out)
+
+
+def build_overlap_schedule(
+    grads,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    wire_dtype=None,
+) -> Tuple[dict, ...]:
+    """Derive the default (all-eager) overlap schedule for a grad
+    pytree: the REVERSED non-empty-leaf sequence is cut into contiguous
+    buckets of at least ``bucket_bytes`` wire bytes (floats count at
+    the compressed ``wire_dtype`` itemsize; the last bucket is ragged).
+
+    Returns a tuple of ``{"leaves": k, "mode": "eager", "via": "rs"}``
+    dicts — the JSON-stable form a
+    :class:`~chainermn_tpu.utils.autotune.Plan` persists — whose leaf
+    counts sum to the tree's non-empty leaf count.  Leaf *sizes* (not
+    structure) drive the boundaries, so the same helper serves
+    ``jax.ShapeDtypeStruct`` trees (the autotuner's candidate builder).
+    """
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes {bucket_bytes} must be positive")
+
+    def _size(leaf) -> int:
+        return int(np.prod(leaf.shape, dtype=np.int64)) if leaf.shape \
+            else 1
+
+    leaves = [l for l in jax.tree.leaves(grads) if _size(l)]
+    schedule = []
+    run, run_bytes = 0, 0
+    for leaf in reversed(leaves):
+        run += 1
+        run_bytes += _size(leaf) * \
+            _wire_dtype_for(leaf.dtype, wire_dtype).itemsize
+        if run_bytes >= bucket_bytes:
+            schedule.append({"leaves": run, "mode": "eager", "via": "rs"})
+            run, run_bytes = 0, 0
+    if run:
+        schedule.append({"leaves": run, "mode": "eager", "via": "rs"})
+    if not schedule:
+        # every leaf empty: a 1-bucket schedule keeps callers branch-free
+        schedule.append({"leaves": 1, "mode": "eager", "via": "rs"})
+    return tuple(schedule)
+
+
+def overlap_exchange(
+    grads,
+    axis_name: str,
+    op: str = "mean",
+    schedule=None,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    wire_dtype=None,
+    inter_axis_name: Optional[str] = None,
+):
+    """Exchange a grad pytree in reverse-leaf-ordered contiguous
+    buckets, each emitted as its gradients become available — the
+    backward-overlapped lowering (strategy ``"overlap"``).
+
+    Args:
+      grads: pytree of per-device gradients (inside ``shard_map``).
+        The exchange collectives carry per-bucket dependencies only, so
+        a bucket's collective can start while the backward pass is
+        still producing the NEXT bucket's gradients — provided the
+        caller's program keeps those gradients join-free (the
+        ``StandardUpdater`` peels the window-final microbatch out of
+        its accumulation scan for exactly this reason).
+      axis_name: mesh axis to reduce over.
+      op: ``"mean"`` or ``"sum"``.
+      schedule: bucket plan over the REVERSED non-empty-leaf sequence —
+        ``({"leaves": k, "mode": "eager"|"deferred",
+        "via": "rs"|"ar"}, ...)`` (dicts or tuples).  ``eager`` buckets
+        stream in reverse-layer order under the backward; ``deferred``
+        buckets are held and exchanged after the eager stream (the
+        window-end regime, per bucket).  ``via`` picks
+        reduce-scatter→all-gather (``rs``, the default — the ZeRO-
+        friendly two-launch form) or a single all-reduce (``ar``).
+        ``None`` derives the all-eager default from ``bucket_bytes``
+        (:func:`build_overlap_schedule`).
+      bucket_bytes / wire_dtype: as :func:`fused_allreduce`; the
+        non-float wire exemption applies identically (ints and bools
+        never cross the wire compressed).
+      inter_axis_name: when given, each bucket lowers hierarchically
+        over the 2-D mesh (:func:`hierarchical_allreduce`) instead of
+        ``via`` — the stream/anchor structure is unchanged.
+
+    Dtype runs: a bucket may span leaves of several dtypes; each
+    maximal same-wire-dtype run inside the bucket is packed (and, for
+    multi-leaf runs, concatenated) into one flat vector per collective.
+    Only ADJACENT leaves ever share a concat, so no bucket waits on
+    gradients produced far from its own — the arena packer's global
+    concat is exactly the join this lowering exists to avoid.
+    """
+    if op not in ("sum", "mean"):
+        raise ValueError(f"unsupported overlap exchange op {op!r}")
+    leaves, treedef = jax.tree.flatten(grads)
+    order = [i for i in range(len(leaves) - 1, -1, -1)
+             if leaves[i].size != 0]
+    if not order:
+        return grads
+    if schedule is None:
+        schedule = build_overlap_schedule(grads, bucket_bytes, wire_dtype)
+    sched = _normalize_schedule(schedule)
+    n_sched = sum(k for k, _, _ in sched)
+    if n_sched != len(order):
+        raise ValueError(
+            f"overlap schedule covers {n_sched} leaves, grad tree has "
+            f"{len(order)} non-empty leaves — the plan was tuned for a "
+            f"different payload signature")
+
+    def _wire_of(dtype):
+        return _wire_dtype_for(dtype, wire_dtype)
+
+    # cut the reversed leaf order into (bucket, mode, via) groups
+    buckets = []
+    pos = 0
+    for k, mode, via in sched:
+        buckets.append((order[pos: pos + k], mode, via))
+        pos += k
+
+    out: List[Optional[jax.Array]] = list(leaves)
+    red = lax.pmean if op == "mean" else lax.psum
+    tok = None
+
+    def _exchange_bucket(idxs, via):
+        nonlocal tok
+        # maximal same-wire-dtype runs of ADJACENT leaves
+        runs = []
+        for i in idxs:
+            w = _wire_of(leaves[i].dtype)
+            if runs and runs[-1][0] == w:
+                runs[-1][1].append(i)
+            else:
+                runs.append((w, [i]))
+        for w, run in runs:
+            flat = [leaves[i].reshape(-1) for i in run]
+            flat = [v if v.dtype == w else v.astype(w) for v in flat]
+            vec = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+            if tok is not None:
+                # bucket-boundary anchor: pin the stream order and keep
+                # the collective combiner from re-joining the buckets
+                vec, tok = lax.optimization_barrier((vec, tok))
+            if inter_axis_name is not None:
+                r = hierarchical_allreduce(vec, axis_name,
+                                           inter_axis_name, op=op)
+            elif via == "rs":
+                r = reduce_scatter_allgather(vec, axis_name, op=op)
+            else:
+                r = red(vec, axis_name)
+            tok = r[:1]
+            off = 0
+            for i in run:
+                size = leaves[i].size
+                piece = r[off: off + size].reshape(leaves[i].shape)
+                out[i] = piece if piece.dtype == leaves[i].dtype \
+                    else piece.astype(leaves[i].dtype)
+                off += size
+
+    for idxs, mode, via in buckets:
+        if mode == "eager":
+            _exchange_bucket(idxs, via)
+    for idxs, mode, via in buckets:
+        if mode == "deferred":
+            _exchange_bucket(idxs, via)
+    return treedef.unflatten(out)
+
+
 def _plan_fields(plan) -> Tuple[str, int, Optional[str]]:
     """Normalise a plan carrier (``utils.autotune.Plan``, a plain dict,
     or anything with the three attributes) to
@@ -390,6 +624,14 @@ def _plan_fields(plan) -> Tuple[str, int, Optional[str]]:
         raise ValueError(
             f"plan strategy {strategy!r} not one of {PLAN_STRATEGIES}")
     return strategy, int(bucket or DEFAULT_BUCKET_BYTES), wire
+
+
+def _plan_schedule(plan):
+    """The plan's overlap ``schedule`` (or None for the derived
+    default) — tolerated on any carrier shape ``_plan_fields`` takes."""
+    if isinstance(plan, dict):
+        return plan.get("schedule")
+    return getattr(plan, "schedule", None)
 
 
 def plan_allreduce(
@@ -439,6 +681,12 @@ def plan_allreduce(
         return fused_allreduce(grads, axis_name, op=op,
                                bucket_bytes=bucket_bytes, wire_dtype=wire,
                                inter_axis_name=inter_axis_name)
+    if strategy == "overlap":
+        return overlap_exchange(grads, axis_name, op=op,
+                                schedule=_plan_schedule(plan),
+                                bucket_bytes=bucket_bytes,
+                                wire_dtype=wire,
+                                inter_axis_name=inter_axis_name)
 
     # reduce_scatter: fused buckets, each lowered rs -> ag over the axis
     buckets, spec = flatten_buckets(grads, bucket_bytes, wire)

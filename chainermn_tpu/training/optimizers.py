@@ -24,6 +24,7 @@ no threads.
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -298,16 +299,26 @@ class Zero1Transformation(NamedTuple):
     TYPE marks the ZeRO-1 state layout, so consumers that must carry the
     state differently (``StandardUpdater``: world-stacked, sharded over
     the data axis) can detect it instead of asking the user to repeat a
-    ``zero1=True`` flag that could silently disagree."""
+    ``zero1=True`` flag that could silently disagree.
+
+    ``overlap`` marks that the owner asked for the backward-overlapped
+    exchange: ZeRO-1's per-leaf ``psum_scatter``s are already join-free
+    (each depends only on its own gradient leaf — the property the
+    overlap lowering builds for the fused paths), so the flag's whole
+    job is telling ``StandardUpdater`` to peel the window-final
+    microbatch out of its accumulation scan, putting a backward pass
+    in the outer program for those scatters to hide under."""
 
     init: Callable
     update: Callable
+    overlap: bool = False
 
 
 def zero1_optimizer(
     inner: optax.GradientTransformation,
     axis_name: str,
     wire_dtype=None,
+    overlap: bool = False,
 ) -> optax.GradientTransformation:
     """ZeRO-1: shard ``inner``'s optimiser state across ``axis_name``.
 
@@ -378,7 +389,7 @@ def zero1_optimizer(
 
         return jax.tree.map(gather, upd_shards, grads), state
 
-    return Zero1Transformation(init, update)
+    return Zero1Transformation(init, update, overlap=bool(overlap))
 
 
 def shard_opt_state(optimizer, params):
@@ -473,6 +484,11 @@ def zero1_init(tx, params, mesh, axis_name: str):
     return f(params)
 
 
+# one-time (per process) warning for plan= under ZeRO-1 — the fallback
+# must be visible, not a silent downgrade, but not a per-step nag either
+_ZERO1_PLAN_WARNED = False
+
+
 def create_multi_node_optimizer(
     actual_optimizer: optax.GradientTransformation,
     comm=None,
@@ -485,6 +501,7 @@ def create_multi_node_optimizer(
     bucket_bytes: Optional[int] = None,
     inter_axis_name: Optional[str] = None,
     plan=None,
+    overlap: Any = False,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimiser with cross-replica gradient averaging.
 
@@ -541,8 +558,33 @@ def create_multi_node_optimizer(
         superseded by the plan's strategy/bucket/wire fields.
         Hierarchical candidates enter the search only when
         ``inter_axis_name`` is given (the step must bind the axis).
-        Incompatible with ``zero1`` (whose reduce-scatter/all-gather
-        pair is a different exchange family).
+        Under ``zero1`` the plan is IGNORED with a one-time warning:
+        ZeRO-1's reduce-scatter/all-gather pair is a different exchange
+        family the planner does not drive, and the analytic path is
+        the correct fallback — so ``plan="auto"`` is safe to set
+        globally across a fleet where some jobs shard their optimizer
+        state.
+      overlap: fire the gradient exchange DURING the backward pass
+        instead of after it (the backward-overlapped lowering,
+        ``ops.fused.overlap_exchange``): the grad pytree is cut into
+        reverse-layer-ordered buckets and each bucket's
+        reduce-scatter→all-gather is emitted as soon as its gradients
+        exist, so XLA hides wire time under the remaining backward
+        compute (``utils.comm_model.assert_overlap_collectives`` is
+        the HLO proof).  ``True`` with ``plan=None`` builds a static
+        overlap plan (analytic schedule from ``bucket_bytes`` /
+        ``allreduce_grad_dtype``); with ``plan="auto"`` the autotuner
+        searches the *schedule* dimension (bucket boundaries ×
+        eager/deferred per bucket) and the winner stays in the overlap
+        family; ``"auto"`` (with ``plan="auto"``) lets measurement
+        pick between the overlap and window-end families.  Under
+        ``zero1`` the per-leaf reduce-scatters are already join-free,
+        so the flag only marks the transformation for the updater's
+        final-microbatch peel.  ``StandardUpdater`` detects overlap
+        from the plan and restructures its accumulation scan so the
+        window-final microbatch's backward sits in the outer program —
+        otherwise the scan would join every gradient and there would
+        be nothing to overlap under.
     """
     ax = axis_name or (comm.axis_name if comm is not None else None)
     if ax is None:
@@ -550,10 +592,20 @@ def create_multi_node_optimizer(
     if accum_steps < 1:
         raise ValueError(f"accum_steps {accum_steps} must be >= 1")
     if plan is not None and zero1:
-        raise ValueError(
-            "plan= drives the cross_replica_mean exchange; ZeRO-1 "
-            "replaces that exchange with its reduce-scatter/all-gather "
-            "pair — the two cannot be combined")
+        # graceful fallback, not an error: plan="auto" must be safe to
+        # set globally.  ZeRO-1's reduce-scatter/all-gather pair is its
+        # own (analytic, per-leaf, join-free) exchange; the plan would
+        # drive an exchange that never runs.
+        global _ZERO1_PLAN_WARNED
+        if not _ZERO1_PLAN_WARNED:
+            _ZERO1_PLAN_WARNED = True
+            warnings.warn(
+                "create_multi_node_optimizer: plan= is ignored under "
+                "zero1=True — ZeRO-1 exchanges gradients through its "
+                "own reduce-scatter/all-gather pair, so the analytic "
+                "path is used instead of the tuned plan (warning shown "
+                "once per process)", RuntimeWarning, stacklevel=2)
+        plan = None
     inner = actual_optimizer
     if double_buffering:
         inner = optax.chain(_double_buffer(), inner)
@@ -561,7 +613,32 @@ def create_multi_node_optimizer(
         inner = _grad_accumulation(inner, accum_steps, axis_name=ax)
     if zero1:
         # accumulation INSIDE zero1: the accumulator holds 1/N shards
-        return zero1_optimizer(inner, ax, wire_dtype=allreduce_grad_dtype)
+        return zero1_optimizer(inner, ax,
+                               wire_dtype=allreduce_grad_dtype,
+                               overlap=bool(overlap))
+    if overlap and plan is None:
+        if overlap is not True:
+            # overlap="auto" means "let the MEASUREMENT pick between
+            # the overlap and window-end families" — without
+            # plan="auto" no measurement ever runs, and silently
+            # forcing the static overlap plan would contradict the
+            # request
+            raise ValueError(
+                f"overlap={overlap!r} asks the measured search to "
+                f"choose between the overlap and window-end families, "
+                f"which needs plan='auto'; pass overlap=True for the "
+                f"static (untuned) overlap plan")
+        # static overlap plan: analytic schedule derived from
+        # bucket_bytes at trace time, no tuning, no comm needed
+        from chainermn_tpu.ops import fused as _fused
+        from chainermn_tpu.utils import autotune as _autotune
+
+        plan = _autotune.Plan(
+            strategy="overlap",
+            bucket_bytes=bucket_bytes or _fused.DEFAULT_BUCKET_BYTES,
+            wire_dtype=(jnp.dtype(allreduce_grad_dtype).name
+                        if allreduce_grad_dtype is not None else None),
+        )
     if plan is not None:
         from chainermn_tpu.utils import autotune as _autotune
 
@@ -579,6 +656,14 @@ def create_multi_node_optimizer(
             cell = _autotune.PlanCell()
         else:
             cell = _autotune.PlanCell(_autotune.Plan.from_any(plan))
+        if overlap is True and cell.plan is not None \
+                and cell.plan.strategy != "overlap":
+            raise ValueError(
+                f"overlap=True with an explicit plan of strategy "
+                f"{cell.plan.strategy!r}: the plan drives the exchange, "
+                f"so a window-end plan cannot satisfy the overlap "
+                f"request — pass an 'overlap' plan, plan='auto', or "
+                f"drop overlap=")
         chained = optax.chain(
             _planned_mean(ax, cell, inter_axis_name=inter_axis_name),
             inner)
@@ -586,11 +671,12 @@ def create_multi_node_optimizer(
         # the plan executes inside the USER's shard_map: hierarchical
         # is only runnable when that program binds the second axis.
         # Recorded on the cell so a later drift retune() tunes under
-        # the SAME constraint.
+        # the SAME constraint (including the overlap-family one).
         cell.tune_kwargs = dict(
             inter_axis_name=inter_axis_name,
             allow_hierarchical=(
-                None if inter_axis_name is not None else False))
+                None if inter_axis_name is not None else False),
+            overlap=overlap if overlap else False)
 
         def planned_init(params):
             if cell.plan is None:

@@ -148,6 +148,13 @@ class StandardUpdater:
         ``utils.comm_model.choose_accum_steps`` picks a principled M;
         ``utils.comm_model.assert_accum_collectives`` proves the M→1
         collective count from the compiled HLO.  See docs/PIPELINE.md.
+        With a backward-overlapped optimizer
+        (``create_multi_node_optimizer(overlap=...)``) the window-final
+        microbatch is peeled out of the scan so the per-bucket exchange
+        streams UNDER its backward pass
+        (``assert_overlap_collectives`` is the proof; the peel reorders
+        no accumulation arithmetic, and the overlap path composes
+        bitwise with ``prefetch``/``steps_per_execution``).
       accum_dtype: gradient accumulator dtype (default float32 — wider
         than bf16 params so M summed microbatch grads don't lose
         mantissa).  The accumulated mean is cast back to each param
@@ -327,6 +334,27 @@ class StandardUpdater:
         stateful = self.state is not None
         zero1 = self.zero1
         accum_dtype = self.accum_dtype
+        # Backward-overlapped exchange (plan strategy "overlap", or a
+        # zero1 transformation built with overlap=True): the window-
+        # final microbatch is PEELED out of the accumulation scan.  A
+        # scan is one opaque while op — every gradient leaf becomes
+        # available only when the whole loop retires, so an exchange
+        # after it cannot start under any backward.  With the last
+        # microbatch unrolled in the outer program, each exchange
+        # bucket depends only on its own (accumulated + final) leaves
+        # and the scheduler streams the bucket collectives under the
+        # final backward (assert_overlap_collectives proves it).  The
+        # peel re-orders no float math — the same M microbatch grads
+        # accumulate in the same order; only the exchange lowering
+        # differs from the window-end path (wire tolerance documented
+        # on cross_replica_mean).
+        # The step cache key need not carry this flag: a plan change
+        # bumps the cell generation and update() clears the cache.
+        plan = getattr(getattr(optimizer, "plan_cell", None), "plan",
+                       None)
+        overlap_peel = accum > 1 and (
+            getattr(plan, "strategy", None) == "overlap"
+            or getattr(optimizer, "overlap", False))
         from chainermn_tpu.parallel._compat import pcast as _pcast
 
         def step(carry, *batch):
@@ -387,8 +415,22 @@ class StandardUpdater:
                 acc0 = jax.tree.map(
                     lambda p: _pcast(jnp.zeros(p.shape, accum_dtype),
                                      ax, to="varying"), params)
-                (acc, new_model_state), micro_losses = jax.lax.scan(
-                    micro, (acc0, state), batch)
+                if overlap_peel:
+                    # scan the first M-1 microbatches, unroll the final
+                    # one: its backward lands in the OUTER program,
+                    # where the optimizer's per-bucket exchange can
+                    # start while earlier layers' grads are still being
+                    # produced (see the overlap_peel note above)
+                    (acc, mid_state), micro_losses = jax.lax.scan(
+                        micro, (acc0, state),
+                        tuple(b[:-1] for b in batch))
+                    (acc, new_model_state), last_loss = micro(
+                        (acc, mid_state), tuple(b[-1] for b in batch))
+                    micro_losses = jnp.concatenate(
+                        [micro_losses, last_loss[None]])
+                else:
+                    (acc, new_model_state), micro_losses = jax.lax.scan(
+                        micro, (acc0, state), batch)
                 # local mean over the window, cast back to wire dtype;
                 # STILL device-local — the optimizer's reducer performs
                 # the single window-end cross-replica mean (fused

@@ -14,11 +14,13 @@ from chainermn_tpu.utils.comm_model import (
     CollectiveStats,
     LinkParams,
     assert_accum_collectives,
+    assert_overlap_collectives,
     axis_collective_report,
     choose_accum_steps,
     choose_bucket_bytes,
     choose_prefetch_depth,
     collective_stats,
+    overlap_exposed_time,
     stablehlo_collective_stats,
     wire_bytes_per_device,
 )
@@ -59,8 +61,10 @@ __all__ = [
     "Profiler",
     "SnapshotCorruptError",
     "assert_accum_collectives",
+    "assert_overlap_collectives",
     "autotune_plan",
     "axis_collective_report",
+    "overlap_exposed_time",
     "default_cache_path",
     "load_cached_plan",
     "store_plan",

@@ -90,7 +90,11 @@ __all__ = [
 
 # Bump to invalidate every cached plan (plan semantics / probe harness
 # changes make old measurements incomparable).
-FORMAT_VERSION = 1
+# v2: plans gained the overlap *schedule* dimension (strategy
+# "overlap" + per-bucket eager/deferred modes); v1 plans carry no
+# schedule field and their measurements never saw the overlap
+# candidates, so they must re-tune.
+FORMAT_VERSION = 2
 
 PLAN_CACHE_ENV = "CHAINERMN_TPU_PLAN_CACHE"
 
@@ -105,10 +109,26 @@ class Candidate:
     strategy: str                       # one of ops.fused.PLAN_STRATEGIES
     bucket_bytes: int
     wire_dtype: Optional[str] = None    # "bfloat16" or None (native)
+    # overlap schedule: ((n_leaves, mode, via), ...) over the REVERSED
+    # non-empty-leaf order (see ops.fused.overlap_exchange); None for
+    # the window-end strategies
+    schedule: Optional[Tuple[Tuple[int, str, str], ...]] = None
 
     def label(self) -> str:
         w = self.wire_dtype or "native"
-        return f"{self.strategy}/b{self.bucket_bytes}/{w}"
+        base = f"{self.strategy}/b{self.bucket_bytes}/{w}"
+        if self.schedule is None:
+            return base
+        n_def = sum(1 for _, m, _ in self.schedule if m == "deferred")
+        return f"{base}/s{len(self.schedule)}d{n_def}"
+
+    def schedule_dicts(self) -> Optional[list]:
+        """The schedule in the JSON-stable dict form a :class:`Plan`
+        persists."""
+        if self.schedule is None:
+            return None
+        return [{"leaves": k, "mode": m, "via": v}
+                for k, m, v in self.schedule]
 
 
 @dataclass
@@ -129,6 +149,11 @@ class Plan:
     strategy: str
     bucket_bytes: int
     wire_dtype: Optional[str] = None
+    # overlap schedule — list of {"leaves", "mode", "via"} dicts over
+    # the reversed non-empty-leaf order; None for window-end strategies
+    # (strategy "overlap" with schedule=None derives the all-eager
+    # default from bucket_bytes at trace time)
+    schedule: Optional[list] = None
     measured_ms: Optional[float] = None
     key: Optional[str] = None
     link: Optional[Dict[str, float]] = None
@@ -141,6 +166,7 @@ class Plan:
             "strategy": self.strategy,
             "bucket_bytes": int(self.bucket_bytes),
             "wire_dtype": self.wire_dtype,
+            "schedule": self.schedule,
             "measured_ms": self.measured_ms,
             "key": self.key,
             "link": self.link,
@@ -153,6 +179,7 @@ class Plan:
             strategy=d["strategy"],
             bucket_bytes=int(d["bucket_bytes"]),
             wire_dtype=d.get("wire_dtype"),
+            schedule=d.get("schedule"),
             measured_ms=d.get("measured_ms"),
             key=d.get("key"),
             link=d.get("link"),
@@ -239,10 +266,21 @@ def payload_signature(tree) -> dict:
     }
 
 
-def plan_key(mesh_sig: dict, payload_sig: dict) -> str:
+def plan_key(mesh_sig: dict, payload_sig: dict,
+             variant: Optional[str] = None) -> str:
     """Cache key: hash of the full mesh signature plus the payload
-    digest.  Everything a measurement depends on is inside."""
-    return _digest({"mesh": mesh_sig, "payload": payload_sig["digest"]})
+    digest.  Everything a measurement depends on is inside.
+
+    ``variant`` separates searches run under different FAMILY
+    constraints over the same (mesh, payload) — ``"overlap"`` (winner
+    forced into the backward-overlapped family) and ``"overlap-auto"``
+    (overlap candidates added to the open space) must not share cache
+    entries with the window-end-only search: a hit from one would
+    silently serve the other a plan its constraint forbids."""
+    d = {"mesh": mesh_sig, "payload": payload_sig["digest"]}
+    if variant:
+        d["variant"] = variant
+    return _digest(d)
 
 
 # --------------------------------------------------------------------- #
@@ -390,6 +428,15 @@ def candidate_wire_stats(cand: Candidate, payload_sig: dict,
     frac = (n - 1) / n if n > 1 else 0.0
     if cand.strategy == "per_leaf":
         return max(payload_sig["n_nonempty"], 1), 2.0 * w * frac
+    if cand.strategy == "overlap":
+        # ring bytes match the all-reduce; launches follow the
+        # schedule's per-bucket collective choice (rs→ag = 2, ar = 1)
+        if cand.schedule:
+            launches = sum(2 if via == "rs" else 1
+                           for _, _, via in cand.schedule)
+        else:
+            launches = 2 * _n_buckets(payload_sig, cand)
+        return launches, 2.0 * w * frac
     buckets = _n_buckets(payload_sig, cand)
     if cand.strategy == "fused_flat":
         return buckets, 2.0 * w * frac
@@ -426,18 +473,90 @@ def model_cost(cand: Candidate, payload_sig: dict, axis_size: int,
     return launches * link.latency_s + wire / link.bandwidth_bytes_per_s
 
 
+def _overlap_schedules(leaf_template, bucket_bytes: int,
+                       wire_dtype: Optional[str]) -> List[Tuple]:
+    """Schedule variants for one (bucket, wire) point: the all-eager
+    reverse-layer stream in both per-bucket collective forms
+    (``via="rs"`` reduce-scatter→all-gather, ``via="ar"`` one
+    all-reduce — which form the backend schedules better is exactly
+    what the probe settles), plus — when the stream has at least two
+    buckets — a defer-tail variant holding the last quarter of the
+    stream (the FIRST layers' gradients, produced when the backward is
+    almost done and there is little compute left to hide under) back
+    to the window end, where they contend with nothing."""
+    from chainermn_tpu.ops.fused import build_overlap_schedule
+
+    base = tuple(
+        (e["leaves"], e["mode"], e["via"])
+        for e in build_overlap_schedule(leaf_template, bucket_bytes,
+                                        wire_dtype))
+    out = []
+    for via in ("rs", "ar"):
+        eager = tuple((lv, m, via) for lv, m, _ in base)
+        out.append(eager)
+        k = len(eager)
+        if k >= 2:
+            n_def = max(1, k // 4)
+            out.append(tuple(
+                (lv, "deferred" if i >= k - n_def else m, v)
+                for i, (lv, m, v) in enumerate(eager)))
+    return out
+
+
+def _schedule_wire_buckets(leaf_template, cand: Candidate) \
+        -> Tuple[List[float], List[str], List[int]]:
+    """Per-bucket wire bytes (stream order), modes, and launch counts
+    (2 for ``via="rs"``, 1 for ``"ar"`` — the rs-vs-ar dimension must
+    reach the cost model, or the enumeration's whole point is priced
+    identically) for one overlap candidate, from the leaf template its
+    schedule was built over — the
+    :func:`~chainermn_tpu.utils.comm_model.overlap_exposed_time`
+    inputs."""
+    import jax
+
+    from chainermn_tpu.ops.fused import _wire_dtype_for
+
+    sizes = []
+    for leaf in jax.tree.leaves(leaf_template):
+        ne = int(np.prod(leaf.shape, dtype=np.int64)) if leaf.shape else 1
+        if not ne:
+            continue
+        sizes.append(
+            ne * _wire_dtype_for(leaf.dtype, cand.wire_dtype).itemsize)
+    sizes.reverse()
+    buckets, modes, launches = [], [], []
+    pos = 0
+    for k, mode, via in cand.schedule:
+        buckets.append(float(sum(sizes[pos: pos + k])))
+        modes.append(mode)
+        launches.append(2 if via == "rs" else 1)
+        pos += k
+    return buckets, modes, launches
+
+
 def enumerate_candidates(
     payload_sig: dict,
     axis_size: int,
     allow_hierarchical: bool = False,
     link: Optional[LinkParams] = None,
     grid: Sequence[float] = (0.25, 1.0, 4.0),
+    overlap: Any = False,
+    leaf_template=None,
 ) -> List[Candidate]:
     """The full candidate space (step 1): strategies × a geometric
     bucket grid centred on the analytic optimum ``b*`` × wire dtype.
     The bf16 wire variants are skipped when no payload group would
     actually compress; ``per_leaf`` is a single point (no bucket/wire
-    knobs) and is always first — it doubles as the parity baseline."""
+    knobs) and is always first — it doubles as the parity baseline.
+
+    ``overlap`` adds the backward-overlapped family: per bucket size ×
+    wire dtype, concrete schedules built over ``leaf_template`` (a
+    pytree of abstract or real leaves mirroring the gradient tree —
+    the schedule dimension needs per-leaf sizes the payload signature
+    alone does not carry).  ``overlap=True`` additionally DROPS the
+    window-end strategies (the caller wants the overlap family;
+    per_leaf stays as the parity anchor), while ``"auto"`` keeps the
+    space open and lets measurement decide across families."""
     link = link or LinkParams()
     total = max(int(payload_sig["total_bytes"]), 1)
     b_star = choose_bucket_bytes(total, axis_size, link=link,
@@ -448,13 +567,24 @@ def enumerate_candidates(
     if _compressible(payload_sig):
         wires = (None, "bfloat16")
     cands = [Candidate("per_leaf", total, None)]
-    strategies = ["fused_flat", "reduce_scatter"]
-    if allow_hierarchical:
-        strategies.append("hierarchical")
-    for strat in strategies:
+    if overlap and leaf_template is None:
+        raise ValueError(
+            "overlap candidates need leaf_template (the schedule "
+            "dimension is built from per-leaf sizes)")
+    if not (overlap is True):
+        strategies = ["fused_flat", "reduce_scatter"]
+        if allow_hierarchical:
+            strategies.append("hierarchical")
+        for strat in strategies:
+            for b in buckets:
+                for w in wires:
+                    cands.append(Candidate(strat, b, w))
+    if overlap:
         for b in buckets:
             for w in wires:
-                cands.append(Candidate(strat, b, w))
+                for sched in _overlap_schedules(leaf_template, b, w):
+                    cands.append(Candidate("overlap", b, w,
+                                           schedule=sched))
     return cands
 
 
@@ -648,6 +778,9 @@ def autotune_plan(
     trials: int = 3,
     warmup: int = 1,
     grid: Sequence[float] = (0.25, 1.0, 4.0),
+    overlap: Any = False,
+    t_bwd_s: Optional[float] = None,
+    overlap_slack: float = 0.15,
     force: bool = False,
     seed: int = 0,
 ) -> Plan:
@@ -677,6 +810,31 @@ def autotune_plan(
       trials / warmup: probe repetitions; the warmup runs (compile +
         first execution) are discarded, the median of ``trials`` wins.
       grid: geometric bucket-size factors around the analytic ``b*``.
+      overlap: search the backward-overlapped exchange family
+        (strategy ``"overlap"`` — the plan gains a *schedule*: bucket
+        boundaries over the reversed leaf order plus per-bucket
+        eager/deferred modes).  ``True`` forces the winner into that
+        family (per-leaf stays as the parity anchor only); ``"auto"``
+        adds overlap candidates to the open space and lets the
+        measurement decide; ``False`` (default) keeps the window-end
+        space.  The constraint is part of the cache key (``variant``),
+        so overlap and window-end tunings never serve each other.
+      t_bwd_s: measured backward wall time per microbatch (e.g. the
+        updater's ``main/step_time`` before the exchange dominates) —
+        the overlap schedule ranking's hiding budget.  An isolated
+        probe times TOTAL wire cost but cannot see what overlap hides,
+        so with ``t_bwd_s`` given the overlap winner minimises the
+        modeled EXPOSED time
+        (:func:`~chainermn_tpu.utils.comm_model.overlap_exposed_time`
+        fed each candidate's probe-calibrated per-bucket wire times);
+        without it, the ``overlap_slack`` rule applies.
+      overlap_slack: with no ``t_bwd_s``, the overlap winner is the
+        candidate with the MOST eager stream buckets among those
+        within ``(1 + overlap_slack)×`` of the fastest overlap
+        candidate's isolated time — finer buckets buy overlap room at
+        bounded wire cost, and a single-bucket "schedule" (which a
+        pure isolated-time ranking favours: fewest launches) would
+        re-create the window-end join the family exists to remove.
       force: ignore (and overwrite) any cached plan — the drift
         guard's re-tune entry point.
       seed: probe-data seed (deterministic across ranks: probe inputs
@@ -720,7 +878,10 @@ def autotune_plan(
 
     payload = payload_signature(params)
     mesh_sig = mesh_signature(flat_mesh, hier_shape)
-    key = plan_key(mesh_sig, payload)
+    variant = None
+    if overlap:
+        variant = "overlap" if overlap is True else "overlap-auto"
+    key = plan_key(mesh_sig, payload, variant=variant)
 
     if not force:
         cached = local_hit = load_cached_plan(key, cache_path)
@@ -751,12 +912,54 @@ def autotune_plan(
             return cached
 
     # -- enumerate + prune -------------------------------------------- #
+    leaf_template = None
+    if overlap:
+        leaf_template = [jax.ShapeDtypeStruct(tuple(int(s)
+                                                    for s in l.shape),
+                                              l.dtype)
+                         for l in leaves]
     cands = enumerate_candidates(payload, n,
                                  allow_hierarchical=allow_hierarchical,
-                                 grid=grid)
+                                 grid=grid, overlap=overlap,
+                                 leaf_template=leaf_template)
     baseline, rest = cands[0], cands[1:]
-    rest.sort(key=lambda c: model_cost(c, payload, n, inter_size))
-    probed = [baseline] + rest[:max(top_k, 1)]
+
+    def _prune_cost(c: Candidate) -> float:
+        base = model_cost(c, payload, n, inter_size)
+        if t_bwd_s is not None and c.strategy == "overlap" \
+                and c.schedule:
+            # prune with the objective the final ranking uses: a fine
+            # schedule's extra launches make its ISOLATED cost high,
+            # but most of them hide under the backward — ranking the
+            # prune by isolated cost would drop exactly the schedules
+            # the exposed-time model exists to find
+            from chainermn_tpu.utils.comm_model import (
+                overlap_exposed_time,
+            )
+
+            bkts, modes, launches = _schedule_wire_buckets(
+                leaf_template, c)
+            return overlap_exposed_time(
+                bkts, n, float(t_bwd_s), modes=modes,
+                launches_per_bucket=launches) + 1e-6 * base
+        return base
+
+    k = max(top_k, 1)
+    if overlap and overlap is not True:
+        # open ("auto") space: prune PER FAMILY.  With t_bwd_s given,
+        # overlap candidates' exposed-time cost is near zero while
+        # window-end candidates carry their full isolated cost — a
+        # single sorted list would fill every probe slot with overlap
+        # schedules and the cross-family measurement "auto" promises
+        # would never happen.
+        ov_c = sorted((c for c in rest if c.strategy == "overlap"),
+                      key=_prune_cost)
+        we_c = sorted((c for c in rest if c.strategy != "overlap"),
+                      key=_prune_cost)
+        probed = [baseline] + ov_c[:(k + 1) // 2] + we_c[:k // 2]
+    else:
+        rest.sort(key=_prune_cost)
+        probed = [baseline] + rest[:k]
 
     # -- measure ------------------------------------------------------ #
     n_probes = 0
@@ -797,6 +1000,7 @@ def autotune_plan(
             "strategy": cand.strategy,
             "bucket_bytes": cand.bucket_bytes,
             "wire_dtype": cand.wire_dtype,
+            "schedule": cand.schedule_dicts(),
             "ms": round(median_s * 1e3, 4),
             "modeled_ms": round(
                 model_cost(cand, payload, n, inter_size) * 1e3, 4),
@@ -805,7 +1009,69 @@ def autotune_plan(
         if ok:
             results.append((cand, median_s))
 
-    winner, best_s = min(results, key=lambda r: r[1])
+    pool = results
+    if overlap is True:
+        # the caller asked for the backward-overlapped family: the
+        # per-leaf baseline (and any parity survivor outside the
+        # family) anchors correctness but may not win.  Fall back to
+        # the open pool only if every overlap candidate failed parity.
+        forced = [r for r in results if r[0].strategy == "overlap"]
+        pool = forced or results
+    winner, best_s = min(pool, key=lambda r: r[1])
+
+    # Schedule-aware overlap ranking.  An isolated probe measures a
+    # schedule's TOTAL wire cost but, with no backward running under
+    # it, none of what overlap hides — so raw probe time favours the
+    # single-bucket schedule (fewest launches), which is the window-end
+    # join wearing the overlap strategy's name.
+    ov = [r for r in pool if r[0].strategy == "overlap"
+          and r[0].schedule]
+    if ov and t_bwd_s is not None:
+        # measured hiding budget: rank by modeled EXPOSED time, each
+        # candidate's per-bucket wire times calibrated so their sum
+        # equals its measured isolated probe time
+        from chainermn_tpu.utils.comm_model import overlap_exposed_time
+
+        frac = 2.0 * (n - 1) / n if n > 1 else 0.0
+        lp0 = LinkParams()
+
+        def _effective(r):
+            cand, meas = r
+            if cand.strategy != "overlap" or not cand.schedule:
+                # a window-end exchange hides nothing: fully exposed
+                return (meas, meas)
+            bkts, modes, launches = _schedule_wire_buckets(
+                leaf_template, cand)
+            model_total = sum(
+                k * lp0.latency_s + b * frac / lp0.bandwidth_bytes_per_s
+                for b, k in zip(bkts, launches)) or float(meas)
+            scale = meas / model_total
+            exposed = overlap_exposed_time(
+                bkts, n, float(t_bwd_s),
+                latency_s=lp0.latency_s * scale,
+                bandwidth_bytes_per_s=lp0.bandwidth_bytes_per_s / scale,
+                modes=modes, launches_per_bucket=launches)
+            return (exposed, meas)
+
+        winner, best_s = min(pool, key=_effective)
+    elif ov and (overlap is True or winner.strategy == "overlap"):
+        # no hiding budget given: among overlap candidates within
+        # overlap_slack of the fastest, take the FINEST eager stream —
+        # more buckets at bounded wire cost is more overlap room.
+        # Single-bucket schedules are excluded whenever a multi-bucket
+        # candidate survived parity: one bucket cannot stream under
+        # anything (it IS the window-end join), and on small payloads
+        # its fewest-launches probe time would otherwise always win —
+        # defeating the overlap request the caller made.
+        multi = [r for r in ov if len(r[0].schedule) >= 2]
+        pool_ov = multi or ov
+        best_ov = min(s for _, s in pool_ov)
+        eligible = [r for r in pool_ov
+                    if r[1] <= best_ov * (1.0 + overlap_slack)]
+        winner, best_s = min(
+            eligible,
+            key=lambda r: (-sum(1 for _, m, _ in r[0].schedule
+                                if m == "eager"), r[1]))
 
     # -- fit measured link constants ---------------------------------- #
     samples = []
@@ -819,6 +1085,7 @@ def autotune_plan(
         strategy=winner.strategy,
         bucket_bytes=winner.bucket_bytes,
         wire_dtype=winner.wire_dtype,
+        schedule=winner.schedule_dicts(),
         measured_ms=round(best_s * 1e3, 4),
         key=key,
         link={"latency_s": link.latency_s,
@@ -830,6 +1097,7 @@ def autotune_plan(
             "timings": timings,
             "n_enumerated": len(cands),
             "n_probed": len(probed),
+            "overlap": overlap if overlap else False,
             "trials": trials,
             "created": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds"),

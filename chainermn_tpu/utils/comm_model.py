@@ -40,8 +40,10 @@ __all__ = [
     "choose_bucket_bytes",
     "choose_prefetch_depth",
     "fused_collective_budget",
+    "overlap_exposed_time",
     "assert_fused_collectives",
     "assert_accum_collectives",
+    "assert_overlap_collectives",
 ]
 
 # Interconnect defaults for choose_bucket_bytes: per-collective launch
@@ -173,6 +175,12 @@ class CollectiveStats:
     #                             they run once PER TRIP, so a per-window
     #                             count must treat them separately (the
     #                             accumulation proof hinges on this)
+    async_depth: int = 0        # async -start/-done pairs with at least
+    #                             one OTHER instruction scheduled between
+    #                             the halves: collectives the backend
+    #                             actually runs concurrently with compute
+    #                             (sync lowerings — XLA:CPU today — and
+    #                             back-to-back start;done pairs score 0)
 
     def wire_bytes(self, axis_size: Optional[int] = None) -> float:
         n = axis_size or self.group_size
@@ -267,6 +275,22 @@ def _loop_body_computations(comps: Dict[str, list]) -> set:
     return reach
 
 
+_LHS_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=")
+_DONE_RE = re.compile(r"(" + "|".join(_KINDS) + r")-done\(")
+
+
+def _hlo_texts(compiled) -> list:
+    """The optimised (scheduled) HLO module texts of a
+    ``jax.stages.Compiled`` — instruction order in each computation is
+    the backend's execution schedule, which is what the overlap proof
+    reads."""
+    try:
+        return [m.to_string() for m in compiled.runtime_executable()
+                .hlo_modules()]
+    except Exception:
+        return [compiled.as_text()]
+
+
 def collective_stats(compiled) -> Dict[str, CollectiveStats]:
     """Parse a ``jax.stages.Compiled``'s HLO for collectives.
 
@@ -279,19 +303,39 @@ def collective_stats(compiled) -> Dict[str, CollectiveStats]:
     callers can scale by the trip count, and
     :func:`assert_accum_collectives` can prove a scan body exchanges
     NOTHING.
+
+    Async depth: every ``-start`` whose matching ``-done`` is scheduled
+    with at least one other instruction between the halves bumps its
+    kind's ``.async_depth`` — the count of collectives the backend
+    actually overlaps with other work, as opposed to merely emitting
+    (:func:`assert_overlap_collectives` and ``bench_overlap.py`` read
+    this alongside the schedule-position evidence).
     """
-    try:
-        texts = [m.to_string() for m in compiled.runtime_executable()
-                 .hlo_modules()]
-    except Exception:
-        texts = [compiled.as_text()]
     out: Dict[str, CollectiveStats] = {}
-    for text in texts:
+    for text in _hlo_texts(compiled):
         comps = _split_computations(text)
         looped_comps = _loop_body_computations(comps)
         for comp_name, lines in comps.items():
             in_loop = comp_name in looped_comps
+            pending: Dict[str, tuple] = {}    # lhs -> (stats, instr_idx)
+            n_instr = 0
             for line in lines:
+                lhs = _LHS_RE.match(line)
+                if lhs is not None:
+                    n_instr += 1
+                if pending and _DONE_RE.search(line):
+                    for name in list(pending):
+                        # exact-token match: HLO names may contain
+                        # [\w.-], and XLA's ".N" suffixing makes one
+                        # start's name a PREFIX of another's — a \b
+                        # boundary would pop %all-reduce-start on the
+                        # done line of %all-reduce-start.1
+                        if re.search(r"%" + re.escape(name)
+                                     + r"(?![\w.\-])", line):
+                            st, s_idx = pending.pop(name)
+                            if n_instr - s_idx > 1:
+                                st.async_depth += 1
+                            break
                 m = _INSTR_RE.search(line)
                 if not m:
                     continue
@@ -309,6 +353,8 @@ def collective_stats(compiled) -> Dict[str, CollectiveStats]:
                                          is_start=bool(m.group(3)))
                 if g is not None:
                     st.group_size = g if st.group_size in (None, g) else -1
+                if m.group(3) and lhs is not None:
+                    pending[lhs.group(1)] = (st, n_instr)
     return out
 
 
@@ -656,6 +702,185 @@ def assert_accum_collectives(
             f"{max(0, n_dtype_groups - 1)} ragged group buckets + "
             f"{extra} extra)")
     return count
+
+
+# backward compute markers for the overlap proof: the matmul-shaped ops
+# a training step's forward/backward is made of.  Elementwise optimiser
+# math lowers to fusions without any of these, so "the last dot" is a
+# faithful end-of-backward marker in the schedule.
+_COMPUTE_RE = re.compile(
+    r"=\s*[^ ]+\s+(?:dot|convolution)\(|"
+    r"custom-call.*(?:matmul|convolution)")
+
+
+def assert_overlap_collectives(
+    compiled,
+    kinds=("all-reduce", "reduce-scatter", "all-gather"),
+    min_bytes: int = 256,
+    min_frac: float = 0.5,
+) -> dict:
+    """Prove, from the compiled schedule, that the gradient exchange
+    runs UNDER the backward pass — the overlap analogue of
+    :func:`assert_fused_collectives` / :func:`assert_accum_collectives`.
+
+    XLA prints each computation of an optimised module in execution-
+    schedule order, so position is evidence: an exchange collective
+    scheduled BEFORE the computation's last matmul-shaped op
+    (``dot``/``convolution``/a matmul custom-call) starts while
+    backward compute still remains — wire time that can hide.  The
+    window-end lowerings place every exchange collective after the
+    last backward op; the overlap lowering interleaves them.
+
+    Args:
+      compiled: a ``jax.stages.Compiled`` training step (apply to a
+        ``steps_per_execution == 1`` program; under an outer fused-step
+        scan the while body is the computation measured).
+      kinds: collective kinds that constitute the exchange.
+      min_bytes: ignore call sites smaller than this (the reported
+        scalar loss pmean is 4 bytes and always sits at the window end
+        by construction — it is not a gradient exchange).
+      min_frac: minimum fraction of exchange collectives that must
+        start inside the backward region.
+
+    Returns ``{"inside": n, "total": n, "frac": f, "async_depth": d}``
+    (``async_depth`` summed over ``kinds`` — nonzero only on backends
+    that emit async start/done pairs).  Raises ``AssertionError`` when
+    fewer than ``min_frac`` of the exchange collectives start inside
+    the backward region, or when no exchange collective is found at
+    all (nothing to prove).
+    """
+    kinds = tuple(kinds)
+    inside = total = 0
+    any_compute = False
+    for text in _hlo_texts(compiled):
+        for comp_name, lines in _split_computations(text).items():
+            coll_idx = []
+            last_compute = None
+            for i, line in enumerate(lines):
+                if _COMPUTE_RE.search(line):
+                    last_compute = i
+                    any_compute = True
+                    continue
+                m = _INSTR_RE.search(line)
+                if not m or m.group(2) not in kinds:
+                    continue
+                if _group_size(line) == 1:
+                    continue
+                if _shape_bytes(m.group(1),
+                                is_start=bool(m.group(3))) < min_bytes:
+                    continue
+                coll_idx.append(i)
+            total += len(coll_idx)
+            # a collective in a compute-free computation counts as
+            # OUTSIDE: the accum window-end shape puts every backward
+            # dot inside the scan body and the exchange in the entry —
+            # maximal non-overlap, not missing evidence
+            if last_compute is not None:
+                inside += sum(1 for i in coll_idx if i < last_compute)
+    if total == 0 or not any_compute:
+        missing = ("no matmul-shaped backward op" if total
+                   else f"no {'+'.join(kinds)} exchange collective of "
+                        f">= {min_bytes} bytes")
+        raise AssertionError(
+            f"nothing to prove overlap on: {missing} in the compiled "
+            f"program (wrong program, or min_bytes too high)")
+    stats = collective_stats(compiled)
+    async_depth = sum(stats[k].async_depth for k in kinds if k in stats)
+    frac = inside / total
+    if frac < min_frac:
+        raise AssertionError(
+            f"exchange collectives cluster after the backward pass: "
+            f"{inside}/{total} ({frac:.0%}) start inside the backward "
+            f"region, need >= {min_frac:.0%} — the lowering is not "
+            f"overlapping (window-end join, or the scheduler sank the "
+            f"collectives)")
+    return {"inside": inside, "total": total, "frac": frac,
+            "async_depth": async_depth}
+
+
+def overlap_exposed_time(
+    bucket_wire_bytes,
+    axis_size: int,
+    t_bwd_s: float,
+    latency_s: float = _DEFAULT_LATENCY_S,
+    bandwidth_bytes_per_s: float = _DEFAULT_BANDWIDTH,
+    modes=None,
+    launches_per_bucket: int = 2,
+    link: Optional[LinkParams] = None,
+) -> float:
+    """EXPOSED wire seconds of a backward-overlapped exchange — the
+    overlap-aware cost model behind the schedule search.
+
+    Buckets arrive in stream order (index 0 = the reverse-layer bucket
+    whose gradients the backward produces FIRST).  Modeling gradient
+    production as uniform in bytes over ``t_bwd_s``, eager bucket ``i``
+    becomes ready at ``t_bwd_s × (cumulative bytes through i) /
+    (total bytes)``; a ``deferred`` bucket is ready only when the
+    backward finishes.  The wire serialises buckets (one fabric): each
+    starts at ``max(ready, wire_free)`` and holds the wire for
+
+        ``t_wire = launches_per_bucket · α + 2·b·(n-1)/(n·β)``
+
+    (ring all-reduce bytes; reduce-scatter→all-gather moves the same
+    total).  The exposed cost is ``max(0, finish − t_bwd_s)`` — per
+    bucket, wire time is only paid where ``T_wire`` exceeds the
+    remaining backward compute, which is the ``max(0, T_wire −
+    T_bwd_remaining)`` shape the window-end model lacks.  A window-end
+    exchange is the degenerate all-``deferred`` schedule: exposed =
+    full ``T_ex``.
+
+    Args:
+      bucket_wire_bytes: per-bucket wire byte counts, stream order.
+      axis_size: reduction-axis size ``n``.
+      t_bwd_s: backward wall time the stream can hide under.
+      modes: per-bucket ``"eager"``/``"deferred"`` (default all eager).
+      launches_per_bucket: collective launches per bucket — a scalar
+        (2 for rs→ag, 1 for a lone all-reduce) or a per-bucket
+        sequence, so mixed-``via`` schedules price their launch costs
+        truthfully.
+      link: measured :class:`LinkParams` override (e.g. ``plan.link``).
+
+    Returns exposed seconds (0.0 = the exchange fully hides).
+    """
+    if link is not None:
+        latency_s = link.latency_s
+        bandwidth_bytes_per_s = link.bandwidth_bytes_per_s
+    buckets = [float(b) for b in bucket_wire_bytes]
+    if not buckets or axis_size <= 1:
+        return 0.0
+    if t_bwd_s < 0:
+        raise ValueError(f"t_bwd_s {t_bwd_s} must be >= 0")
+    if modes is None:
+        modes = ["eager"] * len(buckets)
+    if len(modes) != len(buckets):
+        raise ValueError(
+            f"{len(modes)} modes for {len(buckets)} buckets")
+    if isinstance(launches_per_bucket, (int, float)):
+        launches = [float(launches_per_bucket)] * len(buckets)
+    else:
+        launches = [float(x) for x in launches_per_bucket]
+        if len(launches) != len(buckets):
+            raise ValueError(
+                f"{len(launches)} launch counts for {len(buckets)} "
+                f"buckets")
+    total = sum(buckets) or 1.0
+    frac = 2.0 * (axis_size - 1) / axis_size
+    cum = 0.0
+    order = []                  # (ready_s, t_wire_s), stream order
+    deferred = []
+    for b, mode, k in zip(buckets, modes, launches):
+        cum += b
+        t_wire = k * latency_s + b * frac / bandwidth_bytes_per_s
+        if mode == "deferred":
+            deferred.append((t_bwd_s, t_wire))
+        elif mode == "eager":
+            order.append((t_bwd_s * cum / total, t_wire))
+        else:
+            raise ValueError(f"unknown bucket mode {mode!r}")
+    wire_free = 0.0
+    for ready, t_wire in order + deferred:
+        wire_free = max(ready, wire_free) + t_wire
+    return max(0.0, wire_free - t_bwd_s)
 
 
 def axis_collective_report(build_step, axes_sizes, n_devices=8):

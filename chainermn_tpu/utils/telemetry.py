@@ -455,8 +455,17 @@ class TraceRecorder:
                 self._stream_file = None
 
 
-def merge_traces(paths: Sequence[str], out: Optional[str] = None) -> dict:
+def merge_traces(paths, out: Optional[str] = None) -> dict:
     """Fuse per-rank Chrome trace shards into ONE Perfetto document.
+
+    ``paths`` may be a sequence of shard files, a DIRECTORY (every
+    ``*.json`` inside), or a GLOB pattern (``"traces/rank*.json"``).
+    However they arrive, shards are sorted deterministically by their
+    recorded rank (``metadata.rank``; rankless shards sort after, by
+    file name) BEFORE pid assignment — so the same shard set always
+    produces the same Perfetto pid lanes, regardless of listing order
+    (callers used to have to pre-sort paths themselves to keep pids
+    stable across merges).
 
     Each shard keeps its own pid lane (rank → pid).  If two shards
     claim the same pid — e.g. single-process drills exporting twice —
@@ -466,12 +475,37 @@ def merge_traces(paths: Sequence[str], out: Optional[str] = None) -> dict:
 
     Returns the merged document; writes it to ``out`` when given.
     """
-    merged: List[dict] = []
-    meta: List[dict] = []
-    used_pids: set = set()
+    import glob as _glob
+
+    if isinstance(paths, (str, os.PathLike)):
+        root = os.fspath(paths)
+        if os.path.isdir(root):
+            paths = [os.path.join(root, f) for f in os.listdir(root)
+                     if f.endswith(".json")]
+        else:
+            paths = _glob.glob(root)
+        if not paths:
+            # a typo'd glob or empty/missing directory must not
+            # succeed with an empty Perfetto doc (an explicit path
+            # list still raises at open(), as it always did)
+            raise FileNotFoundError(
+                f"merge_traces: no trace shards found at {root!r}")
+
+    shards: List[tuple] = []
     for path in paths:
         with open(path) as f:
             doc = json.load(f)
+        rank = (doc.get("metadata", {}).get("rank")
+                if isinstance(doc, dict) else None)
+        shards.append((path, rank, doc))
+    shards.sort(key=lambda s: (s[1] is None,
+                               s[1] if isinstance(s[1], int) else 0,
+                               os.path.basename(s[0])))
+
+    merged: List[dict] = []
+    meta: List[dict] = []
+    used_pids: set = set()
+    for path, rank, doc in shards:
         # both standard Chrome forms: object with traceEvents, or a
         # bare event array
         events = (doc.get("traceEvents", []) if isinstance(doc, dict)
@@ -488,8 +522,7 @@ def merge_traces(paths: Sequence[str], out: Optional[str] = None) -> dict:
             merged.append(ev)
         meta.append({"path": os.path.basename(path),
                      "pid_shift": shift,
-                     **({} if not isinstance(doc, dict) else
-                        {"rank": doc.get("metadata", {}).get("rank")})})
+                     **({} if rank is None else {"rank": rank})})
     doc = {"traceEvents": merged, "displayTimeUnit": "ms",
            "metadata": {"merged_from": meta}}
     if out is not None:

@@ -111,10 +111,36 @@ class TestPlannedOptimizer:
             cmn.create_multi_node_optimizer(
                 optax.sgd(0.1), axis_name="world", plan="auto")
 
-    def test_plan_with_zero1_raises(self, comm):
-        with pytest.raises(ValueError, match="ZeRO-1"):
+    def test_plan_with_zero1_falls_back_with_one_warning(self, comm,
+                                                         monkeypatch):
+        """plan='auto' must be safe to set globally: under zero1 the
+        plan is ignored in favour of the analytic reduce-scatter path,
+        with ONE RuntimeWarning per process (not an error, not a
+        per-construction nag)."""
+        import warnings as _warnings
+
+        from chainermn_tpu.training import optimizers as _opt
+
+        monkeypatch.setattr(_opt, "_ZERO1_PLAN_WARNED", False)
+        with _warnings.catch_warnings(record=True) as rec:
+            _warnings.simplefilter("always")
+            opt = cmn.create_multi_node_optimizer(
+                optax.sgd(0.1), comm, zero1=True, plan="auto")
             cmn.create_multi_node_optimizer(
                 optax.sgd(0.1), comm, zero1=True, plan="auto")
+        warned = [w for w in rec if issubclass(w.category,
+                                               RuntimeWarning)]
+        assert len(warned) == 1
+        assert "zero1" in str(warned[0].message)
+        # the fallback is the full ZeRO-1 transformation, and it trains
+        from chainermn_tpu.training.optimizers import Zero1Transformation
+
+        assert isinstance(opt, Zero1Transformation)
+        it = cmn.SerialIterator(_dataset(), 16, repeat=True,
+                                shuffle=True, seed=7)
+        upd = cmn.StandardUpdater(it, opt, _loss_fn, _params(), comm)
+        upd.update()
+        assert np.isfinite(float(upd.observation["main/loss"]))
 
     def test_bad_plan_string_raises(self, comm):
         with pytest.raises(ValueError, match="auto"):

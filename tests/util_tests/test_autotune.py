@@ -542,3 +542,107 @@ class TestLinkParamsFit:
         # slower link -> larger accumulation window
         assert choose_accum_steps(64 << 20, 8, 1e-3, link=slow) >= \
             choose_accum_steps(64 << 20, 8, 1e-3, link=fast)
+
+
+class TestOverlapSchedulePlans:
+    """PR 7: the plan's *schedule* dimension — overlap candidates,
+    variant-separated cache keys, and schedule-bearing plan round-trips
+    (FORMAT_VERSION 2)."""
+
+    def test_plan_roundtrips_schedule(self, tmp_path):
+        sched = [{"leaves": 3, "mode": "eager", "via": "rs"},
+                 {"leaves": 2, "mode": "deferred", "via": "ar"}]
+        plan = autotune.Plan(strategy="overlap", bucket_bytes=4096,
+                             schedule=sched, measured_ms=1.0, key="k1")
+        path = str(tmp_path / "plans.json")
+        autotune.store_plan(plan, path)
+        got = autotune.load_cached_plan("k1", path)
+        assert got.schedule == sched
+        assert autotune.Plan.from_dict(plan.to_dict()).schedule == sched
+
+    def test_plan_key_variant_separates_families(self, comm):
+        mesh_sig = autotune.mesh_signature(comm.mesh)
+        payload = autotune.payload_signature(small_tree())
+        keys = {autotune.plan_key(mesh_sig, payload),
+                autotune.plan_key(mesh_sig, payload, variant="overlap"),
+                autotune.plan_key(mesh_sig, payload,
+                                  variant="overlap-auto")}
+        assert len(keys) == 3
+
+    def test_enumerate_overlap_true_drops_window_end(self):
+        payload = autotune.payload_signature(small_tree())
+        leaves = list(jax.tree.leaves(small_tree()))
+        cands = autotune.enumerate_candidates(
+            payload, 8, overlap=True, leaf_template=leaves)
+        strategies = {c.strategy for c in cands}
+        assert strategies == {"per_leaf", "overlap"}
+        assert all(c.schedule for c in cands
+                   if c.strategy == "overlap")
+        auto = autotune.enumerate_candidates(
+            payload, 8, overlap="auto", leaf_template=leaves)
+        assert {"fused_flat", "overlap"} <= {c.strategy for c in auto}
+        with pytest.raises(ValueError, match="leaf_template"):
+            autotune.enumerate_candidates(payload, 8, overlap=True)
+
+    def test_overlap_tune_forced_family_and_cache_roundtrip(
+            self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        tree = small_tree(n_leaves=8, width=64)
+        plan = tune(comm, tree, cache, overlap=True)
+        assert plan.strategy == "overlap"
+        assert plan.schedule and sum(
+            e["leaves"] for e in plan.schedule) == 8
+        assert plan.n_probes > 0 and not plan.from_cache
+        again = tune(comm, tree, cache, overlap=True)
+        assert again.from_cache and again.n_probes == 0
+        assert again.schedule == plan.schedule
+        # the window-end search does NOT serve the overlap family
+        other = tune(comm, tree, cache)
+        assert not (other.from_cache and other.strategy == "overlap")
+
+    def test_t_bwd_ranking_prefers_finer_schedules(self, comm,
+                                                   tmp_path):
+        """With a hiding budget, the exposed-time model must not pick
+        the single-bucket schedule an isolated-probe ranking favours
+        (that is the window-end join under another name)."""
+        cache = str(tmp_path / "plans.json")
+        tree = {f"w{i}": jnp.asarray(
+            np.random.RandomState(i).randn(64, 64), jnp.float32)
+            for i in range(8)}
+        plan = tune(comm, tree, cache, overlap=True, t_bwd_s=0.1)
+        assert plan.strategy == "overlap"
+        assert len(plan.schedule) >= 2
+
+    def test_schedule_plan_through_exchange_fn(self, comm):
+        """build_exchange_fn executes a schedule-bearing plan — the
+        probe harness and the updater's exchange-time observer share
+        this path."""
+        tree = small_tree(n_leaves=4)
+        plan = autotune.Plan(
+            strategy="overlap", bucket_bytes=1024,
+            schedule=[{"leaves": 2, "mode": "eager", "via": "rs"},
+                      {"leaves": 2, "mode": "deferred", "via": "ar"}])
+        fn, make_data = autotune.build_plan_probe(comm, plan, tree)
+        out = jax.block_until_ready(fn(make_data()))
+        assert jax.tree.structure(out) == jax.tree.structure(
+            jax.tree.map(lambda x: x, tree))
+
+    def test_overlap_auto_without_plan_auto_raises(self, comm):
+        import optax
+
+        with pytest.raises(ValueError, match="plan='auto'"):
+            cmn.create_multi_node_optimizer(
+                optax.sgd(0.1), comm, overlap="auto")
+
+    def test_auto_mode_with_budget_probes_both_families(self, comm,
+                                                        tmp_path):
+        """overlap="auto" + t_bwd_s: the exposed-time prune must not
+        evict every window-end candidate before probing — the
+        cross-family measurement is the mode's whole point."""
+        cache = str(tmp_path / "plans.json")
+        tree = small_tree(n_leaves=8, width=64)
+        plan = tune(comm, tree, cache, overlap="auto", t_bwd_s=0.05,
+                    top_k=6)
+        probed = {t["strategy"] for t in plan.meta["timings"]}
+        assert "overlap" in probed
+        assert probed & {"fused_flat", "reduce_scatter"}, probed

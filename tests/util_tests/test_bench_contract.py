@@ -175,9 +175,13 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
     ("bench_telemetry.py",
      ["--batch", "8", "--dim", "64", "--hidden", "128", "--warmup", "1",
       "--iters", "4", "--rounds", "1"], "x"),
+    ("bench_overlap.py",
+     ["--batch", "8", "--dim", "48", "--hidden", "48", "--n-layers",
+      "4", "--accum-steps", "2", "--warmup", "1", "--iters", "4",
+      "--rounds", "1", "--trials", "1", "--min-frac", "0.4"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
-        "autotune", "telemetry"])
+        "autotune", "telemetry", "overlap"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

@@ -373,3 +373,151 @@ def test_decode_program_parses_per_token_slices():
     unit = (B // 2) * cfg.d_model * 4          # (B_local, 1, D) f32
     assert st.bytes == 2 * P_len * unit, (st, unit)
     assert st.group_size == 2
+
+
+# ------------------------------------------------------------------ #
+# backward-overlap proof machinery (PR 7)
+# ------------------------------------------------------------------ #
+
+
+class _FakeCompiled:
+    def __init__(self, text):
+        self._text = text
+
+    def runtime_executable(self):
+        raise RuntimeError("use as_text")
+
+    def as_text(self):
+        return self._text
+
+
+def test_async_depth_pairs_start_done():
+    """A -start whose -done is scheduled with other instructions
+    between the halves overlaps compute (async_depth 1); a
+    back-to-back start;done pair overlaps nothing (0)."""
+    from chainermn_tpu.utils import collective_stats as cs
+
+    txt = """ENTRY %main (a: f32[8]) -> f32[8] {
+  %ar = f32[1024]{0} all-reduce-start(%x), replica_groups={{0,1,2,3,4,5,6,7}}
+  %d1 = f32[64,64]{1,0} dot(%p, %q)
+  %d2 = f32[64,64]{1,0} dot(%p, %r)
+  %ar.d = f32[1024]{0} all-reduce-done(%ar)
+  %ag = f32[512]{0} all-gather-start(%y), replica_groups={{0,1,2,3,4,5,6,7}}
+  %ag.d = f32[512]{0} all-gather-done(%ag)
+}
+"""
+    st = cs(_FakeCompiled(txt))
+    assert st["all-reduce"].async_depth == 1
+    assert st["all-gather"].async_depth == 0
+    # counts unaffected by the pairing bookkeeping
+    assert st["all-reduce"].count == 1
+    assert st["all-gather"].count == 1
+
+
+def test_assert_overlap_positions_and_min_bytes():
+    from chainermn_tpu.utils import assert_overlap_collectives
+
+    def prog(collective_lines_before, after):
+        body = ["ENTRY %main (a: f32[8]) -> f32[8] {"]
+        body += ["  %d0 = f32[64,64]{1,0} dot(%p, %q)"]
+        body += collective_lines_before
+        body += ["  %d1 = f32[64,64]{1,0} dot(%p, %r)"]
+        body += after
+        body += ["}"]
+        return _FakeCompiled("\n".join(body))
+
+    ar = ("  %ar{i} = f32[1024]{{0}} all-reduce(%x{i}), "
+          "replica_groups={{{{0,1,2,3,4,5,6,7}}}}")
+    tiny = ("  %t = f32[] all-reduce(%l), "
+            "replica_groups={{0,1,2,3,4,5,6,7}}")
+
+    # 1 of 2 big collectives inside, the 4-byte loss pmean ignored
+    rep = assert_overlap_collectives(
+        prog([ar.format(i=0)], [ar.format(i=1), tiny]))
+    assert rep == {"inside": 1, "total": 2, "frac": 0.5,
+                   "async_depth": 0}
+    # all big collectives after the last dot -> clustered
+    with pytest.raises(AssertionError, match="cluster"):
+        assert_overlap_collectives(
+            prog([], [ar.format(i=0), ar.format(i=1)]))
+    # nothing above the byte floor -> nothing to prove
+    with pytest.raises(AssertionError, match="nothing to prove"):
+        assert_overlap_collectives(prog([], [tiny]))
+    # compute-free program -> nothing to prove either
+    with pytest.raises(AssertionError, match="nothing to prove"):
+        assert_overlap_collectives(_FakeCompiled(
+            "ENTRY %main (a: f32[8]) -> f32[8] {\n"
+            + ar.format(i=0) + "\n}\n"))
+
+
+def test_overlap_exposed_time_model():
+    from chainermn_tpu.utils import overlap_exposed_time
+
+    buckets = [1 << 20] * 4
+    n = 8
+    kw = dict(latency_s=1e-5, bandwidth_bytes_per_s=1e9)
+    t_wire_each = 2 * 1e-5 + 2 * (1 << 20) * (7 / 8) / 1e9
+    t_ex = 4 * t_wire_each
+
+    # no backward to hide under: eager and deferred both pay full T_ex
+    assert overlap_exposed_time(buckets, n, 0.0, **kw) == \
+        pytest.approx(t_ex)
+    assert overlap_exposed_time(buckets, n, 0.0,
+                                modes=["deferred"] * 4, **kw) == \
+        pytest.approx(t_ex)
+
+    # a long backward: the eager stream hides everything but the LAST
+    # bucket (ready only when backward ends); window-end (all
+    # deferred) still pays the full serial T_ex
+    t_bwd = 10 * t_ex
+    eager = overlap_exposed_time(buckets, n, t_bwd, **kw)
+    deferred = overlap_exposed_time(buckets, n, t_bwd,
+                                    modes=["deferred"] * 4, **kw)
+    assert eager == pytest.approx(t_wire_each)
+    assert deferred == pytest.approx(t_ex)
+    assert eager < deferred
+
+    # degenerate inputs
+    assert overlap_exposed_time([], n, 1.0) == 0.0
+    assert overlap_exposed_time(buckets, 1, 1.0) == 0.0
+    with pytest.raises(ValueError, match="modes"):
+        overlap_exposed_time(buckets, n, 1.0, modes=["eager"])
+    with pytest.raises(ValueError, match="mode"):
+        overlap_exposed_time(buckets, n, 1.0,
+                             modes=["eager", "soon", "eager", "eager"])
+
+
+def test_async_depth_dotted_suffix_names_pair_exactly():
+    """XLA's .N suffixing makes one start's name a PREFIX of another's
+    — the done-line match must be exact-token, or the wrong start is
+    popped and the real pair orphaned."""
+    from chainermn_tpu.utils import collective_stats as cs
+
+    txt = """ENTRY %main (a: f32[8]) -> f32[8] {
+  %all-reduce-start = f32[256]{0} all-reduce-start(%x), replica_groups={{0,1,2,3,4,5,6,7}}
+  %all-reduce-start.1 = f32[256]{0} all-reduce-start(%y), replica_groups={{0,1,2,3,4,5,6,7}}
+  %d1 = f32[64,64]{1,0} dot(%p, %q)
+  %done.1 = f32[256]{0} all-reduce-done(%all-reduce-start.1)
+  %d2 = f32[64,64]{1,0} dot(%p, %r)
+  %done.0 = f32[256]{0} all-reduce-done(%all-reduce-start)
+}
+"""
+    st = cs(_FakeCompiled(txt))
+    # both pairs straddle at least one other instruction
+    assert st["all-reduce"].async_depth == 2
+
+
+def test_overlap_exposed_time_per_bucket_launches():
+    """Mixed-via schedules price their launch costs truthfully: an
+    all-"ar" stream (1 launch/bucket) costs one latency less per
+    bucket than the rs→ag default in the latency-dominated regime."""
+    from chainermn_tpu.utils import overlap_exposed_time
+
+    buckets = [1024] * 6
+    kw = dict(latency_s=1e-3, bandwidth_bytes_per_s=1e12)
+    rs = overlap_exposed_time(buckets, 8, 0.0, **kw)
+    ar = overlap_exposed_time(buckets, 8, 0.0,
+                              launches_per_bucket=[1] * 6, **kw)
+    assert rs == pytest.approx(ar + 6 * 1e-3)
+    with pytest.raises(ValueError, match="launch counts"):
+        overlap_exposed_time(buckets, 8, 0.0, launches_per_bucket=[1])

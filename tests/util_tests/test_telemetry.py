@@ -511,3 +511,59 @@ class TestMetricsExport:
             open(tmp_path / "metrics.jsonl").readline())
         assert "main/loss" in entry
         assert "main/step_time" not in entry
+
+
+class TestMergeTraceDiscovery:
+    """PR 7 satellite: merge_traces accepts a directory or glob and
+    sorts shards by recorded rank BEFORE pid assignment, so the same
+    shard set always yields the same Perfetto lanes regardless of
+    filesystem listing order."""
+
+    def _shards(self, tmp_path, ranks):
+        for i, rank in enumerate(ranks):
+            rec = TraceRecorder(enabled=True, rank=rank)
+            with rec.span(f"work.{rank}", cat="step"):
+                pass
+            # file names deliberately NOT in rank order
+            rec.export_chrome(str(tmp_path / f"shard_{i}.json"))
+
+    def test_directory_input_sorts_by_rank(self, tmp_path):
+        self._shards(tmp_path, [2, 0, 1])
+        doc = merge_traces(str(tmp_path))
+        ranks = [m["rank"] for m in doc["metadata"]["merged_from"]]
+        assert ranks == [0, 1, 2]
+        assert {e["pid"] for e in doc["traceEvents"]} == {0, 1, 2}
+
+    def test_glob_input_matches_directory(self, tmp_path):
+        self._shards(tmp_path, [1, 0])
+        via_glob = merge_traces(str(tmp_path / "shard_*.json"))
+        via_dir = merge_traces(str(tmp_path))
+        assert via_glob["traceEvents"] == via_dir["traceEvents"]
+
+    def test_colliding_pids_shift_deterministically(self, tmp_path):
+        """Two rankless same-pid shards: the basename-sorted SECOND
+        one is shifted, however the paths are listed."""
+        for name in ("zzz.json", "aaa.json"):
+            with open(tmp_path / name, "w") as f:
+                json.dump([{"name": name, "ph": "X", "ts": 1.0,
+                            "dur": 1.0, "pid": 5, "tid": 0}], f)
+        doc = merge_traces([str(tmp_path / "zzz.json"),
+                            str(tmp_path / "aaa.json")])
+        by_name = {e["name"]: e["pid"] for e in doc["traceEvents"]}
+        assert by_name == {"aaa.json": 5, "zzz.json": 6}
+
+    def test_explicit_sequence_still_rank_sorted(self, tmp_path):
+        self._shards(tmp_path, [1, 0])
+        paths = [str(tmp_path / "shard_0.json"),   # rank 1 first
+                 str(tmp_path / "shard_1.json")]
+        doc = merge_traces(paths)
+        ranks = [m["rank"] for m in doc["metadata"]["merged_from"]]
+        assert ranks == [0, 1]
+
+    def test_empty_glob_or_missing_dir_raises(self, tmp_path):
+        """A typo'd glob or missing directory must not succeed with an
+        empty merged document."""
+        with pytest.raises(FileNotFoundError, match="no trace shards"):
+            merge_traces(str(tmp_path / "rnk*.json"))
+        with pytest.raises(FileNotFoundError, match="no trace shards"):
+            merge_traces(str(tmp_path / "does-not-exist"))
