@@ -11,7 +11,7 @@ mesh instead of MPI/NCCL calls.
 
 from chainermn_tpu.parallel import _compat  # noqa: F401  (jax shims first)
 from chainermn_tpu import (extensions, links, models, ops,
-                           parallel, testing, utils)
+                           parallel, serving, testing, utils)
 from chainermn_tpu.extensions import (
     add_global_except_hook,
     create_multi_node_checkpointer,
@@ -91,6 +91,7 @@ __all__ = [
     "utils",
     "scatter_dataset",
     "scatter_index",
+    "serving",
     "shuffle_data_blocks",
     "testing",
 ]

@@ -489,7 +489,8 @@ def _decode_preamble(mesh_cfg, cfg: TransformerConfig, max_len: int):
 
 
 def _make_cache(cfg: TransformerConfig, rows: int, kv_len_local: int,
-                kv_heads_local: int, layers_local: int):
+                kv_heads_local: int, layers_local: int,
+                batch_varying: bool = True):
     """Zero KV cache pair ``(L_local, rows, kv_len_local, Hkv_local,
     Dh)``, typed varying over every mesh axis its contents will carry.
     ``layers_local`` = this stage's layer count — with pipe-parallel
@@ -499,8 +500,15 @@ def _make_cache(cfg: TransformerConfig, rows: int, kv_len_local: int,
     (the R× context win).  ``kv_cache_dtype="int8"`` stores values
     int8 plus fp32 per-(token, head) scales with a trailing singleton
     (so cache writes treat values and scales identically) — half the
-    cache HBM, which is what bounds long-context decode."""
-    axes = ["pipe", "data", "expert", "model"]
+    cache HBM, which is what bounds long-context decode.
+
+    ``batch_varying=False`` skips the data/expert varying typing: the
+    serving engine's prefill-to-pool program computes a one-row chunk
+    REPLICATED across the batch shards (a single request has no batch
+    parallelism to use) and writes it to a batch-replicated block
+    pool, so the chunk must stay invariant over those axes."""
+    axes = ["pipe", "data", "expert", "model"] if batch_varying \
+        else ["pipe", "model"]
     if lax.axis_size("seq") > 1:
         # seq-varying only when the axis is real: at R == 1 the
         # single-member softmax path never psums over seq, so a varying
@@ -630,7 +638,8 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                      max_len: int = 0, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 1.0,
                      eos_id: int = -1, pad_id: int = 0,
-                     quantized: bool = False):
+                     quantized: bool = False,
+                     with_row_state: bool = False):
     """Build ``generate(params, prompt, key=None, prompt_lens=None)
     -> (B, max_len)``.
 
@@ -661,6 +670,19 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
     convention).  ``quantized=True`` expects int8 weight-only params
     from :func:`...quantization.quantize_params_int8` (≈half the HBM
     traffic per token).
+
+    ``with_row_state=True`` returns ``(tokens, done, gen_len)``: the
+    per-row loop state that used to stay buried in the while carry
+    (only the all-rows-done scalar escaped, as the exit condition).
+    ``done`` (B,) bool marks rows that stopped by emitting ``eos_id``
+    (all-False when eos is disabled or a row ran to ``max_len``);
+    ``gen_len`` (B,) int32 counts each row's GENERATED tokens — the
+    eos token included, the frozen tail's padding excluded — i.e.
+    exactly the positions ``tokens[b, P:P+gen_len[b]]`` that carry
+    real output under the frozen-row padding semantics.  This is the
+    per-row bookkeeping a request-level scheduler (the serving
+    engine) needs from a batch: which rows finished, and where each
+    row's output ends.
     """
     _validate_sampling_filters(top_k, top_p, temperature)
     _validate_eos_pad(cfg, eos_id, pad_id)
@@ -741,9 +763,15 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
             (buf, _, _), _ = lax.scan(
                 step, (buf, cache, key),
                 jnp.arange(Plen - 1, max_len - 1))
+            # no eos: every row generates the full tail
+            gen_len = _vary(
+                jnp.full((B,), max_len - Plen, jnp.int32),
+                "data", "expert")
         else:
+            gen_len = _vary(jnp.zeros((B,), jnp.int32), "data", "expert")
+
             def cond(carry):
-                buf, caches, key, t, done = carry
+                buf, caches, key, t, done, gen_len = carry
                 # the while condition must be mesh-invariant: keep
                 # going while ANY shard still has an unfinished row —
                 # pmax of the shards' not-all-done bits (done derives
@@ -754,28 +782,35 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 return (t < max_len - 1) & (running > 0)
 
             def wbody(carry):
-                buf, caches, key, t, done = carry
+                buf, caches, key, t, done, gen_len = carry
+                # rows not frozen ENTERING the step emit a real token
+                # this step (the eos itself included — it is written,
+                # then freezes the row); frozen rows emit padding
+                gen_len = gen_len + (~done).astype(jnp.int32)
                 buf, caches, key, done = token_step(
                     buf, caches, key, t, done)
-                return (buf, caches, key, t + 1, done)
+                return (buf, caches, key, t + 1, done, gen_len)
 
-            buf, _, _, _, _ = lax.while_loop(
+            buf, _, _, _, done, gen_len = lax.while_loop(
                 cond, wbody,
-                (buf, cache, key, jnp.int32(Plen - 1), done))
-        return buf
+                (buf, cache, key, jnp.int32(Plen - 1), done, gen_len))
+        return buf, done, gen_len
 
     def body(params, prompt, key):
-        return _body(params, prompt, key, None)
+        buf, done, gen_len = _body(params, prompt, key, None)
+        return (buf, done, gen_len) if with_row_state else buf
 
     def body_padded(params, prompt, lens, key):
-        return _body(params, prompt, key,
-                     jnp.int32(prompt.shape[1]) - lens)
+        buf, done, gen_len = _body(params, prompt, key,
+                                   jnp.int32(prompt.shape[1]) - lens)
+        return (buf, done, gen_len) if with_row_state else buf
 
+    out_specs = (batch_spec,) * 3 if with_row_state else batch_spec
     fn = jax.jit(jax.shard_map(
         body,
         mesh=mesh_cfg.mesh,
         in_specs=(specs, batch_spec, P()),
-        out_specs=batch_spec,
+        out_specs=out_specs,
     ))
     lazy = {}   # the padded program compiles on first use only
 
@@ -792,7 +827,7 @@ def make_generate_fn(mesh_cfg, cfg: TransformerConfig, *,
                 body_padded,
                 mesh=mesh_cfg.mesh,
                 in_specs=(specs, batch_spec, batch_spec, P()),
-                out_specs=batch_spec,
+                out_specs=out_specs,
             ))
         return lazy["padded"](params, prompt, lens, key)
 

@@ -1,0 +1,29 @@
+"""Serving subsystem: slot-based continuous batching over a
+block-paged KV cache.
+
+``engine`` schedules requests onto decode slots (queue, admission into
+freed slots mid-stream, per-row EOS eviction, FCFS/shortest-prompt
+policies); ``kv_blocks`` supplies the paging layer (free-list block
+allocator, prefill-to-pool scatter, copy-on-admit gather, horizon
+rebase) that keeps the decode step one compiled program over the dense
+static cache; ``minilm`` is the portable reference decode backend (and
+adapter-protocol example) — the flagship transformer rides the same
+engine through :class:`TransformerAdapter`.  See docs/SERVING.md
+("Serving at scale") and ``bench_serving.py``.
+"""
+
+from .engine import Completion, Request, ServingEngine, TransformerAdapter
+from .kv_blocks import BlockAllocator, blocks_needed
+from .minilm import MiniLMAdapter, MiniLMConfig, init_minilm
+
+__all__ = [
+    "BlockAllocator",
+    "Completion",
+    "MiniLMAdapter",
+    "MiniLMConfig",
+    "Request",
+    "ServingEngine",
+    "TransformerAdapter",
+    "blocks_needed",
+    "init_minilm",
+]

@@ -1,0 +1,726 @@
+"""Slot-based continuous batching engine over the block-paged KV cache.
+
+Every decode mode in ``models.decoding`` serves ONE static batch per
+``generate`` call: rows enter together, and the while-loop exits when
+the LAST row finishes — a slot whose row hit EOS idles until the whole
+batch drains, and a request that arrives mid-call waits for the next
+batch.  Under ragged, continuously-arriving traffic (the ROADMAP's
+millions-of-users scenario) both wastes are unbounded.  This engine
+replaces the batch with SLOTS:
+
+- a request **queue** with a scheduler policy hook (FCFS or
+  shortest-prompt-first built in, or any callable);
+- **admission**: a freed slot is refilled mid-stream — the new
+  request's prompt is prefilled into pool blocks
+  (:mod:`~chainermn_tpu.serving.kv_blocks`) and copy-on-admit
+  gathered into the slot's contiguous cache lane;
+- **per-row eviction**: a slot leaves the moment ITS row is done
+  (EOS or token budget), not when the last row is;
+- a **decode round** program advancing every live slot
+  ``round_tokens`` positions — the ONE compiled program property of
+  the static cache is preserved: the cache stays the dense
+  ``_make_cache`` layout, per-row raggedness rides the
+  ``pos_offset`` origin mechanism the padded decode paths already
+  use, and a global position clock (plus a block-aligned **rebase**
+  shift when it nears the horizon) keeps the buffer static forever.
+
+The engine is MODEL-AGNOSTIC: a decode adapter supplies
+``make_cache`` / ``prefill`` / ``step`` plus sharding specs (see
+:class:`~chainermn_tpu.serving.minilm.MiniLMAdapter` for the protocol
+example and :class:`TransformerAdapter` for the flagship).  Decoding
+is greedy — which is what makes the engine's exactness guarantee
+testable: every admitted request's tokens are token-identical to its
+solo static decode, independent of what shares its rounds (pinned in
+``tests/serving_tests/test_engine.py``).
+
+Single-controller: results are fetched by host indexing into the
+sharded token buffer, so every shard must be addressable from this
+process (the 8-device CPU mesh and single-host TPU slices; multi-host
+serving needs a fetch collective and is future work).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.parallel._compat import pcast, typeof
+from chainermn_tpu.utils.telemetry import get_recorder
+
+from . import kv_blocks as kvb
+
+__all__ = ["Completion", "Request", "ServingEngine", "TransformerAdapter"]
+
+
+def _vary(x, *axes):
+    """Type ``x`` varying over ``axes`` on vma jax; identity pre-vma
+    (``pcast``/``typeof`` resolve through the compat shims)."""
+    need = tuple(a for a in axes if a not in typeof(x).vma)
+    return pcast(x, need, to="varying") if need else x
+
+
+@dataclasses.dataclass(eq=False)     # identity equality: ndarray fields
+class Request:
+    """One queued generation request (host-side)."""
+
+    rid: str
+    prompt: np.ndarray          # (P,) int32
+    max_new: int                # token budget (eos may end the row early)
+    t_submit: float = 0.0
+    t_admit: Optional[float] = None
+    t_first: Optional[float] = None
+
+
+@dataclasses.dataclass(eq=False)
+class Completion:
+    """A finished request: ``tokens`` are the GENERATED tokens only
+    (first EOS kept when one was emitted, budget-truncated otherwise —
+    the ``make_generate_fn`` convention)."""
+
+    rid: str
+    prompt: np.ndarray
+    tokens: np.ndarray
+    t_submit: float
+    t_admit: float
+    t_first: float
+    t_done: float
+    slot: int
+
+    @property
+    def n_generated(self) -> int:
+        return int(self.tokens.shape[0])
+
+    @property
+    def ttft(self) -> float:
+        """Time-to-first-token: submit → first generated token on host."""
+        return self.t_first - self.t_submit
+
+
+class TransformerAdapter:
+    """Decode adapter binding the flagship transformer
+    (``models.decoding``) to the serving engine.
+
+    Shards like ``make_generate_fn``: batch over ``data×expert``,
+    heads over ``model``, layers+cache over ``pipe``; params via
+    ``param_specs``.  Requires vma-typed jax (``TransformerConfig``
+    refuses to construct without it); on older jaxes use
+    :class:`~chainermn_tpu.serving.minilm.MiniLMAdapter`.  MoE configs
+    are rejected — router capacity depends on batch composition, which
+    would break the engine's token-identity guarantee — and ``seq``
+    meshes are rejected like every ``pos_offset`` path.
+    """
+
+    batch_axes = ("data", "expert")
+
+    def __init__(self, mesh_cfg, cfg, *, quantized: bool = False):
+        from chainermn_tpu.models.decoding import _decode_preamble
+
+        if cfg.moe:
+            raise ValueError(
+                "MoE decode under continuous batching is not supported: "
+                "router capacity couples rows, so a request's tokens "
+                "would depend on what shares its rounds — the exactness "
+                "guarantee the engine is built on")
+        if mesh_cfg.mesh.shape.get("seq", 1) != 1:
+            raise ValueError(
+                "continuous batching drives per-row position origins "
+                "(pos_offset), which seq-KV decode does not support: "
+                "use a seq=1 mesh (shard batch/heads/layers instead)")
+        # validates fsdp-off, pipe divisibility; local sizes for caches
+        _, _, self._kv_heads_local, self._layers_local = \
+            _decode_preamble(mesh_cfg, cfg, 0)
+        self.mesh_cfg = mesh_cfg
+        self.cfg = cfg
+        self.quantized = quantized
+
+    def param_specs(self):
+        from chainermn_tpu.models import param_specs
+
+        return param_specs(self.cfg, quantized=self.quantized)
+
+    def cache_specs(self):
+        spec = P("pipe", self.batch_axes, None, "model")
+        n = 4 if self.cfg.kv_cache_dtype == "int8" else 2
+        return (spec,) * n
+
+    def make_cache(self, rows, kv_len, batch_varying=True):
+        from chainermn_tpu.models.decoding import _make_cache
+
+        return _make_cache(self.cfg, rows, kv_len, self._kv_heads_local,
+                           self._layers_local,
+                           batch_varying=batch_varying)
+
+    def step(self, params, caches, tok, t, pos_offset):
+        from chainermn_tpu.models.decoding import _decode_step
+
+        return _decode_step(self.cfg, params, caches, tok, t,
+                            pos_offset=pos_offset)
+
+    def prefill(self, params, caches, toks, pos_offset):
+        from chainermn_tpu.models.decoding import _decode_step
+
+        _, caches = _decode_step(self.cfg, params, caches, toks, 0,
+                                 with_logits=False,
+                                 chunk_attends_cache=True,
+                                 pos_offset=pos_offset)
+        return caches
+
+
+def _fcfs(queue: Sequence[Request], engine) -> Request:
+    return queue[0]
+
+
+def _spf(queue: Sequence[Request], engine) -> Request:
+    """Shortest-prompt-first (stable: FCFS among equals)."""
+    return min(queue, key=lambda r: r.prompt.shape[0])
+
+
+_POLICIES = {"fcfs": _fcfs, "spf": _spf}
+
+
+class ServingEngine:
+    """Continuous-batching scheduler around one decode adapter.
+
+    Args:
+      adapter: decode backend (``MiniLMAdapter`` / ``TransformerAdapter``).
+      params: model parameters (host or device); placed replicated /
+        per ``adapter.param_specs()`` once at construction.
+      n_slots: concurrent decode rows; must divide evenly over the
+        mesh's batch shards.
+      horizon: the dense cache's position capacity.  The global clock
+        lives in ``[0, horizon)``; a block-aligned rebase shift
+        reclaims retired positions when admissions near the edge.
+      max_prompt: longest admissible prompt; rounded up to a block
+        multiple internally (``Pq``) — every prompt prefills as one
+        right-aligned ``Pq`` chunk so admission is ONE compiled
+        program, not one per length.
+      block: position-block size of the staging pool (and the rebase
+        granularity).
+      pool_blocks: staging-pool capacity in blocks (default: one full
+        ``Pq`` chunk per slot).  A staged request holds only
+        ``ceil(P/block)`` blocks — its real footprint — so a deep
+        ragged queue stages many more requests than slots.
+      eos_id / pad_id: early-stop token semantics, exactly
+        ``make_generate_fn``'s (first EOS kept, frozen rows emit pad).
+      round_tokens: decode-round length — positions advanced per
+        dispatch; the host observes the per-row done bitmap between
+        rounds (larger = less dispatch overhead, more post-EOS waste).
+      policy: ``"fcfs"``, ``"spf"``, or ``callable(queue, engine) ->
+        Request`` choosing the next admission from the queue.
+      gang: static-batching mode — admit only when EVERY slot is free
+        (the whole gang drains before the next forms).  This is the
+        bench's baseline arm: same programs, same dispatch granularity,
+        only the scheduling differs.
+      prefill_ahead: stage up to this many queued requests' prompts
+        into the pool while slots are still busy (0 disables; default
+        ``n_slots``).  Admission of a staged request skips the prefill
+        compute — only the copy-on-admit gather remains.
+    """
+
+    def __init__(self, adapter, params, *, n_slots: int, horizon: int,
+                 max_prompt: int, block: int = 16,
+                 pool_blocks: Optional[int] = None, eos_id: int = -1,
+                 pad_id: int = 0, round_tokens: int = 4,
+                 policy: Union[str, Callable] = "fcfs",
+                 gang: bool = False,
+                 prefill_ahead: Optional[int] = None,
+                 default_max_new: int = 32):
+        mesh = adapter.mesh_cfg.mesh
+        shards = 1
+        for a in adapter.batch_axes:
+            shards *= mesh.shape.get(a, 1)
+        if n_slots < 1 or n_slots % shards:
+            raise ValueError(
+                f"n_slots={n_slots} must be a positive multiple of the "
+                f"batch shard count {shards} (mesh axes "
+                f"{adapter.batch_axes})")
+        if block < 1 or max_prompt < 1:
+            raise ValueError(
+                f"block={block} and max_prompt={max_prompt} must be >= 1")
+        self._pq = kvb.blocks_needed(max_prompt, block) * block
+        if horizon < self._pq + 1:
+            raise ValueError(
+                f"horizon={horizon} must exceed the padded prompt "
+                f"chunk {self._pq}")
+        self._w = self._pq // block
+        if pool_blocks is None:
+            pool_blocks = n_slots * self._w
+        if pool_blocks < self._w:
+            raise ValueError(
+                f"pool_blocks={pool_blocks} cannot stage even one "
+                f"{self._w}-block prompt chunk")
+        if eos_id >= 0 and pad_id < 0:
+            raise ValueError(f"pad_id={pad_id} must be >= 0 with eos")
+        if round_tokens < 1:
+            raise ValueError(f"round_tokens={round_tokens} must be >= 1")
+        self.set_policy(policy)
+        self.adapter = adapter
+        self.n_slots = n_slots
+        self.horizon = horizon
+        self.max_prompt = max_prompt
+        self.block = block
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.round_tokens = round_tokens
+        self.gang = gang
+        self.prefill_ahead = n_slots if prefill_ahead is None \
+            else prefill_ahead
+        self.default_max_new = default_max_new
+        self._n_local = n_slots // shards
+        self._n_shards = shards
+        self._mesh = mesh
+        self._params = jax.device_put(
+            params, jax.tree.map(
+                lambda s: NamedSharding(mesh, s), adapter.param_specs(),
+                is_leaf=lambda x: isinstance(x, P)))
+        self._alloc = kvb.BlockAllocator(pool_blocks, block)
+        self._build_programs()
+        # reusable host staging for the admit path.  These buffers are
+        # REWRITTEN per admission; everything handed to a jitted call
+        # is copied first (_staging_copy) — a deferred sharded
+        # device_put may alias host memory and block_until_ready does
+        # not force the copy (the iterators.prefetch.put_window
+        # hazard), so the transfer could still be reading the buffer
+        # when the next admission rewrites it.
+        self._prompt_staging = np.zeros((self._pq,), np.int32)
+        self._ids_staging = np.zeros((self._w,), np.int32)
+        self.reset()
+
+    # ------------------------------------------------------------------ #
+    # compiled programs
+    # ------------------------------------------------------------------ #
+
+    def _shard_base(self):
+        idx = 0
+        for a in self.adapter.batch_axes:
+            idx = idx * lax.axis_size(a) + lax.axis_index(a)
+        return idx * self._n_local
+
+    def _build_programs(self):
+        ad = self.adapter
+        mesh = self._mesh
+        bax = ad.batch_axes
+        cspecs = tuple(ad.cache_specs())
+
+        def pool_spec(s):
+            t = tuple(s)
+            if len(t) <= kvb.ROW_AXIS:
+                return P(*t)
+            return P(*(t[:kvb.ROW_AXIS] + (None,)
+                       + t[kvb.ROW_AXIS + 1:]))
+
+        pool_specs = tuple(pool_spec(s) for s in cspecs)
+        row_spec = P(bax)            # (n_slots,) and (n_slots, horizon)
+        pspecs = ad.param_specs()
+        S, H, R = self._n_local, self.horizon, self.round_tokens
+        eos, pad, pq = self.eos_id, self.pad_id, self._pq
+
+        def init_body():
+            caches = tuple(_vary(c, *bax)
+                           for c in ad.make_cache(S, H))
+            buf = _vary(jnp.zeros((S, H), jnp.int32), *bax)
+            return caches, buf
+
+        self._init_fn = jax.jit(jax.shard_map(
+            init_body, mesh=mesh, in_specs=(),
+            out_specs=(cspecs, row_spec)))
+
+        def pool_body():
+            comps = ad.make_cache(1, pq, batch_varying=False)
+            return tuple(
+                jnp.zeros((c.shape[0], self._alloc.n_blocks, self.block)
+                          + c.shape[3:], c.dtype)
+                for c in comps)
+
+        self._pool_init_fn = jax.jit(jax.shard_map(
+            pool_body, mesh=mesh, in_specs=(), out_specs=pool_specs))
+
+        def round_body(params, caches, buf, offsets, done, end_t, t0):
+            def one(carry, r):
+                caches, buf, done = carry
+                t = t0 + r
+                tok = lax.dynamic_slice(
+                    buf, (0, jnp.minimum(t, H - 1)), (S, 1))[:, 0]
+                logits, caches = ad.step(
+                    params, caches, tok, jnp.minimum(t, H - 1), offsets)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(done, pad if pad >= 0 else 0, nxt)
+                if eos >= 0:
+                    done = done | (nxt == eos)
+                done = done | ((t + 1) >= end_t)
+                # steps past every row's end still run inside a round;
+                # their writes must not clamp onto live position H-1
+                wpos = jnp.minimum(t + 1, H - 1)
+                cur = lax.dynamic_slice(buf, (0, wpos), (S, 1))
+                val = jnp.where(t + 1 < H, nxt[:, None], cur)
+                buf = lax.dynamic_update_slice(buf, val, (0, wpos))
+                return (caches, buf, done), None
+
+            (caches, buf, done), _ = lax.scan(
+                one, (caches, buf, done), jnp.arange(R))
+            return caches, buf, done
+
+        self._round_fn = jax.jit(
+            jax.shard_map(
+                round_body, mesh=mesh,
+                in_specs=(pspecs, cspecs, row_spec, row_spec, row_spec,
+                          row_spec, P()),
+                out_specs=(cspecs, row_spec, row_spec)),
+            donate_argnums=(1, 2))
+
+        def prefill_body(params, pools, prompt, p_off, ids, valid):
+            caches = ad.make_cache(1, pq, batch_varying=False)
+            caches = ad.prefill(params, caches, prompt[None, :pq - 1],
+                                p_off[None])
+            return tuple(
+                kvb.scatter_chunk(pc, kvb.chunk_to_blocks(c, self.block),
+                                  ids, valid)
+                for pc, c in zip(pools, caches))
+
+        self._prefill_fn = jax.jit(
+            jax.shard_map(
+                prefill_body, mesh=mesh,
+                in_specs=(pspecs, pool_specs, P(), P(), P(), P()),
+                out_specs=pool_specs),
+            donate_argnums=(1,))
+
+        def admit_body(caches, buf, pools, ids, prompt, slot, dst0):
+            ls = slot - self._shard_base()
+            ok = (ls >= 0) & (ls < S)
+            lsc = jnp.clip(ls, 0, S - 1)
+            caches = tuple(
+                kvb.insert_chunk(c, kvb.gather_blocks(pc, ids), lsc,
+                                 dst0, ok)
+                for c, pc in zip(caches, pools))
+            cur = lax.dynamic_slice(buf, (lsc, dst0), (1, pq))
+            row = jnp.where(ok, prompt[None], cur)
+            buf = lax.dynamic_update_slice(buf, row, (lsc, dst0))
+            return caches, buf
+
+        self._admit_fn = jax.jit(
+            jax.shard_map(
+                admit_body, mesh=mesh,
+                in_specs=(cspecs, row_spec, pool_specs, P(), P(), P(),
+                          P()),
+                out_specs=(cspecs, row_spec)),
+            donate_argnums=(0, 1))
+
+        def rebase_body(caches, buf, delta):
+            caches = tuple(kvb.shift_positions(c, delta) for c in caches)
+            idx = jnp.clip(jnp.arange(H) + delta, 0, H - 1)
+            return caches, jnp.take(buf, idx, axis=1)
+
+        self._rebase_fn = jax.jit(
+            jax.shard_map(
+                rebase_body, mesh=mesh,
+                in_specs=(cspecs, row_spec, P()),
+                out_specs=(cspecs, row_spec)),
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+
+    def reset(self) -> None:
+        """(Re)initialize device and scheduler state, keeping the
+        compiled programs — benches reuse one engine across arms."""
+        self._caches, self._buf = self._init_fn()
+        self._pools = self._pool_init_fn()
+        if not self._buf.is_fully_addressable:
+            raise RuntimeError(
+                "ServingEngine needs every shard addressable from this "
+                "process (single-controller serving); multi-host result "
+                "fetch is not implemented")
+        self._alloc = kvb.BlockAllocator(self._alloc.n_blocks, self.block)
+        self._queue: collections.deque = collections.deque()
+        self._staged = {}           # rid -> (ids (W,), prompt_row (Pq,))
+        self._slot_req: List[Optional[Request]] = [None] * self.n_slots
+        self._offsets = np.full((self.n_slots,), self.horizon, np.int32)
+        self._done = np.ones((self.n_slots,), bool)
+        self._end_t = np.zeros((self.n_slots,), np.int32)
+        self._clock = self._pq - 1
+        self._pending_first: set = set()
+        self._next_rid = 0
+        self.admit_log: List[str] = []
+        self.n_rebases = 0
+        self.n_rounds = 0
+        self.useful_tokens = 0
+
+    # ------------------------------------------------------------------ #
+    # public API
+    # ------------------------------------------------------------------ #
+
+    def warm(self) -> None:
+        """Compile the rebase program ahead of serving (a zero shift is
+        the identity).  The other programs compile on their first
+        natural use; rebase fires only when the horizon binds, which
+        can land its compile inside a latency-sensitive window —
+        benches and latency-bound deployments call this once."""
+        self._caches, self._buf = self._rebase_fn(
+            self._caches, self._buf, np.int32(0))
+
+    def set_policy(self, policy: Union[str, Callable]) -> None:
+        """Swap the admission policy (host-side only — no recompile)."""
+        if callable(policy):
+            self._policy = policy
+        elif policy in _POLICIES:
+            self._policy = _POLICIES[policy]
+        else:
+            raise ValueError(
+                f"policy {policy!r} not in {sorted(_POLICIES)} and not "
+                "callable")
+
+    def submit(self, prompt, max_new: Optional[int] = None,
+               request_id: Optional[str] = None) -> str:
+        """Queue one request; returns its id."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if not 1 <= prompt.shape[0] <= self.max_prompt:
+            raise ValueError(
+                f"prompt length {prompt.shape[0]} not in "
+                f"[1, {self.max_prompt}]")
+        max_new = self.default_max_new if max_new is None else int(max_new)
+        if not 1 <= max_new <= self.horizon - self._pq:
+            raise ValueError(
+                f"max_new={max_new} not in [1, horizon - padded prompt "
+                f"= {self.horizon - self._pq}]")
+        if request_id is None:
+            request_id = f"r{self._next_rid}"
+            self._next_rid += 1
+        if any(r.rid == request_id for r in self._queue) \
+                or any(r is not None and r.rid == request_id
+                       for r in self._slot_req):
+            raise ValueError(f"request id {request_id!r} already live")
+        self._queue.append(Request(request_id, prompt, max_new,
+                                   t_submit=time.perf_counter()))
+        get_recorder().counter("serve/queue_depth", len(self._queue),
+                               cat="serve")
+        return request_id
+
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and self.n_active == 0
+
+    def step(self) -> List[Completion]:
+        """One scheduler iteration: evict finished rows, admit from the
+        queue, run one decode round.  Returns completions."""
+        rec = get_recorder()
+        out: List[Completion] = []
+        self._evict_phase(out, rec)
+        self._admit_phase(rec)
+        live = any(self._slot_req[s] is not None and not self._done[s]
+                   for s in range(self.n_slots))
+        if live:
+            with rec.span("serve/decode_round", cat="serve",
+                          step=int(self._clock), tokens=self.round_tokens,
+                          active=self.n_active):
+                self._caches, self._buf, done_dev = self._round_fn(
+                    self._params, self._caches, self._buf,
+                    self._offsets, self._done, self._end_t,
+                    np.int32(self._clock))
+                # np.array, not asarray: the host mirror is mutated by
+                # admissions, and jax arrays view out read-only
+                self._done = np.array(done_dev)     # the round's sync
+            self._clock += self.round_tokens
+            self.n_rounds += 1
+            now = time.perf_counter()
+            for s in self._pending_first:
+                self._slot_req[s].t_first = now
+            self._pending_first.clear()
+        rec.counter("serve/active_slots", self.n_active, cat="serve")
+        return out
+
+    def run(self, max_steps: Optional[int] = None) -> List[Completion]:
+        """Drive :meth:`step` until queue and slots drain."""
+        out: List[Completion] = []
+        steps = 0
+        while not self.idle:
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    def stats(self) -> dict:
+        issued = self.n_rounds * self.round_tokens * self.n_slots
+        return {
+            "rounds": self.n_rounds,
+            "rebases": self.n_rebases,
+            "useful_tokens": self.useful_tokens,
+            "slot_utilization": (self.useful_tokens / issued
+                                 if issued else 0.0),
+            "pool_utilization": self._alloc.utilization,
+            "queue_depth": len(self._queue),
+        }
+
+    # ------------------------------------------------------------------ #
+    # phases
+    # ------------------------------------------------------------------ #
+
+    def _evict_phase(self, out: List[Completion], rec) -> None:
+        for s in range(self.n_slots):
+            req = self._slot_req[s]
+            if req is None or not self._done[s]:
+                continue
+            with rec.span("serve/evict", cat="serve", rid=req.rid,
+                          slot=s):
+                row = np.asarray(self._buf[s])
+                first = int(self._offsets[s] + req.prompt.shape[0] - 1)
+                gen = row[first + 1: int(self._end_t[s]) + 1]
+                if self.eos_id >= 0:
+                    hits = np.nonzero(gen == self.eos_id)[0]
+                    if hits.size:
+                        gen = gen[:int(hits[0]) + 1]
+                self._slot_req[s] = None
+                self._offsets[s] = self.horizon     # mask-all sentinel
+                self._end_t[s] = 0
+                self.useful_tokens += int(gen.shape[0])
+            out.append(Completion(
+                rid=req.rid, prompt=req.prompt, tokens=np.array(gen),
+                t_submit=req.t_submit, t_admit=req.t_admit,
+                t_first=req.t_first, t_done=time.perf_counter(),
+                slot=s))
+
+    def _pick(self) -> Request:
+        req = self._policy(list(self._queue), self)
+        if req not in self._queue:
+            raise ValueError(
+                f"policy returned a request not in the queue: {req!r}")
+        return req
+
+    def _admit_phase(self, rec) -> None:
+        free = [s for s in range(self.n_slots)
+                if self._slot_req[s] is None]
+        if self.gang and len(free) < self.n_slots:
+            free = []                   # static batching: whole gang only
+        while free and self._queue:
+            req = self._pick()
+            a = self._clock
+            if a + req.max_new > self.horizon - 1:
+                if not self._maybe_rebase(req.max_new, rec):
+                    break               # horizon full until rows retire
+                a = self._clock
+            if not self._ensure_staged(req, rec):
+                break                   # pool full until slots drain
+            slot = free.pop(0)
+            self._queue.remove(req)
+            dst0 = a + 1 - self._pq
+            assert dst0 >= 0, (a, self._pq)   # clock >= Pq-1 invariant
+            with rec.span("serve/admit", cat="serve", rid=req.rid,
+                          slot=slot, step=int(a)):
+                ids, prompt_row = self._staged.pop(req.rid)
+                self._caches, self._buf = self._admit_fn(
+                    self._caches, self._buf, self._pools, ids,
+                    prompt_row, np.int32(slot), np.int32(dst0))
+                self._alloc.free_row(req.rid)
+            p = req.prompt.shape[0]
+            self._offsets[slot] = a + 1 - p
+            self._end_t[slot] = a + req.max_new
+            self._done[slot] = False
+            self._slot_req[slot] = req
+            self._pending_first.add(slot)
+            req.t_admit = time.perf_counter()
+            self.admit_log.append(req.rid)
+            rec.counter("serve/queue_depth", len(self._queue),
+                        cat="serve")
+        if self.prefill_ahead:
+            budget = self.prefill_ahead
+            for req in list(self._queue):
+                if budget <= 0:
+                    break
+                if req.rid in self._staged:
+                    continue
+                if not self._stage(req, rec, steal=False):
+                    break
+                budget -= 1
+
+    # ------------------------------------------------------------------ #
+    # staging / paging
+    # ------------------------------------------------------------------ #
+
+    def _staging_copy(self, buf: np.ndarray) -> np.ndarray:
+        """The one copy the admit path owes: staging buffers are
+        rewritten per admission, and a deferred sharded ``device_put``
+        may alias host memory without ``block_until_ready`` forcing the
+        copy (see ``iterators.prefetch.put_window``)."""
+        return np.array(buf)
+
+    def _stage(self, req: Request, rec, steal: bool) -> bool:
+        """Prefill ``req``'s prompt into pool blocks.  ``steal`` frees
+        queue-tail stagings to make room (used on the admission path,
+        where the request must land NOW; prefill-ahead never steals)."""
+        # the right-aligned prompt's real content lives in the chunk's
+        # LAST ceil(P/block) blocks; only those need pool backing
+        n_real = kvb.blocks_needed(req.prompt.shape[0], self.block)
+        ids = self._alloc.alloc(req.rid, n_real)
+        while ids is None and steal:
+            victims = [r for r in reversed(list(self._queue))
+                       if r.rid in self._staged and r is not req]
+            if not victims:
+                return False
+            victim = victims[0]
+            self._alloc.free_row(victim.rid)
+            del self._staged[victim.rid]
+            ids = self._alloc.alloc(req.rid, n_real)
+        if ids is None:
+            return False
+        with rec.span("serve/prefill", cat="serve", rid=req.rid,
+                      blocks=n_real):
+            st = self._prompt_staging
+            st[:] = max(self.pad_id, 0)
+            st[self._pq - req.prompt.shape[0]:] = req.prompt
+            prompt_row = self._staging_copy(st)
+            ids_np = self._ids_staging
+            ids_np[:] = self._alloc.padded_table(req.rid, self._w)
+            ids_row = self._staging_copy(ids_np)
+            self._pools = self._prefill_fn(
+                self._params, self._pools, prompt_row,
+                np.int32(self._pq - req.prompt.shape[0]), ids_row,
+                ids_row >= 0)
+            self._staged[req.rid] = (ids_row, prompt_row)
+        return True
+
+    def _ensure_staged(self, req: Request, rec) -> bool:
+        return req.rid in self._staged or self._stage(req, rec,
+                                                      steal=True)
+
+    def _maybe_rebase(self, needed_new: int, rec) -> bool:
+        """Shift every lane down by a block-aligned delta so an
+        admission at the current clock can fit ``needed_new`` more
+        positions; True if it now fits."""
+        active = [s for s in range(self.n_slots)
+                  if self._slot_req[s] is not None]
+        if not active:
+            # nothing live: the device content is all retired garbage —
+            # reset the clock outright, no shift needed
+            self._clock = self._pq - 1
+            return self._clock + needed_new <= self.horizon - 1
+        # the shift may neither strand a live position (<= min offset)
+        # nor pull the clock under Pq-1 (admissions insert a full Pq
+        # chunk at clock+1-Pq, which must stay >= 0)
+        delta = (min(int(min(self._offsets[s] for s in active)),
+                     self._clock - (self._pq - 1))
+                 // self.block) * self.block
+        if delta > 0:
+            with rec.span("serve/rebase", cat="serve", delta=delta,
+                          step=int(self._clock)):
+                self._caches, self._buf = self._rebase_fn(
+                    self._caches, self._buf, np.int32(delta))
+            for s in active:
+                self._offsets[s] -= delta
+                self._end_t[s] -= delta
+            self._clock -= delta
+            self.n_rebases += 1
+        return self._clock + needed_new <= self.horizon - 1

@@ -1,0 +1,233 @@
+"""Block-paged KV cache primitives for the serving engine.
+
+The decode stack keeps its KV cache as ONE dense static-shape buffer
+per component (``models.decoding._make_cache``: ``(L, rows, kv_len,
+heads, d_head)``) so the per-token step stays a single compiled
+program.  Continuous batching breaks the assumption behind that shape:
+requests arrive and finish raggedly, so neither the row set nor the
+position range is fixed for the lifetime of the program.  This module
+supplies the paging layer that reconciles the two:
+
+- :class:`BlockAllocator` — a host-side free-list allocator over
+  fixed-size POSITION blocks with per-row block tables.  A staged
+  (prefilled but not yet scheduled) request holds ``ceil(P/block)``
+  blocks — its actual prompt footprint — instead of a whole
+  ``max_len`` slot, which is how heterogeneous prompt lengths share
+  the staging pool.
+- Device-side block ops (:func:`chunk_to_blocks`,
+  :func:`scatter_chunk`, :func:`gather_blocks`, :func:`insert_chunk`,
+  :func:`shift_positions`) — pure ``jnp`` functions over cache
+  COMPONENT arrays, composable inside any ``shard_map`` body.  The
+  engine strings them into three jitted programs: prefill→pool
+  (scatter), pool→slot copy-on-admit (gather + contiguous insert —
+  the defrag step that lets the decode program keep reading a dense
+  per-slot layout), and the horizon rebase (shift every lane down by
+  a block-aligned delta so the global position clock never exhausts
+  the static buffer).
+
+Layout convention (shared with ``_make_cache``): every cache component
+carries its ROWS on axis 1 and its POSITIONS on axis 2; leading axis 0
+(layers) and trailing axes (heads, head dim, int8-scale singletons)
+are opaque.  The pool form of a component replaces (rows, positions)
+with (n_blocks, block): physically scattered fixed-size position
+blocks, addressed only through per-row tables — exactly the
+memory-efficient redistribution framing of PAPERS.md 2112.01075, with
+the gather/scatter pair as the portable collective-free lowering.
+
+Trade-off, stated plainly: true paged ATTENTION (vLLM-style) indexes
+the block table inside the kernel and never copies; this layer instead
+pays one O(prompt) copy per admission (and one O(cache) shift per
+rebase) so the hot per-token step stays byte-for-byte the program
+``_make_cache`` already compiles.  On a step that reads the whole
+cache every token anyway, the admission copy is noise; what paging
+buys here is the ragged-length pool accounting and the static-shape
+guarantee.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "BlockAllocator",
+    "ROW_AXIS",
+    "POS_AXIS",
+    "blocks_needed",
+    "chunk_to_blocks",
+    "scatter_chunk",
+    "gather_blocks",
+    "insert_chunk",
+    "shift_positions",
+]
+
+# Cache-component layout contract (see module docstring).
+ROW_AXIS = 1
+POS_AXIS = 2
+
+
+def blocks_needed(length: int, block: int) -> int:
+    """Blocks covering ``length`` positions (0 positions → 0 blocks)."""
+    if length < 0:
+        raise ValueError(f"length {length} must be >= 0")
+    return -(-length // block)
+
+
+class BlockAllocator:
+    """Free-list allocator over a pool of fixed-size position blocks.
+
+    Host-side bookkeeping only — the device arrays live with the
+    engine.  Rows (request ids) own lists of physical block ids; the
+    free list is LIFO so recently-freed blocks are reused while still
+    warm.  Allocation is all-or-nothing: a request that cannot get its
+    full block count holds nothing (no partial admissions to unwind).
+    """
+
+    def __init__(self, n_blocks: int, block: int):
+        if n_blocks < 1 or block < 1:
+            raise ValueError(
+                f"n_blocks={n_blocks} and block={block} must be >= 1")
+        self.n_blocks = int(n_blocks)
+        self.block = int(block)
+        self._free: List[int] = list(range(n_blocks - 1, -1, -1))
+        self._tables: Dict[object, List[int]] = {}
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        """Fraction of pool blocks currently owned by rows."""
+        return 1.0 - len(self._free) / self.n_blocks
+
+    def rows(self):
+        return list(self._tables)
+
+    def table(self, row_id) -> List[int]:
+        """The row's block ids, oldest position first (a copy)."""
+        return list(self._tables[row_id])
+
+    def alloc(self, row_id, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks for ``row_id``; ``None`` if the pool
+        cannot satisfy the FULL request (nothing is taken)."""
+        if row_id in self._tables:
+            raise ValueError(f"row {row_id!r} already holds blocks")
+        if n < 0:
+            raise ValueError(f"n={n} must be >= 0")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._tables[row_id] = ids
+        return list(ids)
+
+    def free_row(self, row_id) -> int:
+        """Return the row's blocks to the free list; count returned.
+        Unknown rows free nothing (idempotent evictions)."""
+        ids = self._tables.pop(row_id, None)
+        if not ids:
+            return 0
+        self._free.extend(reversed(ids))
+        return len(ids)
+
+    def padded_table(self, row_id, width: int) -> np.ndarray:
+        """The row's table RIGHT-aligned into ``width`` int32 entries,
+        missing leading entries = -1.  This is the wire form the
+        engine's admit program takes: a right-aligned prompt occupies
+        the LAST ``len(table)`` of its padded chunk's blocks, so the
+        -1 padding marks the chunk blocks that hold only left-pad
+        garbage (gathered from a clamped id and masked by the
+        attention validity window — never read as real K/V)."""
+        ids = self._tables[row_id]
+        if len(ids) > width:
+            raise ValueError(
+                f"row {row_id!r} holds {len(ids)} blocks > width {width}")
+        out = np.full((width,), -1, np.int32)
+        if ids:
+            out[width - len(ids):] = np.asarray(ids, np.int32)
+        return out
+
+
+# ---------------------------------------------------------------------- #
+# device-side block ops (pure jnp; usable inside shard_map bodies)
+# ---------------------------------------------------------------------- #
+
+def chunk_to_blocks(comp, block: int):
+    """Reshape a one-row cache component ``(..., 1, Pq, *rest)`` into
+    its block form ``(..., Pq // block, block, *rest)``."""
+    import jax.numpy as jnp  # noqa: F401  (kept light at module import)
+
+    if comp.shape[ROW_AXIS] != 1:
+        raise ValueError(
+            f"chunk must hold one row, got {comp.shape[ROW_AXIS]}")
+    pq = comp.shape[POS_AXIS]
+    if pq % block:
+        raise ValueError(f"chunk positions {pq} not divisible by "
+                         f"block {block}")
+    shape = (comp.shape[0], pq // block, block) + comp.shape[3:]
+    return comp.reshape(shape)
+
+
+def scatter_chunk(pool_comp, block_comp, ids, valid):
+    """Write a chunk's blocks into the pool at physical ``ids``.
+
+    ``pool_comp``: ``(D0, n_blocks, block, *rest)``; ``block_comp``:
+    ``(D0, W, block, *rest)``; ``ids``: (W,) int32 (invalid entries
+    may be any value); ``valid``: (W,) bool.  Invalid entries are
+    routed OUT of bounds and dropped (``mode="drop"``) — clamping
+    them to a real block would collide with that block's own write
+    whenever the allocator legitimately hands it out, and scatter
+    order for duplicate indices is backend-defined."""
+    import jax.numpy as jnp
+
+    nb = pool_comp.shape[1]
+    idx = jnp.where(valid, jnp.clip(ids, 0, nb - 1), nb)
+    return pool_comp.at[:, idx].set(block_comp, mode="drop")
+
+
+def gather_blocks(pool_comp, ids):
+    """Assemble pool blocks ``ids`` (W,) into a contiguous one-row
+    chunk ``(D0, 1, W * block, *rest)``.  Ids are clamped — invalid
+    (-1) entries produce garbage positions whose content the caller
+    must keep outside every attention validity window (the engine's
+    left-pad region)."""
+    import jax.numpy as jnp
+
+    nb = pool_comp.shape[1]
+    idx = jnp.clip(ids, 0, nb - 1)
+    picked = jnp.take(pool_comp, idx, axis=1)   # (D0, W, block, *rest)
+    shape = (picked.shape[0], 1, picked.shape[1] * picked.shape[2]) \
+        + picked.shape[3:]
+    return picked.reshape(shape)
+
+
+def insert_chunk(cache_comp, chunk_comp, row, dst, ok):
+    """Copy-on-admit: land a contiguous chunk ``(D0, 1, Pq, *rest)``
+    into ``cache_comp`` at (local) ``row``, positions ``[dst, dst+Pq)``.
+    ``ok`` (scalar bool) gates the write — on a row-sharded cache only
+    the shard owning the global slot writes, everyone else rewrites
+    the current value (``row`` must arrive pre-clamped into local
+    range)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    start = (0, row, dst) + (0,) * (cache_comp.ndim - 3)
+    cur = lax.dynamic_slice(cache_comp, start, chunk_comp.shape)
+    new = jnp.where(ok, chunk_comp, cur)
+    return lax.dynamic_update_slice(cache_comp, new, start)
+
+
+def shift_positions(comp, delta):
+    """Rebase: shift a component's position axis down by ``delta``
+    (``new[..., p, ...] = old[..., p + delta, ...]``, tail clamped to
+    the last position).  The engine only calls this with block-aligned
+    deltas no larger than the smallest live offset, so every live
+    position survives and the clamped tail holds only positions the
+    advancing clock has yet to rewrite (never inside any row's
+    attention window)."""
+    import jax.numpy as jnp
+
+    h = comp.shape[POS_AXIS]
+    idx = jnp.clip(jnp.arange(h) + delta, 0, h - 1)
+    return jnp.take(comp, idx, axis=POS_AXIS)

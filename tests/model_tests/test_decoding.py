@@ -1448,3 +1448,50 @@ def test_pp_decode_beam_and_guards():
         make_generate_fn(
             mc, tiny_cfg(n_layers=4, virtual_pipe=2,
                          pipeline_schedule="interleaved"), max_len=T)
+
+
+def test_generate_row_state_pins_frozen_row_semantics():
+    """``with_row_state=True`` exposes the while-carry's per-row done
+    bitmap and decoded length (only the all-rows-done scalar used to
+    escape, as the loop exit).  ``gen_len`` must count exactly the
+    real generated tokens — the eos included, the frozen tail's
+    padding excluded — and ``done`` must mark exactly the eos-stopped
+    rows, pinned here against the eos-less run's prefix."""
+    cfg = tiny_cfg()
+    mc = MeshConfig(data=1, devices=jax.devices()[:1])
+    host = init_transformer(jax.random.PRNGKey(0), cfg)
+    from chainermn_tpu.models import shard_params as _sp
+
+    params = _sp(mc, cfg, host)
+    toks = prompt(length=4)
+    Plen = 4
+    plain = np.asarray(
+        make_generate_fn(mc, cfg, max_len=T)(params, toks))
+    # an eos that provably fires: row 0's own third generated token
+    eos = int(plain[0, Plen + 2])
+    pad = 0 if eos != 0 else 1
+    gen = make_generate_fn(mc, cfg, max_len=T, eos_id=eos, pad_id=pad,
+                           with_row_state=True)
+    out, done, lens = (np.asarray(x) for x in gen(params, toks))
+    assert out.shape == (B, T)
+    assert done.shape == (B,) and done.dtype == bool
+    assert lens.shape == (B,) and lens.dtype == np.int32
+    assert done[0]              # the crafted eos stopped row 0
+    for b in range(B):
+        region = out[b, Plen:]
+        n = int(lens[b])
+        if done[b]:
+            assert region[n - 1] == eos       # eos kept AND counted
+            assert not np.any(region[:n - 1] == eos)
+            assert np.all(region[n:] == pad)  # frozen tail is padding
+        else:
+            assert n == T - Plen              # ran to the buffer end
+        # up to each row's own end, row state and tokens agree with
+        # the eos-less decode (freezing never rewrites real output)
+        np.testing.assert_array_equal(out[b, :Plen + n],
+                                      plain[b, :Plen + n])
+    # eos disabled: the scan path reports full-length rows, none done
+    gen2 = make_generate_fn(mc, cfg, max_len=T, with_row_state=True)
+    out2, done2, lens2 = (np.asarray(x) for x in gen2(params, toks))
+    np.testing.assert_array_equal(out2, plain)
+    assert not done2.any() and np.all(lens2 == T - Plen)

@@ -1,0 +1,83 @@
+"""Shared MiniLM fixtures for the serving-engine suite.
+
+Session-scoped model/adapter (compiles are the cost here, not compute)
+plus an independent greedy oracle: a plain python loop over the same
+adapter's pure step/prefill functions — no shard_map, no engine code —
+so engine-vs-oracle token identity actually pins the scheduler, not
+two copies of one bug."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.parallel import MeshConfig
+from chainermn_tpu.serving import (
+    MiniLMAdapter,
+    MiniLMConfig,
+    init_minilm,
+)
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="session")
+def mini_cfg():
+    return MiniLMConfig(vocab_size=VOCAB, d_model=32, n_heads=2,
+                        d_head=16, d_ff=64, n_layers=2, max_pos=256)
+
+
+@pytest.fixture(scope="session")
+def mini_params(mini_cfg):
+    return init_minilm(jax.random.PRNGKey(0), mini_cfg)
+
+
+@pytest.fixture(scope="session")
+def mini_adapter(mini_cfg):
+    return MiniLMAdapter(MeshConfig(data=8), mini_cfg)
+
+
+@pytest.fixture(scope="session")
+def oracle(mini_adapter, mini_params):
+    """``oracle(prompt, max_new, eos=-1) -> (n,) generated tokens`` —
+    the solo static greedy decode every engine request must match."""
+    ad, params = mini_adapter, mini_params
+    cache = {}
+
+    def run(prompt, max_new, eos=-1):
+        key = (bytes(np.asarray(prompt, np.int32)), int(max_new),
+               int(eos))
+        if key in cache:
+            return cache[key]
+        prompt = np.asarray(prompt, np.int32)
+        p = prompt.shape[0]
+        caches = ad.make_cache(1, p + max_new)
+        offs = jnp.zeros((1,), jnp.int32)
+        if p > 1:
+            caches = ad.prefill(
+                params, caches, jnp.asarray(prompt[None, :p - 1]), offs)
+        tok = jnp.asarray(prompt[-1:], jnp.int32)
+        out = []
+        for t in range(p - 1, p - 1 + max_new):
+            logits, caches = ad.step(params, caches, tok, jnp.int32(t),
+                                     offs)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            out.append(int(tok[0]))
+            if eos >= 0 and out[-1] == eos:
+                break
+        cache[key] = np.asarray(out, np.int32)
+        return cache[key]
+
+    return run
+
+
+@pytest.fixture(scope="session")
+def ragged_trace():
+    """Factory: (prompt, max_new) pairs with ragged lengths/budgets."""
+
+    def make(rng, n, vocab=VOCAB, max_prompt=16, min_new=4, max_new=24):
+        return [(rng.randint(0, vocab, rng.randint(2, max_prompt + 1)),
+                 int(rng.randint(min_new, max_new + 1)))
+                for _ in range(n)]
+
+    return make

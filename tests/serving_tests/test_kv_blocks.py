@@ -1,0 +1,143 @@
+"""Block-paged KV cache primitives: free-list allocator bookkeeping and
+the scatter/gather/insert/shift device ops the engine's programs are
+built from (exercised here on plain arrays — the ops are pure jnp, the
+same code path shard_map traces)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import kv_blocks as kvb
+
+
+class TestAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = kvb.BlockAllocator(8, 4)
+        assert a.n_free == 8 and a.utilization == 0.0
+        ids = a.alloc("r0", 3)
+        assert len(ids) == 3 and len(set(ids)) == 3
+        assert a.n_free == 5 and a.table("r0") == ids
+        assert a.utilization == pytest.approx(3 / 8)
+        assert a.free_row("r0") == 3
+        assert a.n_free == 8
+        # idempotent: unknown rows free nothing
+        assert a.free_row("r0") == 0
+
+    def test_all_or_nothing(self):
+        a = kvb.BlockAllocator(4, 2)
+        assert a.alloc("big", 5) is None
+        assert a.n_free == 4            # nothing taken
+        a.alloc("r0", 3)
+        assert a.alloc("r1", 2) is None
+        assert a.n_free == 1
+
+    def test_no_double_ownership(self):
+        a = kvb.BlockAllocator(6, 2)
+        i0 = a.alloc("r0", 2)
+        i1 = a.alloc("r1", 4)
+        assert not set(i0) & set(i1)
+        with pytest.raises(ValueError):
+            a.alloc("r0", 1)
+
+    def test_padded_table_right_aligned(self):
+        a = kvb.BlockAllocator(8, 4)
+        ids = a.alloc("r", 2)
+        t = a.padded_table("r", 5)
+        assert t.dtype == np.int32 and t.shape == (5,)
+        assert list(t[:3]) == [-1, -1, -1]
+        assert list(t[3:]) == ids
+        with pytest.raises(ValueError):
+            a.padded_table("r", 1)
+
+    def test_blocks_needed(self):
+        assert kvb.blocks_needed(0, 4) == 0
+        assert kvb.blocks_needed(1, 4) == 1
+        assert kvb.blocks_needed(4, 4) == 1
+        assert kvb.blocks_needed(5, 4) == 2
+        with pytest.raises(ValueError):
+            kvb.blocks_needed(-1, 4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            kvb.BlockAllocator(0, 4)
+        a = kvb.BlockAllocator(2, 2)
+        with pytest.raises(ValueError):
+            a.alloc("r", -1)
+
+
+def _chunk(pq=8, layers=2, rest=(3,), seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(layers, 1, pq, *rest).astype(np.float32))
+
+
+class TestDeviceOps:
+    def test_chunk_blocks_scatter_gather_roundtrip(self):
+        block, nb = 4, 6
+        chunk = _chunk(pq=8)
+        pool = jnp.zeros((2, nb, block, 3), jnp.float32)
+        blocks = kvb.chunk_to_blocks(chunk, block)
+        assert blocks.shape == (2, 2, block, 3)
+        ids = jnp.asarray([5, 2], jnp.int32)
+        valid = jnp.asarray([True, True])
+        pool = kvb.scatter_chunk(pool, blocks, ids, valid)
+        out = kvb.gather_blocks(pool, ids)
+        np.testing.assert_array_equal(out, chunk)
+        # physical placement really is scattered
+        np.testing.assert_array_equal(pool[:, 5], chunk[:, 0, :4])
+        np.testing.assert_array_equal(pool[:, 2], chunk[:, 0, 4:])
+
+    def test_scatter_invalid_ids_are_noops(self):
+        block = 4
+        chunk = _chunk(pq=8)
+        pool0 = jnp.asarray(
+            np.random.RandomState(1).randn(2, 4, block, 3), jnp.float32)
+        blocks = kvb.chunk_to_blocks(chunk, block)
+        ids = jnp.asarray([-1, 3], jnp.int32)
+        pool = kvb.scatter_chunk(pool0, blocks, ids,
+                                 jnp.asarray([False, True]))
+        # the invalid entry must leave every block untouched
+        np.testing.assert_array_equal(pool[:, 0], pool0[:, 0])
+        np.testing.assert_array_equal(pool[:, 3], chunk[:, 0, 4:])
+
+    def test_scatter_invalid_entry_never_collides_with_block_zero(self):
+        # the allocator legitimately hands out block 0; a pad entry
+        # sharing an index with it (via clamping) would make the
+        # winner backend-defined — invalid entries must be dropped,
+        # not clamped, so the real write always lands
+        block = 4
+        chunk = _chunk(pq=8, seed=3)
+        pool0 = jnp.asarray(
+            np.random.RandomState(4).randn(2, 4, block, 3), jnp.float32)
+        blocks = kvb.chunk_to_blocks(chunk, block)
+        ids = jnp.asarray([-1, 0], jnp.int32)       # pad + REAL block 0
+        pool = kvb.scatter_chunk(pool0, blocks, ids,
+                                 jnp.asarray([False, True]))
+        np.testing.assert_array_equal(pool[:, 0], chunk[:, 0, 4:])
+        np.testing.assert_array_equal(pool[:, 1:], pool0[:, 1:])
+
+    def test_chunk_to_blocks_validation(self):
+        with pytest.raises(ValueError):
+            kvb.chunk_to_blocks(jnp.zeros((2, 2, 8, 3)), 4)  # 2 rows
+        with pytest.raises(ValueError):
+            kvb.chunk_to_blocks(jnp.zeros((2, 1, 7, 3)), 4)  # 7 % 4
+
+    def test_insert_chunk_masked(self):
+        cache = jnp.zeros((2, 4, 16, 3), jnp.float32)
+        chunk = _chunk(pq=8, seed=2)
+        out = kvb.insert_chunk(cache, chunk, jnp.int32(1), jnp.int32(5),
+                               jnp.asarray(True))
+        np.testing.assert_array_equal(out[:, 1, 5:13], chunk[:, 0])
+        assert float(jnp.abs(out[:, 0]).sum()) == 0.0
+        # masked write (the non-owning shard's path) changes nothing
+        out2 = kvb.insert_chunk(cache, chunk, jnp.int32(1), jnp.int32(5),
+                                jnp.asarray(False))
+        np.testing.assert_array_equal(out2, cache)
+
+    def test_shift_positions(self):
+        comp = jnp.asarray(
+            np.arange(2 * 3 * 8 * 1).reshape(2, 3, 8, 1), jnp.float32)
+        out = kvb.shift_positions(comp, jnp.int32(3))
+        np.testing.assert_array_equal(out[:, :, :5], comp[:, :, 3:])
+        # clamped tail repeats the last position
+        np.testing.assert_array_equal(out[:, :, 5:],
+                                      jnp.repeat(comp[:, :, 7:], 3, 2))

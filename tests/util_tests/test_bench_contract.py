@@ -179,9 +179,15 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
      ["--batch", "8", "--dim", "48", "--hidden", "48", "--n-layers",
       "4", "--accum-steps", "2", "--warmup", "1", "--iters", "4",
       "--rounds", "1", "--trials", "1", "--min-frac", "0.4"], "x"),
+    ("bench_serving.py",
+     ["--requests", "8", "--slots", "8", "--horizon", "128",
+      "--max-prompt", "16", "--block", "8", "--min-new", "4",
+      "--max-new", "24", "--round-tokens", "2", "--d-model", "32",
+      "--n-layers", "1", "--heads", "2", "--vocab", "64",
+      "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
-        "autotune", "telemetry", "overlap"])
+        "autotune", "telemetry", "overlap", "serving"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
