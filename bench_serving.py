@@ -15,7 +15,11 @@ prompt lengths and token budgets, replayed against the wall clock.
 Reported: aggregate generated tokens/sec per arm (the ratio is the
 headline), p50/p99 time-to-first-token (arrival → first token on
 host — queueing included, which is where static batching bleeds), and
-slot utilization.  Token identity across the two arms is verified
+slot utilization.  Percentiles come through the SLO layer
+(``ServingEngine.request_records()`` → ``SLOReport``'s shared-lattice
+histograms) and are asserted equal to the raw numpy math each run —
+the dashboard number IS the bench number.  Token identity across the
+two arms is verified
 per request and recorded (the engine's exactness guarantee: scheduling
 must never change anyone's tokens).
 
@@ -74,15 +78,36 @@ def _replay(engine, trace):
     return completions, t_end - t0 - trace[0][0]
 
 
-def _arm_stats(completions, makespan):
+def _arm_stats(arm, completions, makespan):
+    """Percentiles through the SLO layer (the engine's request records
+    + ``SLOReport``'s shared-lattice histograms), asserted equal to the
+    ad-hoc numpy math this bench used to carry — the dedup is only
+    safe if the recorded numbers do not move."""
     import numpy as np
 
-    ttft = np.asarray([c.ttft for c in completions])
+    from chainermn_tpu.serving import SLOReport
+
+    slo = SLOReport(percentiles=(50, 99))
+    slo.add_arm(arm, completions)
+    s = slo.summary()[arm]
+    # under the histogram's exact-sample cap the SLO percentiles must
+    # reproduce numpy's to float rounding — the equivalence the dedup
+    # (and the SLO layer's credibility) rests on.  Past the cap (a
+    # --requests > 512 run) the histogram deliberately switches to
+    # interpolated bucket quantiles, so only the exact path is pinned.
+    if slo.histograms(arm)["ttft"].exact:
+        ttft = np.asarray([c.ttft for c in completions])
+        for q in (50, 99):
+            want = float(np.percentile(ttft, q))
+            assert abs(s["ttft"][f"p{q}"] - want) \
+                <= 1e-9 * max(1.0, want), q
     tokens = int(sum(c.n_generated for c in completions))
     return {
         "tokens_per_sec": tokens / makespan,
-        "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
-        "ttft_p99_ms": float(np.percentile(ttft, 99)) * 1e3,
+        "ttft_p50_ms": s["ttft"]["p50"] * 1e3,
+        "ttft_p99_ms": s["ttft"]["p99"] * 1e3,
+        "queue_wait_p50_ms": s["queue_wait"]["p50"] * 1e3,
+        "tpot_p50_ms": s["tpot"]["p50"] * 1e3,
         "makespan_s": makespan,
         "tokens": tokens,
     }
@@ -136,7 +161,12 @@ def run(args):
             engine.gang = gang
             comps, makespan = _replay(engine, trace)
             assert len(comps) == args.requests, (arm, len(comps))
-            stats = _arm_stats(comps, makespan)
+            # the engine's own per-request records carry the derived
+            # queue_wait/ttft/tpot fields — same objects the replay
+            # collected, exposed the way SLO consumers get them
+            records = engine.request_records()
+            assert len(records) == len(comps)
+            stats = _arm_stats(arm, records, makespan)
             stats["slot_utilization"] = \
                 engine.stats()["slot_utilization"]
             if arm not in arms or stats["tokens_per_sec"] \
@@ -170,6 +200,13 @@ def run(args):
             round(arms["continuous"]["ttft_p99_ms"], 1),
         "static_ttft_p50_ms": round(arms["static"]["ttft_p50_ms"], 1),
         "static_ttft_p99_ms": round(arms["static"]["ttft_p99_ms"], 1),
+        "continuous_queue_wait_p50_ms":
+            round(arms["continuous"]["queue_wait_p50_ms"], 1),
+        "static_queue_wait_p50_ms":
+            round(arms["static"]["queue_wait_p50_ms"], 1),
+        "continuous_tpot_p50_ms":
+            round(arms["continuous"]["tpot_p50_ms"], 2),
+        "static_tpot_p50_ms": round(arms["static"]["tpot_p50_ms"], 2),
         "continuous_slot_utilization":
             round(arms["continuous"]["slot_utilization"], 3),
         "static_slot_utilization":

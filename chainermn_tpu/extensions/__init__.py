@@ -24,6 +24,11 @@
   time series).  Defined in :mod:`chainermn_tpu.utils.telemetry`,
   re-exported here because they plug into the trainer like the rest
   (docs/OBSERVABILITY.md).
+- :class:`GoodputReport` / :class:`MetricsTextfile` — metrics-layer
+  extensions (goodput/badput wall-time decomposition from the flight
+  recorder's phase stats; Prometheus-textfile flush of the merged
+  metrics registry).  Defined in :mod:`chainermn_tpu.utils.metrics`,
+  re-exported here for the same reason (docs/OBSERVABILITY.md).
 """
 
 from chainermn_tpu.extensions.allreduce_persistent import (
@@ -43,12 +48,15 @@ from chainermn_tpu.extensions.observation_aggregator import (
 from chainermn_tpu.extensions.preemption import PreemptionCheckpointer
 from chainermn_tpu.extensions.snapshot import multi_node_snapshot
 from chainermn_tpu.extensions.watchdog import TrainingWatchdog
+from chainermn_tpu.utils.metrics import GoodputReport, MetricsTextfile
 from chainermn_tpu.utils.telemetry import MetricsExport, StragglerReport
 
 __all__ = [
     "AllreducePersistentValues",
     "FailOnNonNumber",
+    "GoodputReport",
     "MetricsExport",
+    "MetricsTextfile",
     "MultiNodeCheckpointer",
     "ObservationAggregator",
     "PreemptionCheckpointer",

@@ -114,6 +114,9 @@ class MultiNodeCheckpointer:
             n += 1
             q = f"{path}.corrupt{n}"
         os.replace(path, q)
+        from chainermn_tpu.utils.metrics import get_registry
+
+        get_registry().inc("checkpoint/quarantined")
         return q
 
     def _checked_local_load(self, it: int):
@@ -153,6 +156,7 @@ class MultiNodeCheckpointer:
 
     def save(self, updater, trainer=None) -> None:
         from chainermn_tpu.training._resume import collect_train_state
+        from chainermn_tpu.utils.metrics import get_registry
         from chainermn_tpu.utils.telemetry import get_recorder
 
         it = updater.iteration
@@ -170,9 +174,14 @@ class MultiNodeCheckpointer:
                 state["model_state"] = updater.state
             fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
             if self.async_write:
+                # async writes are counted at the successful join
+                # (_join_pending), where their failure would surface
                 self._save_async(os.path.join(self.path, fn), state, it)
                 return
             save_state(os.path.join(self.path, fn), state)
+            # counted only after the write lands: a scraper diffs this
+            # against on-disk snapshots to detect losses
+            get_registry().inc("checkpoint/snapshots_written")
             self._saved_iterations.add(it)
             # all shards of this iteration exist before older sets are
             # GC'd
@@ -243,6 +252,9 @@ class MultiNodeCheckpointer:
             raise RuntimeError(
                 f"async checkpoint write of iteration {it} failed"
             ) from box["error"]
+        from chainermn_tpu.utils.metrics import get_registry
+
+        get_registry().inc("checkpoint/snapshots_written")
         self._saved_iterations.add(it)
         if barrier_and_gc:
             self.comm.barrier()
@@ -355,6 +367,9 @@ class MultiNodeCheckpointer:
                 "shard(s) on at least one process — restoring iteration "
                 "%d instead (bad files quarantined as *.corrupt)",
                 skipped, it)
+            from chainermn_tpu.utils.metrics import get_registry
+
+            get_registry().inc("checkpoint/fallback_resumes")
         saved_world = int(state.get("world_size", self.comm.inter_size))
         if saved_world != self.comm.inter_size:
             # same-world-size restart contract (the reference's implicit

@@ -245,10 +245,12 @@ class TrainingWatchdog:
         self._iteration = iteration
         self._last_beat = time.monotonic()
         self._reported_current_stall = False
+        from chainermn_tpu.utils.metrics import get_registry
         from chainermn_tpu.utils.telemetry import get_recorder
 
         get_recorder().instant("watchdog/heartbeat", cat="watchdog",
                                step=iteration, beats=self._beats)
+        get_registry().inc("watchdog/heartbeats")
         self._publish_beat()
 
     def start(self) -> None:
@@ -311,6 +313,12 @@ class TrainingWatchdog:
             # deserves its own report
             self._reported_current_stall = True
         self.stall_count += 1
+        try:
+            from chainermn_tpu.utils.metrics import get_registry
+
+            get_registry().inc("watchdog/stalls")
+        except Exception:
+            pass    # the stall path must survive a broken metrics layer
         rank = getattr(self.comm, "inter_rank", 0) if self.comm else 0
         report = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -15,6 +15,7 @@ engine through :class:`TransformerAdapter`.  See docs/SERVING.md
 from .engine import Completion, Request, ServingEngine, TransformerAdapter
 from .kv_blocks import BlockAllocator, blocks_needed
 from .minilm import MiniLMAdapter, MiniLMConfig, init_minilm
+from .slo import SLOReport
 
 __all__ = [
     "BlockAllocator",
@@ -22,6 +23,7 @@ __all__ = [
     "MiniLMAdapter",
     "MiniLMConfig",
     "Request",
+    "SLOReport",
     "ServingEngine",
     "TransformerAdapter",
     "blocks_needed",

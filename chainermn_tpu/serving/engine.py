@@ -54,6 +54,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.parallel._compat import pcast, typeof
+from chainermn_tpu.utils.metrics import get_registry
 from chainermn_tpu.utils.telemetry import get_recorder
 
 from . import kv_blocks as kvb
@@ -84,7 +85,11 @@ class Request:
 class Completion:
     """A finished request: ``tokens`` are the GENERATED tokens only
     (first EOS kept when one was emitted, budget-truncated otherwise —
-    the ``make_generate_fn`` convention)."""
+    the ``make_generate_fn`` convention).  The derived latency fields
+    (``queue_wait`` / ``ttft`` / ``tpot`` / ``e2e``) are THE request
+    record — ``ServingEngine.request_records()`` hands these back so
+    callers (``SLOReport``, ``bench_serving``) stop recomputing them
+    from raw timestamps."""
 
     rid: str
     prompt: np.ndarray
@@ -100,9 +105,26 @@ class Completion:
         return int(self.tokens.shape[0])
 
     @property
+    def queue_wait(self) -> float:
+        """Submit → admission into a decode slot (where static
+        batching bleeds)."""
+        return self.t_admit - self.t_submit
+
+    @property
     def ttft(self) -> float:
         """Time-to-first-token: submit → first generated token on host."""
         return self.t_first - self.t_submit
+
+    @property
+    def tpot(self) -> float:
+        """Time-per-output-token after the first (the decode steady
+        state): ``(t_done - t_first) / (n_generated - 1)``."""
+        return (self.t_done - self.t_first) / max(self.n_generated - 1, 1)
+
+    @property
+    def e2e(self) -> float:
+        """Submit → eviction with every token on host."""
+        return self.t_done - self.t_submit
 
 
 class TransformerAdapter:
@@ -224,6 +246,11 @@ class ServingEngine:
         into the pool while slots are still busy (0 disables; default
         ``n_slots``).  Admission of a staged request skips the prefill
         compute — only the copy-on-admit gather remains.
+      record_history: how many completed requests
+        :meth:`request_records` retains (a bounded ring — a
+        long-running server must not grow a completion list without
+        bound; completions returned from :meth:`step` are unaffected).
+        0 disables retention.
     """
 
     def __init__(self, adapter, params, *, n_slots: int, horizon: int,
@@ -233,7 +260,8 @@ class ServingEngine:
                  policy: Union[str, Callable] = "fcfs",
                  gang: bool = False,
                  prefill_ahead: Optional[int] = None,
-                 default_max_new: int = 32):
+                 default_max_new: int = 32,
+                 record_history: int = 4096):
         mesh = adapter.mesh_cfg.mesh
         shards = 1
         for a in adapter.batch_axes:
@@ -275,6 +303,10 @@ class ServingEngine:
         self.prefill_ahead = n_slots if prefill_ahead is None \
             else prefill_ahead
         self.default_max_new = default_max_new
+        if record_history < 0:
+            raise ValueError(
+                f"record_history={record_history} must be >= 0")
+        self.record_history = record_history
         self._n_local = n_slots // shards
         self._n_shards = shards
         self._mesh = mesh
@@ -451,6 +483,8 @@ class ServingEngine:
         self._pending_first: set = set()
         self._next_rid = 0
         self.admit_log: List[str] = []
+        self._records: collections.deque = collections.deque(
+            maxlen=self.record_history)
         self.n_rebases = 0
         self.n_rounds = 0
         self.useful_tokens = 0
@@ -503,6 +537,9 @@ class ServingEngine:
                                    t_submit=time.perf_counter()))
         get_recorder().counter("serve/queue_depth", len(self._queue),
                                cat="serve")
+        reg = get_registry()
+        reg.inc("serve/submitted")
+        reg.set("serve/queue_depth", len(self._queue))
         return request_id
 
     @property
@@ -536,8 +573,13 @@ class ServingEngine:
             self._clock += self.round_tokens
             self.n_rounds += 1
             now = time.perf_counter()
+            reg = get_registry()
             for s in self._pending_first:
-                self._slot_req[s].t_first = now
+                req = self._slot_req[s]
+                req.t_first = now
+                # TTFT lands here — the first moment the request's
+                # first generated token is host-observable
+                reg.observe("serve/ttft", now - req.t_submit)
             self._pending_first.clear()
         rec.counter("serve/active_slots", self.n_active, cat="serve")
         return out
@@ -565,6 +607,25 @@ class ServingEngine:
             "queue_depth": len(self._queue),
         }
 
+    def request_records(self) -> List[Completion]:
+        """The newest completed requests (up to ``record_history``,
+        oldest dropped; cleared by :meth:`reset`), in eviction order —
+        the :class:`Completion` the engine already built at eviction,
+        with the derived ``queue_wait`` / ``ttft`` / ``tpot`` /
+        ``e2e`` latency fields, so SLO consumers (``SLOReport``,
+        ``bench_serving``) never recompute them."""
+        return list(self._records)
+
+    def metrics_snapshot(self) -> dict:
+        """The ``serve/*`` slice of the global metrics registry —
+        per-request queue-wait/TTFT/TPOT/e2e histograms plus
+        submit/admit/evict/rebase counters recorded at the points that
+        hold the timestamps.  Empty when the registry is disabled
+        (``CHAINERMN_TPU_METRICS=1`` or
+        ``utils.metrics.get_registry().enable()`` turn it on);
+        :meth:`request_records` is the always-on per-request form."""
+        return get_registry().snapshot(prefix="serve/")
+
     # ------------------------------------------------------------------ #
     # phases
     # ------------------------------------------------------------------ #
@@ -587,11 +648,18 @@ class ServingEngine:
                 self._offsets[s] = self.horizon     # mask-all sentinel
                 self._end_t[s] = 0
                 self.useful_tokens += int(gen.shape[0])
-            out.append(Completion(
+            comp = Completion(
                 rid=req.rid, prompt=req.prompt, tokens=np.array(gen),
                 t_submit=req.t_submit, t_admit=req.t_admit,
                 t_first=req.t_first, t_done=time.perf_counter(),
-                slot=s))
+                slot=s)
+            self._records.append(comp)
+            reg = get_registry()
+            reg.inc("serve/evictions")
+            reg.inc("serve/generated_tokens", comp.n_generated)
+            reg.observe("serve/tpot", comp.tpot)
+            reg.observe("serve/e2e", comp.e2e)
+            out.append(comp)
 
     def _pick(self) -> Request:
         req = self._policy(list(self._queue), self)
@@ -635,6 +703,10 @@ class ServingEngine:
             self.admit_log.append(req.rid)
             rec.counter("serve/queue_depth", len(self._queue),
                         cat="serve")
+            reg = get_registry()
+            reg.inc("serve/admits")
+            reg.observe("serve/queue_wait", req.t_admit - req.t_submit)
+            reg.set("serve/queue_depth", len(self._queue))
         if self.prefill_ahead:
             budget = self.prefill_ahead
             for req in list(self._queue):
@@ -723,4 +795,5 @@ class ServingEngine:
                 self._end_t[s] -= delta
             self._clock -= delta
             self.n_rebases += 1
+            get_registry().inc("serve/rebases")
         return self._clock + needed_new <= self.horizon - 1

@@ -1,0 +1,123 @@
+"""SLO reporting over serving request records.
+
+The engine timestamps every request lifecycle (submit → admit → first
+token → eviction) and :meth:`~chainermn_tpu.serving.ServingEngine.
+request_records` exposes the derived per-request latencies.  This
+module turns those records into the report a serving operator actually
+reads: per-arm p50/p9x for queue wait, TTFT, TPOT and end-to-end
+latency, on the shared :class:`~chainermn_tpu.utils.metrics.Histogram`
+lattice — the same percentile math the metrics registry, the
+Prometheus exposition and ``bench_serving`` use, so the number on the
+dashboard IS the number in the bench JSON (small request counts ride
+the histogram's exact-sample path, which is numpy-``linear``
+identical; ``bench_serving`` asserts that equivalence every run).
+
+"Arms" are whatever populations are being compared: scheduling modes
+(continuous vs gang), model variants, deployment slices.  One arm is
+fine too.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, Optional, Sequence
+
+from chainermn_tpu.utils.metrics import Histogram
+
+__all__ = ["SLOReport"]
+
+_FIELDS = ("queue_wait", "ttft", "tpot", "e2e")
+
+
+def _field(record, name: str) -> Optional[float]:
+    if isinstance(record, dict):
+        return record.get(name)
+    return getattr(record, name, None)
+
+
+class SLOReport:
+    """Per-arm latency percentiles from request records.
+
+    Args:
+      percentiles: which percentiles :meth:`summary` reports
+        (``p<q>`` keys; default p50/p95/p99).
+
+    Use::
+
+        slo = SLOReport()
+        slo.add_arm("continuous", engine.request_records())
+        print(slo.render())            # the operator table (ms)
+        slo.summary()["continuous"]["ttft"]["p99"]   # seconds
+    """
+
+    def __init__(self, percentiles: Sequence[float] = (50, 95, 99)):
+        self.percentiles = tuple(percentiles)
+        self._arms: Dict[str, Dict[str, Histogram]] = {}
+
+    def add_arm(self, name: str, records: Iterable) -> "SLOReport":
+        """Fold ``records`` (``Completion``s, or dicts with the same
+        field names) into arm ``name``'s histograms; repeated calls
+        accumulate.  Returns self for chaining."""
+        hists = self._arms.setdefault(
+            name, {f: Histogram() for f in _FIELDS})
+        for rec in records:
+            for f in _FIELDS:
+                v = _field(rec, f)
+                if v is not None:
+                    hists[f].observe(float(v))
+        return self
+
+    @property
+    def arms(self):
+        return tuple(self._arms)
+
+    def histograms(self, arm: str) -> Dict[str, Histogram]:
+        """The arm's per-field lattice histograms (mergeable /
+        exportable through ``utils.metrics`` like any other)."""
+        return dict(self._arms[arm])
+
+    def summary(self) -> dict:
+        """``{arm: {field: {count, mean, p50, ..., max}}}``, seconds."""
+        out = {}
+        for arm, hists in self._arms.items():
+            out[arm] = {}
+            for f, h in hists.items():
+                row = {"count": h.count, "mean": h.mean, "max": h.max}
+                for q in self.percentiles:
+                    row[f"p{q:g}"] = h.percentile(q)
+                out[arm][f] = row
+        return out
+
+    def to_dict(self) -> dict:
+        return {"percentiles": list(self.percentiles),
+                "arms": self.summary()}
+
+    def write_json(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, default=float)
+        return path
+
+    def render(self) -> str:
+        """The printable table, milliseconds (TPOT included — it is a
+        latency too, just per token)."""
+        cols = ["arm", "metric", "n", "mean_ms"] + \
+            [f"p{q:g}_ms" for q in self.percentiles] + ["max_ms"]
+        rows = []
+        for arm, fields in self.summary().items():
+            for f in _FIELDS:
+                s = fields[f]
+
+                def ms(v):
+                    return "-" if v is None else f"{v * 1e3:.2f}"
+
+                rows.append([arm, f, str(s["count"]), ms(s["mean"])]
+                            + [ms(s[f"p{q:g}"])
+                               for q in self.percentiles]
+                            + [ms(s["max"])])
+        widths = [max(len(r[i]) for r in [cols] + rows)
+                  for i in range(len(cols))]
+        fmt = "  ".join(f"{{:>{w}}}" for w in widths)
+        return "\n".join(fmt.format(*r) for r in [cols] + rows)
+
+    def __str__(self) -> str:
+        return self.render()

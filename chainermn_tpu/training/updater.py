@@ -34,6 +34,7 @@ from chainermn_tpu.iterators.prefetch import (
     default_converter,
     put_window,
 )
+from chainermn_tpu.utils.metrics import get_registry
 from chainermn_tpu.utils.profiling import get_profiler
 from chainermn_tpu.utils.telemetry import get_recorder
 
@@ -695,6 +696,12 @@ class StandardUpdater:
             "main/device_time": device_time / n_iters,
             "main/step_time": (host_time + device_time) / n_iters,
         }
+        # the step-time DISTRIBUTION (not just this tick's value): the
+        # metrics registry's lattice histogram feeds p50/p99 step-time
+        # SLOs and the Prometheus exposition; no-op while disabled
+        reg = get_registry()
+        reg.observe("train/step_time", (host_time + device_time) / n_iters)
+        reg.inc("train/iterations", n_iters)
         if self.accum_steps > 1:
             # wall time per OPTIMIZER update (the window), vs step_time's
             # per-microbatch denominator — the pair makes the

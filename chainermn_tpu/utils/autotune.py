@@ -908,8 +908,15 @@ def autotune_plan(
                         store_plan(cached, cache_path)
                     except OSError:
                         pass
+        from chainermn_tpu.utils.metrics import get_registry
+
         if cached is not None:
+            get_registry().inc("autotune/plan_cache_hits")
             return cached
+        # counted only when the lookup actually ran and came up empty:
+        # a force=True retune (the drift guard's path) never consults
+        # the cache, so it must not depress the scraped hit rate
+        get_registry().inc("autotune/plan_cache_misses")
 
     # -- enumerate + prune -------------------------------------------- #
     leaf_template = None
@@ -969,6 +976,7 @@ def autotune_plan(
     raw = _probe_tree(params, n, seed)
     flat_data = _place(raw, flat_mesh, (axis_name,))
     hier_data = None
+    from chainermn_tpu.utils.metrics import get_registry
     from chainermn_tpu.utils.telemetry import get_recorder
 
     tracer = get_recorder()
@@ -991,6 +999,8 @@ def autotune_plan(
             median_s, out = _time_candidate(fn, data, trials, warmup)
             probe_sp.set(median_ms=round(median_s * 1e3, 4))
         n_probes += max(trials, 1) + max(warmup, 1)
+        get_registry().inc("autotune/probes")
+        get_registry().observe("autotune/probe_time", median_s)
         if cand.strategy == "per_leaf":
             ref_out = out
             ok = True

@@ -1,0 +1,858 @@
+"""Metrics & SLO layer — a low-overhead registry of counters, gauges
+and latency histograms with cross-rank merge and fleet exposition.
+
+The flight recorder (:mod:`chainermn_tpu.utils.telemetry`) answers
+*"what happened, when"* — a timeline of span events.  Nothing in the
+stack turned those timestamps into *distributions*: ``bench_serving``
+recomputed TTFT percentiles ad-hoc with numpy, ``StragglerReport``
+allgathered per-phase *means* only, and no component exposed anything
+a fleet scraper could read.  This module is the distribution layer:
+
+- :class:`Counter` — monotonic total (requests admitted, snapshots
+  written, stalls).  Cross-rank merge is a sum.
+- :class:`Gauge` — last-set value plus the max it ever held (queue
+  depth, goodput).  Cross-rank merge keeps max-of-max and max-of-last.
+- :class:`Histogram` — a latency distribution over a FIXED log-spaced
+  bucket lattice shared by every histogram in every process
+  (:data:`LATTICE_EDGES`), so cross-rank merge is a bucket-wise sum —
+  no quantile sketches to reconcile, no per-rank boundary drift.
+  Below :attr:`~Histogram.sample_cap` observations the raw samples are
+  retained too, so small-n percentiles are EXACT (numpy-identical
+  linear interpolation); past the cap, p50/p9x come from interpolated
+  bucket quantiles (error bounded by one bucket's width, a factor of
+  ``10^(1/8) ≈ 1.33``).
+- :class:`MetricsRegistry` — the process-global name→instrument table
+  with the same enabled/disabled discipline as ``TraceRecorder``:
+  disabled, every record call is an early return and the instrument
+  getters hand back ONE shared no-op singleton (allocation-free,
+  pinned by test).  ``CHAINERMN_TPU_METRICS=1`` enables at import.
+- :func:`merge_metrics` — ``allgather_obj`` every rank's snapshot and
+  fold: counters sum, gauges max, histograms bucket-sum, divergent
+  name sets union (the PR 6 ``ObservationAggregator`` convention).
+  The rows arrive rank-ordered and the fold is deterministic, so every
+  rank computes ONE identical merged snapshot.
+- Exposition: :func:`to_prometheus` (node-exporter textfile
+  convention — ``# TYPE`` headers, cumulative ``_bucket{le=...}``
+  rows, label sets such as ``rank="0"``; :func:`export_prometheus`
+  writes it atomically) and :func:`export_jsonl` (append-one-line
+  snapshots for dashboards).  :func:`parse_prometheus_text` /
+  :func:`histogram_from_prometheus` close the round trip.
+
+Trainer extensions: :class:`GoodputReport` decomposes window wall time
+into productive compute vs checkpoint / exchange-probe / host-blocked
+/ stall badput by draining the flight recorder's phase stats, and
+:class:`MetricsTextfile` flushes the (optionally cross-rank merged)
+registry to ``<out>/metrics.prom`` on trigger.
+
+This module must stay importable without jax: :mod:`telemetry` (which
+the iterator layer imports) builds its per-phase histograms on the
+shared lattice here, and everything jax-flavoured (``merge_metrics``'s
+communicator, ``GoodputReport``'s recorder) is resolved lazily.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+import time
+from bisect import bisect_left
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "GoodputReport",
+    "Histogram",
+    "LATTICE_EDGES",
+    "MetricsRegistry",
+    "MetricsTextfile",
+    "export_jsonl",
+    "export_prometheus",
+    "get_registry",
+    "histogram_from_prometheus",
+    "merge_metrics",
+    "parse_prometheus_text",
+    "set_registry",
+    "to_prometheus",
+]
+
+# ---------------------------------------------------------------------- #
+# the shared bucket lattice
+# ---------------------------------------------------------------------- #
+
+# Fixed log-spaced upper edges from 100 ns to 100 ks, 8 buckets per
+# decade.  FIXED is the point: every histogram in every process buckets
+# against the same edges, so a cross-rank (or cross-run) merge is a
+# plain bucket-wise sum.  The range covers everything this stack
+# times — a µs-scale counter bump to a day-scale training window —
+# and 8/decade bounds interpolated-quantile error at 10^(1/8) ≈ 1.33×.
+_LAT_LO_EXP = -7
+_LAT_HI_EXP = 5
+_LAT_PER_DECADE = 8
+
+LATTICE_EDGES: tuple = tuple(
+    10.0 ** (_LAT_LO_EXP + i / _LAT_PER_DECADE)
+    for i in range((_LAT_HI_EXP - _LAT_LO_EXP) * _LAT_PER_DECADE + 1)
+)
+_N_BUCKETS = len(LATTICE_EDGES) + 1        # + overflow (> last edge)
+
+
+def bucket_index(value: float) -> int:
+    """The lattice bucket holding ``value``: the first bucket whose
+    upper edge is ``>= value`` (Prometheus ``le`` semantics — a value
+    exactly on an edge belongs to that edge's bucket), with the final
+    index catching overflow.  ``bisect`` on the precomputed edges, so
+    boundary membership is exact — no float-log wobble."""
+    return bisect_left(LATTICE_EDGES, value)
+
+
+# ---------------------------------------------------------------------- #
+# instruments
+# ---------------------------------------------------------------------- #
+
+class Counter:
+    """Monotonic total.  Merge = sum."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: float = 0.0):
+        self.value = float(value)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def to_snapshot(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+    @classmethod
+    def from_snapshot(cls, d: dict) -> "Counter":
+        return cls(float(d.get("value", 0.0)))
+
+    def merge(self, d: dict) -> None:
+        self.value += float(d.get("value", 0.0))
+
+
+class Gauge:
+    """Last-set value + the max it ever held.  Merge keeps the max of
+    both (a merged queue-depth gauge answers "how deep did any rank's
+    queue get", which is the fleet question)."""
+
+    __slots__ = ("last", "max")
+
+    def __init__(self, last: Optional[float] = None,
+                 max: Optional[float] = None):
+        self.last = last
+        self.max = max
+
+    def set(self, value: float) -> None:
+        value = float(value)
+        self.last = value
+        self.max = value if self.max is None else builtins_max(
+            self.max, value)
+
+    def to_snapshot(self) -> dict:
+        return {"type": "gauge", "last": self.last, "max": self.max}
+
+    @classmethod
+    def from_snapshot(cls, d: dict) -> "Gauge":
+        return cls(d.get("last"), d.get("max"))
+
+    def merge(self, d: dict) -> None:
+        for attr in ("last", "max"):
+            v = d.get(attr)
+            if v is None:
+                continue
+            cur = getattr(self, attr)
+            setattr(self, attr,
+                    v if cur is None else builtins_max(cur, v))
+
+
+builtins_max = max      # `Gauge.max` shadows the builtin in its scope
+
+
+class Histogram:
+    """Latency distribution on the shared lattice.
+
+    Exact below the cap: until ``sample_cap`` observations the raw
+    samples are retained, and :meth:`percentile` computes the
+    numpy-``linear``-identical exact quantile.  Past the cap the
+    samples are dropped (memory stays bounded however long the job
+    runs) and quantiles interpolate within the lattice bucket the
+    target rank lands in, clamped to the observed ``[min, max]``.
+
+    Merge (:meth:`merge`) is bucket-wise sum + count/sum/min/max
+    folds; exactness survives a merge whenever the combined sample
+    count still fits the cap.
+    """
+
+    SAMPLE_CAP = 512
+
+    __slots__ = ("count", "sum", "min", "max", "_counts", "_samples",
+                 "sample_cap")
+
+    def __init__(self, sample_cap: Optional[int] = None):
+        self.sample_cap = (self.SAMPLE_CAP if sample_cap is None
+                           else int(sample_cap))
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._counts = [0] * _N_BUCKETS
+        self._samples: Optional[List[float]] = []
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
+        self._counts[bucket_index(value)] += 1
+        if self._samples is not None:
+            if len(self._samples) < self.sample_cap:
+                self._samples.append(value)
+            else:
+                self._samples = None    # over the cap: buckets only
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    @property
+    def exact(self) -> bool:
+        """True while every observation is still individually retained
+        (percentiles are exact, not interpolated)."""
+        return (self._samples is not None
+                and len(self._samples) == self.count)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """The ``q``-th percentile (``0 <= q <= 100``); ``None`` when
+        empty.  Exact (numpy ``linear``) below the cap, interpolated
+        bucket quantile above it."""
+        if self.count == 0:
+            return None
+        if self.exact:
+            s = sorted(self._samples)
+            rank = (q / 100.0) * (len(s) - 1)
+            lo = int(math.floor(rank))
+            hi = min(lo + 1, len(s) - 1)
+            return s[lo] + (s[hi] - s[lo]) * (rank - lo)
+        target = (q / 100.0) * self.count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo_edge = 0.0 if i == 0 else LATTICE_EDGES[i - 1]
+                if i < len(LATTICE_EDGES):
+                    hi_edge = LATTICE_EDGES[i]
+                else:
+                    # overflow bucket: the observed max bounds it; a
+                    # wire round trip loses min/max, so degrade to the
+                    # last edge (a lower bound) rather than crash
+                    hi_edge = self.max if self.max is not None \
+                        else lo_edge
+                est = lo_edge + (hi_edge - lo_edge) * (
+                    (target - cum) / c)
+                # the observed extrema tighten the bucket's edges
+                if self.min is not None:
+                    est = builtins_max(est, self.min)
+                if self.max is not None:
+                    est = min(est, self.max)
+                return est
+            cum += c
+        return self.max
+
+    def bucket_counts(self) -> Dict[int, int]:
+        """Sparse ``{bucket_index: count}`` (the merge/export wire
+        form; index ``len(LATTICE_EDGES)`` is the overflow bucket)."""
+        return {i: c for i, c in enumerate(self._counts) if c}
+
+    def to_snapshot(self) -> dict:
+        return {
+            "type": "histogram",
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "counts": self.bucket_counts(),
+            "samples": (list(self._samples)
+                        if self._samples is not None else None),
+        }
+
+    @classmethod
+    def from_snapshot(cls, d: dict) -> "Histogram":
+        h = cls()
+        h.merge(d)
+        return h
+
+    def merge(self, d: dict) -> None:
+        """Fold a snapshot dict in: bucket-wise sum (the shared lattice
+        makes this exact), count/sum adds, min/max folds, samples kept
+        only while the combined count still fits the cap."""
+        self.count += int(d.get("count", 0))
+        self.sum += float(d.get("sum", 0.0))
+        for attr, fold in (("min", min), ("max", builtins_max)):
+            v = d.get(attr)
+            if v is not None:
+                cur = getattr(self, attr)
+                setattr(self, attr, v if cur is None else fold(cur, v))
+        for i, c in (d.get("counts") or {}).items():
+            self._counts[int(i)] += int(c)     # str keys post-JSON
+        other = d.get("samples")
+        if (self._samples is not None and other is not None
+                and len(self._samples) + len(other) <= self.sample_cap):
+            self._samples.extend(float(v) for v in other)
+        else:
+            self._samples = None
+
+
+_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class _NullInstrument:
+    """The disabled-path instrument: ONE shared instance answering
+    every record method as a no-op, so a disabled registry allocates
+    nothing per record (pinned by test — the TraceRecorder
+    ``_NULL_SPAN`` discipline)."""
+
+    __slots__ = ()
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+
+_NULL_INSTRUMENT = _NullInstrument()
+
+
+# ---------------------------------------------------------------------- #
+# registry
+# ---------------------------------------------------------------------- #
+
+class MetricsRegistry:
+    """Process-global name → instrument table.
+
+    Disabled (the production default until ``CHAINERMN_TPU_METRICS=1``
+    or :meth:`enable`): the instrument getters return the shared
+    no-op singleton and the convenience recorders early-return — the
+    instrumented hot paths (engine admit/evict, updater step,
+    checkpoint save) pay one attribute read and nothing else.
+
+    Instrument names are slash-namespaced like span names
+    (``serve/ttft``, ``train/step_time``, ``checkpoint/quarantined``);
+    a name keeps its first-registered type for the registry's lifetime
+    (re-registering under another type raises — silent shadowing would
+    corrupt the merge math).
+    """
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def _get(self, name: str, cls):
+        if not self.enabled:
+            return _NULL_INSTRUMENT
+        inst = self._metrics.get(name)
+        if inst is None:
+            with self._lock:
+                inst = self._metrics.get(name)
+                if inst is None:
+                    inst = cls()
+                    self._metrics[name] = inst
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} is a {type(inst).__name__}, not a "
+                f"{cls.__name__} — one name, one instrument type")
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    # convenience recorders (what the instrumented call sites use) --- #
+
+    def inc(self, name: str, n: float = 1.0) -> None:
+        if not self.enabled:
+            return
+        self.counter(name).inc(n)
+
+    def set(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        self.gauge(name).set(value)
+
+    def observe(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        self.histogram(name).observe(value)
+
+    # snapshot / lifecycle ------------------------------------------- #
+
+    def snapshot(self, prefix: Optional[str] = None) -> Dict[str, dict]:
+        """Name → snapshot-dict (JSON-safe, detached from the live
+        instruments), optionally restricted to a name prefix."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: inst.to_snapshot() for name, inst in items
+                if prefix is None or name.startswith(prefix)}
+
+    def load(self, snapshot: Dict[str, dict]) -> None:
+        """Fold a snapshot into this registry (merge semantics per
+        instrument type) — the inverse of :meth:`snapshot` and the
+        worker half of :func:`merge_metrics`."""
+        for name in sorted(snapshot):
+            d = snapshot[name]
+            cls = _TYPES.get(d.get("type"))
+            if cls is None:
+                continue
+            inst = self._metrics.get(name)
+            if inst is None:
+                with self._lock:
+                    inst = self._metrics.setdefault(name, cls())
+            if isinstance(inst, cls):   # divergent-type rows are dropped
+                inst.merge(d)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+def _from_env() -> MetricsRegistry:
+    enabled = os.environ.get("CHAINERMN_TPU_METRICS", "") \
+        not in ("", "0")
+    return MetricsRegistry(enabled=enabled)
+
+
+_GLOBAL = _from_env()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry every instrumented subsystem records
+    into (disabled by default — see module docstring)."""
+    return _GLOBAL
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the global registry (tests, scoped benches); returns the
+    previous one so callers can restore it."""
+    global _GLOBAL
+    prev = _GLOBAL
+    _GLOBAL = registry
+    return prev
+
+
+# ---------------------------------------------------------------------- #
+# cross-rank merge
+# ---------------------------------------------------------------------- #
+
+def merge_metrics(comm, registry: Optional[MetricsRegistry] = None
+                  ) -> MetricsRegistry:
+    """Allgather every process's snapshot and fold them into ONE merged
+    registry — counters sum, gauges keep max-of-{last,max}, histograms
+    bucket-wise sum on the shared lattice, divergent name sets union
+    (ranks may run different extensions — each metric merges over the
+    ranks that reported it, the ``ObservationAggregator`` convention).
+
+    COLLECTIVE: every process must call.  ``allgather_obj`` hands every
+    rank the same rank-ordered rows and the fold is deterministic, so
+    the merged snapshot is identical on every rank — safe to gate
+    rank-0-only exposition on.
+    """
+    reg = registry if registry is not None else get_registry()
+    rows = comm.allgather_obj(reg.snapshot())
+    merged = MetricsRegistry(enabled=True)
+    for row in rows:
+        merged.load(row)
+    return merged
+
+
+# ---------------------------------------------------------------------- #
+# exposition: Prometheus text + JSONL
+# ---------------------------------------------------------------------- #
+
+def _prom_name(name: str) -> str:
+    n = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return "_" + n if n and n[0].isdigit() else n
+
+
+def _prom_labels(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + body + "}"
+
+
+def _prom_float(v: float) -> str:
+    return format(float(v), ".17g")     # round-trips doubles exactly
+
+
+def to_prometheus(snapshot, labels: Optional[Dict[str, str]] = None
+                  ) -> str:
+    """Render a registry (or a :meth:`MetricsRegistry.snapshot` dict)
+    as Prometheus exposition text, node-exporter-textfile style.
+
+    Histograms emit cumulative ``_bucket{le=...}`` rows for every
+    NON-EMPTY lattice bucket plus the mandatory ``le="+Inf"``, and
+    ``_sum`` / ``_count`` — successive-row differences reconstruct the
+    exact bucket counts (:func:`histogram_from_prometheus`), and the
+    17-digit ``le`` values match the lattice edges float-exactly.
+    ``labels`` (e.g. ``{"rank": "0"}``) ride every sample.
+    """
+    if isinstance(snapshot, MetricsRegistry):
+        snapshot = snapshot.snapshot()
+    lines: List[str] = []
+    lab = _prom_labels(labels)
+    for name in sorted(snapshot):
+        d = snapshot[name]
+        pname = _prom_name(name)
+        kind = d.get("type")
+        if kind == "counter":
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname}{lab} {_prom_float(d['value'])}")
+        elif kind == "gauge":
+            if d.get("last") is None:
+                continue
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname}{lab} {_prom_float(d['last'])}")
+        elif kind == "histogram":
+            lines.append(f"# TYPE {pname} histogram")
+            counts = {int(i): int(c)
+                      for i, c in (d.get("counts") or {}).items()}
+            cum = 0
+            for i in sorted(counts):
+                cum += counts[i]
+                le = ("+Inf" if i >= len(LATTICE_EDGES)
+                      else _prom_float(LATTICE_EDGES[i]))
+                blab = _prom_labels(dict(labels or {}, le=le))
+                lines.append(f"{pname}_bucket{blab} {cum}")
+            if not counts or max(counts) < len(LATTICE_EDGES):
+                blab = _prom_labels(dict(labels or {}, le="+Inf"))
+                lines.append(f"{pname}_bucket{blab} {cum}")
+            lines.append(f"{pname}_sum{lab} {_prom_float(d['sum'])}")
+            lines.append(f"{pname}_count{lab} {int(d['count'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+_PROM_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+
+
+def parse_prometheus_text(text: str) -> Dict[str, dict]:
+    """Parse :func:`to_prometheus` output back into snapshot-shaped
+    dicts: ``{name: {"type", "value"|"last"|("count","sum","buckets")}}``
+    where histogram ``buckets`` is ``[(le, cumulative_count), ...]`` in
+    emission order (``le`` is ``math.inf`` for ``+Inf``).  The
+    round-trip half the tests pin."""
+    types: Dict[str, str] = {}
+    out: Dict[str, dict] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _PROM_LINE.match(line)
+        if not m:
+            continue
+        name, labels, value = (m.group("name"), m.group("labels") or "",
+                               m.group("value"))
+        base, suffix = name, None
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and types.get(name[: -len(suf)]) \
+                    == "histogram":
+                base, suffix = name[: -len(suf)], suf
+                break
+        kind = types.get(base)
+        if kind == "histogram":
+            entry = out.setdefault(base, {"type": "histogram",
+                                          "buckets": [], "count": 0,
+                                          "sum": 0.0})
+            if suffix == "_bucket":
+                le_m = re.search(r'le="([^"]+)"', labels)
+                if le_m:
+                    le = (math.inf if le_m.group(1) == "+Inf"
+                          else float(le_m.group(1)))
+                    entry["buckets"].append((le, int(float(value))))
+            elif suffix == "_sum":
+                entry["sum"] = float(value)
+            elif suffix == "_count":
+                entry["count"] = int(float(value))
+        elif kind == "counter":
+            out[base] = {"type": "counter", "value": float(value)}
+        elif kind == "gauge":
+            out[base] = {"type": "gauge", "last": float(value)}
+    return out
+
+
+def histogram_from_prometheus(entry: dict) -> Histogram:
+    """Rebuild a lattice :class:`Histogram` from a parsed exposition
+    entry.  Bucket counts are exact (cumulative differences mapped back
+    to lattice indices by float-equal ``le`` match); raw samples and
+    min/max do not survive the wire, so percentiles come from the
+    interpolated-bucket path."""
+    h = Histogram()
+    h._samples = None
+    h.count = int(entry.get("count", 0))
+    h.sum = float(entry.get("sum", 0.0))
+    prev = 0
+    for le, cum in entry.get("buckets", []):
+        c = cum - prev
+        prev = cum
+        if c <= 0:
+            continue
+        if math.isinf(le):
+            idx = len(LATTICE_EDGES)
+        else:
+            idx = bisect_left(LATTICE_EDGES, le)
+            if idx >= len(LATTICE_EDGES) \
+                    or LATTICE_EDGES[idx] != le:
+                raise ValueError(
+                    f"le={le!r} is not a lattice edge — was this text "
+                    "produced by a different lattice version?")
+        h._counts[idx] += c
+    return h
+
+
+def export_prometheus(path: str, registry=None,
+                      labels: Optional[Dict[str, str]] = None) -> str:
+    """Write the exposition text atomically (tmp + rename — the
+    node-exporter textfile-collector contract: a scraper must never
+    read a half-written file)."""
+    reg = registry if registry is not None else get_registry()
+    text = to_prometheus(reg, labels=labels)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def export_jsonl(path: str, registry=None, **extra) -> str:
+    """Append ONE JSON line ``{"ts", ..., "metrics": snapshot}`` — the
+    time-series form (each flush is a point; dashboards diff
+    counters/buckets between lines)."""
+    reg = registry if registry is not None else get_registry()
+    entry = {"ts": time.time(), **extra, "metrics": reg.snapshot()}
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, default=float) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------- #
+# trainer extensions
+# ---------------------------------------------------------------------- #
+
+class GoodputReport:
+    """Goodput/badput accounting: decompose each report window's wall
+    time into productive compute vs named badput, from the flight
+    recorder's phase stats.
+
+    On each trigger, the wall clock since the last fire is the window;
+    the recorder's per-phase totals are drained from this report's OWN
+    phase channel (``open_phase_channel`` — an independent accumulator,
+    so a ``StragglerReport`` draining the default channel on any
+    trigger still sees every interval) and decomposed into:
+
+    - ``productive_s`` — ``step/dispatch`` + ``step/accum_window`` +
+      ``step/retire``: dispatching windows and blocking on device
+      results, i.e. wall time the accelerator is doing model work.
+    - ``host_blocked_s`` — ``step/host``: waiting for input assembly
+      (the prefetch residual).
+    - ``checkpoint_s`` — ``checkpoint/save_shard`` +
+      ``checkpoint/resume`` (the outermost checkpoint spans; an
+      async-write checkpointer only bills its main-thread half here —
+      the overlapped disk write is not badput).
+    - ``exchange_probe_s`` — ``step/exchange_probe``: the isolated
+      drift-guard re-times.
+    - ``stall_s`` — the unaccounted remainder (extensions, evaluators,
+      GC pauses, genuine stalls).
+
+    ``goodput = productive_s / window_s`` is observed as
+    ``main/goodput`` and mirrored into the metrics registry (gauge
+    ``train/goodput``; per-category ``goodput/*_s`` counters accumulate
+    the decomposition for scrapers).  The full report lands in
+    :attr:`last_report` and (``write=True``) ``<out>/goodput.jsonl``.
+
+    Needs the flight recorder ENABLED — with it off every phase drains
+    empty and the whole window would read as stall, so the report marks
+    itself ``trace_enabled: False`` and observes nothing.
+    """
+
+    trigger = (1, "epoch")
+    priority = 87   # near StragglerReport (85); order is immaterial —
+    # each drains its own phase channel
+
+    CHANNEL = "goodput"
+
+    PRODUCTIVE = ("step/dispatch", "step/accum_window", "step/retire")
+    HOST_BLOCKED = ("step/host",)
+    CHECKPOINT = ("checkpoint/save_shard", "checkpoint/resume")
+    EXCHANGE_PROBE = ("step/exchange_probe",)
+
+    def __init__(self, comm=None, recorder=None, write: bool = True,
+                 registry: Optional[MetricsRegistry] = None):
+        self.comm = comm
+        self.recorder = recorder
+        self.write = write
+        self.registry = registry
+        self.last_report: Optional[dict] = None
+        self._t_last: Optional[float] = None
+
+    def _recorder(self):
+        rec = self.recorder
+        if rec is None:
+            from chainermn_tpu.utils.telemetry import get_recorder
+
+            rec = get_recorder()
+        # idempotent: the private channel must exist before (and keep
+        # existing while) spans accumulate — re-asserted per access so
+        # a swapped global recorder picks it up from the next span on.
+        # The name filter keeps the channel from accumulating (and
+        # retaining) spans this report never drains.
+        rec.open_phase_channel(
+            self.CHANNEL,
+            names=(self.PRODUCTIVE + self.HOST_BLOCKED
+                   + self.CHECKPOINT + self.EXCHANGE_PROBE))
+        return rec
+
+    def initialize(self, trainer=None) -> None:
+        self._recorder()        # open the channel before the first window
+        self._t_last = time.perf_counter()
+
+    def __call__(self, trainer=None) -> None:
+        rec = self._recorder()
+        now = time.perf_counter()
+        if self._t_last is None:        # used without initialize()
+            self._t_last = now
+        window = now - self._t_last
+        self._t_last = now
+        names = (self.PRODUCTIVE + self.HOST_BLOCKED + self.CHECKPOINT
+                 + self.EXCHANGE_PROBE)
+        drained = rec.drain_phase_stats(names, channel=self.CHANNEL)
+
+        def total(group: Sequence[str]) -> float:
+            return sum(drained[n]["total_s"] for n in group
+                       if n in drained)
+
+        productive = total(self.PRODUCTIVE)
+        host_blocked = total(self.HOST_BLOCKED)
+        checkpoint = total(self.CHECKPOINT)
+        probe = total(self.EXCHANGE_PROBE)
+        accounted = productive + host_blocked + checkpoint + probe
+        stall = builtins_max(0.0, window - accounted)
+        goodput = (productive / window
+                   if window > 0 and rec.enabled else None)
+        self.last_report = {
+            "iteration": (trainer.updater.iteration
+                          if trainer is not None else None),
+            "window_s": window,
+            "productive_s": productive,
+            "badput": {
+                "host_blocked_s": host_blocked,
+                "checkpoint_s": checkpoint,
+                "exchange_probe_s": probe,
+                "stall_s": stall,
+            },
+            "goodput": goodput,
+            "trace_enabled": rec.enabled,
+        }
+        if goodput is not None:
+            if trainer is not None:
+                trainer.observation["main/goodput"] = goodput
+            reg = (self.registry if self.registry is not None
+                   else get_registry())
+            reg.set("train/goodput", goodput)
+            reg.inc("goodput/productive_s", productive)
+            reg.inc("goodput/host_blocked_s", host_blocked)
+            reg.inc("goodput/checkpoint_s", checkpoint)
+            reg.inc("goodput/exchange_probe_s", probe)
+            reg.inc("goodput/stall_s", stall)
+        if (self.write and trainer is not None
+                and (self.comm is None
+                     or getattr(self.comm, "inter_rank", 0) == 0)):
+            try:
+                path = os.path.join(getattr(trainer, "out", "."),
+                                    "goodput.jsonl")
+                with open(path, "a") as f:
+                    f.write(json.dumps(self.last_report, default=float)
+                            + "\n")
+            except OSError:
+                pass            # observability must never kill training
+
+
+class MetricsTextfile:
+    """Trainer extension flushing the registry to a Prometheus textfile
+    on trigger (node-exporter textfile-collector convention: atomic
+    tmp+rename writes of ``<out>/metrics.prom``).
+
+    With ``comm=`` on a multi-process world the flush is COLLECTIVE:
+    every rank enters :func:`merge_metrics` and rank 0 writes the one
+    merged file (samples labeled ``rank="merged"``).  Without a comm
+    (or single-process) each process writes its own file, rank-labeled.
+    """
+
+    trigger = (1, "epoch")
+    priority = 40   # after GoodputReport (87) / StragglerReport (85)
+    # fed the registry, before LogReport-style consumers don't matter
+
+    def __init__(self, comm=None, filename: str = "metrics.prom",
+                 path: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.comm = comm
+        self.filename = filename
+        self.path = path
+        self.registry = registry
+
+    def initialize(self, trainer) -> None:
+        if self.path is None:
+            self.path = os.path.join(getattr(trainer, "out", "."),
+                                     self.filename)
+
+    def __call__(self, trainer=None) -> None:
+        if self.path is None:
+            self.path = self.filename
+        reg = (self.registry if self.registry is not None
+               else get_registry())
+        if self.comm is not None \
+                and getattr(self.comm, "inter_size", 1) > 1:
+            merged = merge_metrics(self.comm, reg)
+            if self.comm.inter_rank != 0:
+                return
+            reg, labels = merged, {"rank": "merged"}
+        else:
+            rank = getattr(self.comm, "inter_rank", 0) \
+                if self.comm is not None else 0
+            labels = {"rank": str(rank)}
+        try:
+            export_prometheus(self.path, reg, labels=labels)
+        except OSError:
+            pass                # a full disk must never kill training

@@ -66,6 +66,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from chainermn_tpu.utils.metrics import Histogram
+
 __all__ = [
     "MetricsExport",
     "SpanEvent",
@@ -207,7 +209,13 @@ class TraceRecorder:
             maxlen=self.capacity)
         self._lock = threading.Lock()
         self._stream_file = None
-        self._phase_acc: Dict[str, List[float]] = {}  # name -> [n, tot, mx]
+        # phase-stats accumulators: one independent CHANNEL per
+        # consumer, stored as [name_filter_or_None, {name: [n, tot, mx,
+        # Histogram]}]; the default "" channel (no filter) feeds
+        # StragglerReport, and open_phase_channel() gives other
+        # consumers (GoodputReport) their own interval state so a drain
+        # on one never steals another's feed
+        self._phase_channels: Dict[str, list] = {"": [None, {}]}
         self._thread_names: Dict[int, str] = {}
         # wall-clock anchor: perf_counter is monotonic but arbitrary;
         # the pair lets exports (and merge across processes) place
@@ -287,13 +295,20 @@ class TraceRecorder:
         self._ring.append(ev)      # deque.append is atomic
         if ev.ph == _PH_SPAN:
             with self._lock:
-                acc = self._phase_acc.get(ev.name)
-                if acc is None:
-                    self._phase_acc[ev.name] = [1, ev.dur, ev.dur]
-                else:
+                for flt, accs in self._phase_channels.values():
+                    if flt is not None and ev.name not in flt:
+                        continue
+                    acc = accs.get(ev.name)
+                    if acc is None:
+                        # the histogram rides the shared metrics
+                        # lattice, so StragglerReport's cross-rank
+                        # merge is a bucket sum
+                        acc = accs[ev.name] = [0, 0.0, ev.dur,
+                                               Histogram()]
                     acc[0] += 1
                     acc[1] += ev.dur
                     acc[2] = max(acc[2], ev.dur)
+                    acc[3].observe(ev.dur)
         if self.stream_path is not None:
             self._stream(ev)
 
@@ -320,7 +335,8 @@ class TraceRecorder:
     def clear(self) -> None:
         self._ring.clear()
         with self._lock:
-            self._phase_acc.clear()
+            for chan in self._phase_channels.values():
+                chan[1].clear()
         self.dropped = 0
 
     # ------------------------------------------------------------------ #
@@ -342,28 +358,63 @@ class TraceRecorder:
         # fault an export with "deque mutated during iteration"
         return [ev.to_dict() for ev in list(self._ring)]
 
-    def drain_phase_stats(self, names: Optional[Sequence[str]] = None
-                          ) -> Dict[str, dict]:
-        """Per-span-name ``{count, total_s, max_s}`` accumulated since
-        the last drain, then reset.  Survives ring wrap (accumulated at
-        record time), so interval statistics stay exact however small
-        the ring — this is :class:`StragglerReport`'s feed.
+    def open_phase_channel(self, key: str,
+                           names: Optional[Sequence[str]] = None
+                           ) -> str:
+        """Register an INDEPENDENT phase-stats accumulator.  A channel
+        sees every span recorded after it opens (restricted to
+        ``names`` when given — a consumer with a fixed name list should
+        pass it, so the channel neither pays accumulation cost nor
+        retains histograms for spans it will never drain); draining one
+        channel never touches another, so interval consumers with
+        overlapping name sets (``StragglerReport`` on the default
+        channel, ``GoodputReport`` on its own) each get the full feed.
+        Idempotent for the same arguments (re-opening replaces the
+        filter); returns ``key``."""
+        flt = None if names is None else frozenset(names)
+        with self._lock:
+            chan = self._phase_channels.get(key)
+            if chan is None:
+                self._phase_channels[key] = [flt, {}]
+            else:
+                chan[0] = flt
+        return key
+
+    def drain_phase_stats(self, names: Optional[Sequence[str]] = None,
+                          channel: str = "") -> Dict[str, dict]:
+        """Per-span-name ``{count, total_s, max_s, hist}`` accumulated
+        on ``channel`` since its last drain, then reset (``hist`` is a
+        duration :class:`~chainermn_tpu.utils.metrics.Histogram`
+        snapshot on the shared lattice — the per-phase distribution
+        behind :class:`StragglerReport`'s tail percentiles).  Survives
+        ring wrap (accumulated at record time), so interval statistics
+        stay exact however small the ring.
 
         ``names`` drains ONLY those span names, leaving the rest
-        accumulating — so consumers with disjoint filters (two
-        StragglerReports on different phases/triggers) never steal each
-        other's intervals."""
+        accumulating; ``channel`` selects which consumer's accumulator
+        to drain (default: the shared one ``StragglerReport`` uses).
+        An unknown channel raises — :meth:`open_phase_channel` is the
+        one registration point, and a typo'd key silently returning
+        ``{}`` forever is exactly the bug that must not ship."""
         with self._lock:
+            chan = self._phase_channels.get(channel)
+            if chan is None:
+                raise KeyError(
+                    f"unknown phase channel {channel!r} — call "
+                    f"open_phase_channel first (open: "
+                    f"{sorted(self._phase_channels)})")
+            accs = chan[1]
             if names is None:
-                drained = self._phase_acc
-                self._phase_acc = {}
+                drained = dict(accs)
+                accs.clear()
             else:
                 drained = {}
                 for name in names:
-                    acc = self._phase_acc.pop(name, None)
+                    acc = accs.pop(name, None)
                     if acc is not None:
                         drained[name] = acc
-        return {name: {"count": a[0], "total_s": a[1], "max_s": a[2]}
+        return {name: {"count": a[0], "total_s": a[1], "max_s": a[2],
+                       "hist": a[3].to_snapshot()}
                 for name, a in drained.items()}
 
     # ------------------------------------------------------------------ #
@@ -580,7 +631,12 @@ class StragglerReport:
     accumulated since the last fire, ``allgather_obj`` them, and for
     every phase any rank reported compute the mean-of-means, the
     slowest rank, and the skew ratio (slowest rank's mean / cross-rank
-    mean; 1.0 = perfectly balanced).  Processes may report divergent
+    mean; 1.0 = perfectly balanced) — plus, because the drained stats
+    carry per-phase duration histograms on the shared metrics lattice
+    (:mod:`chainermn_tpu.utils.metrics`), the MERGED cross-rank p50
+    and p99 per phase and a tail-skew attribution (``slowest_rank_p99``
+    / ``skew_p99``): stragglers live in tails, which a mean hides.
+    Processes may report divergent
     phase sets (rank-0-only extensions, mid-epoch joins) — each phase
     aggregates over the ranks that actually reported it, the
     :class:`~chainermn_tpu.extensions.ObservationAggregator`
@@ -624,16 +680,29 @@ class StragglerReport:
         # other's accumulated intervals
         local = rec.drain_phase_stats(
             None if self.phases is None else sorted(self.phases))
-        means = {name: s["total_s"] / max(s["count"], 1)
-                 for name, s in local.items()}
+        rows = {name: {"mean": s["total_s"] / max(s["count"], 1),
+                       "hist": s["hist"]}
+                for name, s in local.items()}
         # collective: every process calls, even with an empty interval
-        gathered = self.comm.allgather_obj(means)
+        gathered = self.comm.allgather_obj(rows)
         phases: Dict[str, dict] = {}
         worst = 1.0
         for name in sorted(set().union(*(d.keys() for d in gathered))
                            if gathered else ()):
-            per_rank = {r: d[name] for r, d in enumerate(gathered)
-                        if name in d}
+            # rows may be bare floats (older shards / hand-built test
+            # fakes) or the {"mean", "hist"} dicts recorded here
+            per_rank = {}
+            hists = {}
+            for r, d in enumerate(gathered):
+                if name not in d:
+                    continue
+                val = d[name]
+                if isinstance(val, dict):
+                    per_rank[r] = val["mean"]
+                    if val.get("hist") is not None:
+                        hists[r] = val["hist"]
+                else:
+                    per_rank[r] = float(val)
             mean = sum(per_rank.values()) / len(per_rank)
             slowest_rank = max(per_rank, key=per_rank.get)
             skew = (per_rank[slowest_rank] / mean) if mean > 0 else 1.0
@@ -644,6 +713,27 @@ class StragglerReport:
                 "skew": skew,
                 "ranks": len(per_rank),
             }
+            if hists:
+                # tail attribution on the shared lattice: the merged
+                # cross-rank distribution's p50/p99 (bucket-wise sum —
+                # exact while the combined samples fit the cap), plus
+                # which rank owns the worst p99 and how far its tail
+                # sits from the fleet's — stragglers live in tails,
+                # not means
+                merged = Histogram()
+                for h in hists.values():
+                    merged.merge(h)
+                p50, p99 = merged.percentile(50), merged.percentile(99)
+                rank_p99 = {r: Histogram.from_snapshot(h).percentile(99)
+                            for r, h in hists.items()}
+                slowest_p99 = max(rank_p99, key=rank_p99.get)
+                phases[name].update({
+                    "p50_s": p50,
+                    "p99_s": p99,
+                    "slowest_rank_p99": slowest_p99,
+                    "skew_p99": (rank_p99[slowest_p99] / p99
+                                 if p99 else 1.0),
+                })
             worst = max(worst, skew)
         self.last_report = {
             "iteration": (trainer.updater.iteration

@@ -1,0 +1,146 @@
+"""Serving metrics & SLO surface: the engine's request records carry
+the derived latency fields, the ``serve/*`` registry wiring records at
+the points that hold the timestamps, and ``SLOReport`` percentiles
+over a run reproduce raw numpy within rounding (the equivalence
+``bench_serving``'s dedup leans on)."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import ServingEngine, SLOReport
+from chainermn_tpu.utils.metrics import (
+    Histogram,
+    MetricsRegistry,
+    set_registry,
+)
+
+
+@pytest.fixture(scope="module")
+def engine(mini_adapter, mini_params):
+    return ServingEngine(mini_adapter, mini_params, n_slots=8,
+                         horizon=160, max_prompt=16, block=8,
+                         round_tokens=4)
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _run_trace(engine, rng, n=12):
+    engine.reset()
+    for _ in range(n):
+        prompt = rng.randint(0, 64, rng.randint(2, 12))
+        engine.submit(prompt, max_new=int(rng.randint(4, 16)))
+    comps = engine.run(max_steps=2000)
+    assert len(comps) == n
+    return comps
+
+
+class TestRequestRecords:
+    def test_records_expose_derived_fields(self, engine):
+        comps = _run_trace(engine, np.random.RandomState(0))
+        recs = engine.request_records()
+        assert [r.rid for r in recs] == [c.rid for c in comps]
+        for r in recs:
+            assert r.queue_wait == r.t_admit - r.t_submit >= 0
+            assert r.ttft == r.t_first - r.t_submit > 0
+            assert r.e2e == r.t_done - r.t_submit >= r.ttft
+            assert r.tpot == (r.t_done - r.t_first) \
+                / max(r.n_generated - 1, 1) >= 0
+
+    def test_reset_clears_records(self, engine):
+        _run_trace(engine, np.random.RandomState(1), n=4)
+        assert len(engine.request_records()) == 4
+        engine.reset()
+        assert engine.request_records() == []
+
+    def test_record_history_bounded(self, mini_adapter, mini_params):
+        """A long-running server must not grow the completion list
+        without bound: the ring keeps the newest record_history."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4, record_history=5)
+        comps = _run_trace(eng, np.random.RandomState(6), n=8)
+        recs = eng.request_records()
+        assert len(recs) == 5
+        assert [r.rid for r in recs] == [c.rid for c in comps[-5:]]
+
+
+class TestRegistryWiring:
+    def test_serve_metrics_recorded_at_lifecycle_points(self, engine,
+                                                        registry):
+        n = 10
+        _run_trace(engine, np.random.RandomState(2), n=n)
+        snap = engine.metrics_snapshot()
+        assert snap["serve/submitted"]["value"] == n
+        assert snap["serve/admits"]["value"] == n
+        assert snap["serve/evictions"]["value"] == n
+        for name in ("serve/queue_wait", "serve/ttft", "serve/tpot",
+                     "serve/e2e"):
+            assert snap[name]["type"] == "histogram"
+            assert snap[name]["count"] == n, name
+        # histograms hold the SAME numbers the request records derive
+        recs = engine.request_records()
+        h = Histogram.from_snapshot(snap["serve/ttft"])
+        assert h.percentile(50) == pytest.approx(
+            float(np.percentile([r.ttft for r in recs], 50)))
+        assert snap["serve/generated_tokens"]["value"] \
+            == sum(r.n_generated for r in recs)
+        # queue depth gauge saw the initial burst
+        assert snap["serve/queue_depth"]["max"] >= 1
+
+    def test_disabled_registry_records_nothing_but_records_live(
+            self, engine):
+        comps = _run_trace(engine, np.random.RandomState(3), n=4)
+        assert engine.metrics_snapshot() == {}
+        assert len(engine.request_records()) == len(comps) == 4
+
+
+class TestSLOReport:
+    def test_percentiles_reproduce_numpy(self, engine):
+        comps = _run_trace(engine, np.random.RandomState(4), n=16)
+        slo = SLOReport(percentiles=(50, 95, 99))
+        slo.add_arm("run", engine.request_records())
+        s = slo.summary()["run"]
+        for field in ("queue_wait", "ttft", "tpot", "e2e"):
+            vals = [getattr(c, field) for c in comps]
+            assert s[field]["count"] == len(vals)
+            for q in (50, 95, 99):
+                assert s[field][f"p{q}"] == pytest.approx(
+                    float(np.percentile(vals, q)), rel=1e-9), \
+                    (field, q)
+
+    def test_multi_arm_render_and_json(self, engine, tmp_path):
+        slo = SLOReport(percentiles=(50, 99))
+        _run_trace(engine, np.random.RandomState(5), n=6)
+        slo.add_arm("continuous", engine.request_records())
+        engine.gang = True
+        try:
+            _run_trace(engine, np.random.RandomState(5), n=6)
+        finally:
+            engine.gang = False
+        slo.add_arm("static", engine.request_records())
+        assert slo.arms == ("continuous", "static")
+        table = slo.render()
+        for token in ("continuous", "static", "ttft", "p99_ms"):
+            assert token in table
+        import json
+
+        path = slo.write_json(str(tmp_path / "slo.json"))
+        doc = json.load(open(path))
+        assert set(doc["arms"]) == {"continuous", "static"}
+        assert doc["arms"]["static"]["ttft"]["count"] == 6
+        # gang mode queues harder: its mean queue wait is no better
+        cont = slo.summary()["continuous"]["queue_wait"]["mean"]
+        stat = slo.summary()["static"]["queue_wait"]["mean"]
+        assert stat >= cont * 0.5   # sanity, not a perf claim
+
+    def test_dict_records_accepted(self):
+        slo = SLOReport(percentiles=(50,))
+        slo.add_arm("a", [{"queue_wait": 0.1, "ttft": 0.2,
+                           "tpot": 0.01, "e2e": 0.5}])
+        assert slo.summary()["a"]["e2e"]["p50"] == pytest.approx(0.5)

@@ -175,6 +175,9 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
     ("bench_telemetry.py",
      ["--batch", "8", "--dim", "64", "--hidden", "128", "--warmup", "1",
       "--iters", "4", "--rounds", "1"], "x"),
+    ("bench_metrics_registry.py",
+     ["--batch", "8", "--dim", "64", "--hidden", "128", "--warmup", "1",
+      "--iters", "4", "--rounds", "1"], "x"),
     ("bench_overlap.py",
      ["--batch", "8", "--dim", "48", "--hidden", "48", "--n-layers",
       "4", "--accum-steps", "2", "--warmup", "1", "--iters", "4",
@@ -187,7 +190,8 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
       "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
-        "autotune", "telemetry", "overlap", "serving"])
+        "autotune", "telemetry", "metrics_registry", "overlap",
+        "serving"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

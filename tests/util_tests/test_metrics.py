@@ -1,0 +1,531 @@
+"""Metrics & SLO layer (utils/metrics): lattice bucket-boundary
+exactness, exact-vs-interpolated percentiles, cross-rank merge math
+(counters sum / gauges max / histograms bucket-sum, divergent-key
+union), the disabled path's shared no-op singleton, Prometheus text
+round-trip, JSONL snapshot schema, and the trainer extensions
+(GoodputReport wall-time decomposition, MetricsTextfile flush) plus
+the StandardUpdater step-time wiring."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.utils import metrics as M
+from chainermn_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    GoodputReport,
+    Histogram,
+    LATTICE_EDGES,
+    MetricsRegistry,
+    MetricsTextfile,
+    bucket_index,
+    export_jsonl,
+    export_prometheus,
+    get_registry,
+    histogram_from_prometheus,
+    merge_metrics,
+    parse_prometheus_text,
+    set_registry,
+    to_prometheus,
+)
+
+
+@pytest.fixture()
+def registry():
+    """Fresh enabled registry installed as the global one; the previous
+    global is restored afterwards."""
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+class FakeComm:
+    """N-rank allgather fake: rank 0's row is the caller's object, the
+    rest are supplied — the merge-math harness (a single-process world
+    only ever allgathers one row)."""
+
+    inter_rank = 0
+    inter_size = 3
+
+    def __init__(self, *other_rows):
+        self.rows = list(other_rows)
+
+    def allgather_obj(self, obj):
+        return [obj] + self.rows
+
+
+# ---------------------------------------------------------------------- #
+# lattice
+# ---------------------------------------------------------------------- #
+
+class TestLattice:
+    def test_edges_are_log_spaced_and_monotonic(self):
+        ratios = [LATTICE_EDGES[i + 1] / LATTICE_EDGES[i]
+                  for i in range(len(LATTICE_EDGES) - 1)]
+        assert all(r == pytest.approx(10 ** (1 / 8)) for r in ratios)
+        assert list(LATTICE_EDGES) == sorted(LATTICE_EDGES)
+
+    def test_boundary_exactness(self):
+        """A value EXACTLY on an edge belongs to that edge's bucket
+        (Prometheus ``le`` semantics), with no float-log wobble at any
+        edge; the next representable value up crosses into the next
+        bucket."""
+        for i, edge in enumerate(LATTICE_EDGES):
+            assert bucket_index(edge) == i
+            assert bucket_index(math.nextafter(edge, math.inf)) == i + 1
+        assert bucket_index(0.0) == 0
+        assert bucket_index(float(LATTICE_EDGES[-1]) * 2) \
+            == len(LATTICE_EDGES)
+
+    def test_observe_lands_on_edge_bucket(self):
+        h = Histogram()
+        edge = LATTICE_EDGES[17]
+        h.observe(edge)
+        assert h.bucket_counts() == {17: 1}
+
+
+# ---------------------------------------------------------------------- #
+# histogram percentiles
+# ---------------------------------------------------------------------- #
+
+class TestHistogram:
+    def test_small_n_percentiles_exact_numpy_identical(self):
+        rng = np.random.RandomState(0)
+        vals = list(rng.lognormal(-4, 2, size=100))
+        h = Histogram()
+        for v in vals:
+            h.observe(v)
+        assert h.exact
+        for q in (0, 10, 50, 90, 95, 99, 100):
+            assert h.percentile(q) == pytest.approx(
+                float(np.percentile(vals, q)), rel=1e-12)
+        assert h.mean == pytest.approx(float(np.mean(vals)))
+
+    def test_over_cap_interpolated_within_bucket_width(self):
+        """Past the cap, samples drop and quantiles interpolate within
+        a lattice bucket — error bounded by one bucket's width
+        (10^(1/8) ≈ 1.33×)."""
+        rng = np.random.RandomState(1)
+        vals = list(rng.uniform(0.01, 0.1, size=2000))
+        h = Histogram(sample_cap=64)
+        for v in vals:
+            h.observe(v)
+        assert not h.exact and h.count == 2000
+        for q in (50, 99):
+            true = float(np.percentile(vals, q))
+            est = h.percentile(q)
+            assert true / 10 ** (1 / 8) <= est <= true * 10 ** (1 / 8)
+        # extrema clamp the interpolation
+        assert h.percentile(0) >= h.min
+        assert h.percentile(100) <= h.max
+
+    def test_empty_histogram(self):
+        h = Histogram()
+        assert h.percentile(50) is None and h.mean is None
+
+    def test_merge_is_bucket_sum_and_keeps_exactness_under_cap(self):
+        a, b = Histogram(), Histogram()
+        vals_a, vals_b = [0.001, 0.02, 0.3], [0.004, 5.0]
+        for v in vals_a:
+            a.observe(v)
+        for v in vals_b:
+            b.observe(v)
+        a.merge(b.to_snapshot())
+        whole = Histogram()
+        for v in vals_a + vals_b:
+            whole.observe(v)
+        assert a.bucket_counts() == whole.bucket_counts()
+        assert a.count == 5 and a.exact
+        assert a.percentile(50) == pytest.approx(whole.percentile(50))
+        assert a.min == min(vals_a + vals_b)
+        assert a.max == max(vals_a + vals_b)
+
+    def test_merge_past_cap_drops_samples_keeps_buckets(self):
+        a = Histogram(sample_cap=4)
+        b = Histogram(sample_cap=4)
+        for v in (0.001, 0.002, 0.003):
+            a.observe(v)
+        for v in (0.004, 0.005):
+            b.observe(v)
+        a.merge(b.to_snapshot())
+        assert not a.exact and a.count == 5
+        assert sum(a.bucket_counts().values()) == 5
+        assert a.percentile(50) is not None
+
+    def test_snapshot_round_trip_post_json(self):
+        h = Histogram()
+        for v in (0.001, 0.5, 30.0):
+            h.observe(v)
+        snap = json.loads(json.dumps(h.to_snapshot()))  # str keys
+        back = Histogram.from_snapshot(snap)
+        assert back.bucket_counts() == h.bucket_counts()
+        assert back.percentile(99) == pytest.approx(h.percentile(99))
+
+
+# ---------------------------------------------------------------------- #
+# registry: disabled path + discipline
+# ---------------------------------------------------------------------- #
+
+class TestRegistry:
+    def test_disabled_returns_shared_noop_singleton(self):
+        """Allocation-free when disabled: every instrument getter hands
+        back the SAME no-op object, the recorders early-return, and
+        nothing reaches the table (the TraceRecorder _NULL_SPAN
+        discipline)."""
+        reg = MetricsRegistry(enabled=False)
+        a = reg.counter("serve/admits")
+        b = reg.histogram("serve/ttft")
+        c = reg.gauge("serve/queue_depth")
+        assert a is b is c is M._NULL_INSTRUMENT
+        a.inc()
+        b.observe(0.5)
+        c.set(3)
+        reg.inc("x")
+        reg.observe("y", 1.0)
+        reg.set("z", 2.0)
+        assert len(reg) == 0 and reg.snapshot() == {}
+
+    def test_enable_disable_toggle(self):
+        reg = MetricsRegistry()
+        reg.inc("a")
+        reg.enable()
+        reg.inc("a")
+        reg.disable()
+        reg.inc("a")
+        assert reg.snapshot()["a"]["value"] == 1.0
+
+    def test_name_keeps_first_type(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("x")
+        with pytest.raises(TypeError):
+            reg.histogram("x")
+
+    def test_env_switch(self, monkeypatch):
+        monkeypatch.delenv("CHAINERMN_TPU_METRICS", raising=False)
+        assert not M._from_env().enabled
+        monkeypatch.setenv("CHAINERMN_TPU_METRICS", "0")
+        assert not M._from_env().enabled
+        monkeypatch.setenv("CHAINERMN_TPU_METRICS", "1")
+        assert M._from_env().enabled
+
+    def test_snapshot_prefix_filter(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("serve/admits")
+        reg.inc("train/iterations")
+        assert set(reg.snapshot(prefix="serve/")) == {"serve/admits"}
+
+
+# ---------------------------------------------------------------------- #
+# cross-rank merge
+# ---------------------------------------------------------------------- #
+
+class TestMerge:
+    def _rank_row(self, n_admits, depth, ttfts, extra=None):
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("serve/admits", n_admits)
+        reg.set("serve/queue_depth", depth)
+        for v in ttfts:
+            reg.observe("serve/ttft", v)
+        if extra:
+            reg.inc(extra)
+        return reg.snapshot()
+
+    def test_counter_gauge_histogram_merge_math(self, registry):
+        registry.inc("serve/admits", 3)
+        registry.set("serve/queue_depth", 2)
+        for v in (0.01, 0.02):
+            registry.observe("serve/ttft", v)
+        comm = FakeComm(
+            self._rank_row(5, 9, [0.04], extra="rank1/only"),
+            self._rank_row(1, 4, [0.08, 0.5]),
+        )
+        merged = merge_metrics(comm, registry)
+        s = merged.snapshot()
+        # counters sum
+        assert s["serve/admits"]["value"] == 9.0
+        # gauges keep the fleet max
+        assert s["serve/queue_depth"]["last"] == 9.0
+        assert s["serve/queue_depth"]["max"] == 9.0
+        # histograms bucket-sum on the shared lattice, exactly
+        h = Histogram.from_snapshot(s["serve/ttft"])
+        whole = Histogram()
+        for v in (0.01, 0.02, 0.04, 0.08, 0.5):
+            whole.observe(v)
+        assert h.bucket_counts() == whole.bucket_counts()
+        assert h.count == 5 and h.max == 0.5
+        assert h.percentile(99) == pytest.approx(whole.percentile(99))
+        # divergent name sets union (the ObservationAggregator
+        # convention): a rank-1-only metric survives
+        assert s["rank1/only"]["value"] == 1.0
+
+    def test_merge_deterministic_identical_everywhere(self, registry):
+        """The fold over rank-ordered rows is deterministic — every
+        rank folding the same allgathered rows produces ONE identical
+        snapshot (what rank-0-only exposition gates on)."""
+        rows = [self._rank_row(i + 1, i, [0.01 * (i + 1)])
+                for i in range(3)]
+
+        class RowsComm:
+            def allgather_obj(self, obj):
+                return [json.loads(json.dumps(r)) for r in rows]
+
+        snaps = [merge_metrics(RowsComm(), registry).snapshot()
+                 for _ in range(3)]
+        assert json.dumps(snaps[0], sort_keys=True, default=float) \
+            == json.dumps(snaps[1], sort_keys=True, default=float) \
+            == json.dumps(snaps[2], sort_keys=True, default=float)
+
+    def test_merge_over_real_communicator(self, comm, registry):
+        """The collective path: one process world, but the real
+        ``allgather_obj`` transport (pickle round trip included)."""
+        registry.inc("train/iterations", 7)
+        registry.observe("train/step_time", 0.012)
+        merged = merge_metrics(comm, registry)
+        s = merged.snapshot()
+        assert s["train/iterations"]["value"] == 7.0
+        assert s["train/step_time"]["count"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# exposition: Prometheus + JSONL
+# ---------------------------------------------------------------------- #
+
+class TestPrometheus:
+    def test_round_trip_all_instrument_types(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("serve/admits", 42)
+        reg.set("serve/queue_depth", 5)
+        vals = [1e-8, 0.001, 0.0012, 0.5, 3.0, 1e6]
+        for v in vals:
+            reg.observe("serve/ttft", v)
+        text = to_prometheus(reg, labels={"rank": "3"})
+        assert '# TYPE serve_admits counter' in text
+        assert 'rank="3"' in text
+        parsed = parse_prometheus_text(text)
+        assert parsed["serve_admits"] == {"type": "counter",
+                                          "value": 42.0}
+        assert parsed["serve_queue_depth"]["last"] == 5.0
+        h = histogram_from_prometheus(parsed["serve_ttft"])
+        orig = reg.histogram("serve/ttft")
+        # cumulative-bucket diffs reconstruct the exact lattice counts
+        # (underflow and overflow included)
+        assert h.bucket_counts() == orig.bucket_counts()
+        assert h.count == len(vals)
+        assert h.sum == pytest.approx(orig.sum)
+
+    def test_overflow_percentile_survives_wire_round_trip(self):
+        """min/max don't survive the exposition wire; a quantile
+        landing in the overflow bucket must degrade to the last lattice
+        edge (a lower bound), not crash."""
+        reg = MetricsRegistry(enabled=True)
+        reg.observe("h", 0.5)
+        reg.observe("h", 5e5)           # past the last edge
+        h = histogram_from_prometheus(
+            parse_prometheus_text(to_prometheus(reg))["h"])
+        assert h.percentile(99.99) == pytest.approx(LATTICE_EDGES[-1])
+        # with the live histogram the observed max bounds it instead
+        live = reg.histogram("h")
+        assert live.percentile(99.99) <= 5e5
+
+    def test_histogram_has_mandatory_inf_bucket(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.observe("h", 0.5)
+        text = to_prometheus(reg)
+        assert 'h_bucket{le="+Inf"} 1' in text
+        parsed = parse_prometheus_text(text)
+        assert parsed["h"]["buckets"][-1] == (math.inf, 1)
+
+    def test_name_sanitization(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("serve/queue-wait.p99")
+        parsed = parse_prometheus_text(to_prometheus(reg))
+        assert "serve_queue_wait_p99" in parsed
+
+    def test_export_atomic_file(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("c", 2)
+        path = str(tmp_path / "metrics.prom")
+        export_prometheus(path, reg, labels={"rank": "0"})
+        parsed = parse_prometheus_text(open(path).read())
+        assert parsed["c"]["value"] == 2.0
+        assert not (tmp_path / "metrics.prom.tmp").exists()
+
+
+class TestJsonl:
+    def test_snapshot_schema(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("serve/admits", 2)
+        reg.observe("serve/ttft", 0.01)
+        path = str(tmp_path / "metrics.jsonl")
+        export_jsonl(path, reg, rank=0)
+        export_jsonl(path, reg, rank=0)
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) == 2
+        for entry in lines:
+            assert {"ts", "rank", "metrics"} <= set(entry)
+            m = entry["metrics"]
+            assert m["serve/admits"] == {"type": "counter", "value": 2.0}
+            h = m["serve/ttft"]
+            assert h["type"] == "histogram"
+            assert {"count", "sum", "min", "max", "counts",
+                    "samples"} <= set(h)
+            assert h["count"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# GoodputReport
+# ---------------------------------------------------------------------- #
+
+class FakeTrainer:
+    def __init__(self, out):
+        class U:
+            iteration = 11
+        self.updater = U()
+        self.observation = {}
+        self.out = str(out)
+
+
+class TestGoodputReport:
+    def test_decomposition_sums_to_window(self, tmp_path, registry):
+        from chainermn_tpu.utils.telemetry import TraceRecorder
+
+        rec = TraceRecorder(enabled=True, rank=0)
+        gp = GoodputReport(recorder=rec, registry=registry)
+        gp.initialize()
+        for _ in range(10):
+            rec.record("step/dispatch", 0.004)
+            rec.record("step/retire", 0.006)
+            rec.record("step/host", 0.002)
+        rec.record("checkpoint/save_shard", 0.05)
+        rec.record("step/exchange_probe", 0.01)
+        trainer = FakeTrainer(tmp_path)
+        gp(trainer)
+        rep = gp.last_report
+        assert rep["productive_s"] == pytest.approx(0.1)
+        assert rep["badput"]["host_blocked_s"] == pytest.approx(0.02)
+        assert rep["badput"]["checkpoint_s"] == pytest.approx(0.05)
+        assert rep["badput"]["exchange_probe_s"] == pytest.approx(0.01)
+        # stall is the unaccounted remainder, clamped at zero: these
+        # synthetic spans outweigh the (µs-scale) real wall window, so
+        # nothing is unaccounted (the real-window tiling is asserted in
+        # the trainer integration test below)
+        assert rep["badput"]["stall_s"] == 0.0
+        assert rep["goodput"] == pytest.approx(
+            rep["productive_s"] / rep["window_s"])
+        assert trainer.observation["main/goodput"] == rep["goodput"]
+        # registry mirror for scrapers
+        snap = registry.snapshot()
+        assert snap["train/goodput"]["last"] == rep["goodput"]
+        assert snap["goodput/checkpoint_s"]["value"] \
+            == pytest.approx(0.05)
+        # rank 0 writes the jsonl series
+        line = json.loads(open(tmp_path / "goodput.jsonl").read())
+        assert line["iteration"] == 11 and "badput" in line
+
+    def test_disabled_recorder_reports_nothing(self, tmp_path):
+        from chainermn_tpu.utils.telemetry import TraceRecorder
+
+        gp = GoodputReport(recorder=TraceRecorder(enabled=False),
+                           write=False)
+        gp.initialize()
+        trainer = FakeTrainer(tmp_path)
+        gp(trainer)
+        assert gp.last_report["goodput"] is None
+        assert gp.last_report["trace_enabled"] is False
+        assert "main/goodput" not in trainer.observation
+
+    def test_private_channel_never_steals_other_consumers_feed(
+            self, registry):
+        """The goodput drain runs on its OWN phase channel — a
+        catch-all StragglerReport drain (default channel) on the same
+        or any other trigger still sees EVERY interval, including the
+        names goodput accounts."""
+        from chainermn_tpu.utils.telemetry import TraceRecorder
+
+        rec = TraceRecorder(enabled=True, rank=0)
+        gp = GoodputReport(recorder=rec, write=False,
+                           registry=registry)
+        gp.initialize()     # opens the channel before spans accumulate
+        rec.record("step/dispatch", 0.01)
+        rec.record("prefetch/slot_wait", 0.5)
+        gp()
+        assert gp.last_report["productive_s"] == pytest.approx(0.01)
+        left = rec.drain_phase_stats()
+        assert left["step/dispatch"]["count"] == 1
+        assert left["step/dispatch"]["total_s"] == pytest.approx(0.01)
+        assert "prefetch/slot_wait" in left
+        # and the private channel's interval state is its own: a second
+        # goodput fire sees only NEW spans, not the drained window again
+        gp()
+        assert gp.last_report["productive_s"] == 0.0
+
+
+# ---------------------------------------------------------------------- #
+# MetricsTextfile + trainer integration
+# ---------------------------------------------------------------------- #
+
+class TestMetricsTextfile:
+    def test_writes_rank_labeled_promfile(self, tmp_path, registry):
+        registry.inc("serve/admits", 4)
+        mt = MetricsTextfile(registry=registry,
+                             path=str(tmp_path / "metrics.prom"))
+        mt()
+        text = open(tmp_path / "metrics.prom").read()
+        parsed = parse_prometheus_text(text)
+        assert parsed["serve_admits"]["value"] == 4.0
+        assert 'rank="0"' in text
+
+    def test_trainer_integration_with_goodput(self, comm, tmp_path,
+                                              registry):
+        """Full stack on the 8-device mesh: enabled recorder + registry,
+        updater feeds the step-time histogram, GoodputReport decomposes
+        the window, MetricsTextfile flushes the promfile."""
+        import jax
+        import optax
+
+        import chainermn_tpu as cmn
+        from chainermn_tpu.models import (init_mlp, mlp_apply,
+                                          softmax_cross_entropy)
+        from chainermn_tpu.utils.telemetry import (TraceRecorder,
+                                                   set_recorder)
+
+        rec = TraceRecorder(enabled=True, rank=0)
+        prev = set_recorder(rec)
+        try:
+            rng = np.random.RandomState(0)
+            ds = [(rng.randn(6).astype(np.float32), np.int32(i % 3))
+                  for i in range(64)]
+            it = cmn.SerialIterator(ds, 16, shuffle=True, seed=3)
+            params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+            opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+
+            def loss_fn(p, x, y):
+                return softmax_cross_entropy(mlp_apply(p, x), y)
+
+            upd = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+            trainer = cmn.Trainer(upd, (2, "epoch"), out=str(tmp_path))
+            trainer.extend(GoodputReport(comm))
+            trainer.extend(MetricsTextfile(comm))
+            trainer.run()
+
+            snap = get_registry().snapshot()
+            st = snap["train/step_time"]
+            assert st["type"] == "histogram"
+            assert st["count"] == trainer.updater.iteration
+            assert snap["train/iterations"]["value"] \
+                == trainer.updater.iteration
+            assert snap["train/goodput"]["last"] > 0
+            parsed = parse_prometheus_text(
+                open(tmp_path / "metrics.prom").read())
+            assert parsed["train_step_time"]["count"] \
+                == trainer.updater.iteration
+            lines = [json.loads(l)
+                     for l in open(tmp_path / "goodput.jsonl")]
+            assert len(lines) == 2      # one per epoch
+            assert all(0 <= l["goodput"] <= 1 for l in lines)
+        finally:
+            set_recorder(prev)

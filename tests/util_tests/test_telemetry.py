@@ -90,6 +90,29 @@ class TestRing:
         # drained: the next interval starts clean
         assert rec.drain_phase_stats() == {}
 
+    def test_phase_channels_independent_and_filtered(self):
+        """open_phase_channel gives a consumer its own interval state:
+        a name filter keeps it from accumulating spans it will never
+        drain, and draining it leaves the default channel untouched."""
+        rec = TraceRecorder(capacity=64, enabled=True, rank=0)
+        rec.open_phase_channel("goodput", names=["step/dispatch"])
+        rec.record("step/dispatch", 0.01)
+        rec.record("prefetch/slot_wait", 0.5)
+        mine = rec.drain_phase_stats(channel="goodput")
+        assert list(mine) == ["step/dispatch"]     # filter held
+        assert mine["step/dispatch"]["count"] == 1
+        # the default channel still has BOTH intervals in full
+        shared = rec.drain_phase_stats()
+        assert shared["step/dispatch"]["count"] == 1
+        assert shared["prefetch/slot_wait"]["count"] == 1
+        # and the private channel's next interval starts clean
+        assert rec.drain_phase_stats(channel="goodput") == {}
+
+    def test_unknown_phase_channel_raises(self):
+        rec = TraceRecorder(capacity=8, enabled=True, rank=0)
+        with pytest.raises(KeyError):
+            rec.drain_phase_stats(channel="typo")
+
     def test_bad_capacity_rejected(self):
         with pytest.raises(ValueError):
             TraceRecorder(capacity=0)
@@ -463,6 +486,69 @@ class TestStragglerReport:
         assert retire["skew"] == pytest.approx(1.0)
         assert retire["ranks"] == 3
         assert sr.last_report["max_skew"] == pytest.approx(0.2 / 0.15)
+
+    def test_per_phase_tail_percentiles(self, recorder):
+        """Phases gain p50/p99 from the shared metrics lattice — the
+        drained stats carry per-phase histograms, ranks' histograms
+        bucket-sum, and tail skew attributes the worst p99 to a rank
+        (exact here: the sample counts sit under the histogram cap)."""
+        durations = [0.001 * (1 + i % 10) for i in range(200)]
+        for d in durations:
+            recorder.record("step/host", d)
+
+        class FakeComm:
+            inter_rank = 0
+
+            def allgather_obj(self, obj):
+                # rank 1 reports an identical distribution: merged
+                # percentiles equal the local ones and tail skew is 1
+                return [obj, obj]
+
+        sr = StragglerReport(FakeComm(), recorder=recorder, write=False)
+        sr()
+        host = sr.last_report["phases"]["step/host"]
+        assert host["p50_s"] == pytest.approx(
+            float(np.percentile(durations, 50)), rel=1e-9)
+        assert host["p99_s"] == pytest.approx(
+            float(np.percentile(durations, 99)), rel=1e-9)
+        assert host["slowest_rank_p99"] in (0, 1)
+        assert host["skew_p99"] == pytest.approx(1.0)
+        # means/skew attribution unchanged alongside the tails
+        assert host["skew"] == pytest.approx(1.0)
+
+    def test_tail_skew_attributes_slow_rank(self, recorder):
+        """A rank whose distribution has the same mean but a heavier
+        tail is exactly what the mean-based skew misses and the p99
+        skew catches."""
+        from chainermn_tpu.utils.metrics import Histogram
+
+        recorder.record("step/host", 0.01)
+
+        def row(vals):
+            h = Histogram()
+            for v in vals:
+                h.observe(v)
+            return {"step/host": {
+                "mean": sum(vals) / len(vals), "hist": h.to_snapshot()}}
+
+        balanced = [0.01] * 100
+        # same 0.01 mean, but 2% of the samples at 10x: the rank's own
+        # p99 lands on the 0.1 s tail while the merged fleet p99 (tail
+        # mass diluted to 1%) stays near 0.01 s
+        heavy = [0.8 / 98] * 98 + [0.1] * 2
+
+        class FakeComm:
+            inter_rank = 0
+
+            def allgather_obj(self, obj):
+                return [row(balanced), row(heavy)]
+
+        sr = StragglerReport(FakeComm(), recorder=recorder, write=False)
+        sr()
+        host = sr.last_report["phases"]["step/host"]
+        assert host["skew"] == pytest.approx(1.0, abs=1e-6)
+        assert host["slowest_rank_p99"] == 1
+        assert host["skew_p99"] > 1.5
 
     def test_phase_filter_drains_only_its_names(self, recorder):
         class FakeComm:
