@@ -21,7 +21,17 @@ implements MPI-ordered p2p object send/recv on top of it:
   are absorbed by bounded exponential-backoff retries (``KV_RETRIES``);
   timeouts keep one-shot semantics and the per-lane sequence counters
   only advance after a message is known to exist, so a retried verb can
-  never desynchronise the lane.
+  never desynchronise the lane.  Retries feed the metrics registry
+  (``comm/kv_retries`` counter, ``comm/kv_wait`` histogram) so a flaky
+  coordination service is visible to a scraper, not just to whoever
+  greps the logs.
+- Every payload is tagged with the channel's **mesh generation**
+  (:meth:`KVObjectChannel.set_generation` — the elastic-membership
+  epoch).  A message published under an older generation — traffic from
+  a pre-resize incarnation that survived on the store — is rejected at
+  receipt with the typed :class:`StaleGenerationError` instead of being
+  consumed as a live message by the resized world
+  (``training/elastic.py``, docs/RESILIENCE.md "Elastic resume").
 
 This is a *control-plane* channel (datasets, checkpoint agreement,
 user-level ``send_obj``), not a tensor path — tensors ride XLA
@@ -33,6 +43,28 @@ from __future__ import annotations
 import pickle
 import time
 from typing import Any
+
+
+class StaleGenerationError(RuntimeError):
+    """A received message was published under a different mesh
+    generation than this channel's current one.  After an elastic
+    resize, survivors fence their channels to the new membership epoch
+    (:class:`chainermn_tpu.training.elastic.ElasticMembership`); a
+    message from the pre-resize incarnation still sitting on the KV
+    store must surface as this typed error, never be silently consumed
+    as live traffic by the new world.  On the p2p lane the rejected
+    message IS consumed (lane advanced, keys deleted — recv is the
+    sole reader), so the lane stays usable for current-generation
+    traffic; a group allgather rejects WITHOUT deleting (its n−1
+    concurrent readers make deletion a race) and the whole collective
+    must be re-entered together.
+
+    Scope: fencing guards lanes WITHIN one coordination-service
+    incarnation (channels whose both ends moved through the same epoch
+    sequence).  Isolation between store incarnations comes from fresh
+    channel tags (the communicators' incarnation counters) and, for
+    between-run relaunches, from ``jax.distributed`` re-init handing
+    every incarnation a fresh store."""
 
 
 class DataSizeError(ValueError):
@@ -102,6 +134,25 @@ def _kv_set(setter, key: str, value) -> None:
     _kv_retry(once, "key set")
 
 
+def kv_overwrite(client, key: str, value) -> None:
+    """ONE-attempt overwrite-in-place set — the shared primitive behind
+    every periodically-republished status key (watchdog beats/metrics,
+    membership records).  No retry/backoff: these run on hot or
+    best-effort paths where a flaky service must cost one failed RPC,
+    never sleeps — callers decide whether a failure is swallowed.  The
+    legacy-client fallback is delete+set, NOT already-exists tolerance,
+    which for an overwrite-in-place key would silently freeze the value
+    (a frozen heartbeat counter reads as a dead peer)."""
+    try:
+        client.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:   # client predates allow_overwrite
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        client.key_value_set(key, value)
+
+
 def _kv_delete(client, key: str) -> None:
     """Retrying delete that also tolerates "already gone": a transient
     failure whose first attempt DID land server-side must not turn the
@@ -123,16 +174,47 @@ def _kv_retry(fn, what: str):
     times with exponential backoff; non-transient errors propagate
     immediately.  Safe for every KV verb used here: set/delete are
     idempotent (same key, same value / absent-ok), and a retried GET
-    re-reads an immutable published value."""
+    re-reads an immutable published value.
+
+    This is the choke point every KV verb funnels through, so it is
+    also where retries become observable: ``comm/kv_retries`` counts
+    the retry attempts (0 on a clean first try — the counter moving at
+    all means the coordination service is flaking) and ``comm/kv_wait``
+    records each verb's total wall time including backoff sleeps.
+    Disabled registry (the default) costs one attribute read."""
+    from chainermn_tpu.utils.metrics import get_registry
+
+    reg = get_registry()
+    # t0 armed unconditionally: a registry enabled mid-verb must record
+    # the verb's real duration, not perf_counter() minus a 0.0 sentinel
+    t0 = time.perf_counter()
+
+    def _observe(attempt: int) -> None:
+        if not reg.enabled:
+            return
+        if attempt:
+            reg.inc("comm/kv_retries", attempt)
+        reg.observe("comm/kv_wait", time.perf_counter() - t0)
+
     delay = KV_BACKOFF_BASE_S
     for attempt in range(KV_RETRIES + 1):
         try:
-            return fn()
+            out = fn()
         except Exception as e:
             if attempt >= KV_RETRIES or not _is_transient(e):
+                _observe(attempt)
                 raise
             time.sleep(delay)
             delay = min(delay * 2, KV_BACKOFF_MAX_S)
+        else:
+            _observe(attempt)
+            return out
+
+
+# Envelope marker for generation-tagged payloads — self-describing so a
+# mis-paired reader fails loudly instead of handing user code a tuple it
+# never sent.
+_GEN_ENVELOPE = "cmnobj-gen1"
 
 
 class KVObjectChannel:
@@ -145,6 +227,21 @@ class KVObjectChannel:
         self._recv_seq: dict = {}
         self._ag_seq = 0
         self._ag_frames: dict = {}  # seq -> own frame count (for lazy GC)
+        # mesh generation (elastic-membership epoch): every published
+        # payload carries it, every received payload is checked against
+        # it.  0 = the pre-elastic default; both ends of a lane move
+        # together when ElasticMembership.fence() bumps it.
+        self._generation = 0
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def set_generation(self, generation: int) -> None:
+        """Fence this channel to ``generation`` (the agreed membership
+        epoch).  From now on published messages carry it and received
+        messages must match it — see :class:`StaleGenerationError`."""
+        self._generation = int(generation)
 
     @property
     def _client(self):
@@ -165,7 +262,7 @@ class KVObjectChannel:
         the metadata key last (its presence implies every chunk is
         readable).  ``keyfn(part)`` names the keys.  Returns the frame
         count."""
-        payload = pickle.dumps(obj)
+        payload = pickle.dumps((_GEN_ENVELOPE, self._generation, obj))
         if len(payload) > MAX_OBJ_BYTES:
             raise DataSizeError(
                 f"{what} payload is {len(payload)} bytes, over the "
@@ -199,7 +296,23 @@ class KVObjectChannel:
             raise RuntimeError(
                 f"{what} corruption: expected {total} bytes, "
                 f"reassembled {len(buf)}")
-        return pickle.loads(bytes(buf))
+        msg = pickle.loads(bytes(buf))
+        if not (isinstance(msg, tuple) and len(msg) == 3
+                and msg[0] == _GEN_ENVELOPE):
+            raise RuntimeError(
+                f"{what}: payload is not a generation-tagged envelope — "
+                "sender and receiver run different channel versions")
+        gen, obj = msg[1], msg[2]
+        if gen != self._generation:
+            from chainermn_tpu.utils.metrics import get_registry
+
+            get_registry().inc("comm/stale_generation_rejected")
+            raise StaleGenerationError(
+                f"{what}: message from mesh generation {gen} rejected "
+                f"(this channel is fenced to generation "
+                f"{self._generation}) — traffic from a different "
+                "membership epoch must not be consumed as live")
+        return obj
 
     def send(self, obj: Any, src: int, dst: int) -> None:
         """Send ``obj`` on the (src, dst) lane; returns when published."""
@@ -239,6 +352,14 @@ class KVObjectChannel:
                 p, -1, s, "gmeta" if part == "meta" else "g" + part)
 
         self._ag_frames[s] = self._publish(obj, keyfn(me), "allgather_obj")
+        # A stale-generation frame propagates _collect's typed error
+        # WITHOUT deleting the rejected member's keys: unlike the p2p
+        # lane (one reader — recv consumes what it rejects), a group
+        # message has n−1 concurrent readers, and deleting under a peer
+        # still mid-read would turn its fast typed rejection into a
+        # full-timeout hang.  The orphaned keys are bounded by one
+        # message and reclaimed by the publisher's lazy GC if it ever
+        # allgathers again.
         return [
             obj if p == me else self._collect(
                 keyfn(p), f"obj allgather from process {p}")
@@ -258,10 +379,21 @@ class KVObjectChannel:
         # errors — a timeout still propagates before this line runs)
         self._recv_seq[(src, dst)] = seq + 1
         nframes = int(meta.split(",")[0])
-        obj = self._collect(
-            lambda part: self._key(src, dst, seq, part), "obj channel",
-            meta=meta)
-        for k in range(nframes):
-            _kv_delete(client, self._key(src, dst, seq, f"c{k}"))
-        _kv_delete(client, self._key(src, dst, seq, "meta"))
+
+        def _delete_message():
+            for k in range(nframes):
+                _kv_delete(client, self._key(src, dst, seq, f"c{k}"))
+            _kv_delete(client, self._key(src, dst, seq, "meta"))
+
+        try:
+            obj = self._collect(
+                lambda part: self._key(src, dst, seq, part),
+                "obj channel", meta=meta)
+        except StaleGenerationError:
+            # a rejected message is still CONSUMED: its keys are deleted
+            # so the dead slot cannot shadow a later publish landing on
+            # the same (src, dst, seq) coordinates
+            _delete_message()
+            raise
+        _delete_message()
         return obj

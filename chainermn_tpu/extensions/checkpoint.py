@@ -17,7 +17,16 @@ mount empty, see SURVEY.md §3.5).  Semantics preserved:
   skipped (fallback resume; docs/RESILIENCE.md);
 - superseded snapshot sets are garbage-collected after a successful save;
 - world size must match at restart (checked, like the reference's implicit
-  contract).
+  contract) — UNLESS the checkpointer was built ``elastic=True``: every
+  shard is stamped with a topology signature
+  (``training/elastic.topology_signature``) and a resume whose live
+  topology differs deterministically re-lays the saved state onto the
+  new world (``training/elastic.relayout_state``): replicated leaves
+  load from any clean shard, world-stacked ZeRO-1 optimizer state is
+  re-sliced bitwise-equal to a from-scratch sharding at the new size,
+  and the snapshot-riding exchange plan is invalidated so resume
+  re-tunes.  A same-topology resume never enters the re-layout path
+  (:attr:`MultiNodeCheckpointer.last_resume_mode` says which ran).
 
 TPU shift: "rank" here is ``comm.inter_rank`` (the *process*), not the
 device — with a single controller there is exactly one shard file.  What
@@ -35,7 +44,7 @@ from typing import List, Optional, Set
 
 from chainermn_tpu.utils.serialization import (
     SnapshotCorruptError,
-    load_state,
+    load_state_with_topology,
     save_state,
 )
 
@@ -66,7 +75,8 @@ class MultiNodeCheckpointer:
     priority = 30
 
     def __init__(self, comm, path: str, name: str = "snapshot",
-                 async_write: bool = False, history: int = 1):
+                 async_write: bool = False, history: int = 1,
+                 elastic: bool = False):
         self.comm = comm
         self.path = path
         self.name = name
@@ -76,6 +86,11 @@ class MultiNodeCheckpointer:
         # only fall back if an older complete set still exists
         # (docs/RESILIENCE.md recommends 2 for production jobs).
         self.history = max(int(history), 1)
+        self.elastic = bool(elastic)
+        # "exact" | "relayout" | None — which resume path the last
+        # maybe_load took (the drills pin that same-topology resumes
+        # never re-lay)
+        self.last_resume_mode = None
         self._saved_iterations: Set[int] = set()
         self._pending = None  # (thread, iteration, error_box)
 
@@ -83,20 +98,50 @@ class MultiNodeCheckpointer:
     # inventory
     # ------------------------------------------------------------------ #
 
-    def _local_iterations(self) -> Set[int]:
+    def _local_iterations(self, any_rank: bool = False) -> Set[int]:
+        """Iterations this process can see shards for on its disk —
+        own-rank files only by default; ``any_rank`` widens to every
+        rank's files (the elastic-resume inventory: after a shrink, or
+        for the grown ranks that never had a shard of their own, any
+        clean shard covers the replicated state and the full gathered
+        ZeRO stack)."""
         if not os.path.isdir(self.path):
             return set()
         found = set()
         for fn in os.listdir(self.path):
             m = _FILE_RE.match(fn)
             if (m and m.group("name") == self.name
-                    and int(m.group("rank")) == self.comm.inter_rank):
+                    and (any_rank
+                         or int(m.group("rank")) == self.comm.inter_rank)):
                 found.add(int(m.group("iter")))
         return found
 
+    def _iteration_shards(self, it: int):
+        """``(rank, path)`` of every on-disk shard of iteration ``it``,
+        own rank first then ascending — the deterministic read order of
+        the elastic borrow path."""
+        if not os.path.isdir(self.path):
+            return []
+        rows = []
+        for fn in os.listdir(self.path):
+            m = _FILE_RE.match(fn)
+            if (m and m.group("name") == self.name
+                    and int(m.group("iter")) == it):
+                rows.append((int(m.group("rank")),
+                             os.path.join(self.path, fn)))
+        me = self.comm.inter_rank
+        rows.sort(key=lambda rp: (rp[0] != me, rp[0]))
+        return rows
+
     def _common_iterations(self) -> List[int]:
-        """Iterations every process holds (the agreement allgather)."""
-        all_sets = self.comm.allgather_obj(self._local_iterations())
+        """Iterations every process holds (the agreement allgather).
+        In elastic mode the per-rank inventory is any-rank, matching
+        the widened resume discovery: after a GROW, ranks that never
+        owned a shard of an old set still see (and protect) the
+        borrowable files — otherwise the first post-grow save would
+        evict the only covering set ``history`` exists to keep."""
+        all_sets = self.comm.allgather_obj(
+            self._local_iterations(any_rank=self.elastic))
         common = set.intersection(*all_sets) if all_sets else set()
         return sorted(common)
 
@@ -120,32 +165,54 @@ class MultiNodeCheckpointer:
         return q
 
     def _checked_local_load(self, it: int):
-        """Load THIS rank's shard of iteration ``it`` through the
-        CRC-checked read path; quarantine + return ``None`` on
-        corruption, return ``None`` (no quarantine) when the file
-        vanished underneath us (a peer's concurrent GC on a shared
-        filesystem — "gone" is not "damaged").  The checked load IS the
-        verification, so each candidate set is read at most once."""
-        fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
-        path = os.path.join(self.path, fn)
-        try:
-            return load_state(path)
-        except SnapshotCorruptError as e:
+        """Load iteration ``it`` through the CRC-checked read path;
+        quarantine + return ``None`` on corruption, return ``None`` (no
+        quarantine) when the file vanished underneath us (a peer's
+        concurrent GC on a shared filesystem — "gone" is not
+        "damaged").  The checked load IS the verification, so each
+        candidate set is read at most once.
+
+        Default: THIS rank's shard only.  ``elastic=True`` adds the
+        borrow path: when the own-rank shard is missing or damaged,
+        other ranks' shards of the same iteration are tried in
+        ascending rank order (each shard holds the complete gathered
+        state — serialization's ``_host_view`` contract — so ONE clean
+        shard is the minimal covering set).  Only own-rank files are
+        ever quarantined; a peer's file is its owner's to rename."""
+        me = self.comm.inter_rank
+        if self.elastic:
+            candidates = self._iteration_shards(it)
+        else:
+            candidates = [(me, os.path.join(
+                self.path, _snapshot_filename(self.name, it, me)))]
+        for rank, path in candidates:
             try:
-                where = os.path.basename(self._quarantine(path))
-            except OSError as qe:
-                # a failing rename (EROFS, EACCES, disk error) must not
-                # unwind out of the agreement protocol — peers are
-                # blocked in the verdict allgather; vote False and let
-                # the caller's local exclusion retire the candidate
-                where = f"<quarantine failed: {qe}>"
-            _LOG.warning(
-                "rank %d: shard %s failed its integrity check and was "
-                "quarantined as %s: %s", self.comm.inter_rank, fn,
-                where, e)
-            return None
-        except FileNotFoundError:
-            return None
+                # one open: the topology comes off the same verified
+                # __meta__ record the load parsed (None = pre-elastic)
+                return load_state_with_topology(path)
+            except SnapshotCorruptError as e:
+                fn = os.path.basename(path)
+                if rank != me:
+                    _LOG.warning(
+                        "rank %d: borrowed shard %s (rank %d) failed "
+                        "its integrity check — trying the next shard: "
+                        "%s", me, fn, rank, e)
+                    continue
+                try:
+                    where = os.path.basename(self._quarantine(path))
+                except OSError as qe:
+                    # a failing rename (EROFS, EACCES, disk error) must
+                    # not unwind out of the agreement protocol — peers
+                    # are blocked in the verdict allgather; vote False
+                    # and let the caller's local exclusion retire the
+                    # candidate
+                    where = f"<quarantine failed: {qe}>"
+                _LOG.warning(
+                    "rank %d: shard %s failed its integrity check and "
+                    "was quarantined as %s: %s", me, fn, where, e)
+            except FileNotFoundError:
+                continue
+        return None
 
     # ------------------------------------------------------------------ #
     # save (extension __call__)
@@ -153,6 +220,17 @@ class MultiNodeCheckpointer:
 
     def __call__(self, trainer) -> None:
         self.save(trainer.updater, trainer)
+
+    def _topology(self, updater) -> dict:
+        """The topology signature this save is stamped with (also the
+        live signature a resume compares against)."""
+        from chainermn_tpu.training.elastic import topology_signature
+
+        return topology_signature(
+            self.comm,
+            params=getattr(updater, "params", None),
+            opt_state=getattr(updater, "opt_state", None),
+            zero1=bool(getattr(updater, "zero1", False)))
 
     def save(self, updater, trainer=None) -> None:
         from chainermn_tpu.training._resume import collect_train_state
@@ -163,6 +241,9 @@ class MultiNodeCheckpointer:
         with get_recorder().span("checkpoint/save_shard",
                                  cat="checkpoint", step=it,
                                  async_write=self.async_write):
+            topology = self._topology(updater)
+            # the signature rides __meta__ (serialization stamps it), not
+            # the state tree — strings/dicts must not become array leaves
             state = {
                 "iteration": it,
                 "world_size": self.comm.inter_size,
@@ -176,9 +257,11 @@ class MultiNodeCheckpointer:
             if self.async_write:
                 # async writes are counted at the successful join
                 # (_join_pending), where their failure would surface
-                self._save_async(os.path.join(self.path, fn), state, it)
+                self._save_async(os.path.join(self.path, fn), state, it,
+                                 topology)
                 return
-            save_state(os.path.join(self.path, fn), state)
+            save_state(os.path.join(self.path, fn), state,
+                       topology=topology)
             # counted only after the write lands: a scraper diffs this
             # against on-disk snapshots to detect losses
             get_registry().inc("checkpoint/snapshots_written")
@@ -192,7 +275,8 @@ class MultiNodeCheckpointer:
     # async write path
     # ------------------------------------------------------------------ #
 
-    def _save_async(self, path: str, state, it: int) -> None:
+    def _save_async(self, path: str, state, it: int,
+                    topology=None) -> None:
         """Overlap the file write with training (orbax-style, own
         implementation).  Ordering:
 
@@ -226,7 +310,7 @@ class MultiNodeCheckpointer:
 
         def write():
             try:
-                save_state(path, host_state)
+                save_state(path, host_state, topology=topology)
             except BaseException as e:  # surfaced at the next join
                 box["error"] = e
 
@@ -297,6 +381,22 @@ class MultiNodeCheckpointer:
             except FileNotFoundError:
                 pass
             self._saved_iterations.discard(it)
+        if self.elastic and self.comm.inter_rank == 0 \
+                and os.path.isdir(self.path):
+            # after a shrink, shards of ranks >= inter_size belong to
+            # nobody's own inventory; rank 0 reaps the superseded ones
+            # under the same protection rules (live peers' files — rank
+            # < inter_size — are their owners' to manage, never touched)
+            for fn in os.listdir(self.path):
+                m = _FILE_RE.match(fn)
+                if not m or m.group("name") != self.name:
+                    continue
+                if int(m.group("rank")) >= self.comm.inter_size \
+                        and int(m.group("iter")) not in protected:
+                    try:
+                        os.remove(os.path.join(self.path, fn))
+                    except FileNotFoundError:
+                        pass
 
     # ------------------------------------------------------------------ #
     # resume
@@ -341,8 +441,12 @@ class MultiNodeCheckpointer:
             # normally removes a bad shard from the inventory, but the
             # explicit exclusion keeps every rank's candidate sequence
             # identical — and the loop strictly descending — even when
-            # a quarantine rename itself fails (read-only filesystem)
-            mine = self._local_iterations() - rejected
+            # a quarantine rename itself fails (read-only filesystem).
+            # Elastic mode widens the inventory to any rank's shards:
+            # after a shrink (or for grown ranks that never owned one)
+            # any clean shard covers the full gathered state.
+            mine = self._local_iterations(any_rank=self.elastic) \
+                - rejected
             rows = self.comm.allgather_obj(mine)
             common = sorted(set.intersection(*rows)) if rows else []
             if not common:
@@ -354,11 +458,12 @@ class MultiNodeCheckpointer:
                         "files kept as *.corrupt", skipped)
                 return None
             it = common[-1]
-            state = self._checked_local_load(it)
-            if state is None:
+            loaded = self._checked_local_load(it)
+            if loaded is None:
                 rejected.add(it)
-            verdicts = self.comm.allgather_obj(state is not None)
+            verdicts = self.comm.allgather_obj(loaded is not None)
             if all(verdicts):
+                state, saved_topo = loaded
                 break
             skipped.append(it)
         if skipped:
@@ -370,15 +475,62 @@ class MultiNodeCheckpointer:
             from chainermn_tpu.utils.metrics import get_registry
 
             get_registry().inc("checkpoint/fallback_resumes")
-        saved_world = int(state.get("world_size", self.comm.inter_size))
-        if saved_world != self.comm.inter_size:
-            # same-world-size restart contract (the reference's implicit
-            # mpiexec -n N requirement, made explicit here)
-            raise RuntimeError(
-                f"snapshot at iteration {it} was saved with world size "
-                f"{saved_world}, but this job has {self.comm.inter_size} "
-                "processes — sharded checkpoints resume at identical world "
-                "size only (use multi_node_snapshot for resize-safe saves)")
+        from chainermn_tpu.training.elastic import (
+            relayout_state,
+            same_topology,
+        )
+
+        cur_topo = self._topology(updater)
+        if saved_topo is not None \
+                and not same_topology(saved_topo, cur_topo):
+            if not self.elastic:
+                raise RuntimeError(
+                    f"snapshot at iteration {it} was saved under a "
+                    f"different topology (world "
+                    f"{saved_topo.get('world_size')} over "
+                    f"{saved_topo.get('inter_size')} process(es) vs "
+                    f"live {cur_topo['world_size']} over "
+                    f"{cur_topo['inter_size']}) — sharded checkpoints "
+                    "resume at identical world size unless the "
+                    "checkpointer is built elastic=True "
+                    "(docs/RESILIENCE.md 'Elastic resume')")
+            state = relayout_state(state, saved_topo, cur_topo)
+            self.last_resume_mode = "relayout"
+            from chainermn_tpu.utils.metrics import get_registry
+
+            get_registry().inc("checkpoint/relayout_resumes")
+            _LOG.info(
+                "elastic resume: snapshot at iteration %d re-laid from "
+                "world %s onto world %s", it,
+                saved_topo.get("world_size"), cur_topo["world_size"])
+        else:
+            # the exact (bitwise) path: same topology, or a pre-elastic
+            # snapshot whose only recorded contract is the process count
+            saved_world = int(state.get("world_size",
+                                        self.comm.inter_size))
+            if saved_world != self.comm.inter_size:
+                # same-world-size restart contract (the reference's
+                # implicit mpiexec -n N requirement, made explicit here)
+                if self.elastic:
+                    # an elastic checkpointer landed here only because
+                    # the shard predates topology stamping — there is
+                    # no layout record to re-lay from
+                    raise RuntimeError(
+                        f"snapshot at iteration {it} was saved with "
+                        f"world size {saved_world} (this job: "
+                        f"{self.comm.inter_size} processes) and carries "
+                        "no topology stamp — it predates elastic "
+                        "resume and cannot be re-laid; restart at its "
+                        "original world size once, then new saves "
+                        "resize freely")
+                raise RuntimeError(
+                    f"snapshot at iteration {it} was saved with world "
+                    f"size {saved_world}, but this job has "
+                    f"{self.comm.inter_size} processes — sharded "
+                    "checkpoints resume at identical world size only "
+                    "(use elastic=True for topology-stamped resize-safe "
+                    "resume, or multi_node_snapshot)")
+            self.last_resume_mode = "exact"
         updater.params = state["params"]
         updater.opt_state = state["opt_state"]
         if "model_state" in state:
@@ -404,6 +556,7 @@ class MultiNodeCheckpointer:
 def create_multi_node_checkpointer(
     comm, path: str, name: str = "snapshot",
     async_write: bool = False, history: int = 1,
+    elastic: bool = False,
 ) -> MultiNodeCheckpointer:
     """Factory with the reference's exact name and signature shape.
 
@@ -416,6 +569,19 @@ def create_multi_node_checkpointer(
     how many of the newest complete sets survive garbage collection;
     use 2+ so a corrupted newest set has an older verified set for
     fallback resume to land on (docs/RESILIENCE.md).
+
+    ``elastic=True`` turns on topology-aware resume: every shard is
+    already stamped with its topology signature; with the flag, a
+    resume whose live topology differs from the stamp re-lays the
+    saved state onto the new world size deterministically (ZeRO-1
+    optimizer shards re-sliced bitwise-equal to a from-scratch
+    sharding, replicated leaves loaded from any clean shard, the
+    snapshot-riding exchange plan invalidated so resume re-tunes), and
+    shard discovery widens to any rank's files so shrunken or grown
+    worlds find the minimal covering set.  Same-topology resumes stay
+    on the exact bitwise path (``last_resume_mode == "exact"``).  See
+    docs/RESILIENCE.md "Elastic resume".
     """
     return MultiNodeCheckpointer(comm, path, name,
-                                 async_write=async_write, history=history)
+                                 async_write=async_write, history=history,
+                                 elastic=elastic)

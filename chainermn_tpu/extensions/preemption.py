@@ -49,6 +49,14 @@ class PreemptionCheckpointer:
         (raise it if host-side object collectives are expensive in a
         very large job; the grace window is seconds, so 1 is right for
         nearly everyone).
+      membership: optional
+        :class:`~chainermn_tpu.training.elastic.ElasticMembership`.
+        After the collective save, the stop is recorded on the durable
+        membership file (``note_stop``) so the relaunch — at whatever
+        world size the scheduler grants — agrees a NEW membership epoch
+        before touching the snapshot set, and resumes through the
+        checkpointer's elastic re-layout path when the world changed
+        (docs/RESILIENCE.md "Elastic resume").
     """
 
     trigger = (1, "iteration")
@@ -60,9 +68,10 @@ class PreemptionCheckpointer:
 
     def __init__(self, checkpointer, comm=None,
                  signals: Sequence[int] = (signal.SIGTERM,),
-                 check_interval: int = 1):
+                 check_interval: int = 1, membership=None):
         self.checkpointer = checkpointer
         self.comm = comm
+        self.membership = membership
         self.signaled = False
         self._signals = tuple(signals)
         self._prev_handlers = {}
@@ -121,6 +130,11 @@ class PreemptionCheckpointer:
             return
         it = trainer.updater.iteration
         self.checkpointer.save(trainer.updater, trainer)
+        if self.membership is not None:
+            # feed the elastic cycle: the durable record of this stop is
+            # what makes the relaunch's agree() bump the epoch past this
+            # incarnation even on a fresh coordination service
+            self.membership.note_stop(reason="preemption", iteration=it)
         trainer.stop(
             f"preemption signal received; checkpoint saved at "
             f"iteration {it}")

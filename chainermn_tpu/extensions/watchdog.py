@@ -50,6 +50,7 @@ from typing import Callable, Optional
 __all__ = ["TrainingWatchdog"]
 
 _KV_PREFIX = "watchdog/hb"
+_KV_METRICS_PREFIX = "watchdog/metrics"
 
 
 def _thread_stacks() -> dict:
@@ -98,6 +99,14 @@ class TrainingWatchdog:
         global :func:`chainermn_tpu.utils.telemetry.get_recorder`;
         empty when tracing is disabled.  Heartbeats are also recorded
         as instant events, so the trace itself shows the beat cadence.
+      metrics_publish_interval: minimum seconds between KV publishes of
+        this rank's metrics snapshot (``watchdog/metrics/<rank>``,
+        overwritten in place; multi-process + enabled registry only).
+        The stall report embeds a MERGED metrics snapshot
+        (``metrics`` / ``metrics_prom`` keys): the local registry
+        folded with every peer's last published snapshot — computed
+        without any collective, because a hung job cannot run one —
+        so the job's last Prometheus state ships with the diagnosis.
 
     Use::
 
@@ -120,7 +129,8 @@ class TrainingWatchdog:
                  on_stall: Optional[Callable[[dict], None]] = None,
                  report_path: Optional[str] = None,
                  exit_code: int = 42,
-                 trace_tail_events: int = 64):
+                 trace_tail_events: int = 64,
+                 metrics_publish_interval: float = 2.0):
         if stall_timeout <= 0:
             raise ValueError("stall_timeout must be > 0")
         self.stall_timeout = float(stall_timeout)
@@ -134,6 +144,8 @@ class TrainingWatchdog:
         self.report_path = report_path
         self.exit_code = exit_code
         self.trace_tail_events = int(trace_tail_events)
+        self.metrics_publish_interval = float(metrics_publish_interval)
+        self._metrics_published_m = None
         self.stall_count = 0          # reports fired (monotonic)
         self.last_report: Optional[dict] = None
         self._beats = 0
@@ -165,27 +177,74 @@ class TrainingWatchdog:
         kv = self._kv
         if kv is None:
             return
-        key = f"{_KV_PREFIX}/{self.comm.inter_rank}"
-        value = f"{self._beats},{time.time()}"
-        # ONE attempt, no retry/backoff: this runs on the training main
-        # thread every iteration, so a flaky coordination service must
-        # cost one failed RPC, never retry sleeps.  The legacy-client
-        # fallback is delete+set — NOT already-exists tolerance, which
-        # for this overwrite-in-place key would silently freeze the
-        # counter and make healthy ranks read as dead peers.
+        from chainermn_tpu.communicators._obj_channel import kv_overwrite
+
         try:
-            try:
-                kv.key_value_set(key, value, allow_overwrite=True)
-            except TypeError:  # client predates allow_overwrite
-                try:
-                    kv.key_value_delete(key)
-                except Exception:
-                    pass
-                kv.key_value_set(key, value)
+            # one attempt, no retry sleeps (kv_overwrite's contract) —
+            # this runs on the training main thread every iteration
+            kv_overwrite(kv, f"{_KV_PREFIX}/{self.comm.inter_rank}",
+                         f"{self._beats},{time.time()}")
         except Exception:
             # best-effort: a dropped beat degrades detection quality by
             # one interval, it must never kill training
             pass
+
+    def _publish_metrics(self) -> None:
+        """Best-effort KV publish of this rank's metrics snapshot, so a
+        SURVIVOR's stall report can merge a dead peer's last state.
+        Throttled (``metrics_publish_interval``); multi-process worlds
+        with an enabled registry only — everyone else pays one branch."""
+        kv = self._kv
+        if kv is None:
+            return
+        from chainermn_tpu.utils.metrics import get_registry
+
+        reg = get_registry()
+        if not reg.enabled:
+            return
+        now_m = time.monotonic()
+        if self._metrics_published_m is not None and \
+                now_m - self._metrics_published_m \
+                < self.metrics_publish_interval:
+            return
+        self._metrics_published_m = now_m
+        from chainermn_tpu.communicators._obj_channel import kv_overwrite
+
+        try:
+            kv_overwrite(kv, f"{_KV_METRICS_PREFIX}/{self.comm.inter_rank}",
+                         json.dumps(reg.snapshot(), default=float))
+        except Exception:
+            pass    # observability must never kill training
+
+    def _merged_metrics(self):
+        """The local registry snapshot folded with every peer's last
+        KV-published snapshot — a merged fleet view computed WITHOUT a
+        collective (a hung job cannot run one).  Returns the merged
+        snapshot dict (empty when the registry is disabled and no peer
+        published)."""
+        from chainermn_tpu.utils.metrics import (
+            MetricsRegistry,
+            get_registry,
+        )
+
+        merged = MetricsRegistry(enabled=True)
+        merged.load(get_registry().snapshot())
+        kv = self._kv
+        if kv is not None:
+            try:
+                entries = kv.key_value_dir_get(_KV_METRICS_PREFIX)
+            except Exception:
+                entries = []
+            me = self.comm.inter_rank
+            for key, value in entries:
+                try:
+                    rank = int(str(key).rsplit("/", 1)[-1])
+                    if rank == me:
+                        continue    # local registry is fresher
+                    merged.load(json.loads(value))
+                except (ValueError, TypeError):
+                    continue
+        return merged.snapshot()
 
     def _peer_ages(self) -> dict:
         """``{rank: seconds_since_the_READER_last_saw_its_beat_counter
@@ -252,6 +311,7 @@ class TrainingWatchdog:
                                step=iteration, beats=self._beats)
         get_registry().inc("watchdog/heartbeats")
         self._publish_beat()
+        self._publish_metrics()
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -345,6 +405,24 @@ class TrainingWatchdog:
         except Exception:
             report["trace_tail"] = []
             report["trace_enabled"] = False
+        # the job's last Prometheus state, merged across ranks from the
+        # KV-published snapshots (no collective — see _merged_metrics):
+        # a hung job ships its metrics with the diagnosis
+        try:
+            from chainermn_tpu.utils.metrics import (
+                get_registry as _get_reg,
+                to_prometheus,
+            )
+
+            snap = self._merged_metrics()
+            report["metrics"] = snap
+            report["metrics_prom"] = to_prometheus(
+                snap, labels={"rank": "merged"})
+            report["metrics_enabled"] = _get_reg().enabled
+        except Exception:
+            report["metrics"] = {}
+            report["metrics_prom"] = ""
+            report["metrics_enabled"] = False
         self.last_report = report
         path = self.report_path or "stall_report.json"
         try:

@@ -203,16 +203,28 @@ class FaultPlan:
     - ``sigterm_at_iteration`` — ``SIGTERM`` self: the preemption
       notice; with an async checkpointer on the same tick the signal
       lands MID-write, exercising the join-on-crash path.
+      ``sigterm_rank`` (default ``None`` = every rank) restricts the
+      signal to ONE rank — the real preemption shape, where a single
+      host gets the notice and the rest learn of it through
+      ``PreemptionCheckpointer``'s collective flag OR-reduce.
     - ``corrupt_at_iteration`` + ``corrupt_path`` — flip
       ``corrupt_n_bytes`` bytes of that file (:func:`corrupt_file`).
     - ``delay_at_iteration`` + ``delay_rank`` + ``delay_seconds`` —
       stall ONE rank past a watchdog threshold.
     - ``nan_at_iteration`` — poison the updater's params with NaN so
       the NEXT step's loss is non-finite (drives ``FailOnNonNumber``).
+    - ``resize_at_iteration`` + ``resize_to`` — the shrink/grow drill:
+      checkpoint through the injector's ``checkpointer`` (topology
+      stamped) and stop the trainer cleanly, recording that the relaunch
+      should run at world size ``resize_to``.  The driving test then
+      rebuilds the job on the new topology and resumes through the
+      checkpointer's elastic re-layout path (docs/RESILIENCE.md
+      "Elastic resume").
     """
 
     kill_at_iteration: Optional[int] = None
     sigterm_at_iteration: Optional[int] = None
+    sigterm_rank: Optional[int] = None
     corrupt_at_iteration: Optional[int] = None
     corrupt_path: Optional[str] = None
     corrupt_n_bytes: int = 8
@@ -220,6 +232,8 @@ class FaultPlan:
     delay_rank: int = 0
     delay_seconds: float = 0.0
     nan_at_iteration: Optional[int] = None
+    resize_at_iteration: Optional[int] = None
+    resize_to: int = 0
     seed: int = 0
 
     def to_json(self) -> str:
@@ -242,9 +256,12 @@ class FaultInjector:
     trigger = (1, "iteration")
     priority = 1
 
-    def __init__(self, plan: FaultPlan, comm=None):
+    def __init__(self, plan: FaultPlan, comm=None, checkpointer=None):
         self.plan = plan
         self.comm = comm
+        # the resize action saves through a real checkpointer so the
+        # stopped state is topology-stamped for the elastic relaunch
+        self.checkpointer = checkpointer
         self.fired: list = []
 
     def _rank(self) -> int:
@@ -268,7 +285,21 @@ class FaultInjector:
             corrupt_file(plan.corrupt_path, plan.corrupt_n_bytes,
                          seed=plan.seed)
             self.fired.append(("corrupt", it))
-        if plan.sigterm_at_iteration == it:
+        if plan.resize_at_iteration == it:
+            if self.checkpointer is None:
+                raise RuntimeError(
+                    "FaultPlan.resize_at_iteration needs "
+                    "FaultInjector(checkpointer=...) — the resize drill "
+                    "must save a topology-stamped snapshot to resume "
+                    "from")
+            self.checkpointer.save(trainer.updater, trainer)
+            self.fired.append(("resize", it, plan.resize_to))
+            trainer.stop(
+                f"elastic resize drill: snapshot saved at iteration "
+                f"{it}; relaunch at world={plan.resize_to}")
+        if plan.sigterm_at_iteration == it and (
+                plan.sigterm_rank is None
+                or self._rank() == plan.sigterm_rank):
             self.fired.append(("sigterm", it))
             os.kill(os.getpid(), _signal.SIGTERM)
         if plan.kill_at_iteration == it:

@@ -1,6 +1,15 @@
 """Training integration layer (reference L3/L5: optimizers, evaluators,
 trainer extension protocol)."""
 
+from .elastic import (
+    ElasticMembership,
+    MembershipRecord,
+    RelayoutError,
+    StaleGenerationError,
+    relayout_state,
+    same_topology,
+    topology_signature,
+)
 from .evaluators import (
     Evaluator,
     GenericMultiNodeEvaluator,
@@ -19,14 +28,21 @@ from .triggers import IntervalTrigger, get_trigger
 from .updater import StandardUpdater, default_converter, fuse_steps
 
 __all__ = [
+    "ElasticMembership",
     "Evaluator",
+    "MembershipRecord",
     "PlannedOptimizer",
     "GenericMultiNodeEvaluator",
     "IntervalTrigger",
     "LogReport",
     "PrintReport",
+    "RelayoutError",
+    "StaleGenerationError",
     "StandardUpdater",
     "Trainer",
+    "relayout_state",
+    "same_topology",
+    "topology_signature",
     "create_multi_node_evaluator",
     "create_multi_node_optimizer",
     "cross_replica_mean",

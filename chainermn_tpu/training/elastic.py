@@ -1,0 +1,562 @@
+"""Elastic resume — topology signatures, shrink/grow re-layout of
+sharded train state, and membership epochs on the coordination store.
+
+PR 3's resilience layer assumed a fixed world: resume demanded the same
+topology, and a preempted fleet that came back smaller simply could not
+use its own snapshots.  This module is the missing spine
+(docs/RESILIENCE.md "Elastic resume"):
+
+- :func:`topology_signature` — the layout a snapshot was written under:
+  world size (mesh members), process count, mesh shape/axis names, and
+  the per-leaf shard layout of every ZeRO-1 optimizer-state leaf
+  ("Automatic Cross-Replica Sharding of Weight Update", PAPERS.md
+  2004.13336 — the layout that must survive a resize).  Stamped into
+  every shard's ``__meta__`` (``utils/serialization.py``) and into the
+  state dict itself.
+- :func:`relayout_state` — the deterministic re-slicing of a saved
+  state onto a new world size W′ ≠ W, following the memory-efficient
+  array-redistribution formulation (PAPERS.md 2112.01075) in its
+  host-side form: each world-stacked ZeRO-1 shard leaf is concatenated
+  back to its true flat extent (the minimal covering read — padding
+  never travels), re-padded for W′ and re-split, so the result is
+  BITWISE what a from-scratch sharding of the gathered state at W′
+  would hold.  Replicated leaves pass through untouched; the
+  snapshot-riding exchange plan is dropped (the plan cache is keyed by
+  topology, so resume re-tunes rather than replaying a stale program).
+- :class:`ElasticMembership` — epoch-numbered membership records:
+  survivors of a preemption agree a new world size + rank assignment
+  collectively (over the coordination-service KV store only — the
+  data plane may be the thing that died) BEFORE any process touches
+  the snapshot set, and :meth:`ElasticMembership.fence` tags every
+  object channel with the agreed epoch so stale-generation traffic
+  from the previous incarnation is rejected
+  (:class:`~chainermn_tpu.communicators._obj_channel.StaleGenerationError`).
+
+The consumer is ``MultiNodeCheckpointer(..., elastic=True)``: on resume
+it compares the stamped signature against the live topology and enters
+the re-layout path only on a mismatch — a same-topology resume stays on
+the exact (bitwise) path and never re-slices anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from chainermn_tpu.communicators._obj_channel import (
+    KVObjectChannel,
+    StaleGenerationError,
+)
+
+_LOG = logging.getLogger(__name__)
+
+__all__ = [
+    "ElasticMembership",
+    "MembershipRecord",
+    "RelayoutError",
+    "StaleGenerationError",
+    "TOPOLOGY_FORMAT",
+    "gather_zero1_leaves",
+    "relayout_state",
+    "same_topology",
+    "shard_zero1_leaves",
+    "topology_signature",
+]
+
+# Bump when the signature's meaning changes: a format mismatch is a
+# topology mismatch (conservative — the re-layout path validates, the
+# exact path must never silently trust a record it cannot read).
+TOPOLOGY_FORMAT = 1
+
+# The scalar fields two signatures must agree on to count as the SAME
+# topology (the per-leaf layouts are derived from these + the tree).
+_COMPARE_KEYS = ("format", "world_size", "inter_size", "axis_names",
+                 "mesh_shape", "zero1")
+
+
+class RelayoutError(RuntimeError):
+    """A saved state could not be deterministically re-laid onto the new
+    topology (missing/garbled layout record, a leaf the signature cannot
+    identify, zero1-mode mismatch).  Typed so the checkpointer can
+    distinguish "this resize is unsafe" from file corruption."""
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+# --------------------------------------------------------------------- #
+# topology signatures
+# --------------------------------------------------------------------- #
+
+def _leaf_paths(tree) -> List[tuple]:
+    from jax.tree_util import tree_flatten_with_path
+
+    paths, _ = tree_flatten_with_path(tree)
+    return paths
+
+
+def _zero1_leaf_layout(opt_state, params, world: int) -> List[dict]:
+    """Per-leaf layout records for a world-stacked ZeRO-1 state tree, in
+    flattened-leaf order:
+
+    - ``{"kind": "shard", "size": N}`` — a ``(world, ceil(N/world))``
+      stack of 1-D parameter shards (``zero1_optimizer``'s
+      ``_leaf_shard`` layout); ``N`` is the mirrored parameter's true
+      element count, identified by the same longest-path-suffix match
+      ``shard_opt_state`` uses (``mu.blocks.w`` ↔ ``blocks.w``).
+    - ``{"kind": "stack"}`` — a leading member axis over per-member
+      replicas (adam's ``count``): every row identical by construction.
+    - ``{"kind": "rep"}`` — no member axis at all (replicated scalar).
+    """
+    # shapes only — never np.asarray a leaf here: multi-process-sharded
+    # arrays are not fully addressable and must not be pulled to host
+    # just to record their layout
+    by_path: Dict[tuple, int] = {}
+    for path, p in _leaf_paths(params):
+        shape = tuple(np.shape(p))
+        by_path[tuple(str(k) for k in path)] = (
+            int(np.prod(shape, dtype=np.int64)) if shape else 1)
+
+    layouts = []
+    for path, leaf in _leaf_paths(opt_state):
+        shape = tuple(np.shape(leaf))
+        keys = tuple(str(k) for k in path)
+        spec: dict = None
+        if len(shape) == 2 and shape[0] == world:
+            # longest matching path suffix whose padded shard width
+            # equals this stack's — includes the empty suffix for a
+            # bare-array params "tree"
+            for start in range(len(keys) + 1):
+                n = by_path.get(keys[start:])
+                if n is not None and _ceil_div(n, world) == shape[1]:
+                    spec = {"kind": "shard", "size": n}
+                    break
+        if spec is None:
+            if len(shape) >= 1 and shape[0] == world:
+                spec = {"kind": "stack"}
+            else:
+                spec = {"kind": "rep"}
+        layouts.append(spec)
+    return layouts
+
+
+def topology_signature(comm, params=None, opt_state=None,
+                       zero1: bool = False) -> dict:
+    """The JSON-safe layout record a snapshot is stamped with.
+
+    ``world_size`` is the mesh-member count (``comm.size`` — the axis
+    ZeRO-1 shards over), ``inter_size`` the process count; with
+    ``zero1`` and both trees given, ``opt_leaves`` records every
+    optimizer-state leaf's shard layout so :func:`relayout_state` can
+    re-slice it onto a different world deterministically."""
+    mesh = getattr(comm, "mesh", None)
+    sig = {
+        "format": TOPOLOGY_FORMAT,
+        "world_size": int(getattr(comm, "size", 1)),
+        "inter_size": int(getattr(comm, "inter_size", 1)),
+        "axis_names": (list(mesh.axis_names) if mesh is not None
+                       else None),
+        "mesh_shape": ([int(s) for s in np.asarray(mesh.devices).shape]
+                       if mesh is not None else None),
+        "zero1": bool(zero1),
+    }
+    if zero1 and params is not None and opt_state is not None:
+        sig["opt_leaves"] = _zero1_leaf_layout(
+            opt_state, params, sig["world_size"])
+    return sig
+
+
+def same_topology(a: Optional[dict], b: Optional[dict]) -> bool:
+    """Whether two signatures describe the SAME topology (the exact
+    bitwise resume path).  ``None`` (a pre-elastic snapshot) never
+    matches — the caller decides whether legacy rules apply."""
+    if a is None or b is None:
+        return False
+    return all(a.get(k) == b.get(k) for k in _COMPARE_KEYS)
+
+
+# --------------------------------------------------------------------- #
+# shrink/grow re-layout
+# --------------------------------------------------------------------- #
+
+def _rows_identical(arr: np.ndarray) -> bool:
+    first = arr[:1]
+    return all(arr[i:i + 1].tobytes() == first.tobytes()
+               for i in range(1, arr.shape[0]))
+
+
+def _relayout_leaf(leaf, spec: dict, new_world: int, where: str):
+    arr = np.asarray(leaf)
+    kind = spec.get("kind")
+    if kind == "rep":
+        return arr
+    if kind == "shard":
+        if arr.ndim != 2:
+            raise RelayoutError(
+                f"{where}: recorded as a shard stack but has shape "
+                f"{arr.shape} — the snapshot's layout record does not "
+                "describe this tree")
+        size = int(spec["size"])
+        flat = arr.reshape(-1)
+        if flat.size < size:
+            raise RelayoutError(
+                f"{where}: shard stack holds {flat.size} elements, "
+                f"fewer than the recorded parameter size {size}")
+        s2 = _ceil_div(size, new_world)
+        out = np.zeros((new_world * s2,), dtype=arr.dtype)
+        # the minimal covering read: only the true extent travels, the
+        # old padding is dropped and fresh zero padding is laid exactly
+        # where a from-scratch sharding at new_world would put it
+        out[:size] = flat[:size]
+        return out.reshape(new_world, s2)
+    if kind == "stack":
+        if arr.ndim < 1 or arr.shape[0] < 1:
+            raise RelayoutError(f"{where}: empty member stack")
+        if not _rows_identical(arr):
+            raise RelayoutError(
+                f"{where}: member-stacked leaf rows differ but the "
+                "layout record did not identify it as a parameter "
+                "shard — refusing to re-slice state whose layout is "
+                "unknown (a silent slice would corrupt the optimizer)")
+        if new_world <= arr.shape[0]:
+            return arr[:new_world]
+        reps = [arr] + [arr[:1]] * (new_world - arr.shape[0])
+        return np.concatenate(reps, axis=0)
+    raise RelayoutError(f"{where}: unknown layout kind {kind!r}")
+
+
+def relayout_state(state: dict, topo_old: dict, topo_new: dict) -> dict:
+    """Re-lay a checkpointer state dict saved under ``topo_old`` onto
+    ``topo_new``'s world size.  Deterministic and host-side: every rank
+    computes the identical result from the same shard bytes.
+
+    Replicated entries (``params``, ``model_state``) pass through;
+    world-stacked ZeRO-1 optimizer state is re-sliced per its recorded
+    layout (bitwise-equal to a from-scratch sharding of the gathered
+    state at the new world — the drill in
+    ``tests/extension_tests/test_elastic_checkpoint.py`` pins this);
+    the snapshot-riding exchange plan is dropped so resume re-tunes for
+    the new topology instead of replaying a stale program."""
+    if bool(topo_old.get("zero1")) != bool(topo_new.get("zero1")):
+        raise RelayoutError(
+            f"snapshot was saved with zero1={topo_old.get('zero1')} but "
+            f"this job runs zero1={topo_new.get('zero1')} — elastic "
+            "resume re-lays a sharding, it does not convert between "
+            "replicated and ZeRO-1 optimizer state")
+    new_world = int(topo_new["world_size"])
+    out = dict(state)
+    if topo_old.get("zero1"):
+        layouts = topo_old.get("opt_leaves")
+        if layouts is None:
+            raise RelayoutError(
+                "snapshot records zero1=True but carries no per-leaf "
+                "layout — it predates the elastic-resume format and "
+                "can only restart at its original topology")
+        import jax
+
+        leaves, treedef = jax.tree.flatten(state["opt_state"])
+        if len(leaves) != len(layouts):
+            raise RelayoutError(
+                f"snapshot records {len(layouts)} optimizer-state "
+                f"leaves but the tree holds {len(leaves)} — the model "
+                "changed shape as well as the world; elastic resume "
+                "only re-lays the same model")
+        new_leaves = [
+            _relayout_leaf(leaf, spec, new_world, f"opt_state leaf {i}")
+            for i, (leaf, spec) in enumerate(zip(leaves, layouts))]
+        out["opt_state"] = jax.tree.unflatten(treedef, new_leaves)
+    ts = state.get("train_state")
+    if isinstance(ts, dict) and "exchange_plan" in ts:
+        ts = dict(ts)
+        ts.pop("exchange_plan")
+        out["train_state"] = ts
+        _LOG.info(
+            "elastic resume: dropped the snapshot-riding exchange plan "
+            "(tuned for world=%s) — the new topology re-tunes",
+            topo_old.get("world_size"))
+    return out
+
+
+def gather_zero1_leaves(opt_state, layouts: List[dict]):
+    """Gather a world-stacked ZeRO-1 state tree to its full flat values
+    (``shard`` leaves → 1-D true-extent arrays, ``stack`` leaves → one
+    representative row, ``rep`` leaves unchanged) — the host-side
+    equivalent of the in-program all-gather, used by the drills to
+    prove re-layout against a from-scratch gather."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(opt_state)
+    if len(leaves) != len(layouts):
+        raise RelayoutError(
+            f"{len(layouts)} layout records for {len(leaves)} leaves")
+    out = []
+    for leaf, spec in zip(leaves, layouts):
+        arr = np.asarray(leaf)
+        if spec["kind"] == "shard":
+            out.append(arr.reshape(-1)[: int(spec["size"])])
+        elif spec["kind"] == "stack":
+            out.append(arr[0])
+        else:
+            out.append(arr)
+    return jax.tree.unflatten(treedef, out)
+
+
+def shard_zero1_leaves(full_state, layouts: List[dict], world: int):
+    """Inverse of :func:`gather_zero1_leaves`: lay a gathered state onto
+    ``world`` members from scratch (pad to ``ceil(N/world)·world``,
+    split contiguously, re-stack) — the reference layout
+    :func:`relayout_state` must match bitwise."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(full_state)
+    if len(leaves) != len(layouts):
+        raise RelayoutError(
+            f"{len(layouts)} layout records for {len(leaves)} leaves")
+    out = []
+    for leaf, spec in zip(leaves, layouts):
+        arr = np.asarray(leaf)
+        if spec["kind"] == "shard":
+            size = int(spec["size"])
+            s = _ceil_div(size, world)
+            flat = np.zeros((world * s,), dtype=arr.dtype)
+            flat[:size] = arr.reshape(-1)[:size]
+            out.append(flat.reshape(world, s))
+        elif spec["kind"] == "stack":
+            out.append(np.concatenate([arr[None]] * world, axis=0))
+        else:
+            out.append(arr)
+    return jax.tree.unflatten(treedef, out)
+
+
+# --------------------------------------------------------------------- #
+# membership epochs
+# --------------------------------------------------------------------- #
+
+@dataclasses.dataclass
+class MembershipRecord:
+    """One agreed membership epoch: who is in the world and in what
+    order.  ``members`` is the sorted list of surviving process ids;
+    a process's new rank is its index in that list."""
+
+    epoch: int
+    world_size: int
+    members: List[int]
+    created: float = 0.0
+
+    def rank_of(self, process_id: int) -> int:
+        return self.members.index(process_id)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MembershipRecord":
+        return cls(epoch=int(d["epoch"]),
+                   world_size=int(d["world_size"]),
+                   members=[int(m) for m in d["members"]],
+                   created=float(d.get("created", 0.0)))
+
+
+class ElasticMembership:
+    """Epoch-numbered membership agreement over the coordination store.
+
+    Protocol (``agree()``, collective over the CURRENT incarnation's
+    processes): every survivor contributes ``(process_id,
+    last_known_epoch)`` through a KV-only allgather — deliberately not
+    an XLA collective, because membership must be agreeable exactly
+    when the data plane is the thing that died — and every process
+    folds the same rows into the same record: members = the sorted
+    contributor ids, epoch = max(previous epochs) + 1.  The first
+    member persists the record beside the snapshots (``path``, atomic
+    write) so epochs survive relaunch, and publishes it on the KV
+    store (``elastic/membership/<epoch>``) for tooling.  Only after
+    ``agree()`` returns does anyone touch the snapshot set — the
+    checkpointer's re-layout path then maps the agreed world onto the
+    saved shards.
+
+    ``fence(...)`` tags object channels with the agreed epoch
+    (:meth:`KVObjectChannel.set_generation`): traffic from a previous
+    incarnation that survived on the store is then rejected with
+    :class:`StaleGenerationError` instead of being consumed by the
+    resized world.
+
+    ``PreemptionCheckpointer(..., membership=...)`` feeds the cycle:
+    on the preemption notice it records the stop (``note_stop``) after
+    the collective save, so the relaunch — at whatever world size the
+    scheduler grants — bumps the epoch past every incarnation that ever
+    wrote a snapshot.
+
+    Bootstrap contract: ``agree()`` itself necessarily runs BEFORE any
+    epoch is agreed, so its own allgather cannot be generation-fenced.
+    Between-run relaunches are safe because ``jax.distributed`` re-init
+    hands every incarnation a FRESH coordination store (a dead world's
+    keys do not survive into the new one) plus per-process incarnation-
+    salted channel tags for repeated agreements within one store.  A
+    future WITHIN-run resize over a store that outlives its world (the
+    ROADMAP item) must additionally salt the bootstrap tag with an
+    incarnation identity survivors already share — e.g. the snapshot
+    directory's persisted epoch — before this protocol is safe there.
+    """
+
+    KV_PREFIX = "elastic"
+
+    # per-process creation counter: distinct ElasticMembership objects
+    # must not share KV lanes (their allgather sequence numbers restart
+    # at 0).  SPMD-consistent because every process constructs its
+    # memberships in the same order — the same program-identity
+    # discipline the communicators already assume.
+    _INCARNATIONS = 0
+
+    def __init__(self, comm, path: Optional[str] = None,
+                 filename: str = "membership.json",
+                 timeout_ms: int = 60_000):
+        self.comm = comm
+        self.path = path
+        self.filename = filename
+        self.record: Optional[MembershipRecord] = None
+        inc = ElasticMembership._INCARNATIONS
+        ElasticMembership._INCARNATIONS = inc + 1
+        self._channel = KVObjectChannel(
+            tag=f"elastic-membership-i{inc}", timeout_ms=timeout_ms)
+
+    # -- persistence --------------------------------------------------- #
+
+    @property
+    def _file(self) -> Optional[str]:
+        if self.path is None:
+            return None
+        return os.path.join(self.path, self.filename)
+
+    def _read_file(self) -> dict:
+        f = self._file
+        if f is None:
+            return {}
+        try:
+            with open(f) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return {}
+
+    def _write_file(self, payload: dict) -> None:
+        f = self._file
+        if f is None:
+            return
+        os.makedirs(os.path.dirname(f) or ".", exist_ok=True)
+        tmp = f"{f}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+        os.replace(tmp, f)
+
+    def stored_epoch(self) -> int:
+        """The newest epoch this process can see locally (the persisted
+        record; 0 when none exists — the first incarnation agrees
+        epoch 1)."""
+        return int(self._read_file().get("epoch", 0))
+
+    # -- the KV side --------------------------------------------------- #
+
+    @property
+    def _kv(self):
+        """Coordination-service client, or ``None`` outside a
+        multi-process world (single-controller jobs need no KV)."""
+        if int(getattr(self.comm, "inter_size", 1)) <= 1:
+            return None
+        from jax._src import distributed
+
+        return distributed.global_state.client
+
+    def _publish_record(self, rec: MembershipRecord) -> None:
+        kv = self._kv
+        if kv is None:
+            return
+        from chainermn_tpu.communicators._obj_channel import kv_overwrite
+
+        payload = json.dumps(rec.to_dict(), sort_keys=True)
+        for key, value in ((f"{self.KV_PREFIX}/epoch", str(rec.epoch)),
+                           (f"{self.KV_PREFIX}/membership/{rec.epoch}",
+                            payload)):
+            try:
+                kv_overwrite(kv, key, value)
+            except Exception:
+                pass    # best-effort exposition; the file is durable
+
+    # -- the collective ------------------------------------------------ #
+
+    def agree(self) -> MembershipRecord:
+        """Agree this incarnation's membership record (COLLECTIVE: every
+        surviving process must call).  Returns the record; also stored
+        as :attr:`record`."""
+        me = int(getattr(self.comm, "inter_rank", 0))
+        n = int(getattr(self.comm, "inter_size", 1))
+        prev = self.stored_epoch()
+        if n <= 1:
+            rows = [(me, prev)]
+        else:
+            rows = self._channel.allgather(
+                (me, prev), list(range(n)), me)
+        members = sorted(int(r) for r, _ in rows)
+        epoch = max(int(p) for _, p in rows) + 1
+        rec = MembershipRecord(epoch=epoch, world_size=len(members),
+                               members=members, created=time.time())
+        if me == members[0]:
+            self._write_file(rec.to_dict())
+            self._publish_record(rec)
+        self.record = rec
+        _LOG.info(
+            "elastic membership epoch %d agreed: world_size=%d "
+            "members=%s (this process: rank %d)",
+            epoch, rec.world_size, members, rec.rank_of(me))
+        return rec
+
+    def fence(self, *targets) -> int:
+        """Fence object channels to the agreed epoch.  Each target is a
+        :class:`KVObjectChannel` or anything carrying one as
+        ``_obj_channel`` (a communicator).  Returns the generation
+        set.  Must run AFTER :meth:`agree`."""
+        if self.record is None:
+            raise RuntimeError(
+                "fence() before agree() — there is no agreed epoch to "
+                "fence to")
+        gen = self.record.epoch
+        for t in targets:
+            chan = getattr(t, "_obj_channel", t)
+            if not hasattr(chan, "set_generation"):
+                raise TypeError(
+                    f"cannot fence {type(t).__name__}: no object "
+                    "channel found")
+            chan.set_generation(gen)
+        return gen
+
+    def note_stop(self, reason: str = "",
+                  iteration: Optional[int] = None) -> None:
+        """Record that this incarnation stopped deliberately (the
+        preemption path calls this after its collective save), so the
+        relaunch's ``agree()`` bumps past this epoch even on a fresh
+        coordination service.  First member writes; others no-op."""
+        me = int(getattr(self.comm, "inter_rank", 0))
+        writer = (self.record.members[0] if self.record is not None
+                  else 0)
+        if me != writer:
+            return
+        if self._file is None:
+            _LOG.warning(
+                "ElasticMembership.note_stop: no durable path was "
+                "configured (path=None), so this stop is NOT recorded "
+                "— a relaunch cannot bump the epoch past this "
+                "incarnation; pass path=<snapshot dir> to get the "
+                "documented preemption→relaunch cycle")
+            return
+        payload = self._read_file()
+        if self.record is not None:
+            payload.update(self.record.to_dict())
+        payload.setdefault("epoch", self.stored_epoch())
+        payload["stopped"] = {"reason": reason, "iteration": iteration,
+                              "ts": time.time()}
+        self._write_file(payload)

@@ -29,7 +29,8 @@ import zlib
 import jax
 import numpy as np
 
-__all__ = ["SnapshotCorruptError", "load_state", "save_state",
+__all__ = ["SnapshotCorruptError", "load_state",
+           "load_state_with_topology", "read_topology", "save_state",
            "verify_state"]
 
 
@@ -68,8 +69,15 @@ def _leaf_crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
-def save_state(path: str, pytree) -> None:
-    """Atomically write ``pytree`` (arrays / numeric scalars) to ``path``."""
+def save_state(path: str, pytree, topology=None) -> None:
+    """Atomically write ``pytree`` (arrays / numeric scalars) to ``path``.
+
+    ``topology`` (optional, a JSON-safe dict — see
+    :func:`chainermn_tpu.training.elastic.topology_signature`) is stamped
+    into the ``__meta__`` record so a resume at a DIFFERENT world size can
+    probe what layout the shard was written under (:func:`read_topology`)
+    without unpickling leaf data into a tree.  Snapshots without it load
+    exactly as before — the stamp is additive."""
     from chainermn_tpu.utils.telemetry import get_recorder
 
     with get_recorder().span("checkpoint/save", cat="checkpoint",
@@ -84,9 +92,11 @@ def save_state(path: str, pytree) -> None:
         dtypes = [str(np.asarray(v).dtype) for v in leaves]
         crcs = [_leaf_crc(payload[f"leaf_{i:05d}"])
                 for i in range(len(leaves))]
-        meta_bytes = pickle.dumps(
-            {"treedef": treedef, "dtypes": dtypes, "crcs": crcs,
-             "meta_crc_excluded": True})
+        meta = {"treedef": treedef, "dtypes": dtypes, "crcs": crcs,
+                "meta_crc_excluded": True}
+        if topology is not None:
+            meta["topology"] = topology
+        meta_bytes = pickle.dumps(meta)
         # the meta record guards itself too: its own CRC rides in a
         # separate tiny array, so a flipped bit inside the pickle is a
         # typed error, not an unpickling crash
@@ -177,9 +187,37 @@ def verify_state(path: str) -> None:
             pass
 
 
+def read_topology(path: str):
+    """The topology signature stamped into ``path``'s ``__meta__`` (or
+    ``None`` for snapshots written before the elastic-resume layer).
+    Reads and CRC-checks only the meta record — leaf payloads are never
+    touched, so probing every candidate shard of a resize resume costs
+    one small read per file, not a full load.  Raises
+    :class:`SnapshotCorruptError` on a damaged archive/meta;
+    ``FileNotFoundError`` propagates ("gone" is not "damaged")."""
+    try:
+        z = np.load(path, allow_pickle=False)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise SnapshotCorruptError(
+            f"{path}: not a readable npz archive "
+            f"({type(e).__name__}: {e})") from e
+    with z:
+        return _read_meta(z, path).get("topology")
+
+
 def load_state(path: str):
     """Inverse of :func:`save_state`; returns the restored pytree.
     Raises :class:`SnapshotCorruptError` on any integrity failure."""
+    return load_state_with_topology(path)[0]
+
+
+def load_state_with_topology(path: str):
+    """Like :func:`load_state` but returns ``(pytree, topology)`` —
+    the stamped signature comes from the same already-verified
+    ``__meta__`` record, so the elastic resume path pays no second
+    archive open (``None`` for pre-elastic snapshots)."""
     import ml_dtypes  # noqa: F401  (registers bfloat16/fp8 with numpy)
 
     from chainermn_tpu.utils.telemetry import get_recorder
@@ -202,4 +240,5 @@ def load_state(path: str):
                 arr = arr.view(want)
             leaves.append(arr)
         sp.set(n_leaves=len(leaves))
-    return jax.tree.unflatten(meta["treedef"], leaves)
+    return (jax.tree.unflatten(meta["treedef"], leaves),
+            meta.get("topology"))

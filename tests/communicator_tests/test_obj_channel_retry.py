@@ -173,3 +173,135 @@ class TestChannelUnderFlakes:
         chan.send(payload, src=2, dst=0)
         client.fail_first = client.calls + 3
         assert chan.recv(src=2, dst=0) == payload
+
+
+class TestRetryMetrics:
+    """Satellite of the elastic PR: retries were invisible to the
+    scraper — the ``_kv_retry`` choke point now feeds ``comm/kv_retries``
+    (a counter of retry attempts) and ``comm/kv_wait`` (a histogram of
+    per-verb wall time including backoff sleeps)."""
+
+    @pytest.fixture()
+    def registry(self):
+        from chainermn_tpu.utils.metrics import (
+            MetricsRegistry,
+            set_registry,
+        )
+
+        reg = MetricsRegistry(enabled=True)
+        prev = set_registry(reg)
+        yield reg
+        set_registry(prev)
+
+    def test_clean_call_counts_no_retries(self, registry):
+        assert _kv_retry(lambda: "ok", "test") == "ok"
+        snap = registry.snapshot()
+        assert "comm/kv_retries" not in snap
+        assert snap["comm/kv_wait"]["count"] == 1
+
+    def test_transient_flakes_count_retries_and_wait(self, registry):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("UNAVAILABLE")
+            return "ok"
+
+        assert _kv_retry(fn, "test") == "ok"
+        snap = registry.snapshot()
+        assert snap["comm/kv_retries"]["value"] == 2
+        assert snap["comm/kv_wait"]["count"] == 1
+        # the recorded wait includes the two backoff sleeps
+        assert snap["comm/kv_wait"]["max"] >= 2 * 0.001
+
+    def test_exhausted_retries_still_recorded(self, registry):
+        def fn():
+            raise RuntimeError("UNAVAILABLE forever")
+
+        with pytest.raises(RuntimeError):
+            _kv_retry(fn, "test")
+        snap = registry.snapshot()
+        assert snap["comm/kv_retries"]["value"] \
+            == _obj_channel.KV_RETRIES
+        assert snap["comm/kv_wait"]["count"] == 1
+
+    def test_disabled_registry_records_nothing(self):
+        from chainermn_tpu.utils.metrics import get_registry
+
+        assert not get_registry().enabled  # the production default
+        assert _kv_retry(lambda: 1, "test") == 1
+        assert len(get_registry()) == 0
+
+
+class TestGenerationFencing:
+    """Membership-epoch fencing: a message published under an older
+    mesh generation must be REJECTED at receipt (typed
+    ``StaleGenerationError``), never consumed as live traffic by the
+    resized world — and the lane stays usable for current-generation
+    messages afterwards."""
+
+    def test_stale_generation_rejected_then_lane_recovers(
+            self, monkeypatch):
+        from chainermn_tpu.communicators._obj_channel import (
+            StaleGenerationError,
+        )
+
+        client = _FlakyClient()
+        chan = _channel(client, monkeypatch)
+        assert chan.generation == 0
+        chan.send("pre-resize", src=0, dst=1)   # published under gen 0
+        # the survivors agree a new membership epoch and fence
+        chan.set_generation(1)
+        with pytest.raises(StaleGenerationError, match="generation 0"):
+            chan.recv(src=0, dst=1)
+        # the rejected message is CONSUMED: lane advanced AND its keys
+        # deleted, so the dead slot cannot shadow a later publish onto
+        # the same (src, dst, seq) coordinates
+        assert not [k for k in client.store if k.startswith("t/0.1.0/")]
+        chan.send("post-resize", src=0, dst=1)
+        assert chan.recv(src=0, dst=1) == "post-resize"
+
+    def test_future_generation_also_rejected(self, monkeypatch):
+        from chainermn_tpu.communicators._obj_channel import (
+            StaleGenerationError,
+        )
+
+        client = _FlakyClient()
+        chan = _channel(client, monkeypatch)
+        chan.set_generation(3)
+        chan.send("from-the-future", src=1, dst=0)
+        chan.set_generation(2)   # this end never saw epoch 3
+        with pytest.raises(StaleGenerationError, match="generation 3"):
+            chan.recv(src=1, dst=0)
+
+    def test_allgather_carries_generation(self, monkeypatch):
+        client = _FlakyClient()
+        chan = _channel(client, monkeypatch)
+        chan.set_generation(5)
+        # single-member group: the payload still round-trips through
+        # the envelope machinery via publish
+        assert chan.allgather({"x": 1}, [0], 0) == [{"x": 1}]
+
+    def test_stale_rejection_counted(self, monkeypatch):
+        from chainermn_tpu.communicators._obj_channel import (
+            StaleGenerationError,
+        )
+        from chainermn_tpu.utils.metrics import (
+            MetricsRegistry,
+            set_registry,
+        )
+
+        reg = MetricsRegistry(enabled=True)
+        prev = set_registry(reg)
+        try:
+            client = _FlakyClient()
+            chan = _channel(client, monkeypatch)
+            chan.send("old", src=0, dst=1)
+            chan.set_generation(9)
+            with pytest.raises(StaleGenerationError):
+                chan.recv(src=0, dst=1)
+            snap = reg.snapshot()
+            assert snap["comm/stale_generation_rejected"]["value"] == 1
+        finally:
+            set_registry(prev)

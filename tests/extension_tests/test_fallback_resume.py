@@ -180,18 +180,96 @@ class TestFallbackResume:
 
         _, path = ckpt
         cp2 = create_multi_node_checkpointer(comm, str(path))
-        real_load = ckpt_mod.load_state
+        real_load = ckpt_mod.load_state_with_topology
 
         def racing_load(p):
             if p.endswith("snapshot_iter_15.0"):
                 os.remove(p)  # the race: file disappears underneath us
             return real_load(p)
 
-        monkeypatch.setattr(ckpt_mod, "load_state", racing_load)
+        monkeypatch.setattr(ckpt_mod, "load_state_with_topology",
+                            racing_load)
         fresh = FakeUpdater()
         assert cp2.maybe_load(fresh) == 10
         np.testing.assert_allclose(fresh.params["w"], 10.0)
         assert not any(".corrupt" in f for f in os.listdir(path))
+
+    def test_quarantine_never_counts_against_history(self, comm,
+                                                     tmp_path):
+        """GC × quarantine interplay: a ``*.corrupt`` set must neither
+        occupy a ``history=N`` protection slot (it is not a usable
+        fallback target — counting it would silently shrink the real
+        headroom) nor ever be evicted, including across the
+        collective-agreement ``_cleanup`` that runs after a fallback
+        resume."""
+        cp = create_multi_node_checkpointer(comm, str(tmp_path),
+                                            history=2)
+        stash, cp._cleanup = cp._cleanup, lambda keep: None
+        up = FakeUpdater()
+        for it in (5, 10, 15):
+            up.iteration = it
+            up.params = {"w": np.full(3, float(it))}
+            cp.save(up)
+        cp._cleanup = stash
+        corrupt_file(str(tmp_path / "snapshot_iter_15.0"), seed=1)
+
+        # fallback resume quarantines 15 and restores 10
+        fresh = FakeUpdater()
+        cp2 = create_multi_node_checkpointer(comm, str(tmp_path),
+                                             history=2)
+        assert cp2.maybe_load(fresh) == 10
+        assert (tmp_path / "snapshot_iter_15.0.corrupt").exists()
+
+        # the next save runs the REAL collective-agreement _cleanup:
+        # protection must fall on the two newest USABLE sets {20, 10} —
+        # the quarantined 15 takes no slot and is not evicted
+        fresh.iteration = 20
+        fresh.params = {"w": np.full(3, 20.0)}
+        cp2.save(fresh)
+        names = sorted(os.listdir(tmp_path))
+        assert "snapshot_iter_15.0.corrupt" in names
+        assert "snapshot_iter_10.0" in names, (
+            "the quarantined set consumed a history slot: the usable "
+            "fallback set 10 was evicted")
+        assert "snapshot_iter_20.0" in names
+        assert "snapshot_iter_5.0" not in names
+
+        # and it survives further GC cycles indefinitely
+        fresh.iteration = 25
+        fresh.params = {"w": np.full(3, 25.0)}
+        cp2.save(fresh)
+        names = sorted(os.listdir(tmp_path))
+        assert "snapshot_iter_15.0.corrupt" in names
+        assert sorted(n for n in names if n.endswith(".0")) == [
+            "snapshot_iter_20.0", "snapshot_iter_25.0"]
+
+    def test_quarantine_preserved_after_fallback_resume_roundtrip(
+            self, comm, tmp_path):
+        """A second resume AFTER the fallback must elect the surviving
+        set without touching the quarantined bytes — post-mortem
+        evidence outlives any number of resume cycles."""
+        cp = create_multi_node_checkpointer(comm, str(tmp_path),
+                                            history=2)
+        stash, cp._cleanup = cp._cleanup, lambda keep: None
+        up = FakeUpdater()
+        for it in (5, 10):
+            up.iteration = it
+            up.params = {"w": np.full(3, float(it))}
+            cp.save(up)
+        cp._cleanup = stash
+        corrupt_file(str(tmp_path / "snapshot_iter_10.0"), seed=3)
+        before = None
+        for _ in range(2):
+            fresh = FakeUpdater()
+            loader = create_multi_node_checkpointer(
+                comm, str(tmp_path), history=2)
+            assert loader.maybe_load(fresh) == 5
+            q = tmp_path / "snapshot_iter_10.0.corrupt"
+            assert q.exists()
+            blob = q.read_bytes()
+            if before is not None:
+                assert blob == before, "quarantined bytes changed"
+            before = blob
 
     def test_clean_sets_resume_unchanged(self, comm, ckpt):
         """No corruption → identical behaviour to the old presence-only

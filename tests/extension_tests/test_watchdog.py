@@ -59,6 +59,59 @@ class TestWatchdogUnit:
         on_disk = json.load(open(tmp_path / "stall.json"))
         assert on_disk["kind"] == "local-stall"
 
+    def test_stall_report_embeds_merged_metrics(self, tmp_path):
+        """A hung job's last Prometheus state ships with the diagnosis:
+        the stall report carries the merged metrics snapshot and its
+        exposition text alongside the trace tail."""
+        from chainermn_tpu.utils.metrics import (
+            MetricsRegistry,
+            set_registry,
+        )
+
+        prev = set_registry(MetricsRegistry(enabled=True))
+        try:
+            reports = []
+            wd = TrainingWatchdog(stall_timeout=0.2, check_interval=0.05,
+                                  on_stall=reports.append,
+                                  report_path=str(tmp_path / "s.json"))
+            wd.start()
+            try:
+                wd.heartbeat(iteration=3)   # records watchdog/heartbeats
+                deadline = time.monotonic() + 1.0
+                while not reports and time.monotonic() < deadline:
+                    time.sleep(0.02)
+            finally:
+                wd.stop()
+            rep = reports[0]
+            assert rep["metrics_enabled"] is True
+            assert rep["metrics"]["watchdog/heartbeats"]["value"] == 1
+            assert "watchdog/stalls" in rep["metrics"]
+            assert "watchdog_heartbeats" in rep["metrics_prom"]
+            assert 'rank="merged"' in rep["metrics_prom"]
+            # and the on-disk report serialized it too
+            on_disk = json.load(open(tmp_path / "s.json"))
+            assert on_disk["metrics"]["watchdog/heartbeats"]["value"] == 1
+        finally:
+            set_registry(prev)
+
+    def test_stall_report_metrics_disabled_registry(self, tmp_path):
+        """Registry off (the production default): the report still
+        carries the keys, empty — never an exception path."""
+        reports = []
+        wd = TrainingWatchdog(stall_timeout=0.15, check_interval=0.05,
+                              on_stall=reports.append,
+                              report_path=str(tmp_path / "s.json"))
+        wd.start()
+        try:
+            wd.heartbeat(iteration=1)
+            deadline = time.monotonic() + 1.0
+            while not reports and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            wd.stop()
+        assert reports[0]["metrics_enabled"] is False
+        assert reports[0]["metrics"] == {}
+
     def test_not_armed_before_first_heartbeat(self, tmp_path):
         """Compile time before step 1 must never false-fire."""
         wd = TrainingWatchdog(stall_timeout=0.1, check_interval=0.05,

@@ -258,9 +258,17 @@ def scenario_watchdog_stall(comm):
 
     from chainermn_tpu.communicators._obj_channel import KVObjectChannel
     from chainermn_tpu.extensions import TrainingWatchdog
+    from chainermn_tpu.utils.metrics import MetricsRegistry, set_registry
 
     chan = KVObjectChannel(tag="wdtest")
     r = comm.inter_rank
+    # enabled registry + a rank-unique marker: the survivor's stall
+    # report must embed a MERGED metrics snapshot that includes the
+    # DEAD peer's last KV-published state (no collective involved)
+    set_registry(MetricsRegistry(enabled=True))
+    from chainermn_tpu.utils.metrics import get_registry
+
+    get_registry().inc(f"drill/rank{r}_marker")
     reports = []
     wd = TrainingWatchdog(
         stall_timeout=1.0, check_interval=0.25, comm=comm,
@@ -291,6 +299,15 @@ def scenario_watchdog_stall(comm):
         assert peer, (
             f"survivor never detected the stalled peer: {reports}")
         assert peer[0]["peer_heartbeat_ages_s"][1] > 1.0
+        # the hung job's last Prometheus state ships with the
+        # diagnosis: the merged snapshot holds BOTH ranks' markers —
+        # the dead peer's via its KV-published snapshot
+        assert peer[0]["metrics_enabled"] is True
+        assert "drill/rank0_marker" in peer[0]["metrics"], \
+            sorted(peer[0]["metrics"])
+        assert "drill/rank1_marker" in peer[0]["metrics"], \
+            sorted(peer[0]["metrics"])
+        assert "drill_rank1_marker" in peer[0]["metrics_prom"]
     _kv_barrier(comm, chan)
 
 
@@ -1278,6 +1295,173 @@ def scenario_alltoall_window(comm):
         assert [len(g["pad"]) for g in got] == [50 * p + r
                                                 for p in range(n)], got
     comm.barrier()
+
+
+def scenario_elastic_membership(comm):
+    """Membership epochs + generation fencing across REAL processes,
+    entirely on the coordination-service KV store (no XLA collectives —
+    membership must be agreeable exactly when the data plane died):
+    survivors agree an epoch-numbered record collectively, fence their
+    object channels to it, and a message published under the OLD
+    generation is REJECTED (typed ``StaleGenerationError``) while the
+    lane stays usable for current-generation traffic."""
+    from chainermn_tpu.communicators._obj_channel import (
+        KVObjectChannel,
+        StaleGenerationError,
+    )
+    from chainermn_tpu.training.elastic import ElasticMembership
+
+    me, n = comm.inter_rank, comm.inter_size
+    boot = KVObjectChannel(tag="elastic-boot")
+    # share the durable membership dir without array collectives
+    path = boot.allgather(
+        tempfile.mkdtemp(prefix="elastic_mp_") if me == 0 else None,
+        list(range(n)), me)[0]
+
+    m = ElasticMembership(comm, path=path)
+    rec = m.agree()
+    assert rec.epoch == 1 and rec.world_size == n, rec
+    assert rec.members == list(range(n)), rec
+    assert rec.rank_of(me) == me
+
+    # rank 0 publishes BEFORE fencing — the pre-resize incarnation's
+    # traffic, still sitting on the store when the new epoch starts
+    chan = KVObjectChannel(tag="elastic-data")
+    if me == 0:
+        chan.send("stale-traffic", src=0, dst=1)
+    m.fence(chan)
+    assert chan.generation == rec.epoch
+    if me == 0:
+        # post-fence traffic rides the agreed generation
+        chan.send({"epoch": rec.epoch}, src=0, dst=1)
+    if me == 1:
+        try:
+            got = chan.recv(src=0, dst=1)
+            raise AssertionError(
+                f"stale-generation message was consumed: {got!r}")
+        except StaleGenerationError:
+            pass
+        # the lane advanced past the rejected message — the fenced
+        # world's own traffic is delivered normally
+        assert chan.recv(src=0, dst=1) == {"epoch": 1}
+
+    # a relaunch (fresh membership object, persisted file) bumps the
+    # epoch past every incarnation that ever agreed one
+    rec2 = ElasticMembership(comm, path=path).agree()
+    assert rec2.epoch == 2, rec2
+    rows = boot.allgather((rec.epoch, rec2.epoch), list(range(n)), me)
+    assert all(r == (1, 2) for r in rows), rows
+
+
+def scenario_preemption_sigterm(comm):
+    """The PreemptionCheckpointer end-to-end FaultPlan drill: a REAL
+    ``SIGTERM`` on ONE process only → the preemption flag OR-reduces
+    collectively → both ranks save the SAME iteration and stop clean →
+    resume bitwise-matches an uninterrupted run.
+
+    Deliberately touches no cross-process XLA collectives: each process
+    trains on its own local device over identical data (states are
+    bitwise-identical by construction) while the flag OR-reduce,
+    checkpoint agreement, and barriers ride the coordination-service KV
+    channel — the preemption path must work exactly where the data
+    plane cannot."""
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.communicators._obj_channel import KVObjectChannel
+    from chainermn_tpu.extensions import (
+        PreemptionCheckpointer,
+        create_multi_node_checkpointer,
+    )
+    from chainermn_tpu.models import init_mlp, mlp_apply, \
+        softmax_cross_entropy
+    from chainermn_tpu.testing import FaultInjector, FaultPlan
+
+    me, n = comm.inter_rank, comm.inter_size
+
+    class KVComm:
+        """Control-plane communicator facade over the KV store only."""
+
+        def __init__(self, tag):
+            self._chan = KVObjectChannel(tag=tag)
+
+        inter_rank = property(lambda self: jax.process_index())
+        inter_size = property(lambda self: jax.process_count())
+        size = property(lambda self: jax.process_count())
+        mesh = None
+
+        def allgather_obj(self, obj):
+            return self._chan.allgather(
+                obj, list(range(self.inter_size)), self.inter_rank)
+
+        def barrier(self):
+            self.allgather_obj(None)
+
+    boot = KVObjectChannel(tag="presig-boot")
+    path = boot.allgather(
+        tempfile.mkdtemp(prefix="presig_") if me == 0 else None,
+        list(range(n)), me)[0]
+
+    local = cmn.create_communicator(
+        "tpu_xla", devices=jax.local_devices())
+    rng = np.random.RandomState(0)      # identical data on every rank
+    data = [(rng.randn(4).astype(np.float32), np.int32(i % 2))
+            for i in range(64)]
+
+    def make_trainer(out, stop=12):
+        it = cmn.SerialIterator(data, 16, shuffle=True, seed=5)
+        params = init_mlp(jax.random.PRNGKey(0), [4, 8, 2])
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), local)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        upd = cmn.StandardUpdater(it, opt, loss_fn, params, local)
+        return cmn.Trainer(upd, (stop, "iteration"),
+                           out=os.path.join(path, out))
+
+    # arm A: the uninterrupted oracle
+    ref = make_trainer("ref")
+    ref.run()
+    assert ref.updater.iteration == 12
+    ref_params = jax.tree.map(np.asarray, ref.updater.params)
+
+    # arm B: rank 0 gets a real SIGTERM at iteration 4; everyone else
+    # learns of it through the collective flag reduce on the next tick
+    kv1 = KVComm("presig-cp1")
+    t1 = make_trainer("drill")
+    cp = create_multi_node_checkpointer(
+        kv1, os.path.join(path, "ckpt"))
+    t1.extend(PreemptionCheckpointer(cp, kv1))
+    inj = FaultInjector(
+        FaultPlan(sigterm_at_iteration=4, sigterm_rank=0), comm=kv1)
+    t1.extend(inj)
+    t1.run()
+    if me == 0:
+        assert ("sigterm", 4) in inj.fired, inj.fired
+    else:
+        assert not inj.fired, inj.fired
+    assert "preemption" in (t1.stop_reason or ""), t1.stop_reason
+    assert t1.updater.iteration == 5, t1.updater.iteration
+    iters = kv1.allgather_obj(sorted(cp._local_iterations()))
+    assert all(x == [5] for x in iters), iters
+
+    # arm C: resume and finish — bitwise vs the oracle
+    kv2 = KVComm("presig-cp2")
+    t2 = make_trainer("resume")
+    cp2 = create_multi_node_checkpointer(
+        kv2, os.path.join(path, "ckpt"))
+    assert cp2.maybe_load(t2.updater, t2) == 5
+    assert cp2.last_resume_mode == "exact"
+    t2.run()
+    assert t2.updater.iteration == 12
+    for a, b in zip(jax.tree.leaves(ref_params),
+                    jax.tree.leaves(jax.tree.map(
+                        np.asarray, t2.updater.params))):
+        np.testing.assert_array_equal(
+            a, b, err_msg="resumed params differ from the "
+                          "uninterrupted run")
+    kv2.barrier()
 
 
 SCENARIOS = {

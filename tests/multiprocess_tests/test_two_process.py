@@ -74,6 +74,16 @@ class TestTwoProcess:
     def test_preemption_collective_flag(self, mp_run):
         mp_run("preemption")
 
+    def test_elastic_membership(self, mp_run):
+        # epoch-numbered membership agreement + generation fencing over
+        # the KV store only; a stale-generation message is REJECTED
+        mp_run("elastic_membership", timeout=240)
+
+    def test_preemption_sigterm_drill(self, mp_run):
+        # real SIGTERM on one process -> OR-reduced collective save ->
+        # both ranks stop clean -> resume bitwise-matches uninterrupted
+        mp_run("preemption_sigterm", timeout=300)
+
     def test_zero1_checkpoint(self, mp_run):
         mp_run("zero1_checkpoint")
 

@@ -149,3 +149,50 @@ class TestLegacyCompat:
         verify_state(p)
         got = load_state(p)
         np.testing.assert_array_equal(got["w"], tree["w"])
+
+
+class TestTopologyStamp:
+    """Elastic-resume stamping: the topology signature rides __meta__
+    (CRC-guarded like the rest of the record), is probe-readable
+    without touching leaf payloads, and its absence — a pre-elastic
+    snapshot — reads as None, never as an error."""
+
+    def test_round_trip(self, tmp_path):
+        from chainermn_tpu.utils import read_topology
+
+        p = str(tmp_path / "snap")
+        topo = {"format": 1, "world_size": 8, "inter_size": 1,
+                "axis_names": ["world"], "mesh_shape": [8],
+                "zero1": True,
+                "opt_leaves": [{"kind": "shard", "size": 10},
+                               {"kind": "stack"}]}
+        save_state(p, _tree(), topology=topo)
+        assert read_topology(p) == topo
+        # the stamped tree itself still round-trips bitwise
+        got = load_state(p)
+        np.testing.assert_array_equal(got["w"], _tree()["w"])
+
+    def test_unstamped_snapshot_reads_none(self, tmp_path):
+        from chainermn_tpu.utils import read_topology
+
+        p = str(tmp_path / "snap")
+        save_state(p, _tree())
+        assert read_topology(p) is None
+
+    def test_damaged_archive_is_typed(self, tmp_path):
+        import os
+
+        from chainermn_tpu.utils import read_topology
+
+        p = str(tmp_path / "snap")
+        save_state(p, _tree(), topology={"world_size": 4})
+        with open(p, "r+b") as f:      # truncate: kills the zip directory
+            f.truncate(os.path.getsize(p) // 2)
+        with pytest.raises(SnapshotCorruptError):
+            read_topology(p)
+
+    def test_missing_file_propagates(self, tmp_path):
+        from chainermn_tpu.utils import read_topology
+
+        with pytest.raises(FileNotFoundError):
+            read_topology(str(tmp_path / "nope"))
