@@ -1,30 +1,47 @@
 """Serving subsystem: slot-based continuous batching over a
-block-paged KV cache.
+block-paged KV cache, with SLO-driven admission control.
 
 ``engine`` schedules requests onto decode slots (queue, admission into
-freed slots mid-stream, per-row EOS eviction, FCFS/shortest-prompt
-policies); ``kv_blocks`` supplies the paging layer (free-list block
-allocator, prefill-to-pool scatter, copy-on-admit gather, horizon
-rebase) that keeps the decode step one compiled program over the dense
-static cache; ``minilm`` is the portable reference decode backend (and
-adapter-protocol example) — the flagship transformer rides the same
-engine through :class:`TransformerAdapter`.  See docs/SERVING.md
-("Serving at scale") and ``bench_serving.py``.
+freed slots mid-stream, per-row EOS eviction, FCFS/shortest-prompt/
+deadline policies, per-request deadlines + ``cancel()``, decode-round
+quarantine); ``admission`` supplies the overload layer (service-time
+prediction from the live TTFT/TPOT lattice histograms, bounded queue
+with priority displacement, per-tenant token quotas, reason-coded
+``ShedCompletion`` fast rejects); ``kv_blocks`` supplies the paging
+layer (free-list block allocator, prefill-to-pool scatter,
+copy-on-admit gather, horizon rebase) that keeps the decode step one
+compiled program over the dense static cache; ``slo`` scores request
+records (percentiles + SLO attainment/goodput); ``minilm`` is the
+portable reference decode backend (and adapter-protocol example) —
+the flagship transformer rides the same engine through
+:class:`TransformerAdapter`.  See docs/SERVING.md ("Serving at
+scale", "Overload and admission"), ``bench_serving.py`` and
+``bench_overload.py``.
 """
 
+from .admission import (
+    SHED_REASONS,
+    AdmissionController,
+    ServiceTimePredictor,
+    ShedCompletion,
+)
 from .engine import Completion, Request, ServingEngine, TransformerAdapter
 from .kv_blocks import BlockAllocator, blocks_needed
 from .minilm import MiniLMAdapter, MiniLMConfig, init_minilm
 from .slo import SLOReport
 
 __all__ = [
+    "AdmissionController",
     "BlockAllocator",
     "Completion",
     "MiniLMAdapter",
     "MiniLMConfig",
     "Request",
+    "SHED_REASONS",
     "SLOReport",
+    "ServiceTimePredictor",
     "ServingEngine",
+    "ShedCompletion",
     "TransformerAdapter",
     "blocks_needed",
     "init_minilm",

@@ -1,0 +1,321 @@
+"""Admission control — the serving engine's overload survival layer.
+
+The PR 8 engine accepts every request forever: the queue grows without
+bound, a request whose deadline is already hopeless ages in it anyway,
+and one tenant can starve every other.  Under overload (λ > capacity —
+the normal state of a popular service) that is the difference between
+a demo and a service: goodput collapses because capacity is spent on
+requests nobody is still waiting for.  This module closes the loop the
+ROADMAP names, using the measurements PR 9 already collects:
+
+- :class:`ServiceTimePredictor` — service-time prediction for free
+  from the same ``serve/ttft`` / ``serve/tpot`` lattice histograms the
+  metrics registry exposes (:mod:`chainermn_tpu.utils.metrics`): the
+  predicted end-to-end time of a ``max_new``-token request is a
+  configurable percentile of observed TTFT plus ``max_new - 1`` times
+  the TPOT percentile.  Cold (no observations, no defaults) it
+  predicts nothing and admission is optimistic — shedding needs
+  evidence.
+- :class:`AdmissionController` — the submit/admit-time decisions:
+  a bounded queue with priority displacement (a more important
+  arrival may displace the least important queued request instead of
+  being rejected), per-tenant in-flight token quotas, and fast-reject
+  load shedding of requests whose predicted completion would breach
+  their deadline.  Decisions are returned as data, never raised —
+  overload is normal operation, not an error.
+- :class:`ShedCompletion` — the typed reject record: reason-coded
+  (:data:`SHED_REASONS`), carried in ``request_records()`` next to
+  real completions, counted in ``serve/shed_<reason>`` metrics, and
+  handled by :class:`~chainermn_tpu.serving.slo.SLOReport` (shed
+  records have no latency fields; the report skip-counts them instead
+  of poisoning percentiles).
+
+The engine half (deadline/timeout enforcement, ``cancel()``, the
+``"deadline"`` scheduling policy, decode-round quarantine) lives in
+:mod:`~chainermn_tpu.serving.engine`; this module is pure host-side
+policy with no jax dependency, unit-testable without a mesh.  See
+docs/SERVING.md "Overload and admission".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from chainermn_tpu.utils.metrics import Histogram
+
+__all__ = ["AdmissionController", "SHED_REASONS", "ServiceTimePredictor",
+           "ShedCompletion"]
+
+#: Every reason code a :class:`ShedCompletion` may carry.  Each is
+#: counted in the ``serve/shed_<reason>`` counter when the metrics
+#: registry is enabled (plus ``serve/shed_total``).
+SHED_REASONS = (
+    "queue_full",     # bounded queue at capacity (backpressure), or
+                      # displaced from it by a higher-priority arrival
+    "over_quota",     # tenant's in-flight token quota exhausted
+    "deadline",       # predicted completion would breach the deadline
+    "timeout",        # deadline expired while still queued
+    "cancelled",      # caller cancel() before admission
+    "quarantined",    # staging/prefill failed for THIS request
+)
+
+
+@dataclasses.dataclass(eq=False)     # identity equality, like Completion
+class ShedCompletion:
+    """A request that terminated WITHOUT being served: rejected at
+    submit, shed from the queue, or cancelled before admission.
+
+    Flows through the same channels as a real
+    :class:`~chainermn_tpu.serving.engine.Completion` (``submit``
+    return / ``step()`` output / ``request_records()``) so callers
+    handle one stream of terminal records.  It has NO latency fields —
+    nothing was served — which is exactly what
+    :meth:`SLOReport.add_arm <chainermn_tpu.serving.slo.SLOReport.
+    add_arm>` skip-counts.
+    """
+
+    rid: str
+    prompt: np.ndarray
+    reason: str                  # one of SHED_REASONS
+    t_submit: float
+    t_shed: float
+    max_new: int = 0
+    priority: int = 0
+    tenant: Optional[str] = None
+    detail: str = ""
+
+    status = "shed"              # class attr: never "ok"
+
+    def __post_init__(self):
+        if self.reason not in SHED_REASONS:
+            raise ValueError(
+                f"reason {self.reason!r} not in {SHED_REASONS}")
+
+    @property
+    def tokens(self) -> np.ndarray:
+        return np.zeros((0,), np.int32)
+
+    @property
+    def n_generated(self) -> int:
+        return 0
+
+
+class ServiceTimePredictor:
+    """Predicted service time from the live TTFT/TPOT distributions.
+
+    Runs on the SAME fixed log-lattice histograms as the ``serve/ttft``
+    / ``serve/tpot`` registry metrics (the PR 9 design point: the
+    buckets the dashboard reads are the buckets the predictor reads),
+    fed by the engine at the same timestamp-holding points.  The
+    prediction is deliberately a tail percentile, not the mean — an
+    admission decision that must hold under load should quote the
+    latency a request is LIKELY TO SEE, and under overload the tail is
+    where requests live.
+
+    Args:
+      quantile: which percentile of the observed distributions to
+        predict with (default 75 — pessimistic enough to shed early
+        under load, not so pessimistic that transient spikes shed
+        everything).
+      default_ttft / default_tpot: cold-start estimates used until the
+        histograms hold at least ``min_count`` observations.  ``None``
+        (the default) means a cold predictor predicts nothing
+        (:meth:`predict_e2e` returns ``None``) and admission stays
+        optimistic — shedding needs evidence.
+      min_count: observations required per histogram before the live
+        percentile replaces the default.
+    """
+
+    def __init__(self, quantile: float = 75.0,
+                 default_ttft: Optional[float] = None,
+                 default_tpot: Optional[float] = None,
+                 min_count: int = 8):
+        if not 0 < quantile <= 100:
+            raise ValueError(f"quantile={quantile} not in (0, 100]")
+        if min_count < 1:
+            raise ValueError(f"min_count={min_count} must be >= 1")
+        self.quantile = float(quantile)
+        self.default_ttft = default_ttft
+        self.default_tpot = default_tpot
+        self.min_count = int(min_count)
+        self.ttft_hist = Histogram()
+        self.tpot_hist = Histogram()
+        # percentile over up to 512 exact samples is a sort; the
+        # scheduler asks per queued request per tick, so memoize until
+        # the next observation
+        self._cache: dict = {}
+
+    # -- feeding (the engine calls these where it observes serve/*) --- #
+
+    def observe_ttft(self, seconds: float) -> None:
+        self.ttft_hist.observe(seconds)
+        self._cache.pop("ttft", None)
+
+    def observe_tpot(self, seconds: float) -> None:
+        self.tpot_hist.observe(seconds)
+        self._cache.pop("tpot", None)
+
+    # -- predictions -------------------------------------------------- #
+
+    def _estimate(self, key: str, hist: Histogram,
+                  default: Optional[float]) -> Optional[float]:
+        if key not in self._cache:
+            self._cache[key] = (hist.percentile(self.quantile)
+                                if hist.count >= self.min_count
+                                else default)
+        return self._cache[key]
+
+    def ttft(self) -> Optional[float]:
+        """Predicted submit→first-token time under current load."""
+        return self._estimate("ttft", self.ttft_hist, self.default_ttft)
+
+    def tpot(self) -> Optional[float]:
+        """Predicted steady-state seconds per generated token."""
+        return self._estimate("tpot", self.tpot_hist, self.default_tpot)
+
+    def predict_e2e(self, max_new: int) -> Optional[float]:
+        """Predicted submit→done seconds for a fresh ``max_new``-token
+        request (TTFT + (max_new−1)·TPOT); ``None`` while cold."""
+        t, p = self.ttft(), self.tpot()
+        if t is None or p is None:
+            return None
+        return t + p * max(int(max_new) - 1, 0)
+
+    def predict_remaining(self, tokens_left: int) -> Optional[float]:
+        """Predicted seconds to generate ``tokens_left`` more tokens
+        for a request already at the head of service (no queue-wait
+        term — that has either elapsed or is the scheduler's to
+        weigh); ``None`` while cold."""
+        p = self.tpot()
+        if p is None:
+            return None
+        return p * max(int(tokens_left), 0)
+
+    def snapshot(self) -> dict:
+        return {
+            "quantile": self.quantile,
+            "ttft": self.ttft(),
+            "tpot": self.tpot(),
+            "ttft_count": self.ttft_hist.count,
+            "tpot_count": self.tpot_hist.count,
+        }
+
+
+class AdmissionController:
+    """Submit/admit-time policy: bounded queue with priority
+    displacement, per-tenant in-flight token quotas, and predictive
+    deadline shedding.
+
+    Attach to an engine via ``ServingEngine(..., admission=ctrl)`` (or
+    assign ``engine.admission`` between arms — host-side only, no
+    recompile).  Priorities are SMALLER-IS-MORE-IMPORTANT integers
+    (class 0 outranks class 1); requests default to class 0.
+
+    Args:
+      max_queue: queue bound.  A submit that would exceed it is shed
+        ``"queue_full"`` — unless some queued request has a strictly
+        LOWER priority (numerically greater), in which case the least
+        important, newest such request is displaced instead and the
+        arrival admitted (the priority-class contract: class 0 traffic
+        is never locked out by a backlog of class 2).  ``None`` (the
+        default) = unbounded, the pre-admission behaviour.
+      quotas: per-tenant in-flight token budgets — the sum of
+        ``max_new`` over a tenant's queued + active requests may not
+        exceed its quota; a submit that would is shed ``"over_quota"``.
+        Tenants absent from the dict fall back to ``default_quota``
+        (``None`` = unlimited).  ``Request.tenant=None`` rows form
+        their own anonymous tenant.
+      default_quota: quota for tenants not named in ``quotas``.
+      predictor: the :class:`ServiceTimePredictor` deadline decisions
+        consult (one is created if omitted).  The engine feeds it
+        live; prime it (``observe_*`` or ``default_*``) to shed from
+        the first request.
+      shed_on_deadline: predictive shedding switch — at submit, a
+        request whose predicted e2e already breaches its deadline is
+        shed ``"deadline"``; while queued, one whose remaining
+        prediction breaches it is shed at the next admit scan rather
+        than aging further.  Expired deadlines (``"timeout"``) are
+        enforced by the engine regardless.
+    """
+
+    def __init__(self, *, max_queue: Optional[int] = None,
+                 quotas: Optional[Dict[Optional[str], float]] = None,
+                 default_quota: Optional[float] = None,
+                 predictor: Optional[ServiceTimePredictor] = None,
+                 shed_on_deadline: bool = True):
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue={max_queue} must be >= 1")
+        for t, q in (quotas or {}).items():
+            if q is not None and q < 1:
+                raise ValueError(
+                    f"quota for tenant {t!r} must be >= 1, got {q}")
+        if default_quota is not None and default_quota < 1:
+            raise ValueError(
+                f"default_quota={default_quota} must be >= 1")
+        self.max_queue = max_queue
+        self.quotas = dict(quotas or {})
+        self.default_quota = default_quota
+        self.predictor = predictor or ServiceTimePredictor()
+        self.shed_on_deadline = shed_on_deadline
+
+    def quota_for(self, tenant: Optional[str]) -> Optional[float]:
+        return self.quotas.get(tenant, self.default_quota)
+
+    def check_submit(self, req, queue: Sequence,
+                     inflight: Dict[Optional[str], int]
+                     ) -> Tuple[bool, Optional[str], Optional[object]]:
+        """The submit-time verdict: ``(admit, reason, victim)``.
+
+        - ``(True, None, None)`` — admit to the queue.
+        - ``(False, reason, None)`` — shed the ARRIVAL with
+          ``reason``.
+        - ``(True, "queue_full", victim)`` — admit the arrival, but
+          displace ``victim`` (a queued request) to make room; the
+          engine sheds the victim ``"queue_full"``.
+
+        Check order: quota (cheapest, per-tenant fairness first),
+        predicted deadline (no point queueing the hopeless), then the
+        queue bound.
+        """
+        quota = self.quota_for(req.tenant)
+        if quota is not None and \
+                inflight.get(req.tenant, 0) + req.max_new > quota:
+            return False, "over_quota", None
+        if self.shed_on_deadline and req.deadline is not None:
+            pred = self.predictor.predict_e2e(req.max_new)
+            if pred is not None and req.t_submit + pred > req.deadline:
+                return False, "deadline", None
+        if self.max_queue is not None and len(queue) >= self.max_queue:
+            victim = self._displacement_victim(req, queue)
+            if victim is not None:
+                return True, "queue_full", victim
+            return False, "queue_full", None
+        return True, None, None
+
+    @staticmethod
+    def _displacement_victim(req, queue: Sequence):
+        """The least important, NEWEST queued request with strictly
+        lower priority than ``req`` (newest = least sunk queue-wait);
+        ``None`` when nobody outranks nobody.  Deterministic: ties on
+        priority break by submit order."""
+        worst_i, worst = max(
+            enumerate(queue), key=lambda t: (t[1].priority, t[0]))
+        del worst_i
+        if worst.priority > req.priority:
+            return worst
+        return None
+
+    def check_queued(self, req, now: float) -> Optional[str]:
+        """Admit-scan verdict for a QUEUED request: ``"deadline"`` when
+        its remaining prediction can no longer meet its deadline,
+        else ``None`` (keep waiting).  Expired deadlines are the
+        engine's own ``"timeout"`` check, run before this one."""
+        if not self.shed_on_deadline or req.deadline is None:
+            return None
+        rem = self.predictor.predict_remaining(req.max_new)
+        if rem is not None and now + rem > req.deadline:
+            return "deadline"
+        return None

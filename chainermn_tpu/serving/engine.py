@@ -37,6 +37,22 @@ Single-controller: results are fetched by host indexing into the
 sharded token buffer, so every shard must be addressable from this
 process (the 8-device CPU mesh and single-host TPU slices; multi-host
 serving needs a fetch collective and is future work).
+
+**Overload and failure.**  Requests carry optional ``deadline`` /
+``timeout``, ``priority`` and ``tenant``; an attached
+:class:`~chainermn_tpu.serving.admission.AdmissionController` bounds
+the queue (with priority displacement), enforces per-tenant in-flight
+token quotas, and fast-rejects requests whose predicted completion
+would breach their deadline — each reject is a typed
+:class:`~chainermn_tpu.serving.admission.ShedCompletion`, never an
+unbounded queue.  Deadlines are enforced engine-side regardless:
+expired queued requests shed ``"timeout"``, expired ACTIVE rows are
+evicted mid-stream with their partial tokens and ``status="timeout"``;
+:meth:`ServingEngine.cancel` drains a queued copy or frees the slot.
+A failure in a per-request program (stage/admit) or in the shared
+decode round quarantines the attributable (or newest-admitted)
+request and keeps the remaining slots serving — see
+docs/SERVING.md "Overload and admission" and docs/RESILIENCE.md.
 """
 
 from __future__ import annotations
@@ -58,6 +74,7 @@ from chainermn_tpu.utils.metrics import get_registry
 from chainermn_tpu.utils.telemetry import get_recorder
 
 from . import kv_blocks as kvb
+from .admission import AdmissionController, ShedCompletion
 
 __all__ = ["Completion", "Request", "ServingEngine", "TransformerAdapter"]
 
@@ -71,7 +88,12 @@ def _vary(x, *axes):
 
 @dataclasses.dataclass(eq=False)     # identity equality: ndarray fields
 class Request:
-    """One queued generation request (host-side)."""
+    """One queued generation request (host-side).
+
+    ``priority`` is a smaller-is-more-important class index (0 is the
+    most important); ``deadline`` is an ABSOLUTE ``time.perf_counter``
+    timestamp (``submit(timeout=...)`` converts); ``tenant`` names the
+    quota bucket the request's ``max_new`` tokens count against."""
 
     rid: str
     prompt: np.ndarray          # (P,) int32
@@ -79,6 +101,9 @@ class Request:
     t_submit: float = 0.0
     t_admit: Optional[float] = None
     t_first: Optional[float] = None
+    priority: int = 0
+    tenant: Optional[str] = None
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass(eq=False)
@@ -89,36 +114,52 @@ class Completion:
     (``queue_wait`` / ``ttft`` / ``tpot`` / ``e2e``) are THE request
     record — ``ServingEngine.request_records()`` hands these back so
     callers (``SLOReport``, ``bench_serving``) stop recomputing them
-    from raw timestamps."""
+    from raw timestamps.
+
+    ``status`` is ``"ok"`` for a request served to EOS/budget;
+    ``"timeout"`` / ``"cancelled"`` / ``"quarantined"`` rows were
+    evicted MID-stream and carry whatever tokens they had generated
+    (possibly none).  Such rows may never have produced a first token,
+    so ``t_admit``/``t_first`` — and the latencies derived from them —
+    can be ``None``; ``SLOReport`` skip-counts those instead of
+    poisoning percentiles."""
 
     rid: str
     prompt: np.ndarray
     tokens: np.ndarray
     t_submit: float
-    t_admit: float
-    t_first: float
+    t_admit: Optional[float]
+    t_first: Optional[float]
     t_done: float
     slot: int
+    status: str = "ok"
+    detail: str = ""
 
     @property
     def n_generated(self) -> int:
         return int(self.tokens.shape[0])
 
     @property
-    def queue_wait(self) -> float:
+    def queue_wait(self) -> Optional[float]:
         """Submit → admission into a decode slot (where static
         batching bleeds)."""
+        if self.t_admit is None:
+            return None
         return self.t_admit - self.t_submit
 
     @property
-    def ttft(self) -> float:
+    def ttft(self) -> Optional[float]:
         """Time-to-first-token: submit → first generated token on host."""
+        if self.t_first is None:
+            return None
         return self.t_first - self.t_submit
 
     @property
-    def tpot(self) -> float:
+    def tpot(self) -> Optional[float]:
         """Time-per-output-token after the first (the decode steady
         state): ``(t_done - t_first) / (n_generated - 1)``."""
+        if self.t_first is None:
+            return None
         return (self.t_done - self.t_first) / max(self.n_generated - 1, 1)
 
     @property
@@ -202,11 +243,42 @@ def _fcfs(queue: Sequence[Request], engine) -> Request:
 
 
 def _spf(queue: Sequence[Request], engine) -> Request:
-    """Shortest-prompt-first (stable: FCFS among equals)."""
-    return min(queue, key=lambda r: r.prompt.shape[0])
+    """Shortest-prompt-first.  Ties break by SUBMIT ORDER explicitly
+    (the queue is submission-ordered), so a seeded trace admits
+    identically on every run — pinned by test."""
+    return min(enumerate(queue),
+               key=lambda t: (t[1].prompt.shape[0], t[0]))[1]
 
 
-_POLICIES = {"fcfs": _fcfs, "spf": _spf}
+def _deadline(queue: Sequence[Request], engine) -> Request:
+    """Deadline-aware: admit the request whose deadline is TIGHTEST
+    relative to its predicted remaining service time (least slack
+    first), within priority classes (class 0 always outranks class 1).
+
+    Slack is ``(deadline - now) - predictor.predict_remaining(max_new)``
+    via the attached admission controller's service-time predictor;
+    without a controller (or while the predictor is cold) it degrades
+    to earliest-deadline-first.  Deadline-less requests sort after all
+    deadlined ones of their class, in submit order.  Every tie breaks
+    by submit order — deterministic across runs of one seeded trace
+    (pinned by test)."""
+    now = time.perf_counter()
+    ctrl = getattr(engine, "admission", None)
+    pred = ctrl.predictor if ctrl is not None else None
+
+    def key(t):
+        i, r = t
+        if r.deadline is None:
+            return (r.priority, 1, 0.0, i)
+        rem = pred.predict_remaining(r.max_new) if pred is not None \
+            else None
+        slack = (r.deadline - now) - (rem if rem is not None else 0.0)
+        return (r.priority, 0, slack, i)
+
+    return min(enumerate(queue), key=key)[1]
+
+
+_POLICIES = {"fcfs": _fcfs, "spf": _spf, "deadline": _deadline}
 
 
 class ServingEngine:
@@ -251,6 +323,16 @@ class ServingEngine:
         long-running server must not grow a completion list without
         bound; completions returned from :meth:`step` are unaffected).
         0 disables retention.
+      policy: ``"fcfs"``, ``"spf"``, ``"deadline"`` (least slack vs
+        predicted service time, within priority classes), or
+        ``callable(queue, engine) -> Request``.
+      admission: optional
+        :class:`~chainermn_tpu.serving.admission.AdmissionController`
+        — queue bound + priority displacement, per-tenant in-flight
+        token quotas, predictive deadline shedding.  Host-side only
+        and swappable between runs (``engine.admission = ...``, like
+        ``gang``); ``None`` admits everything, bounded only by
+        deadlines the requests themselves carry.
     """
 
     def __init__(self, adapter, params, *, n_slots: int, horizon: int,
@@ -261,7 +343,8 @@ class ServingEngine:
                  gang: bool = False,
                  prefill_ahead: Optional[int] = None,
                  default_max_new: int = 32,
-                 record_history: int = 4096):
+                 record_history: int = 4096,
+                 admission: Optional[AdmissionController] = None):
         mesh = adapter.mesh_cfg.mesh
         shards = 1
         for a in adapter.batch_axes:
@@ -303,6 +386,7 @@ class ServingEngine:
         self.prefill_ahead = n_slots if prefill_ahead is None \
             else prefill_ahead
         self.default_max_new = default_max_new
+        self.admission = admission
         if record_history < 0:
             raise ValueError(
                 f"record_history={record_history} must be >= 0")
@@ -479,8 +563,13 @@ class ServingEngine:
         self._offsets = np.full((self.n_slots,), self.horizon, np.int32)
         self._done = np.ones((self.n_slots,), bool)
         self._end_t = np.zeros((self.n_slots,), np.int32)
+        self._slot_status: List[str] = ["ok"] * self.n_slots
+        self._slot_detail: List[str] = [""] * self.n_slots
         self._clock = self._pq - 1
         self._pending_first: set = set()
+        self._pending_shed: List[ShedCompletion] = []
+        self._tenant_tokens: collections.Counter = collections.Counter()
+        self._charged: set = set()      # rids counted in _tenant_tokens
         self._next_rid = 0
         self.admit_log: List[str] = []
         self._records: collections.deque = collections.deque(
@@ -488,6 +577,11 @@ class ServingEngine:
         self.n_rebases = 0
         self.n_rounds = 0
         self.useful_tokens = 0
+        self.wasted_tokens = 0          # partial tokens of non-ok rows
+        self.n_shed: collections.Counter = collections.Counter()
+        self.n_timeouts = 0
+        self.n_cancelled = 0
+        self.n_quarantined = 0
 
     # ------------------------------------------------------------------ #
     # public API
@@ -514,8 +608,22 @@ class ServingEngine:
                 "callable")
 
     def submit(self, prompt, max_new: Optional[int] = None,
-               request_id: Optional[str] = None) -> str:
-        """Queue one request; returns its id."""
+               request_id: Optional[str] = None, *,
+               priority: int = 0, tenant: Optional[str] = None,
+               deadline: Optional[float] = None,
+               timeout: Optional[float] = None
+               ) -> Union[str, ShedCompletion]:
+        """Queue one request; returns its id — or, when the attached
+        admission controller rejects it (queue full, tenant over
+        quota, deadline predicted unmeetable), the reason-coded
+        :class:`ShedCompletion` instead of letting it age in the
+        queue.  The reject is also appended to
+        :meth:`request_records` and counted in ``serve/shed_*``.
+
+        ``deadline`` is an absolute ``time.perf_counter`` timestamp;
+        ``timeout`` is the relative convenience form (seconds from
+        now) — give at most one.  ``priority`` is
+        smaller-is-more-important (class 0 beats class 1)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not 1 <= prompt.shape[0] <= self.max_prompt:
             raise ValueError(
@@ -526,6 +634,13 @@ class ServingEngine:
             raise ValueError(
                 f"max_new={max_new} not in [1, horizon - padded prompt "
                 f"= {self.horizon - self._pq}]")
+        now = time.perf_counter()
+        if timeout is not None:
+            if deadline is not None:
+                raise ValueError("give deadline= OR timeout=, not both")
+            if timeout <= 0:
+                raise ValueError(f"timeout={timeout} must be > 0")
+            deadline = now + timeout
         if request_id is None:
             request_id = f"r{self._next_rid}"
             self._next_rid += 1
@@ -533,14 +648,54 @@ class ServingEngine:
                 or any(r is not None and r.rid == request_id
                        for r in self._slot_req):
             raise ValueError(f"request id {request_id!r} already live")
-        self._queue.append(Request(request_id, prompt, max_new,
-                                   t_submit=time.perf_counter()))
-        get_recorder().counter("serve/queue_depth", len(self._queue),
-                               cat="serve")
+        req = Request(request_id, prompt, max_new, t_submit=now,
+                      priority=int(priority), tenant=tenant,
+                      deadline=deadline)
         reg = get_registry()
         reg.inc("serve/submitted")
+        if self.admission is not None:
+            admit, reason, victim = self.admission.check_submit(
+                req, list(self._queue), self._tenant_tokens)
+            if victim is not None:
+                # a lower-priority queued request makes room; its shed
+                # record flows out of the next step()
+                self._shed_from_queue(victim, "queue_full",
+                                      detail=f"displaced by {req.rid}")
+            if not admit:
+                return self._finish_shed(req, reason)
+        self._queue.append(req)
+        self._tenant_tokens[tenant] += max_new
+        self._charged.add(request_id)
+        get_recorder().counter("serve/queue_depth", len(self._queue),
+                               cat="serve")
         reg.set("serve/queue_depth", len(self._queue))
         return request_id
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a live request: a queued copy is drained (staged
+        blocks freed, a ``ShedCompletion(reason="cancelled")`` flows
+        out of the next :meth:`step`); an ACTIVE row is evicted on the
+        next step with its partial tokens and
+        ``status="cancelled"`` — the slot frees immediately after.
+        Returns False when the id is not live (already completed,
+        shed, or never submitted) — cancellation races are normal, not
+        errors."""
+        for req in list(self._queue):
+            if req.rid == request_id:
+                self._shed_from_queue(req, "cancelled")
+                return True
+        for s in range(self.n_slots):
+            req = self._slot_req[s]
+            if req is not None and req.rid == request_id:
+                if self._done[s]:
+                    # already finished (or already timed out /
+                    # quarantined), just awaiting eviction — too late
+                    # to cancel; don't relabel a served completion
+                    return False
+                self._done[s] = True
+                self._slot_status[s] = "cancelled"
+                return True
+        return False
 
     @property
     def n_active(self) -> int:
@@ -548,41 +703,89 @@ class ServingEngine:
 
     @property
     def idle(self) -> bool:
-        return not self._queue and self.n_active == 0
+        return (not self._queue and self.n_active == 0
+                and not self._pending_shed)
 
-    def step(self) -> List[Completion]:
-        """One scheduler iteration: evict finished rows, admit from the
-        queue, run one decode round.  Returns completions."""
+    def step(self) -> List[Union[Completion, ShedCompletion]]:
+        """One scheduler iteration: evict finished/expired rows, admit
+        from the queue (shedding what can no longer make its
+        deadline), run one decode round.  Returns this iteration's
+        terminal records — served :class:`Completion`\\ s (``status``
+        ``"ok"`` or a mid-stream ``"timeout"`` / ``"cancelled"`` /
+        ``"quarantined"``) and queue-side :class:`ShedCompletion`\\ s.
+
+        A decode-round failure does NOT crash the engine: the
+        newest-admitted live request is quarantined (evicted next
+        step with ``status="quarantined"``) and the remaining slots
+        keep serving — unless the failure consumed the round's donated
+        buffers, in which case the device state is gone and a
+        ``RuntimeError`` propagates."""
         rec = get_recorder()
-        out: List[Completion] = []
+        out: List[Union[Completion, ShedCompletion]] = []
         self._evict_phase(out, rec)
         self._admit_phase(rec)
+        if self._pending_shed:          # queue sheds from this tick
+            out.extend(self._pending_shed)
+            self._pending_shed.clear()
         live = any(self._slot_req[s] is not None and not self._done[s]
                    for s in range(self.n_slots))
         if live:
-            with rec.span("serve/decode_round", cat="serve",
-                          step=int(self._clock), tokens=self.round_tokens,
-                          active=self.n_active):
-                self._caches, self._buf, done_dev = self._round_fn(
-                    self._params, self._caches, self._buf,
-                    self._offsets, self._done, self._end_t,
-                    np.int32(self._clock))
-                # np.array, not asarray: the host mirror is mutated by
-                # admissions, and jax arrays view out read-only
-                self._done = np.array(done_dev)     # the round's sync
-            self._clock += self.round_tokens
-            self.n_rounds += 1
-            now = time.perf_counter()
-            reg = get_registry()
-            for s in self._pending_first:
-                req = self._slot_req[s]
-                req.t_first = now
-                # TTFT lands here — the first moment the request's
-                # first generated token is host-observable
-                reg.observe("serve/ttft", now - req.t_submit)
-            self._pending_first.clear()
+            try:
+                with rec.span("serve/decode_round", cat="serve",
+                              step=int(self._clock),
+                              tokens=self.round_tokens,
+                              active=self.n_active):
+                    self._caches, self._buf, done_dev = self._round_fn(
+                        self._params, self._caches, self._buf,
+                        self._offsets, self._done, self._end_t,
+                        np.int32(self._clock))
+                    # np.array, not asarray: the host mirror is mutated
+                    # by admissions, and jax arrays view out read-only
+                    self._done = np.array(done_dev)  # the round's sync
+            except Exception as err:        # noqa: BLE001 — harden
+                self._on_round_failure(err, rec)
+            else:
+                self._clock += self.round_tokens
+                self.n_rounds += 1
+                now = time.perf_counter()
+                reg = get_registry()
+                for s in self._pending_first:
+                    req = self._slot_req[s]
+                    req.t_first = now
+                    # TTFT lands here — the first moment the request's
+                    # first generated token is host-observable
+                    reg.observe("serve/ttft", now - req.t_submit)
+                    if self.admission is not None:
+                        self.admission.predictor.observe_ttft(
+                            now - req.t_submit)
+                self._pending_first.clear()
         rec.counter("serve/active_slots", self.n_active, cat="serve")
         return out
+
+    def _on_round_failure(self, err, rec) -> None:
+        """Quarantine-and-continue: the shared decode round cannot
+        attribute a failure to one row, so the NEWEST-admitted live
+        request (the thing that most recently changed the batch) is
+        evicted ``status="quarantined"`` and the round retries next
+        step with the remaining rows.  A persistent fault therefore
+        drains the batch one quarantine per step — degraded, never
+        hung.  If the failure consumed the round's donated buffers the
+        device state is unrecoverable and the error propagates."""
+        for leaf in jax.tree.leaves((self._caches, self._buf)):
+            if getattr(leaf, "is_deleted", lambda: False)():
+                raise RuntimeError(
+                    "decode round failed after its donated buffers "
+                    "were consumed — engine state is lost; reset() "
+                    "and resubmit") from err
+        live = [s for s in range(self.n_slots)
+                if self._slot_req[s] is not None and not self._done[s]]
+        victim = max(live,
+                     key=lambda s: (self._slot_req[s].t_admit or 0.0, s))
+        self._done[victim] = True
+        self._slot_status[victim] = "quarantined"
+        self._slot_detail[victim] = f"{type(err).__name__}: {err}"
+        rec.counter("serve/round_failures", 1, cat="serve")
+        get_registry().inc("serve/round_failures")
 
     def run(self, max_steps: Optional[int] = None) -> List[Completion]:
         """Drive :meth:`step` until queue and slots drain."""
@@ -601,10 +804,15 @@ class ServingEngine:
             "rounds": self.n_rounds,
             "rebases": self.n_rebases,
             "useful_tokens": self.useful_tokens,
+            "wasted_tokens": self.wasted_tokens,
             "slot_utilization": (self.useful_tokens / issued
                                  if issued else 0.0),
             "pool_utilization": self._alloc.utilization,
             "queue_depth": len(self._queue),
+            "shed": dict(self.n_shed),
+            "timeouts": self.n_timeouts,
+            "cancelled": self.n_cancelled,
+            "quarantined": self.n_quarantined,
         }
 
     def request_records(self) -> List[Completion]:
@@ -631,15 +839,29 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
 
     def _evict_phase(self, out: List[Completion], rec) -> None:
+        now = time.perf_counter()
         for s in range(self.n_slots):
             req = self._slot_req[s]
-            if req is None or not self._done[s]:
+            if req is None:
                 continue
+            if (not self._done[s] and req.deadline is not None
+                    and now >= req.deadline):
+                # deadline expired MID-stream: evict with the partial
+                # tokens rather than burn more rounds on a miss
+                self._done[s] = True
+                self._slot_status[s] = "timeout"
+            if not self._done[s]:
+                continue
+            status = self._slot_status[s]
+            detail = self._slot_detail[s]
             with rec.span("serve/evict", cat="serve", rid=req.rid,
-                          slot=s):
+                          slot=s, status=status):
                 row = np.asarray(self._buf[s])
                 first = int(self._offsets[s] + req.prompt.shape[0] - 1)
-                gen = row[first + 1: int(self._end_t[s]) + 1]
+                # a mid-stream eviction (timeout/cancel/quarantine)
+                # has only decoded up to the clock, not to its budget
+                end = min(int(self._end_t[s]), self._clock)
+                gen = row[first + 1: end + 1]
                 if self.eos_id >= 0:
                     hits = np.nonzero(gen == self.eos_id)[0]
                     if hits.size:
@@ -647,19 +869,82 @@ class ServingEngine:
                 self._slot_req[s] = None
                 self._offsets[s] = self.horizon     # mask-all sentinel
                 self._end_t[s] = 0
-                self.useful_tokens += int(gen.shape[0])
+                self._slot_status[s] = "ok"
+                self._slot_detail[s] = ""
+                self._pending_first.discard(s)
+                if status == "ok":
+                    self.useful_tokens += int(gen.shape[0])
+                else:
+                    self.wasted_tokens += int(gen.shape[0])
             comp = Completion(
                 rid=req.rid, prompt=req.prompt, tokens=np.array(gen),
                 t_submit=req.t_submit, t_admit=req.t_admit,
                 t_first=req.t_first, t_done=time.perf_counter(),
-                slot=s)
+                slot=s, status=status, detail=detail)
+            self._release_tokens(req)
             self._records.append(comp)
             reg = get_registry()
             reg.inc("serve/evictions")
             reg.inc("serve/generated_tokens", comp.n_generated)
-            reg.observe("serve/tpot", comp.tpot)
-            reg.observe("serve/e2e", comp.e2e)
+            if status == "ok":
+                # only fully-served rows feed the latency
+                # distributions — a truncated timeout row would bias
+                # the predictor (and the dashboard) optimistic
+                reg.observe("serve/tpot", comp.tpot)
+                reg.observe("serve/e2e", comp.e2e)
+                if self.admission is not None:
+                    self.admission.predictor.observe_tpot(comp.tpot)
+            elif status == "timeout":
+                self.n_timeouts += 1
+                reg.inc("serve/timeouts")
+            elif status == "cancelled":
+                self.n_cancelled += 1
+                reg.inc("serve/cancelled")
+            elif status == "quarantined":
+                self.n_quarantined += 1
+                reg.inc("serve/quarantined")
             out.append(comp)
+
+    def _release_tokens(self, req: Request) -> None:
+        if req.rid in self._charged:
+            self._charged.discard(req.rid)
+            self._tenant_tokens[req.tenant] -= req.max_new
+            if self._tenant_tokens[req.tenant] <= 0:
+                del self._tenant_tokens[req.tenant]
+
+    def _finish_shed(self, req: Request, reason: str,
+                     detail: str = "") -> ShedCompletion:
+        """Terminal bookkeeping for a request that will never be
+        served: tenant tokens released, record appended, metrics
+        counted.  Returns the typed reject."""
+        self._release_tokens(req)
+        shed = ShedCompletion(
+            rid=req.rid, prompt=req.prompt, reason=reason,
+            t_submit=req.t_submit, t_shed=time.perf_counter(),
+            max_new=req.max_new, priority=req.priority,
+            tenant=req.tenant, detail=detail)
+        self._records.append(shed)
+        self.n_shed[reason] += 1
+        reg = get_registry()
+        reg.inc("serve/shed_total")
+        # the taxonomy is DISJOINT: queue-side terminations count in
+        # serve/shed_<reason> only; serve/timeouts / serve/cancelled /
+        # serve/quarantined count mid-stream evictions only — their
+        # sum with serve/shed_total is every unserved request once
+        reg.inc("serve/shed_" + reason)
+        return shed
+
+    def _shed_from_queue(self, req: Request, reason: str,
+                         detail: str = "") -> ShedCompletion:
+        self._queue.remove(req)
+        self._staged.pop(req.rid, None)
+        self._alloc.free_row(req.rid)
+        shed = self._finish_shed(req, reason, detail)
+        self._pending_shed.append(shed)
+        get_recorder().counter("serve/queue_depth", len(self._queue),
+                               cat="serve")
+        get_registry().set("serve/queue_depth", len(self._queue))
+        return shed
 
     def _pick(self) -> Request:
         req = self._policy(list(self._queue), self)
@@ -668,7 +953,25 @@ class ServingEngine:
                 f"policy returned a request not in the queue: {req!r}")
         return req
 
+    def _scan_queue_deadlines(self) -> None:
+        """Shed queued requests that expired (``"timeout"``) or — with
+        an admission controller — can no longer meet their deadline
+        per the live prediction (``"deadline"``), instead of letting
+        them age in the queue."""
+        if not self._queue:
+            return
+        now = time.perf_counter()
+        for req in list(self._queue):
+            reason = None
+            if req.deadline is not None and now >= req.deadline:
+                reason = "timeout"
+            elif self.admission is not None:
+                reason = self.admission.check_queued(req, now)
+            if reason is not None:
+                self._shed_from_queue(req, reason)
+
     def _admit_phase(self, rec) -> None:
+        self._scan_queue_deadlines()
         free = [s for s in range(self.n_slots)
                 if self._slot_req[s] is None]
         if self.gang and len(free) < self.n_slots:
@@ -680,19 +983,39 @@ class ServingEngine:
                 if not self._maybe_rebase(req.max_new, rec):
                     break               # horizon full until rows retire
                 a = self._clock
-            if not self._ensure_staged(req, rec):
+            try:
+                staged = self._ensure_staged(req, rec)
+            except Exception as err:    # noqa: BLE001 — harden
+                # prefill failed for THIS request: quarantine it and
+                # keep admitting others — one poison prompt must not
+                # stall the queue (_shed_from_queue frees its blocks)
+                self._check_state_alive(err)
+                self._shed_from_queue(
+                    req, "quarantined",
+                    detail=f"stage: {type(err).__name__}: {err}")
+                continue
+            if not staged:
                 break                   # pool full until slots drain
             slot = free.pop(0)
             self._queue.remove(req)
             dst0 = a + 1 - self._pq
             assert dst0 >= 0, (a, self._pq)   # clock >= Pq-1 invariant
-            with rec.span("serve/admit", cat="serve", rid=req.rid,
-                          slot=slot, step=int(a)):
-                ids, prompt_row = self._staged.pop(req.rid)
-                self._caches, self._buf = self._admit_fn(
-                    self._caches, self._buf, self._pools, ids,
-                    prompt_row, np.int32(slot), np.int32(dst0))
+            try:
+                with rec.span("serve/admit", cat="serve", rid=req.rid,
+                              slot=slot, step=int(a)):
+                    ids, prompt_row = self._staged.pop(req.rid)
+                    self._caches, self._buf = self._admit_fn(
+                        self._caches, self._buf, self._pools, ids,
+                        prompt_row, np.int32(slot), np.int32(dst0))
+                    self._alloc.free_row(req.rid)
+            except Exception as err:    # noqa: BLE001 — harden
+                self._check_state_alive(err)
                 self._alloc.free_row(req.rid)
+                self._pending_shed.append(self._finish_shed(
+                    req, "quarantined",
+                    detail=f"admit: {type(err).__name__}: {err}"))
+                free.insert(0, slot)    # the slot was never filled
+                continue
             p = req.prompt.shape[0]
             self._offsets[slot] = a + 1 - p
             self._end_t[slot] = a + req.max_new
@@ -714,9 +1037,28 @@ class ServingEngine:
                     break
                 if req.rid in self._staged:
                     continue
-                if not self._stage(req, rec, steal=False):
-                    break
+                try:
+                    if not self._stage(req, rec, steal=False):
+                        break
+                except Exception as err:    # noqa: BLE001 — harden
+                    self._check_state_alive(err)
+                    self._shed_from_queue(
+                        req, "quarantined",
+                        detail=f"stage: {type(err).__name__}: {err}")
+                    continue
                 budget -= 1
+
+    def _check_state_alive(self, err) -> None:
+        """Donated-buffer guard for the harden paths: if a failed
+        program call consumed its donated inputs, the device state is
+        unrecoverable — propagate instead of serving garbage."""
+        for leaf in jax.tree.leaves(
+                (self._caches, self._buf, self._pools)):
+            if getattr(leaf, "is_deleted", lambda: False)():
+                raise RuntimeError(
+                    "serving program failed after its donated buffers "
+                    "were consumed — engine state is lost; reset() "
+                    "and resubmit") from err
 
     # ------------------------------------------------------------------ #
     # staging / paging

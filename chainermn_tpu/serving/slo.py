@@ -12,15 +12,28 @@ dashboard IS the number in the bench JSON (small request counts ride
 the histogram's exact-sample path, which is numpy-``linear``
 identical; ``bench_serving`` asserts that equivalence every run).
 
+Not every record carries every latency: a shed request
+(:class:`~chainermn_tpu.serving.admission.ShedCompletion`) was never
+served, and a timed-out/cancelled row may have been evicted before its
+first token — their ``ttft``/``tpot``/``queue_wait`` are ``None`` or
+absent.  Those values are SKIP-COUNTED per arm and field
+(``summary()[arm]["skipped"]``) instead of poisoning the percentiles.
+
 "Arms" are whatever populations are being compared: scheduling modes
-(continuous vs gang), model variants, deployment slices.  One arm is
-fine too.
+(continuous vs gang, FCFS vs shed+deadline), model variants,
+deployment slices.  One arm is fine too.  Under overload the metric
+that separates arms is not a percentile but **goodput-under-SLO** —
+tokens delivered by requests that finished within their target:
+``add_arm(..., slo=...)`` scores it (a scalar e2e target or a
+per-record callable) and the report grows an SLO-attainment/goodput
+column; sheds and mid-stream failures count against attainment, which
+is exactly why shedding hopeless work early can WIN it.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict, Iterable, Optional, Sequence
+from typing import Callable, Dict, Iterable, Optional, Sequence, Union
 
 from chainermn_tpu.utils.metrics import Histogram
 
@@ -30,13 +43,26 @@ _FIELDS = ("queue_wait", "ttft", "tpot", "e2e")
 
 
 def _field(record, name: str) -> Optional[float]:
+    """A record's latency field, ``None`` when missing, ``None``, or
+    unreadable (a property that raises on a partially-populated
+    record must degrade to a skip, not kill the report)."""
+    try:
+        if isinstance(record, dict):
+            return record.get(name)
+        return getattr(record, name, None)
+    except Exception:       # noqa: BLE001 — foreign record types
+        return None
+
+
+def _status(record) -> str:
     if isinstance(record, dict):
-        return record.get(name)
-    return getattr(record, name, None)
+        return record.get("status", "ok")
+    return getattr(record, "status", "ok")
 
 
 class SLOReport:
-    """Per-arm latency percentiles from request records.
+    """Per-arm latency percentiles (and optionally SLO attainment /
+    goodput) from request records.
 
     Args:
       percentiles: which percentiles :meth:`summary` reports
@@ -48,23 +74,64 @@ class SLOReport:
         slo.add_arm("continuous", engine.request_records())
         print(slo.render())            # the operator table (ms)
         slo.summary()["continuous"]["ttft"]["p99"]   # seconds
+
+        slo.add_arm("shed", records, slo=0.5)        # 500 ms target
+        slo.summary()["shed"]["slo"]["attainment"]   # fraction met
     """
 
     def __init__(self, percentiles: Sequence[float] = (50, 95, 99)):
         self.percentiles = tuple(percentiles)
         self._arms: Dict[str, Dict[str, Histogram]] = {}
+        self._skipped: Dict[str, Dict[str, int]] = {}
+        self._slo: Dict[str, Dict[str, float]] = {}
 
-    def add_arm(self, name: str, records: Iterable) -> "SLOReport":
-        """Fold ``records`` (``Completion``s, or dicts with the same
-        field names) into arm ``name``'s histograms; repeated calls
-        accumulate.  Returns self for chaining."""
+    def add_arm(self, name: str, records: Iterable,
+                slo: Optional[Union[float, Callable]] = None
+                ) -> "SLOReport":
+        """Fold ``records`` (``Completion``/``ShedCompletion``s, or
+        dicts with the same field names) into arm ``name``'s
+        histograms; repeated calls accumulate.  Missing/``None``
+        latency fields (sheds, pre-first-token evictions) are
+        skip-counted per field, never observed.
+
+        ``slo`` turns on attainment scoring: a scalar end-to-end
+        target in seconds, or ``callable(record) -> Optional[float]``
+        for per-record targets (return ``None`` to exempt a record).
+        A record ATTAINS its SLO iff it was fully served
+        (``status == "ok"``) and its ``e2e`` is within target; the
+        arm's goodput column sums the generated tokens of attaining
+        records only.  Returns self for chaining."""
         hists = self._arms.setdefault(
             name, {f: Histogram() for f in _FIELDS})
+        skipped = self._skipped.setdefault(
+            name, {f: 0 for f in _FIELDS})
+        # the slo block only ever reflects batches scored WITH slo= —
+        # folding an unscored batch's sheds into a scored arm would
+        # make attainment and shed counts cover different populations
+        score = self._slo.setdefault(
+            name, {"scored": 0, "attained": 0, "goodput_tokens": 0,
+                   "shed": 0}) if slo is not None else None
         for rec in records:
             for f in _FIELDS:
                 v = _field(rec, f)
-                if v is not None:
+                if v is None:
+                    skipped[f] += 1
+                else:
                     hists[f].observe(float(v))
+            if score is None:
+                continue
+            status = _status(rec)
+            if status == "shed":
+                score["shed"] += 1
+            target = slo(rec) if callable(slo) else slo
+            if target is None:
+                continue
+            score["scored"] += 1
+            e2e = _field(rec, "e2e")
+            if status == "ok" and e2e is not None and e2e <= target:
+                score["attained"] += 1
+                n = _field(rec, "n_generated")
+                score["goodput_tokens"] += int(n or 0)
         return self
 
     @property
@@ -76,8 +143,16 @@ class SLOReport:
         exportable through ``utils.metrics`` like any other)."""
         return dict(self._arms[arm])
 
+    def skipped(self, arm: str) -> Dict[str, int]:
+        """Per-field count of records whose value was missing/``None``
+        (shed and pre-first-token records) — reported, not observed."""
+        return dict(self._skipped.get(arm, {}))
+
     def summary(self) -> dict:
-        """``{arm: {field: {count, mean, p50, ..., max}}}``, seconds."""
+        """``{arm: {field: {count, mean, p50, ..., max}}}``, seconds;
+        plus ``"skipped"`` (per-field skip counts) and — for arms
+        scored with ``slo=`` — ``"slo"``
+        (``{scored, attained, attainment, goodput_tokens, shed}``)."""
         out = {}
         for arm, hists in self._arms.items():
             out[arm] = {}
@@ -86,6 +161,13 @@ class SLOReport:
                 for q in self.percentiles:
                     row[f"p{q:g}"] = h.percentile(q)
                 out[arm][f] = row
+            out[arm]["skipped"] = self.skipped(arm)
+            score = self._slo.get(arm)
+            if score is not None:
+                s = dict(score)
+                s["attainment"] = (s["attained"] / s["scored"]
+                                   if s["scored"] else None)
+                out[arm]["slo"] = s
         return out
 
     def to_dict(self) -> dict:
@@ -99,25 +181,41 @@ class SLOReport:
 
     def render(self) -> str:
         """The printable table, milliseconds (TPOT included — it is a
-        latency too, just per token)."""
-        cols = ["arm", "metric", "n", "mean_ms"] + \
+        latency too, just per token); skip counts per metric, and an
+        SLO attainment/goodput line per scored arm."""
+        cols = ["arm", "metric", "n", "skip", "mean_ms"] + \
             [f"p{q:g}_ms" for q in self.percentiles] + ["max_ms"]
         rows = []
-        for arm, fields in self.summary().items():
+        summary = self.summary()
+        for arm, fields in summary.items():
             for f in _FIELDS:
                 s = fields[f]
 
                 def ms(v):
                     return "-" if v is None else f"{v * 1e3:.2f}"
 
-                rows.append([arm, f, str(s["count"]), ms(s["mean"])]
+                rows.append([arm, f, str(s["count"]),
+                             str(fields["skipped"].get(f, 0)),
+                             ms(s["mean"])]
                             + [ms(s[f"p{q:g}"])
                                for q in self.percentiles]
                             + [ms(s["max"])])
         widths = [max(len(r[i]) for r in [cols] + rows)
                   for i in range(len(cols))]
         fmt = "  ".join(f"{{:>{w}}}" for w in widths)
-        return "\n".join(fmt.format(*r) for r in [cols] + rows)
+        lines = [fmt.format(*r) for r in [cols] + rows]
+        for arm, fields in summary.items():
+            score = fields.get("slo")
+            if score is None:
+                continue
+            att = score["attainment"]
+            lines.append(
+                f"{arm}  slo: {score['attained']}/{score['scored']} "
+                f"attained"
+                + (f" ({att * 100:.1f}%)" if att is not None else "")
+                + f"  goodput {score['goodput_tokens']} tok"
+                + f"  shed {score['shed']}")
+        return "\n".join(lines)
 
     def __str__(self) -> str:
         return self.render()

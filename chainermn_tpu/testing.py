@@ -220,6 +220,23 @@ class FaultPlan:
       rebuilds the job on the new topology and resumes through the
       checkpointer's elastic re-layout path (docs/RESILIENCE.md
       "Elastic resume").
+
+    Serving faults (applied by :meth:`FaultInjector.attach_engine` to a
+    ``ServingEngine``, keyed by DECODE-ROUND / staging-call count
+    instead of trainer iteration; each fires once):
+
+    - ``serve_delay_at_round`` + ``serve_delay_seconds`` — stall the
+      named decode round (a slow device / preempted host): deadlines
+      keep being enforced, so the drill shows timeouts and shedding,
+      not a hang.
+    - ``serve_raise_at_round`` — the round dispatch raises (adapter
+      step failure): the engine must quarantine the newest-admitted
+      row and keep the remaining slots serving.
+    - ``serve_exhaust_pool_at_admit`` — before the Nth staging call,
+      hoard EVERY free pool block (fragmentation / leak shape);
+      admission backpressures while active slots keep decoding.  The
+      hoard is released after ``serve_exhaust_pool_rounds`` further
+      decode rounds (recovery half of the drill).
     """
 
     kill_at_iteration: Optional[int] = None
@@ -234,6 +251,11 @@ class FaultPlan:
     nan_at_iteration: Optional[int] = None
     resize_at_iteration: Optional[int] = None
     resize_to: int = 0
+    serve_delay_at_round: Optional[int] = None
+    serve_delay_seconds: float = 0.0
+    serve_raise_at_round: Optional[int] = None
+    serve_exhaust_pool_at_admit: Optional[int] = None
+    serve_exhaust_pool_rounds: int = 4
     seed: int = 0
 
     def to_json(self) -> str:
@@ -307,6 +329,68 @@ class FaultInjector:
             sys.stdout.flush()
             sys.stderr.flush()
             os.kill(os.getpid(), _signal.SIGKILL)
+
+    _FAULT_HOARD = "__fault_pool_hoard__"
+
+    def attach_engine(self, engine):
+        """Apply the plan's SERVING faults to a ``ServingEngine`` by
+        wrapping its decode-round dispatch and staging path (host-side
+        wrappers — no recompile, no engine code knows it is under
+        test).  Round-keyed faults count ROUND DISPATCHES (including
+        failed ones), pool exhaustion counts STAGING calls.  Each
+        fault fires once; firings append to :attr:`fired` as
+        ``("serve_<kind>", count)``.  Returns the engine."""
+        plan = self.plan
+        # "ticks" = round dispatches + staging attempts: the release
+        # countdown must advance even when the pool hoard has idled
+        # every slot (no live rows -> no rounds, but each blocked
+        # admit attempt still stages)
+        state = {"rounds": 0, "stages": 0, "ticks": 0,
+                 "hoard_until": None}
+        real_round = engine._round_fn
+        real_stage = engine._stage
+
+        def maybe_release():
+            if (state["hoard_until"] is not None
+                    and state["ticks"] >= state["hoard_until"]):
+                engine._alloc.free_row(self._FAULT_HOARD)
+                state["hoard_until"] = None
+                self.fired.append(("serve_pool_release", state["ticks"]))
+
+        def round_wrapper(*args, **kwargs):
+            r = state["rounds"]
+            state["rounds"] += 1
+            state["ticks"] += 1
+            if plan.serve_delay_at_round == r:
+                self.fired.append(("serve_delay", r))
+                time.sleep(plan.serve_delay_seconds)
+            if plan.serve_raise_at_round == r:
+                self.fired.append(("serve_raise", r))
+                raise RuntimeError(
+                    "injected decode-round failure "
+                    "(FaultPlan.serve_raise_at_round)")
+            out = real_round(*args, **kwargs)
+            maybe_release()
+            return out
+
+        def stage_wrapper(req, rec, steal):
+            n = state["stages"]
+            state["stages"] += 1
+            state["ticks"] += 1
+            if (plan.serve_exhaust_pool_at_admit == n
+                    and self._FAULT_HOARD not in engine._alloc.rows()):
+                engine._alloc.alloc(self._FAULT_HOARD,
+                                    engine._alloc.n_free)
+                state["hoard_until"] = (
+                    state["ticks"] + plan.serve_exhaust_pool_rounds)
+                self.fired.append(("serve_pool_exhaust", n))
+            out = real_stage(req, rec, steal)
+            maybe_release()
+            return out
+
+        engine._round_fn = round_wrapper
+        engine._stage = stage_wrapper
+        return engine
 
 
 def requires_vma(reason: str = "requires vma-typed shard_map"):

@@ -318,9 +318,22 @@ class _NullInstrument:
     """The disabled-path instrument: ONE shared instance answering
     every record method as a no-op, so a disabled registry allocates
     nothing per record (pinned by test — the TraceRecorder
-    ``_NULL_SPAN`` discipline)."""
+    ``_NULL_SPAN`` discipline).  The READ surface answers like an
+    empty histogram/counter (count 0, ``percentile``/``mean`` →
+    ``None``) so consumers that read live instruments — e.g. a
+    service-time predictor over ``registry.histogram("serve/ttft")``
+    — degrade to "no data" instead of crashing when the registry is
+    disabled."""
 
     __slots__ = ()
+
+    count = 0
+    sum = 0.0
+    min = None
+    max = None
+    value = 0.0
+    last = None
+    mean = None
 
     def inc(self, n: float = 1.0) -> None:
         pass
@@ -330,6 +343,9 @@ class _NullInstrument:
 
     def observe(self, value: float) -> None:
         pass
+
+    def percentile(self, q: float) -> None:
+        return None
 
 
 _NULL_INSTRUMENT = _NullInstrument()

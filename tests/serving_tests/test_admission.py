@@ -1,0 +1,465 @@
+"""Admission control: predictor math, quota/queue-bound/deadline
+shedding, cancellation, deadline scheduling, and the deterministic
+tie-breaks the seeded overload bench depends on.
+
+The host-side policy pieces (predictor, controller verdicts) are pure
+and tested without a mesh; the engine-integration pieces reuse the
+conftest MiniLM fixtures.  Token identity for everything ADMITTED
+stays pinned by the oracle, sheds and all — admission control must
+change WHO is served, never WHAT they are served."""
+
+import time
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import (
+    SHED_REASONS,
+    AdmissionController,
+    ServiceTimePredictor,
+    ServingEngine,
+    ShedCompletion,
+)
+from chainermn_tpu.serving.engine import Request
+from chainermn_tpu.utils.metrics import MetricsRegistry, set_registry
+
+
+def _req(rid, max_new=8, priority=0, tenant=None, deadline=None,
+         t_submit=0.0, plen=4):
+    return Request(rid, np.zeros(plen, np.int32), max_new,
+                   t_submit=t_submit, priority=priority, tenant=tenant,
+                   deadline=deadline)
+
+
+class TestPredictor:
+    def test_cold_predicts_nothing(self):
+        p = ServiceTimePredictor()
+        assert p.ttft() is None and p.tpot() is None
+        assert p.predict_e2e(10) is None
+        assert p.predict_remaining(10) is None
+
+    def test_defaults_until_min_count(self):
+        p = ServiceTimePredictor(default_ttft=0.5, default_tpot=0.01,
+                                 min_count=4)
+        assert p.predict_e2e(11) == pytest.approx(0.5 + 0.01 * 10)
+        for _ in range(4):
+            p.observe_ttft(0.1)
+            p.observe_tpot(0.002)
+        # live percentiles replace the defaults once fed
+        assert p.ttft() == pytest.approx(0.1)
+        assert p.predict_e2e(11) == pytest.approx(0.1 + 0.002 * 10)
+
+    def test_quantile_is_the_tail(self):
+        p = ServiceTimePredictor(quantile=90.0, min_count=1)
+        for v in (0.01, 0.01, 0.01, 0.01, 0.01, 0.01, 0.01, 0.01, 0.01,
+                  1.0):
+            p.observe_tpot(v)
+        assert p.tpot() > 10 * 0.01       # the tail, not the median
+        assert p.tpot() == pytest.approx(
+            float(np.percentile([0.01] * 9 + [1.0], 90)))
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="quantile"):
+            ServiceTimePredictor(quantile=0)
+        with pytest.raises(ValueError, match="min_count"):
+            ServiceTimePredictor(min_count=0)
+
+    def test_snapshot(self):
+        p = ServiceTimePredictor(default_tpot=0.1)
+        snap = p.snapshot()
+        assert snap["tpot"] == 0.1 and snap["ttft_count"] == 0
+
+
+class TestControllerVerdicts:
+    def test_unbounded_admits_everything(self):
+        c = AdmissionController()
+        admit, reason, victim = c.check_submit(_req("a"), [], {})
+        assert (admit, reason, victim) == (True, None, None)
+
+    def test_quota_shed(self):
+        c = AdmissionController(quotas={"t": 20})
+        admit, reason, _ = c.check_submit(
+            _req("a", max_new=8, tenant="t"), [], {"t": 16})
+        assert not admit and reason == "over_quota"
+        # exactly-at-quota admits
+        admit, _, _ = c.check_submit(
+            _req("a", max_new=4, tenant="t"), [], {"t": 16})
+        assert admit
+        # other tenants unaffected (no default quota)
+        admit, _, _ = c.check_submit(
+            _req("a", max_new=100, tenant="u"), [], {"t": 16})
+        assert admit
+
+    def test_default_quota_and_anonymous_tenant(self):
+        c = AdmissionController(default_quota=10)
+        admit, reason, _ = c.check_submit(
+            _req("a", max_new=8), [], {None: 8})
+        assert not admit and reason == "over_quota"
+
+    def test_deadline_shed_needs_evidence(self):
+        cold = AdmissionController()
+        admit, _, _ = cold.check_submit(
+            _req("a", deadline=0.001), [], {})
+        assert admit                     # cold predictor: optimistic
+        hot = AdmissionController(predictor=ServiceTimePredictor(
+            default_ttft=1.0, default_tpot=0.1, min_count=99))
+        admit, reason, _ = hot.check_submit(
+            _req("a", max_new=10, deadline=0.5, t_submit=0.0), [], {})
+        assert not admit and reason == "deadline"
+        # a generous deadline admits
+        admit, _, _ = hot.check_submit(
+            _req("a", max_new=10, deadline=10.0, t_submit=0.0), [], {})
+        assert admit
+        # shed_on_deadline=False disables prediction
+        off = AdmissionController(predictor=hot.predictor,
+                                  shed_on_deadline=False)
+        admit, _, _ = off.check_submit(
+            _req("a", max_new=10, deadline=0.5, t_submit=0.0), [], {})
+        assert admit
+
+    def test_queue_bound_and_displacement(self):
+        c = AdmissionController(max_queue=2)
+        queue = [_req("q0", priority=1), _req("q1", priority=2)]
+        # same-or-higher priority arrival displaces the least
+        # important, NEWEST queued request
+        admit, reason, victim = c.check_submit(
+            _req("a", priority=0), queue, {})
+        assert admit and reason == "queue_full" and victim is queue[1]
+        # no lower-priority victim -> the arrival is shed
+        admit, reason, victim = c.check_submit(
+            _req("a", priority=2), queue, {})
+        assert not admit and reason == "queue_full" and victim is None
+
+    def test_displacement_tie_breaks_newest(self):
+        c = AdmissionController(max_queue=3)
+        queue = [_req("q0", priority=2), _req("q1", priority=2),
+                 _req("q2", priority=2)]
+        _, _, victim = c.check_submit(_req("a", priority=0), queue, {})
+        assert victim is queue[2]        # ties on priority: newest goes
+
+    def test_check_queued(self):
+        pred = ServiceTimePredictor(default_tpot=0.1, min_count=99)
+        c = AdmissionController(predictor=pred)
+        # 10 tokens -> 1s predicted remaining; 0.5s of slack left
+        assert c.check_queued(_req("a", max_new=10, deadline=100.5),
+                              now=100.0) == "deadline"
+        assert c.check_queued(_req("a", max_new=10, deadline=102.0),
+                              now=100.0) is None
+        assert c.check_queued(_req("a", max_new=10), now=100.0) is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_queue"):
+            AdmissionController(max_queue=0)
+        with pytest.raises(ValueError, match="quota"):
+            AdmissionController(quotas={"t": 0})
+        with pytest.raises(ValueError, match="default_quota"):
+            AdmissionController(default_quota=0)
+
+    def test_shed_completion_reason_coded(self):
+        with pytest.raises(ValueError, match="reason"):
+            ShedCompletion("r", np.zeros(1, np.int32), "nope", 0.0, 1.0)
+        s = ShedCompletion("r", np.zeros(1, np.int32), "queue_full",
+                           0.0, 1.0)
+        assert s.n_generated == 0 and s.tokens.shape == (0,)
+        assert s.status == "shed" and s.reason in SHED_REASONS
+
+
+@pytest.fixture(scope="module")
+def engine(mini_adapter, mini_params):
+    return ServingEngine(mini_adapter, mini_params, n_slots=8,
+                         horizon=160, max_prompt=16, block=8,
+                         round_tokens=4)
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _clear_admission(engine):
+    engine.admission = None
+
+
+class TestEngineAdmission:
+    def test_submit_returns_typed_reject_and_records_it(self, engine,
+                                                        registry):
+        engine.reset()
+        engine.admission = AdmissionController(max_queue=1)
+        try:
+            rng = np.random.RandomState(0)
+            r1 = engine.submit(rng.randint(0, 64, 6), max_new=4)
+            assert isinstance(r1, str)
+            r2 = engine.submit(rng.randint(0, 64, 6), max_new=4)
+            assert isinstance(r2, ShedCompletion)
+            assert r2.reason == "queue_full"
+            recs = engine.request_records()
+            assert recs and recs[-1] is r2
+            snap = engine.metrics_snapshot()
+            assert snap["serve/shed_total"]["value"] == 1
+            assert snap["serve/shed_queue_full"]["value"] == 1
+            assert engine.stats()["shed"] == {"queue_full": 1}
+            comps = engine.run(max_steps=500)
+            assert [c.status for c in comps] == ["ok"]
+        finally:
+            _clear_admission(engine)
+
+    def test_displacement_sheds_victim_not_arrival(self, engine):
+        engine.reset()
+        try:
+            rng = np.random.RandomState(1)
+            # fill every slot so the queue actually holds
+            blockers = [engine.submit(rng.randint(0, 64, 6), max_new=24)
+                        for _ in range(8)]
+            assert all(isinstance(b, str) for b in blockers)
+            engine.step()
+            engine.admission = AdmissionController(max_queue=2)
+            lo1 = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                                priority=2)
+            lo2 = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                                priority=2)
+            hi = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                               priority=0)
+            assert isinstance(hi, str)
+            out = engine.run(max_steps=1000)
+            sheds = [c for c in out if isinstance(c, ShedCompletion)]
+            assert len(sheds) == 1 and sheds[0].rid == lo2
+            assert sheds[0].reason == "queue_full"
+            assert "displaced" in sheds[0].detail
+            served = {c.rid for c in out if not isinstance(
+                c, ShedCompletion)}
+            assert hi in served and lo1 in served
+        finally:
+            _clear_admission(engine)
+
+    def test_tenant_quota_inflight_released_on_completion(self, engine):
+        engine.reset()
+        engine.admission = AdmissionController(quotas={"t": 8})
+        try:
+            rng = np.random.RandomState(2)
+            a = engine.submit(rng.randint(0, 64, 6), max_new=8,
+                              tenant="t")
+            assert isinstance(a, str)
+            b = engine.submit(rng.randint(0, 64, 6), max_new=1,
+                              tenant="t")
+            assert isinstance(b, ShedCompletion)
+            assert b.reason == "over_quota"
+            engine.run(max_steps=500)       # a completes, quota frees
+            c = engine.submit(rng.randint(0, 64, 6), max_new=8,
+                              tenant="t")
+            assert isinstance(c, str)
+            engine.run(max_steps=500)
+        finally:
+            _clear_admission(engine)
+
+    def test_predictive_deadline_shed_at_submit(self, engine):
+        engine.reset()
+        engine.admission = AdmissionController(
+            predictor=ServiceTimePredictor(default_ttft=10.0,
+                                           default_tpot=1.0,
+                                           min_count=99))
+        try:
+            r = engine.submit(np.arange(4) % 64, max_new=8, timeout=0.5)
+            assert isinstance(r, ShedCompletion)
+            assert r.reason == "deadline"
+        finally:
+            _clear_admission(engine)
+
+    def test_queued_timeout_sheds_not_ages(self, engine):
+        engine.reset()
+        rng = np.random.RandomState(3)
+        # all slots busy; the deadlined request waits in queue
+        for _ in range(8):
+            engine.submit(rng.randint(0, 64, 6), max_new=24)
+        engine.step()
+        doomed = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                               timeout=1e-4)
+        time.sleep(2e-3)
+        out = engine.run(max_steps=1000)
+        sheds = [c for c in out if isinstance(c, ShedCompletion)]
+        assert [s.rid for s in sheds] == [doomed]
+        assert sheds[0].reason == "timeout"
+        assert engine.stats()["shed"] == {"timeout": 1}
+
+    def test_midstream_timeout_partial_tokens(self, engine, oracle,
+                                              registry):
+        engine.reset()
+        rng = np.random.RandomState(4)
+        p = rng.randint(0, 64, 8)
+        rid = engine.submit(p, max_new=30)
+        engine.step()
+        engine.step()
+        (s,) = [s for s in range(8) if engine._slot_req[s] is not None]
+        engine._slot_req[s].deadline = time.perf_counter() - 1.0
+        comps = engine.run(max_steps=500)
+        (c,) = comps
+        assert c.rid == rid and c.status == "timeout"
+        assert 0 < c.n_generated < 30
+        # the partial tokens are a PREFIX of the solo decode — a
+        # timeout truncates, never corrupts
+        np.testing.assert_array_equal(c.tokens,
+                                      oracle(p, 30)[:c.n_generated])
+        snap = engine.metrics_snapshot()
+        assert snap["serve/timeouts"]["value"] == 1
+        assert engine.stats()["timeouts"] == 1
+        assert engine.stats()["wasted_tokens"] == c.n_generated
+
+    def test_cancel_queued_and_active(self, engine, registry):
+        engine.reset()
+        rng = np.random.RandomState(5)
+        for _ in range(8):
+            engine.submit(rng.randint(0, 64, 6), max_new=16)
+        engine.step()
+        queued = engine.submit(rng.randint(0, 64, 6), max_new=4)
+        active = engine.admit_log[0]
+        assert engine.cancel(queued) and engine.cancel(active)
+        assert not engine.cancel("nope")
+        assert not engine.cancel(queued)    # already drained
+        out = engine.run(max_steps=1000)
+        sheds = {c.rid for c in out if isinstance(c, ShedCompletion)}
+        assert sheds == {queued}
+        by_rid = {c.rid: c for c in out
+                  if not isinstance(c, ShedCompletion)}
+        assert by_rid[active].status == "cancelled"
+        assert engine.stats()["cancelled"] == 1
+        assert engine.stats()["shed"] == {"cancelled": 1}
+        snap = engine.metrics_snapshot()
+        assert snap["serve/cancelled"]["value"] == 1
+        assert snap["serve/shed_cancelled"]["value"] == 1
+
+    def test_cancel_after_done_does_not_relabel(self, engine):
+        """Racing cancel() against completion: a row that already
+        finished its decode (done, awaiting eviction) must NOT be
+        relabelled cancelled — the caller gets False and the served
+        completion stays ok."""
+        engine.reset()
+        rid = engine.submit(np.arange(6) % 64, max_new=4)
+        engine.step()               # admit + round: budget reached
+        (s,) = [s for s in range(engine.n_slots)
+                if engine._slot_req[s] is not None]
+        assert engine._done[s]      # finished, not yet evicted
+        assert not engine.cancel(rid)
+        (c,) = engine.run(max_steps=200)
+        assert c.status == "ok" and c.n_generated == 4
+        assert engine.stats()["cancelled"] == 0
+
+    def test_ttft_tpot_feed_attached_predictor(self, engine):
+        engine.reset()
+        ctrl = AdmissionController()
+        engine.admission = ctrl
+        try:
+            rng = np.random.RandomState(6)
+            for _ in range(4):
+                engine.submit(rng.randint(0, 64, 6), max_new=6)
+            engine.run(max_steps=500)
+            assert ctrl.predictor.ttft_hist.count == 4
+            assert ctrl.predictor.tpot_hist.count == 4
+        finally:
+            _clear_admission(engine)
+
+    def test_timeout_validation(self, engine):
+        engine.reset()
+        with pytest.raises(ValueError, match="not both"):
+            engine.submit(np.arange(4) % 64, max_new=4, timeout=1.0,
+                          deadline=time.perf_counter() + 1)
+        with pytest.raises(ValueError, match="timeout"):
+            engine.submit(np.arange(4) % 64, max_new=4, timeout=0.0)
+
+
+class TestDeterministicPolicies:
+    def test_spf_ties_break_by_submit_order(self, engine):
+        engine.reset()
+        rng = np.random.RandomState(7)
+        # 12 equal-length prompts: spf must degrade to exact FCFS
+        rids = [engine.submit(rng.randint(0, 64, 6), max_new=4)
+                for _ in range(12)]
+        engine.set_policy("spf")
+        try:
+            engine.run(max_steps=500)
+            assert engine.admit_log == rids
+        finally:
+            engine.set_policy("fcfs")
+
+    def test_deadline_policy_orders_by_slack(self, engine):
+        engine.reset()
+        engine.set_policy("deadline")
+        engine.admission = AdmissionController(
+            predictor=ServiceTimePredictor(default_ttft=0.0,
+                                           default_tpot=0.0,
+                                           min_count=99))
+        try:
+            rng = np.random.RandomState(8)
+            # saturate slots so ordering among the queued is visible
+            blockers = [engine.submit(rng.randint(0, 64, 6),
+                                      max_new=12) for _ in range(8)]
+            engine.step()
+            loose = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                                  timeout=500.0)
+            tight = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                                  timeout=400.0)
+            none_ = engine.submit(rng.randint(0, 64, 6), max_new=4)
+            engine.run(max_steps=1000)
+            admits = engine.admit_log
+            assert admits[:8] == blockers
+            order = [admits.index(r) for r in (tight, loose, none_)]
+            assert order == sorted(order)   # tightest slack first,
+        finally:                            # deadline-less last
+            engine.set_policy("fcfs")
+            _clear_admission(engine)
+
+    def test_deadline_policy_priority_classes_first(self, engine):
+        engine.reset()
+        engine.set_policy("deadline")
+        try:
+            rng = np.random.RandomState(9)
+            blockers = [engine.submit(rng.randint(0, 64, 6),
+                                      max_new=12) for _ in range(8)]
+            engine.step()
+            # class 1 with a tight deadline loses to class 0 without
+            lo = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                               priority=1, timeout=300.0)
+            hi = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                               priority=0)
+            engine.run(max_steps=1000)
+            assert engine.admit_log.index(hi) \
+                < engine.admit_log.index(lo)
+        finally:
+            engine.set_policy("fcfs")
+
+    def test_deadline_policy_ties_break_by_submit_order(self, engine):
+        engine.reset()
+        engine.set_policy("deadline")
+        try:
+            rng = np.random.RandomState(10)
+            blockers = [engine.submit(rng.randint(0, 64, 6),
+                                      max_new=12) for _ in range(8)]
+            del blockers
+            engine.step()
+            # identical (priority, no-deadline) keys: submit order
+            rids = [engine.submit(rng.randint(0, 64, 6), max_new=4)
+                    for _ in range(6)]
+            engine.run(max_steps=1000)
+            tail = [r for r in engine.admit_log if r in set(rids)]
+            assert tail == rids
+        finally:
+            engine.set_policy("fcfs")
+
+    def test_seeded_trace_admits_identically_twice(self, engine):
+        engine.set_policy("deadline")
+        try:
+            logs = []
+            for _ in range(2):
+                engine.reset()
+                rng = np.random.RandomState(11)
+                for _ in range(14):
+                    engine.submit(
+                        rng.randint(0, 64, rng.randint(2, 16)),
+                        max_new=int(rng.randint(4, 12)),
+                        timeout=float(rng.uniform(200, 400)))
+                engine.run(max_steps=1000)
+                logs.append(list(engine.admit_log))
+            assert logs[0] == logs[1]
+        finally:
+            engine.set_policy("fcfs")

@@ -13,9 +13,12 @@ import pytest
 
 from chainermn_tpu.parallel import MeshConfig
 from chainermn_tpu.serving import (
+    AdmissionController,
     MiniLMAdapter,
     ServingEngine,
+    ShedCompletion,
 )
+from chainermn_tpu.serving.engine import Request
 from chainermn_tpu.utils.telemetry import (
     TraceRecorder,
     get_recorder,
@@ -168,6 +171,63 @@ class TestScheduling:
         with pytest.raises(ValueError, match="policy"):
             ServingEngine(mini_adapter, mini_params, n_slots=8,
                           horizon=160, max_prompt=16, policy="lifo")
+
+    def test_policy_returning_non_queue_request_raises(self, engine):
+        """A callable policy that fabricates a request (or returns a
+        stale one) must fail loudly at the pick, not admit garbage."""
+        engine.reset()
+        rogue = Request("ghost", np.arange(4, dtype=np.int32), 4)
+        engine.set_policy(lambda queue, eng: rogue)
+        try:
+            engine.submit(np.arange(4) % 64, max_new=4)
+            with pytest.raises(ValueError,
+                               match="not in the queue"):
+                engine.step()
+        finally:
+            engine.set_policy("fcfs")
+            engine.reset()
+
+    def test_submit_validation_rejects_degenerate_requests(self,
+                                                           engine):
+        engine.reset()
+        with pytest.raises(ValueError, match="prompt length"):
+            engine.submit(np.zeros(0, np.int32))
+        with pytest.raises(ValueError, match="max_new"):
+            engine.submit(np.zeros(4, np.int32), max_new=0)
+        with pytest.raises(ValueError, match="max_new"):
+            engine.submit(np.zeros(4, np.int32), max_new=-3)
+        assert engine.idle          # nothing leaked into the queue
+
+    def test_pool_backpressure_victim_steal_with_shedding(
+            self, mini_adapter, mini_params, oracle, ragged_trace):
+        """The PR 8 steal path × the admission layer: a one-chunk pool
+        forces the admission path to steal ahead-staged blocks while a
+        controller is attached and a hopeless deadline sheds — tokens
+        of everything SERVED stay exact, the shed is typed, nothing
+        deadlocks."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            pool_blocks=2, round_tokens=4, policy="spf",
+                            prefill_ahead=4,
+                            admission=AdmissionController(max_queue=64))
+        rng = np.random.RandomState(12)
+        blockers = ragged_trace(rng, 8, min_new=16, max_new=20)
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in blockers]
+        for _ in range(2):
+            eng.step()              # all slots busy; ahead-staging runs
+        long_p = rng.randint(0, 64, 16)
+        short_p = rng.randint(0, 64, 3)
+        rids.append((eng.submit(long_p, max_new=6), long_p, 6))
+        rids.append((eng.submit(short_p, max_new=6), short_p, 6))
+        doomed = eng.submit(rng.randint(0, 64, 4), max_new=6,
+                            timeout=1e-4)
+        time.sleep(2e-3)
+        out = eng.run(max_steps=2000)
+        sheds = [c for c in out if isinstance(c, ShedCompletion)]
+        assert [s.rid for s in sheds] == [doomed]
+        assert sheds[0].reason == "timeout"
+        comps = [c for c in out if not isinstance(c, ShedCompletion)]
+        _check_parity(comps, rids, oracle)
 
     def test_pool_backpressure_steals_ahead_staging(
             self, mini_adapter, mini_params, oracle, ragged_trace):

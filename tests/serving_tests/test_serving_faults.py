@@ -1,0 +1,228 @@
+"""Serving-tier fault drills: each injected fault (slow decode round,
+decode-round exception, pool exhaustion) must complete its drill with
+the engine still serving the remaining slots and the failure visible
+in metrics — no hang, no crash (docs/RESILIENCE.md, serving rows).
+
+Faults are scripted through ``FaultPlan``'s serving actions and
+applied by ``FaultInjector.attach_engine`` — host-side wrappers over
+the round/staging dispatch, the same deterministic-injection
+discipline as the training drills.  Engines are WARMED before a drill
+(first-use compiles take seconds and would eat any realistic
+deadline budget)."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import ServingEngine, ShedCompletion
+from chainermn_tpu.testing import FaultInjector, FaultPlan
+from chainermn_tpu.utils.metrics import MetricsRegistry, set_registry
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _warmed_engine(mini_adapter, mini_params, **kw):
+    eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                        horizon=160, max_prompt=16, block=8,
+                        round_tokens=4, **kw)
+    rng = np.random.RandomState(99)
+    for _ in range(2):
+        eng.submit(rng.randint(0, 64, 8), max_new=4)
+    eng.run(max_steps=200)
+    eng.warm()
+    eng.reset()
+    return eng
+
+
+def _ragged_submit(eng, rng, n, max_new=10, **kw):
+    return [eng.submit(rng.randint(0, 64, rng.randint(2, 16)),
+                       max_new=max_new, **kw) for _ in range(n)]
+
+
+class TestRoundFailure:
+    def test_raise_quarantines_newest_and_keeps_serving(
+            self, mini_adapter, mini_params, oracle, registry):
+        eng = _warmed_engine(mini_adapter, mini_params)
+        inj = FaultInjector(FaultPlan(serve_raise_at_round=1))
+        inj.attach_engine(eng)
+        rng = np.random.RandomState(0)
+        trace = [(rng.randint(0, 64, rng.randint(2, 16)),
+                  int(rng.randint(6, 12))) for _ in range(6)]
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+        comps = eng.run(max_steps=500)       # no hang, no crash
+        assert ("serve_raise", 1) in inj.fired
+        by = {c.rid: c for c in comps}
+        statuses = sorted(c.status for c in comps)
+        assert statuses == ["ok"] * 5 + ["quarantined"]
+        # the quarantined row is the NEWEST admission of the failed
+        # round's batch, and its record names the injected error
+        bad = [c for c in comps if c.status == "quarantined"][0]
+        assert "injected decode-round failure" in bad.detail
+        # everyone else still got their exact solo tokens
+        for rid, p, n in rids:
+            if by[rid].status == "ok":
+                np.testing.assert_array_equal(by[rid].tokens,
+                                              oracle(p, n))
+        assert eng.n_quarantined == 1
+        snap = eng.metrics_snapshot()
+        assert snap["serve/quarantined"]["value"] == 1
+        assert snap["serve/round_failures"]["value"] == 1
+
+    def test_persistent_failure_drains_without_hanging(
+            self, mini_adapter, mini_params):
+        """Every round raising is the worst case: the engine must
+        degrade one quarantine per step until empty — never hang,
+        never crash."""
+        eng = _warmed_engine(mini_adapter, mini_params)
+
+        real = eng._round_fn
+
+        def always_fail(*a, **k):
+            raise RuntimeError("persistent adapter fault")
+
+        eng._round_fn = always_fail
+        rng = np.random.RandomState(1)
+        _ragged_submit(eng, rng, 4)
+        comps = eng.run(max_steps=200)
+        assert sorted(c.status for c in comps) == ["quarantined"] * 4
+        assert eng.idle
+        eng._round_fn = real
+        # the engine still serves after the fault clears
+        _ragged_submit(eng, rng, 2, max_new=4)
+        comps = eng.run(max_steps=200)
+        assert [c.status for c in comps] == ["ok", "ok"]
+
+
+class TestSlowRound:
+    def test_delay_turns_into_timeouts_not_hang(
+            self, mini_adapter, mini_params, registry):
+        eng = _warmed_engine(mini_adapter, mini_params)
+        inj = FaultInjector(FaultPlan(serve_delay_at_round=1,
+                                      serve_delay_seconds=0.4))
+        inj.attach_engine(eng)
+        rng = np.random.RandomState(2)
+        # generous for the warmed round cadence, fatal under the stall
+        _ragged_submit(eng, rng, 8, max_new=12, timeout=0.3)
+        comps = eng.run(max_steps=500)
+        assert ("serve_delay", 1) in inj.fired
+        assert len(comps) == 8
+        timeouts = [c for c in comps
+                    if getattr(c, "status", "") == "timeout"]
+        assert timeouts                     # the stall is VISIBLE
+        assert eng.stats()["timeouts"] == len(timeouts)
+        snap = eng.metrics_snapshot()
+        assert snap["serve/timeouts"]["value"] == len(timeouts)
+        # and the engine is healthy afterwards
+        eng.submit(rng.randint(0, 64, 8), max_new=4)
+        assert [c.status for c in eng.run(max_steps=200)] == ["ok"]
+
+
+class TestPoolExhaustion:
+    def test_exhaustion_backpressures_then_recovers(
+            self, mini_adapter, mini_params, oracle, registry):
+        eng = _warmed_engine(mini_adapter, mini_params,
+                             prefill_ahead=0)
+        inj = FaultInjector(FaultPlan(serve_exhaust_pool_at_admit=8,
+                                      serve_exhaust_pool_rounds=3))
+        inj.attach_engine(eng)
+        rng = np.random.RandomState(3)
+        trace = [(rng.randint(0, 64, rng.randint(2, 16)), 8)
+                 for _ in range(16)]
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+        comps = eng.run(max_steps=2000)
+        kinds = [k for k, *_ in inj.fired]
+        assert "serve_pool_exhaust" in kinds
+        assert "serve_pool_release" in kinds     # recovery half
+        # nothing lost, nothing corrupted: every request served
+        # exactly once the pool came back
+        assert len(comps) == 16
+        by = {c.rid: c for c in comps}
+        for rid, p, n in rids:
+            assert by[rid].status == "ok"
+            np.testing.assert_array_equal(by[rid].tokens, oracle(p, n))
+
+    def test_exhaustion_with_deadlines_sheds_fast(
+            self, mini_adapter, mini_params, registry):
+        """With deadlines attached, a held pool converts queued work
+        into timely ``timeout`` sheds instead of unbounded aging —
+        and the already-admitted rows keep serving throughout."""
+        eng = _warmed_engine(mini_adapter, mini_params,
+                             prefill_ahead=0)
+        # hold the pool effectively forever: the drill's point is
+        # that deadlines bound the damage WITHOUT the pool coming back
+        inj = FaultInjector(FaultPlan(serve_exhaust_pool_at_admit=8,
+                                      serve_exhaust_pool_rounds=10**9))
+        inj.attach_engine(eng)
+        rng = np.random.RandomState(4)
+        first = _ragged_submit(eng, rng, 8, max_new=12, timeout=30.0)
+        for _ in range(2):
+            eng.step()                   # all 8 admitted and decoding
+        starved = _ragged_submit(eng, rng, 4, max_new=8, timeout=0.1)
+        # the starved queue spins cheap host-only steps until the
+        # deadlines expire — give the step budget real headroom
+        comps = eng.run(max_steps=100_000)
+        by = {c.rid: c for c in comps}
+        # admitted rows finished OK while the pool was held
+        assert all(by[r].status == "ok" for r in first)
+        # starved rows shed as timeouts, queue drained, no hang
+        assert all(isinstance(by[r], ShedCompletion)
+                   and by[r].reason == "timeout" for r in starved)
+        assert eng.idle
+        snap = eng.metrics_snapshot()
+        assert snap["serve/shed_timeout"]["value"] == 4
+
+
+class TestStageFailure:
+    def test_poison_prompt_quarantined_queue_flows(
+            self, mini_adapter, mini_params, oracle, registry):
+        """A prefill failure is attributable to ONE request: it is
+        shed ``quarantined`` and the rest of the queue is admitted
+        normally."""
+        eng = _warmed_engine(mini_adapter, mini_params,
+                             prefill_ahead=0)
+        rng = np.random.RandomState(5)
+        poison_rid = {}
+
+        real_stage = eng._stage
+
+        def stage_wrapper(req, rec, steal):
+            if req.rid == poison_rid.get("rid"):
+                raise RuntimeError("injected prefill failure")
+            return real_stage(req, rec, steal)
+
+        eng._stage = stage_wrapper
+        trace = [(rng.randint(0, 64, rng.randint(2, 16)), 6)
+                 for _ in range(10)]
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+        poison_rid["rid"] = rids[3][0]
+        comps = eng.run(max_steps=1000)
+        by = {c.rid: c for c in comps}
+        bad = by[rids[3][0]]
+        assert isinstance(bad, ShedCompletion)
+        assert bad.reason == "quarantined" and "prefill" in bad.detail
+        for rid, p, n in rids:
+            if rid != rids[3][0]:
+                assert by[rid].status == "ok"
+                np.testing.assert_array_equal(by[rid].tokens,
+                                              oracle(p, n))
+        # queue-side termination: counted in the shed taxonomy ONLY
+        # (serve/quarantined covers mid-stream evictions — disjoint)
+        assert eng.stats()["shed"]["quarantined"] == 1
+        assert eng.n_quarantined == 0
+        snap = eng.metrics_snapshot()
+        assert "serve/quarantined" not in snap
+        assert snap["serve/shed_quarantined"]["value"] == 1
+        assert snap["serve/shed_total"]["value"] == 1
+
+    def test_fault_plan_serving_fields_round_trip(self):
+        plan = FaultPlan(serve_delay_at_round=3,
+                         serve_delay_seconds=0.5,
+                         serve_raise_at_round=7,
+                         serve_exhaust_pool_at_admit=2,
+                         serve_exhaust_pool_rounds=9)
+        assert FaultPlan.from_json(plan.to_json()) == plan

@@ -144,3 +144,99 @@ class TestSLOReport:
         slo.add_arm("a", [{"queue_wait": 0.1, "ttft": 0.2,
                            "tpot": 0.01, "e2e": 0.5}])
         assert slo.summary()["a"]["e2e"]["p50"] == pytest.approx(0.5)
+
+
+class TestSLOSkipsAndAttainment:
+    """Shed and timed-out records have no TTFT (or none of the latency
+    fields at all): the report must skip-count them per arm — never
+    observe a None — and the SLO column must score goodput from
+    fully-served records only."""
+
+    def test_none_and_missing_fields_skip_counted(self):
+        from chainermn_tpu.serving import ShedCompletion
+
+        served = [{"queue_wait": 0.01, "ttft": 0.1 * (i + 1),
+                   "tpot": 0.01, "e2e": 0.2 * (i + 1)}
+                  for i in range(4)]
+        timed_out = {"queue_wait": 0.01, "ttft": None, "tpot": None,
+                     "e2e": 0.9, "status": "timeout"}
+        shed = ShedCompletion("s0", np.zeros(2, np.int32),
+                              "queue_full", 0.0, 0.1)
+        slo = SLOReport(percentiles=(50,))
+        slo.add_arm("mix", served + [timed_out, shed])
+        s = slo.summary()["mix"]
+        # percentiles over the PRESENT values only, numpy-identical
+        assert s["ttft"]["count"] == 4
+        assert s["ttft"]["p50"] == pytest.approx(float(np.percentile(
+            [r["ttft"] for r in served], 50)))
+        assert s["e2e"]["count"] == 5       # timeout rows have e2e
+        # the skips are REPORTED, per field
+        assert s["skipped"] == {"queue_wait": 1, "ttft": 2,
+                                "tpot": 2, "e2e": 1}
+        assert slo.skipped("mix")["ttft"] == 2
+
+    def test_partial_completion_properties_skip_not_raise(self):
+        """An engine Completion evicted before its first token has
+        t_admit/t_first None — its derived properties must read as
+        None (skipped), not raise out of the report."""
+        from chainermn_tpu.serving import Completion
+
+        c = Completion(rid="r", prompt=np.zeros(2, np.int32),
+                       tokens=np.zeros(0, np.int32), t_submit=1.0,
+                       t_admit=None, t_first=None, t_done=2.0,
+                       slot=0, status="timeout")
+        assert c.queue_wait is None and c.ttft is None \
+            and c.tpot is None
+        assert c.e2e == pytest.approx(1.0)
+        slo = SLOReport(percentiles=(50,))
+        slo.add_arm("a", [c])
+        assert slo.summary()["a"]["skipped"]["ttft"] == 1
+
+    def test_attainment_and_goodput_scalar_target(self):
+        recs = [
+            {"e2e": 0.2, "n_generated": 10},                  # attains
+            {"e2e": 0.9, "n_generated": 10},                  # late
+            {"e2e": 0.1, "n_generated": 7,
+             "status": "timeout"},                            # not ok
+            {"e2e": None, "n_generated": 0, "status": "shed"},
+        ]
+        slo = SLOReport(percentiles=(50,))
+        slo.add_arm("arm", recs, slo=0.5)
+        s = slo.summary()["arm"]["slo"]
+        assert s["scored"] == 4 and s["attained"] == 1
+        assert s["attainment"] == pytest.approx(0.25)
+        assert s["goodput_tokens"] == 10
+        assert s["shed"] == 1
+        assert "attained" in slo.render() and "goodput" in slo.render()
+
+    def test_attainment_callable_target_with_exemption(self):
+        recs = [{"rid": "a", "e2e": 0.2, "n_generated": 5},
+                {"rid": "b", "e2e": 0.2, "n_generated": 5},
+                {"rid": "c", "e2e": 0.2, "n_generated": 5}]
+        targets = {"a": 0.5, "b": 0.1, "c": None}   # c exempt
+        slo = SLOReport(percentiles=(50,))
+        slo.add_arm("arm", recs, slo=lambda r: targets[r["rid"]])
+        s = slo.summary()["arm"]["slo"]
+        assert s["scored"] == 2 and s["attained"] == 1
+        assert s["goodput_tokens"] == 5
+
+    def test_unscored_batch_leaves_scored_arm_consistent(self):
+        """Accumulating a batch WITHOUT slo= into a previously scored
+        arm folds its latencies in but leaves the slo block untouched
+        — attainment and shed counts must cover one population."""
+        slo = SLOReport(percentiles=(50,))
+        slo.add_arm("a", [{"e2e": 0.2, "n_generated": 3}], slo=0.5)
+        before = dict(slo.summary()["a"]["slo"])
+        slo.add_arm("a", [{"e2e": 0.4, "n_generated": 9},
+                          {"e2e": None, "status": "shed"}])
+        after = slo.summary()["a"]
+        assert after["slo"] == before
+        assert after["e2e"]["count"] == 2       # latencies DID fold in
+
+    def test_unscored_arm_has_no_slo_block(self):
+        slo = SLOReport(percentiles=(50,))
+        slo.add_arm("a", [{"e2e": 0.1}])
+        assert "slo" not in slo.summary()["a"]
+        # json round-trips with the new blocks
+        doc = slo.to_dict()
+        assert doc["arms"]["a"]["skipped"]["ttft"] == 1

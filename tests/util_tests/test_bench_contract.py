@@ -188,13 +188,19 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
       "--max-new", "24", "--round-tokens", "2", "--d-model", "32",
       "--n-layers", "1", "--heads", "2", "--vocab", "64",
       "--rounds", "1"], "x"),
+    ("bench_overload.py",
+     ["--requests", "12", "--slots", "8", "--horizon", "128",
+      "--max-prompt", "16", "--block", "8", "--min-new", "4",
+      "--max-new", "24", "--round-tokens", "2", "--d-model", "32",
+      "--n-layers", "1", "--heads", "2", "--vocab", "64",
+      "--rounds", "1"], "x"),
     ("bench_elastic.py",
      ["--dim", "64", "--hidden", "64", "--batch", "16",
       "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
         "autotune", "telemetry", "metrics_registry", "overlap",
-        "serving", "elastic"])
+        "serving", "overload", "elastic"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
