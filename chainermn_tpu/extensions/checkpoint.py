@@ -43,7 +43,11 @@ import re
 from typing import List, Optional, Set
 
 from chainermn_tpu.utils.serialization import (
+    ShardSetError,
     SnapshotCorruptError,
+    assemble_shard_state,
+    build_shard_part,
+    load_state_with_stamps,
     load_state_with_topology,
     save_state,
 )
@@ -52,11 +56,48 @@ _LOG = logging.getLogger(__name__)
 
 __all__ = ["MultiNodeCheckpointer", "create_multi_node_checkpointer"]
 
-_FILE_RE = re.compile(r"^(?P<name>.+)_iter_(?P<iter>\d+)\.(?P<rank>\d+)$")
+# Two file shapes share one namespace: full per-process shards
+# (``name_iter_7.0`` — rank suffix) and shard-only covering-set parts
+# (``name_iter_7.s3of8`` — member 3 of a world-8 set).  Quarantined
+# ``*.corrupt`` files match neither.
+_FILE_RE = re.compile(
+    r"^(?P<name>.+)_iter_(?P<iter>\d+)\."
+    r"(?:(?P<rank>\d+)|s(?P<member>\d+)of(?P<world>\d+))$")
 
 
 def _snapshot_filename(name: str, iteration: int, rank: int) -> str:
     return f"{name}_iter_{iteration}.{rank}"
+
+
+def _shard_filename(name: str, iteration: int, member: int,
+                    world: int) -> str:
+    return f"{name}_iter_{iteration}.s{member}of{world}"
+
+
+def _host_view_nonshard(state: dict, topology) -> dict:
+    """Host view of every leaf a shard-only set does NOT split.
+
+    ``_host_view`` is a collective for process-spanning leaves, and the
+    flatten order is identical on every process — running this on all
+    ranks before ``build_shard_part`` keeps the collectives symmetric.
+    Shard-kind ``opt_state`` leaves pass through untouched (their rows
+    are extracted locally by ``_member_rows``)."""
+    import jax
+
+    from chainermn_tpu.utils.serialization import (
+        _host_view,
+        shard_leaf_indices,
+    )
+
+    idxs = set(shard_leaf_indices(topology))
+    out = jax.device_get(jax.tree.map(
+        _host_view, {k: v for k, v in state.items() if k != "opt_state"}))
+    if "opt_state" in state:
+        leaves, treedef = jax.tree.flatten(state["opt_state"])
+        leaves = [leaf if i in idxs else jax.device_get(_host_view(leaf))
+                  for i, leaf in enumerate(leaves)]
+        out["opt_state"] = jax.tree.unflatten(treedef, leaves)
+    return out
 
 
 class MultiNodeCheckpointer:
@@ -76,7 +117,7 @@ class MultiNodeCheckpointer:
 
     def __init__(self, comm, path: str, name: str = "snapshot",
                  async_write: bool = False, history: int = 1,
-                 elastic: bool = False):
+                 elastic: bool = False, shard_only: bool = False):
         self.comm = comm
         self.path = path
         self.name = name
@@ -87,33 +128,93 @@ class MultiNodeCheckpointer:
         # (docs/RESILIENCE.md recommends 2 for production jobs).
         self.history = max(int(history), 1)
         self.elastic = bool(elastic)
+        self.shard_only = bool(shard_only)
         # "exact" | "relayout" | None — which resume path the last
         # maybe_load took (the drills pin that same-topology resumes
         # never re-lay)
         self.last_resume_mode = None
         self._saved_iterations: Set[int] = set()
         self._pending = None  # (thread, iteration, error_box)
+        # iterations whose set the background writer is STILL streaming:
+        # excluded from the disk inventory (a partially-renamed
+        # multi-file set must never look complete) and protected from —
+        # while never counting toward — ``history=N`` until the join +
+        # barrier agrees the set complete (docs/RESILIENCE.md
+        # "Scale-free snapshots")
+        self._streaming: Set[int] = set()
+        # double-buffered host copy for the async path: the writer owns
+        # one buffer while the next save's device→host copy fills the
+        # other, so the copy overlaps the previous stream instead of
+        # waiting behind it
+        self._host_bufs = [None, None]
+        self._host_idx = 0
 
     # ------------------------------------------------------------------ #
     # inventory
     # ------------------------------------------------------------------ #
 
-    def _local_iterations(self, any_rank: bool = False) -> Set[int]:
-        """Iterations this process can see shards for on its disk —
-        own-rank files only by default; ``any_rank`` widens to every
-        rank's files (the elastic-resume inventory: after a shrink, or
-        for the grown ranks that never had a shard of their own, any
-        clean shard covers the replicated state and the full gathered
-        ZeRO stack)."""
+    def _scan(self) -> dict:
+        """The on-disk set inventory: ``{iteration: {"ranks": set of
+        full-file ranks, "parts": {member: filename}, "world": int or
+        None}}``.  Quarantined ``*.corrupt`` files match neither file
+        shape and never appear."""
+        out: dict = {}
         if not os.path.isdir(self.path):
-            return set()
-        found = set()
+            return out
         for fn in os.listdir(self.path):
             m = _FILE_RE.match(fn)
-            if (m and m.group("name") == self.name
-                    and (any_rank
-                         or int(m.group("rank")) == self.comm.inter_rank)):
-                found.add(int(m.group("iter")))
+            if not m or m.group("name") != self.name:
+                continue
+            rec = out.setdefault(
+                int(m.group("iter")),
+                {"ranks": set(), "parts": {}, "world": None})
+            if m.group("rank") is not None:
+                rec["ranks"].add(int(m.group("rank")))
+            else:
+                rec["parts"][int(m.group("member"))] = fn
+                rec["world"] = int(m.group("world"))
+
+        return out
+
+    @staticmethod
+    def _parts_complete(rec: dict) -> bool:
+        return (rec["world"] is not None
+                and set(rec["parts"]) == set(range(rec["world"])))
+
+    def _owned_members(self) -> List[int]:
+        """Mesh members whose shard-set part files THIS process writes,
+        quarantines and GCs (single-controller: all of them).  Without
+        a mesh (control-plane facade comms) rank 0 owns everything."""
+        mesh = getattr(self.comm, "mesh", None)
+        if mesh is None:
+            return (list(range(int(getattr(self.comm, "size", 1))))
+                    if self.comm.inter_rank == 0 else [])
+        import jax
+        import numpy as np
+
+        me = jax.process_index()
+        devs = list(np.asarray(mesh.devices, dtype=object).reshape(-1))
+        return [m for m, d in enumerate(devs) if d.process_index == me]
+
+    def _local_iterations(self, any_rank: bool = False) -> Set[int]:
+        """Iterations this process can see COMPLETE sets for on its
+        disk: own-rank full files by default (``any_rank`` widens to
+        every rank's files — the elastic-resume inventory: after a
+        shrink, or for grown ranks that never had a shard of their own,
+        any clean shard covers the replicated state and the full
+        gathered ZeRO stack), plus shard-only covering sets with every
+        member part present.  Iterations still being streamed by the
+        background writer are EXCLUDED — a set counts only once its
+        completion is agreed (the join + barrier)."""
+        found = set()
+        for it, rec in self._scan().items():
+            if it in self._streaming:
+                continue
+            if self.comm.inter_rank in rec["ranks"] \
+                    or (any_rank and rec["ranks"]):
+                found.add(it)
+            elif self._parts_complete(rec):
+                found.add(it)
         return found
 
     def _iteration_shards(self, it: int):
@@ -125,7 +226,11 @@ class MultiNodeCheckpointer:
         rows = []
         for fn in os.listdir(self.path):
             m = _FILE_RE.match(fn)
+            # rank is None for shard-only part files (.sNofM) — they can
+            # share an iteration with full shards after a mode switch or
+            # a mid-quarantine scan, and this path reads full shards only
             if (m and m.group("name") == self.name
+                    and m.group("rank") is not None
                     and int(m.group("iter")) == it):
                 rows.append((int(m.group("rank")),
                              os.path.join(self.path, fn)))
@@ -133,17 +238,24 @@ class MultiNodeCheckpointer:
         rows.sort(key=lambda rp: (rp[0] != me, rp[0]))
         return rows
 
-    def _common_iterations(self) -> List[int]:
-        """Iterations every process holds (the agreement allgather).
-        In elastic mode the per-rank inventory is any-rank, matching
-        the widened resume discovery: after a GROW, ranks that never
-        owned a shard of an old set still see (and protect) the
-        borrowable files — otherwise the first post-grow save would
-        evict the only covering set ``history`` exists to keep."""
-        all_sets = self.comm.allgather_obj(
-            self._local_iterations(any_rank=self.elastic))
-        common = set.intersection(*all_sets) if all_sets else set()
-        return sorted(common)
+    def _agreed_inventory(self):
+        """``(common, streaming)``: iterations every process holds, and
+        the union of iterations any process is still streaming (the
+        agreement allgather).  In elastic mode the per-rank inventory
+        is any-rank, matching the widened resume discovery: after a
+        GROW, ranks that never owned a shard of an old set still see
+        (and protect) the borrowable files — otherwise the first
+        post-grow save would evict the only covering set ``history``
+        exists to keep.  Streaming sets ride the same allgather so
+        every rank protects — and refuses to count — a set a PEER is
+        still writing (the GC × async-save race)."""
+        rows = self.comm.allgather_obj(
+            (self._local_iterations(any_rank=self.elastic),
+             set(self._streaming)))
+        common = set.intersection(*(r[0] for r in rows)) if rows \
+            else set()
+        streaming = set().union(*(r[1] for r in rows)) if rows else set()
+        return sorted(common), streaming
 
     # ------------------------------------------------------------------ #
     # integrity: verification + quarantine
@@ -178,8 +290,15 @@ class MultiNodeCheckpointer:
         ascending rank order (each shard holds the complete gathered
         state — serialization's ``_host_view`` contract — so ONE clean
         shard is the minimal covering set).  Only own-rank files are
-        ever quarantined; a peer's file is its owner's to rename."""
+        ever quarantined; a peer's file is its owner's to rename.
+
+        A shard-only COVERING set (every part has redundancy zero, so
+        there is no borrow order) is loaded whole through
+        :meth:`_load_shard_set` instead."""
         me = self.comm.inter_rank
+        rec = self._scan().get(it)
+        if rec is not None and self._parts_complete(rec):
+            return self._load_shard_set(it, rec)
         if self.elastic:
             candidates = self._iteration_shards(it)
         else:
@@ -213,6 +332,59 @@ class MultiNodeCheckpointer:
             except FileNotFoundError:
                 continue
         return None
+
+    def _load_shard_set(self, it: int, rec: dict):
+        """CRC-checked load + covering-set assembly of a shard-only
+        set.  Every part is needed (zero redundancy), so ANY corrupt
+        part fails the whole set: owned corrupt parts are quarantined
+        (``*.corrupt``), a peer's are left for their owner, and the
+        verdict ``None`` makes the agreement loop fall back to the
+        next-newest set.  A vanished part ("gone" is not "damaged") or
+        a set that no longer tiles simply votes ``None`` without
+        quarantining anything."""
+        me = self.comm.inter_rank
+        owned = set(self._owned_members())
+        parts, topology = [], None
+        for member in sorted(rec["parts"]):
+            path = os.path.join(self.path, rec["parts"][member])
+            try:
+                tree, topo, sp = load_state_with_stamps(path)
+            except FileNotFoundError:
+                return None         # peer GC got there first
+            except SnapshotCorruptError as e:
+                fn = os.path.basename(path)
+                if member in owned:
+                    try:
+                        where = os.path.basename(self._quarantine(path))
+                    except OSError as qe:
+                        where = f"<quarantine failed: {qe}>"
+                    _LOG.warning(
+                        "rank %d: shard-set part %s failed its "
+                        "integrity check and was quarantined as %s: %s",
+                        me, fn, where, e)
+                else:
+                    _LOG.warning(
+                        "rank %d: shard-set part %s (member %d, a "
+                        "peer's) failed its integrity check — voting "
+                        "the set down: %s", me, fn, member, e)
+                return None
+            if sp is None:
+                _LOG.warning(
+                    "rank %d: %s matches the shard-part name pattern "
+                    "but carries no shard_part record — skipping the "
+                    "set", me, os.path.basename(path))
+                return None
+            if sp.get("root"):
+                topology = topo
+            parts.append((sp, tree))
+        try:
+            state = assemble_shard_state(parts)
+        except ShardSetError as e:
+            _LOG.warning(
+                "rank %d: shard set of iteration %d does not assemble "
+                "(%s) — falling back", me, it, e)
+            return None
+        return state, topology
 
     # ------------------------------------------------------------------ #
     # save (extension __call__)
@@ -253,15 +425,13 @@ class MultiNodeCheckpointer:
             }
             if getattr(updater, "state", None) is not None:
                 state["model_state"] = updater.state
-            fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
             if self.async_write:
                 # async writes are counted at the successful join
                 # (_join_pending), where their failure would surface
-                self._save_async(os.path.join(self.path, fn), state, it,
-                                 topology)
+                self._save_async(state, it, topology)
                 return
-            save_state(os.path.join(self.path, fn), state,
-                       topology=topology)
+            for path, tree, part in self._set_jobs(state, it, topology):
+                self._write_part(path, tree, topology, part)
             # counted only after the write lands: a scraper diffs this
             # against on-disk snapshots to detect losses
             get_registry().inc("checkpoint/snapshots_written")
@@ -272,45 +442,122 @@ class MultiNodeCheckpointer:
             self._cleanup(keep=it)
 
     # ------------------------------------------------------------------ #
-    # async write path
+    # set layout + async write path
     # ------------------------------------------------------------------ #
 
-    def _save_async(self, path: str, state, it: int,
-                    topology=None) -> None:
-        """Overlap the file write with training (orbax-style, own
-        implementation).  Ordering:
+    def _set_jobs(self, state, it: int, topology) -> List[tuple]:
+        """The files THIS process owes for one save, as ``(path, tree,
+        shard_part)`` jobs.  Full mode: one per-rank file holding the
+        whole state.  ``shard_only``: one part file per OWNED mesh
+        member — member ``m``'s rows of every ZeRO-1 shard leaf, the
+        member-0 (root) part additionally carrying every replicated
+        entry once — so the set's aggregate cost is ~1× the state
+        instead of N× (docs/RESILIENCE.md "Scale-free snapshots")."""
+        if not self.shard_only:
+            fn = _snapshot_filename(self.name, it, self.comm.inter_rank)
+            return [(os.path.join(self.path, fn), state, None)]
+        world = int(topology["world_size"])
+        # Process-spanning NON-shard leaves (params, stack-kind
+        # opt_state leaves, train state) ride the root part whole, and
+        # ``_host_view`` gathers them COLLECTIVELY — so the gather must
+        # run on EVERY process, not only inside the member-0 owner's
+        # ``save_state`` call (an asymmetric collective would deadlock
+        # a multi-process job: peers write collective-free shard parts
+        # and move on while the root owner blocks in the gather).
+        # Shard-kind leaves stay device-resident: ``_member_rows``
+        # extracts only locally addressable rows, no gather.
+        state = _host_view_nonshard(state, topology)
+        jobs = []
+        for m in self._owned_members():
+            part, rec = build_shard_part(state, topology, m, m + 1,
+                                         root=(m == 0))
+            fn = _shard_filename(self.name, it, m, world)
+            jobs.append((os.path.join(self.path, fn), part, rec))
+        if not jobs:
+            raise RuntimeError(
+                "shard_only save: this process owns no mesh members "
+                "(is the communicator a control-plane facade without a "
+                "mesh?) — shard-only sets need a device mesh to define "
+                "member ownership")
+        return jobs
 
-        1. join the previous write, then barrier + GC — every process
-           reaching save(N+1) has finished writing set N, so N is
-           globally complete and older sets are safe to reap;
-        2. ``jax.device_get`` the state NOW, on the main thread: the
-           donated train step reuses the current params' device buffers
-           on the next step, so the copy cannot be deferred to the
-           writer thread (collectives also stay main-thread-only —
-           the thread touches nothing but host memory and the disk);
-        3. hand the host pytree to a writer thread and return.
-        """
-        import threading
+    def _write_part(self, path: str, tree, topology, shard_part) -> None:
+        """Write ONE file of a set (tmp → atomic rename inside
+        ``save_state``).  The single choke point both the sync and the
+        background-writer paths funnel through — which is also what the
+        fault-injection harness wraps to land a SIGKILL deterministically
+        mid-stream (``FaultPlan.save_stall_after_files``)."""
+        save_state(path, tree, topology=topology, shard_part=shard_part)
 
+    def _host_snapshot(self, tree):
+        """Double-buffered host copy of ``tree``.
+
+        ``jax.device_get`` returns host-numpy leaves BY IDENTITY (no
+        copy) and a deferred sharded ``device_put`` may alias host
+        memory, so the training loop's next donated step would mutate
+        what the writer thread is pickling — the copy is mandatory
+        (the ``iterators.prefetch.put_window`` hazard).  It lands in
+        one of two reusable buffers: the writer owns the buffer of the
+        PREVIOUS save while this copy fills the other, so the
+        device→host copy overlaps the in-flight stream instead of
+        queueing behind it.  ``_host_view`` runs first and on the main
+        thread: process-spanning leaves need a COLLECTIVE gather."""
         import jax
         import numpy as np
 
-        self._join_pending(barrier_and_gc=True)
-        # device_get returns host-numpy leaves BY IDENTITY (no copy), so
-        # a leaf the training loop mutates in place would be pickled
-        # mid-mutation by the writer thread — snapshot real copies.
-        # _host_view first: process-spanning leaves (ZeRO-1 state) need
-        # a COLLECTIVE gather, which must run here on the main thread
-        # (every process calls save on the same tick), never the writer
         from chainermn_tpu.utils.serialization import _host_view
 
-        host_state = jax.tree.map(
-            np.array, jax.device_get(jax.tree.map(_host_view, state)))
+        leaves, treedef = jax.tree.flatten(
+            jax.device_get(jax.tree.map(_host_view, tree)))
+        buf = self._host_bufs[self._host_idx]
+        prev = buf[1] if buf is not None and buf[0] == treedef \
+            and len(buf[1]) == len(leaves) else [None] * len(leaves)
+        out = []
+        for old, leaf in zip(prev, leaves):
+            if isinstance(leaf, np.ndarray):
+                if isinstance(old, np.ndarray) \
+                        and old.shape == leaf.shape \
+                        and old.dtype == leaf.dtype:
+                    np.copyto(old, leaf)
+                    out.append(old)
+                else:
+                    out.append(np.array(leaf))
+            else:
+                out.append(leaf)        # scalars copy by value
+        self._host_bufs[self._host_idx] = (treedef, out)
+        self._host_idx ^= 1
+        return jax.tree.unflatten(treedef, out)
+
+    def _save_async(self, state, it: int, topology=None) -> None:
+        """Overlap the file write with training (orbax-style, own
+        implementation).  Ordering:
+
+        1. slice the set's jobs and copy them device→host into the IDLE
+           half of the double buffer NOW, on the main thread (the
+           donated train step reuses the current params' device buffers
+           on the next step; collectives also stay main-thread-only) —
+           this overlaps with the PREVIOUS save's still-streaming
+           writer, which owns the other buffer;
+        2. join the previous write, then barrier + GC — every process
+           reaching save(N+1) has finished writing set N, so N is
+           globally complete and older sets are safe to reap;
+        3. hand the host jobs to a writer thread and return, marking
+           the iteration ``streaming`` so it neither counts toward nor
+           is evicted by ``history=N`` until its completion is agreed.
+        """
+        import threading
+
+        jobs = self._set_jobs(state, it, topology)
+        host_trees = self._host_snapshot(tuple(t for _, t, _ in jobs))
+        jobs = [(p, ht, rec)
+                for (p, _, rec), ht in zip(jobs, host_trees)]
+        self._join_pending(barrier_and_gc=True)
         box = {}
 
         def write():
             try:
-                save_state(path, host_state, topology=topology)
+                for path, tree, rec in jobs:
+                    self._write_part(path, tree, topology, rec)
             except BaseException as e:  # surfaced at the next join
                 box["error"] = e
 
@@ -320,19 +567,26 @@ class MultiNodeCheckpointer:
         # daemon thread would silently LOSE the snapshot save() already
         # reported as taken)
         th = threading.Thread(target=write, name=f"ckpt-write-{it}")
+        self._streaming.add(it)
         th.start()
         self._pending = (th, it, box)
 
     def _join_pending(self, barrier_and_gc: bool) -> None:
         """Wait for the in-flight write (if any); re-raise its error.
         With ``barrier_and_gc`` the joined iteration is then agreed
-        complete across processes and older sets are reaped."""
+        complete across processes (the barrier — only after it does the
+        set leave ``streaming`` and start counting toward history) and
+        older sets are reaped."""
         if self._pending is None:
             return
         th, it, box = self._pending
         self._pending = None
         th.join()
         if "error" in box:
+            # the set is dead, not streaming: leaving it in _streaming
+            # would exclude it from the inventory AND GC-protect its
+            # partial files forever if the job catches and continues
+            self._streaming.discard(it)
             raise RuntimeError(
                 f"async checkpoint write of iteration {it} failed"
             ) from box["error"]
@@ -342,7 +596,14 @@ class MultiNodeCheckpointer:
         self._saved_iterations.add(it)
         if barrier_and_gc:
             self.comm.barrier()
+            self._streaming.discard(it)      # agreed complete
             self._cleanup(keep=it)
+        else:
+            # crash-unwind join (finalize during an exception): the
+            # files are fully written and durable, but completion was
+            # never AGREED — the local discard keeps this process's
+            # inventory truthful for post-mortem tooling
+            self._streaming.discard(it)
 
     def _cleanup(self, keep: int) -> None:
         """Remove every superseded shard of THIS rank — including orphans
@@ -363,15 +624,27 @@ class MultiNodeCheckpointer:
         orphans of a dead run that got further than this one's resume
         point — never agreed complete, never protected.  Quarantined
         ``*.corrupt`` files never match the shard name pattern and are
-        never touched."""
-        inventory = self._local_iterations() | self._saved_iterations
+        never touched.
+
+        A set the background writer is STILL streaming (here or, with
+        ``history > 1``, on any peer — the streaming sets ride the
+        agreement allgather) never counts toward the ``history`` quota
+        AND is never evicted: counting it would displace a completed
+        fallback set, evicting it would race the writer's renames."""
+        scan = self._scan()
+        inventory = set(scan) | self._saved_iterations
         if self.history > 1:
-            candidates = [i for i in self._common_iterations()
-                          if i <= keep]
+            common, streaming = self._agreed_inventory()
+            candidates = [i for i in common
+                          if i <= keep and i not in streaming]
         else:
             candidates = [keep]
+            streaming = set(self._streaming)
         protected = set(sorted(candidates, reverse=True)[: self.history])
         protected.add(keep)
+        protected |= streaming
+        owned = set(self._owned_members()) if self.shard_only \
+            or any(rec["parts"] for rec in scan.values()) else set()
         for it in inventory:
             if it in protected:
                 continue
@@ -380,19 +653,34 @@ class MultiNodeCheckpointer:
                 os.remove(os.path.join(self.path, fn))
             except FileNotFoundError:
                 pass
+            for member, pfn in scan.get(it, {"parts": {}})["parts"] \
+                    .items():
+                if member in owned:
+                    try:
+                        os.remove(os.path.join(self.path, pfn))
+                    except FileNotFoundError:
+                        pass
             self._saved_iterations.discard(it)
         if self.elastic and self.comm.inter_rank == 0 \
                 and os.path.isdir(self.path):
-            # after a shrink, shards of ranks >= inter_size belong to
+            # after a shrink, shards of ranks >= inter_size (and shard-
+            # set parts of mesh members no live process owns) belong to
             # nobody's own inventory; rank 0 reaps the superseded ones
-            # under the same protection rules (live peers' files — rank
-            # < inter_size — are their owners' to manage, never touched)
+            # under the same protection rules (live peers' files are
+            # their owners' to manage, never touched)
+            world = int(getattr(self.comm, "size", 1))
             for fn in os.listdir(self.path):
                 m = _FILE_RE.match(fn)
-                if not m or m.group("name") != self.name:
+                if not m or m.group("name") != self.name \
+                        or int(m.group("iter")) in protected:
                     continue
-                if int(m.group("rank")) >= self.comm.inter_size \
-                        and int(m.group("iter")) not in protected:
+                if m.group("rank") is not None:
+                    if int(m.group("rank")) >= self.comm.inter_size:
+                        try:
+                            os.remove(os.path.join(self.path, fn))
+                        except FileNotFoundError:
+                            pass
+                elif int(m.group("member")) >= world:
                     try:
                         os.remove(os.path.join(self.path, fn))
                     except FileNotFoundError:
@@ -540,6 +828,22 @@ class MultiNodeCheckpointer:
         self._saved_iterations = self._local_iterations()
         return it
 
+    def rebind_world(self, comm) -> None:
+        """Re-bind to a NEW communicator after a live resize
+        (``training/elastic.ResizeController`` calls this on every
+        registered extension that exposes it).  The in-flight async
+        write — if any — is joined and agreed complete under the OLD
+        comm first (its completion barrier belongs to the world that
+        started it; every process reaches the resize boundary in
+        lockstep, so the collective is safe), then subsequent saves
+        stamp the new world's topology and write the new world's
+        shard-only part set.  Idempotent for a comm already bound."""
+        if comm is self.comm:
+            return
+        self._join_pending(barrier_and_gc=True)
+        self._host_bufs = [None, None]
+        self.comm = comm
+
     def finalize(self, trainer=None) -> None:
         import sys
 
@@ -556,14 +860,32 @@ class MultiNodeCheckpointer:
 def create_multi_node_checkpointer(
     comm, path: str, name: str = "snapshot",
     async_write: bool = False, history: int = 1,
-    elastic: bool = False,
+    elastic: bool = False, shard_only: bool = False,
 ) -> MultiNodeCheckpointer:
     """Factory with the reference's exact name and signature shape.
 
-    ``async_write=True`` overlaps snapshot file writes with training
-    (the device→host copy stays synchronous; pickling + disk IO move to
-    a writer thread, joined at the next save/resume/finalize).  Beyond
-    the reference, which blocked the training loop for the full write.
+    ``async_write=True`` overlaps snapshot file writes with training:
+    the device→host copy lands in a double buffer on the main thread
+    (overlapping the PREVIOUS save's still-streaming write), then
+    pickling + disk IO move to a writer thread, joined at the next
+    save/resume/finalize.  A streaming set neither counts toward nor is
+    evicted by ``history`` until its completion is collectively agreed,
+    and the result loads bitwise-identical to a sync save.  Beyond the
+    reference, which blocked the training loop for the full write.
+
+    ``shard_only=True`` switches saves to scale-free covering sets: one
+    part file per mesh member (each holding that member's rows of every
+    ZeRO-1 shard leaf; the member-0 root part carries the replicated
+    entries once), written by the process owning the member, so the
+    set's aggregate bytes stay ~1× the state regardless of world size —
+    instead of the full-state-per-rank N× layout.  Resume assembles the
+    covering set (all members + root, verified to tile), with the same
+    the-load-is-the-verification + quarantine + collective-agreement
+    fallback semantics as full sets; a partial set (crash mid-stream)
+    simply never looks complete and resume falls back to the newest set
+    that covers.  Composes with ``elastic=True`` (the assembled state
+    re-lays onto a new world exactly like a full snapshot) and with
+    ``async_write``.  See docs/RESILIENCE.md "Scale-free snapshots".
 
     ``history`` (default 1 — the reference's keep-only-latest GC) sets
     how many of the newest complete sets survive garbage collection;
@@ -584,4 +906,4 @@ def create_multi_node_checkpointer(
     """
     return MultiNodeCheckpointer(comm, path, name,
                                  async_write=async_write, history=history,
-                                 elastic=elastic)
+                                 elastic=elastic, shard_only=shard_only)

@@ -109,6 +109,14 @@ class PreemptionCheckpointer:
     def initialize(self, trainer):
         self._install()
 
+    def rebind_world(self, comm) -> None:
+        """Follow a live resize (``ResizeController`` calls this): the
+        flag OR-reduce and the wrapped checkpointer's saves must run on
+        the NEW world's communicator."""
+        if self.comm is not None:
+            self.comm = comm
+        self.checkpointer.rebind_world(comm)
+
     def _global_flag(self) -> bool:
         comm = self.comm
         if comm is None or getattr(comm, "inter_size", 1) <= 1:

@@ -60,6 +60,8 @@ SHED_REASONS = (
     "timeout",        # deadline expired while still queued
     "cancelled",      # caller cancel() before admission
     "quarantined",    # staging/prefill failed for THIS request
+    "draining",       # engine is draining for an epoch change (resize)
+    "stale_epoch",    # submit carried an epoch the engine has moved past
 )
 
 
@@ -86,6 +88,12 @@ class ShedCompletion:
     priority: int = 0
     tenant: Optional[str] = None
     detail: str = ""
+    # Predicted seconds until the backlog that caused this shed drains
+    # (the retry-after header a front-end should quote).  Populated for
+    # CAPACITY sheds — queue_full and drain-mode — from the predictor's
+    # queue-drain estimate; ``None`` while the predictor is cold, and
+    # for reasons where retrying is pointless (deadline, stale_epoch).
+    retry_after: Optional[float] = None
 
     status = "shed"              # class attr: never "ok"
 
@@ -193,6 +201,23 @@ class ServiceTimePredictor:
         if p is None:
             return None
         return p * max(int(tokens_left), 0)
+
+    def predict_queue_drain(self, backlog_tokens: int,
+                            n_slots: int) -> Optional[float]:
+        """Predicted seconds until a backlog of ``backlog_tokens``
+        budget tokens (queued ``max_new`` plus active rows' remaining
+        budgets) drains across ``n_slots`` decode lanes — the
+        retry-after estimate a capacity shed quotes
+        (ROADMAP admission open end #3).  The aggregate token
+        throughput model (``n_slots / TPOT``) deliberately ignores
+        per-request TTFT: across a backlog, prefill cost is amortised
+        and the steady-state decode rate dominates.  ``None`` while
+        cold — a retry header should never be invented without
+        evidence."""
+        p = self.tpot()
+        if p is None:
+            return None
+        return p * max(int(backlog_tokens), 0) / max(int(n_slots), 1)
 
     def snapshot(self) -> dict:
         return {
@@ -307,6 +332,16 @@ class AdmissionController:
         if worst.priority > req.priority:
             return worst
         return None
+
+    def retry_after(self, backlog_tokens: int,
+                    n_slots: int) -> Optional[float]:
+        """The retry-after value a capacity shed should carry: the
+        predictor's queue-drain estimate for the live backlog
+        (``None`` while cold).  The engine computes the backlog —
+        queued ``max_new`` plus active rows' remaining budgets — at
+        the moment of the shed."""
+        return self.predictor.predict_queue_drain(backlog_tokens,
+                                                  n_slots)
 
     def check_queued(self, req, now: float) -> Optional[str]:
         """Admit-scan verdict for a QUEUED request: ``"deadline"`` when

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import re
 import time
 from typing import Callable, List, Optional, Sequence, Union
 
@@ -333,6 +334,12 @@ class ServingEngine:
         and swappable between runs (``engine.admission = ...``, like
         ``gang``); ``None`` admits everything, bounded only by
         deadlines the requests themselves carry.
+      epoch: the serving epoch this engine admits for (the elastic
+        membership epoch — docs/SERVING.md "Epoch drains").  A submit
+        carrying an OLDER epoch is shed ``"stale_epoch"``; during a
+        :meth:`drain` every submit is shed ``"draining"`` with a
+        ``retry_after`` from the predictor's queue-drain estimate;
+        :meth:`complete_drain` re-opens admission under the new epoch.
     """
 
     def __init__(self, adapter, params, *, n_slots: int, horizon: int,
@@ -344,7 +351,8 @@ class ServingEngine:
                  prefill_ahead: Optional[int] = None,
                  default_max_new: int = 32,
                  record_history: int = 4096,
-                 admission: Optional[AdmissionController] = None):
+                 admission: Optional[AdmissionController] = None,
+                 epoch: int = 0):
         mesh = adapter.mesh_cfg.mesh
         shards = 1
         for a in adapter.batch_axes:
@@ -387,6 +395,7 @@ class ServingEngine:
             else prefill_ahead
         self.default_max_new = default_max_new
         self.admission = admission
+        self.epoch = int(epoch)
         if record_history < 0:
             raise ValueError(
                 f"record_history={record_history} must be >= 0")
@@ -582,6 +591,8 @@ class ServingEngine:
         self.n_timeouts = 0
         self.n_cancelled = 0
         self.n_quarantined = 0
+        self.n_drains = 0
+        self._draining = False          # epoch persists across reset()
 
     # ------------------------------------------------------------------ #
     # public API
@@ -611,7 +622,8 @@ class ServingEngine:
                request_id: Optional[str] = None, *,
                priority: int = 0, tenant: Optional[str] = None,
                deadline: Optional[float] = None,
-               timeout: Optional[float] = None
+               timeout: Optional[float] = None,
+               epoch: Optional[int] = None
                ) -> Union[str, ShedCompletion]:
         """Queue one request; returns its id — or, when the attached
         admission controller rejects it (queue full, tenant over
@@ -623,7 +635,15 @@ class ServingEngine:
         ``deadline`` is an absolute ``time.perf_counter`` timestamp;
         ``timeout`` is the relative convenience form (seconds from
         now) — give at most one.  ``priority`` is
-        smaller-is-more-important (class 0 beats class 1)."""
+        smaller-is-more-important (class 0 beats class 1).
+
+        ``epoch`` (optional) is the serving epoch the CALLER believes
+        is current: a mismatch with :attr:`epoch` is shed
+        ``"stale_epoch"`` — a front-end that slept through a resize
+        must re-learn the world, not have its request served under
+        assumptions that moved.  While :meth:`drain` is in progress
+        every submit is shed ``"draining"`` with the predicted
+        ``retry_after``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not 1 <= prompt.shape[0] <= self.max_prompt:
             raise ValueError(
@@ -653,6 +673,25 @@ class ServingEngine:
                       deadline=deadline)
         reg = get_registry()
         reg.inc("serve/submitted")
+        if self._draining:
+            # checked FIRST: during the handover window a front-end
+            # that already learned the NEW epoch is early, not wrong —
+            # it gets the transient "draining" + retry_after, never the
+            # terminal re-learn-the-world verdict below
+            return self._finish_shed(req, "draining",
+                                     retry_after=self._retry_after())
+        if epoch is not None and int(epoch) != self.epoch:
+            if int(epoch) < self.epoch:
+                return self._finish_shed(
+                    req, "stale_epoch",
+                    detail=f"submit epoch {int(epoch)} vs engine epoch "
+                           f"{self.epoch}")
+            # a NEWER epoch: the ENGINE is the stale party (its
+            # complete_drain hasn't run yet) — transient, retry
+            return self._finish_shed(
+                req, "draining", retry_after=self._retry_after(),
+                detail=f"engine epoch {self.epoch} behind submit epoch "
+                       f"{int(epoch)}")
         if self.admission is not None:
             admit, reason, victim = self.admission.check_submit(
                 req, list(self._queue), self._tenant_tokens)
@@ -662,7 +701,10 @@ class ServingEngine:
                 self._shed_from_queue(victim, "queue_full",
                                       detail=f"displaced by {req.rid}")
             if not admit:
-                return self._finish_shed(req, reason)
+                return self._finish_shed(
+                    req, reason,
+                    retry_after=(self._retry_after()
+                                 if reason == "queue_full" else None))
         self._queue.append(req)
         self._tenant_tokens[tenant] += max_new
         self._charged.add(request_id)
@@ -798,6 +840,114 @@ class ServingEngine:
                 break
         return out
 
+    # ------------------------------------------------------------------ #
+    # epoch drains (docs/SERVING.md "Epoch drains")
+    # ------------------------------------------------------------------ #
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, *, timeout: Optional[float] = None,
+              max_steps: Optional[int] = None
+              ) -> List[Union[Completion, ShedCompletion]]:
+        """Retire every ACTIVE row ahead of an epoch change (a live
+        resize, a rolling restart) without restarting the fleet:
+
+        - admission STOPS — queued requests hold their place, every new
+          submit is shed ``"draining"`` with the predictor's
+          ``retry_after`` estimate;
+        - active rows finishing naturally complete ``"ok"``; with
+          ``timeout`` the rest are timeout-evicted at the deadline with
+          their partial tokens (a verified PREFIX of the solo decode —
+          the engine's ordinary mid-stream eviction);
+        - decode rounds keep running until the slots are empty, then
+          this returns the terminal records produced along the way.
+
+        The engine stays in drain mode afterwards;
+        :meth:`complete_drain` re-opens admission under the new epoch
+        (typically after ``ResizeController`` re-formed the world).
+        ``max_steps`` bounds the loop for drills."""
+        if timeout is not None and timeout <= 0:
+            raise ValueError(f"timeout={timeout} must be > 0")
+        self._draining = True
+        self.n_drains += 1
+        get_registry().inc("serve/drains")
+        if timeout is not None:
+            dl = time.perf_counter() + timeout
+            for s in range(self.n_slots):
+                req = self._slot_req[s]
+                if req is not None and not self._done[s]:
+                    req.deadline = dl if req.deadline is None \
+                        else min(req.deadline, dl)
+        out: List[Union[Completion, ShedCompletion]] = []
+        steps = 0
+        with get_recorder().span("serve/drain", cat="serve",
+                                 active=self.n_active,
+                                 queued=len(self._queue)):
+            while self.n_active:
+                out.extend(self.step())
+                steps += 1
+                if max_steps is not None and steps >= max_steps:
+                    break
+        return out
+
+    def complete_drain(self, epoch: Optional[int] = None) -> None:
+        """Re-open admission after a :meth:`drain`, optionally bumping
+        to the NEW serving epoch (the agreed membership epoch).  Queued
+        requests kept their place and admit normally from the next
+        :meth:`step`; epochs only move forward."""
+        if epoch is not None:
+            if int(epoch) < self.epoch:
+                raise ValueError(
+                    f"epoch={epoch} would move backwards (engine is at "
+                    f"{self.epoch}) — epochs only advance")
+            self.epoch = int(epoch)
+        self._draining = False
+
+    def export_queue(self) -> List[Request]:
+        """Remove and return every QUEUED request (submit order,
+        timestamps intact) — the carry-over half of surviving a resize:
+        drain the old engine, export its queue, and
+        :meth:`import_queue` into the engine rebuilt for the new world
+        so waiting requests keep their place instead of being shed.
+        Staged pool blocks are freed (the new engine re-prefills
+        against its own pool)."""
+        reqs = list(self._queue)
+        for r in reqs:
+            self._staged.pop(r.rid, None)
+            self._alloc.free_row(r.rid)
+            self._release_tokens(r)
+        self._queue.clear()
+        get_recorder().counter("serve/queue_depth", 0, cat="serve")
+        get_registry().set("serve/queue_depth", 0)
+        return reqs
+
+    def import_queue(self, reqs: Sequence[Request]) -> None:
+        """Adopt requests exported from another engine (see
+        :meth:`export_queue`); submit order and ``t_submit`` are
+        preserved so queue-wait metrics stay honest across the
+        handover."""
+        for r in reqs:
+            if any(q.rid == r.rid for q in self._queue) \
+                    or any(a is not None and a.rid == r.rid
+                           for a in self._slot_req):
+                raise ValueError(f"request id {r.rid!r} already live")
+            self._queue.append(r)
+            self._tenant_tokens[r.tenant] += r.max_new
+            self._charged.add(r.rid)
+            # auto-assigned rids ("r<n>") from the old engine share this
+            # engine's namespace: advance the counter past them, or the
+            # n-th native submit regenerates an imported rid and raises
+            # "already live" at an ordinary caller
+            m = re.fullmatch(r"r(\d+)", r.rid)
+            if m:
+                self._next_rid = max(self._next_rid,
+                                     int(m.group(1)) + 1)
+        get_recorder().counter("serve/queue_depth", len(self._queue),
+                               cat="serve")
+        get_registry().set("serve/queue_depth", len(self._queue))
+
     def stats(self) -> dict:
         issued = self.n_rounds * self.round_tokens * self.n_slots
         return {
@@ -813,6 +963,9 @@ class ServingEngine:
             "timeouts": self.n_timeouts,
             "cancelled": self.n_cancelled,
             "quarantined": self.n_quarantined,
+            "epoch": self.epoch,
+            "draining": self._draining,
+            "drains": self.n_drains,
         }
 
     def request_records(self) -> List[Completion]:
@@ -912,8 +1065,28 @@ class ServingEngine:
             if self._tenant_tokens[req.tenant] <= 0:
                 del self._tenant_tokens[req.tenant]
 
+    def _backlog_tokens(self) -> int:
+        """The live token backlog a capacity shed quotes: queued
+        budgets plus active rows' remaining budgets."""
+        backlog = sum(r.max_new for r in self._queue)
+        for s in range(self.n_slots):
+            if self._slot_req[s] is not None and not self._done[s]:
+                backlog += max(int(self._end_t[s]) - self._clock, 0)
+        return backlog
+
+    def _retry_after(self) -> Optional[float]:
+        """Predicted seconds until the current backlog drains (the
+        retry-after a capacity shed carries); ``None`` without an
+        admission controller or while its predictor is cold."""
+        if self.admission is None:
+            return None
+        return self.admission.retry_after(self._backlog_tokens(),
+                                          self.n_slots)
+
     def _finish_shed(self, req: Request, reason: str,
-                     detail: str = "") -> ShedCompletion:
+                     detail: str = "",
+                     retry_after: Optional[float] = None
+                     ) -> ShedCompletion:
         """Terminal bookkeeping for a request that will never be
         served: tenant tokens released, record appended, metrics
         counted.  Returns the typed reject."""
@@ -922,7 +1095,7 @@ class ServingEngine:
             rid=req.rid, prompt=req.prompt, reason=reason,
             t_submit=req.t_submit, t_shed=time.perf_counter(),
             max_new=req.max_new, priority=req.priority,
-            tenant=req.tenant, detail=detail)
+            tenant=req.tenant, detail=detail, retry_after=retry_after)
         self._records.append(shed)
         self.n_shed[reason] += 1
         reg = get_registry()
@@ -939,7 +1112,10 @@ class ServingEngine:
         self._queue.remove(req)
         self._staged.pop(req.rid, None)
         self._alloc.free_row(req.rid)
-        shed = self._finish_shed(req, reason, detail)
+        shed = self._finish_shed(
+            req, reason, detail,
+            retry_after=(self._retry_after()
+                         if reason == "queue_full" else None))
         self._pending_shed.append(shed)
         get_recorder().counter("serve/queue_depth", len(self._queue),
                                cat="serve")
@@ -972,6 +1148,11 @@ class ServingEngine:
 
     def _admit_phase(self, rec) -> None:
         self._scan_queue_deadlines()
+        if self._draining:
+            # drain mode: no admissions, no speculative prefill — the
+            # queue holds (deadlines above still enforced) until
+            # complete_drain() re-opens under the new epoch
+            return
         free = [s for s in range(self.n_slots)
                 if self._slot_req[s] is None]
         if self.gang and len(free) < self.n_slots:

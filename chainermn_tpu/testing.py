@@ -220,6 +220,20 @@ class FaultPlan:
       rebuilds the job on the new topology and resumes through the
       checkpointer's elastic re-layout path (docs/RESILIENCE.md
       "Elastic resume").
+    - ``resize_live_at_iteration`` + ``resize_live_to`` — the LIVE
+      resize drill: arm the injector's ``resize_controller``
+      (``training/elastic.ResizeController``) at that iteration's step
+      boundary.  The controller runs at the very end of the same tick
+      (priority 0 < the injector's 1), so the world changes at exactly
+      the boundary a save/restart would have used — and training
+      continues in the same process.
+    - ``save_stall_after_files`` + ``save_stall_seconds`` — slow the
+      checkpointer's per-file write hook: after the Nth file of a set
+      lands, each further file waits ``save_stall_seconds`` first.
+      Composed with ``kill_at_iteration`` on an async shard-only save,
+      the SIGKILL deterministically lands MID-stream, leaving a partial
+      covering set — the crash-during-shard-only-save drill
+      (docs/RESILIENCE.md).
 
     Serving faults (applied by :meth:`FaultInjector.attach_engine` to a
     ``ServingEngine``, keyed by DECODE-ROUND / staging-call count
@@ -251,6 +265,10 @@ class FaultPlan:
     nan_at_iteration: Optional[int] = None
     resize_at_iteration: Optional[int] = None
     resize_to: int = 0
+    resize_live_at_iteration: Optional[int] = None
+    resize_live_to: int = 0
+    save_stall_after_files: Optional[int] = None
+    save_stall_seconds: float = 0.0
     serve_delay_at_round: Optional[int] = None
     serve_delay_seconds: float = 0.0
     serve_raise_at_round: Optional[int] = None
@@ -278,13 +296,37 @@ class FaultInjector:
     trigger = (1, "iteration")
     priority = 1
 
-    def __init__(self, plan: FaultPlan, comm=None, checkpointer=None):
+    def __init__(self, plan: FaultPlan, comm=None, checkpointer=None,
+                 resize_controller=None):
         self.plan = plan
         self.comm = comm
         # the resize action saves through a real checkpointer so the
         # stopped state is topology-stamped for the elastic relaunch
         self.checkpointer = checkpointer
+        # the LIVE resize action arms this controller instead of
+        # stopping the trainer (training/elastic.ResizeController)
+        self.resize_controller = resize_controller
         self.fired: list = []
+        if checkpointer is not None \
+                and plan.save_stall_after_files is not None:
+            self._attach_save_stall(checkpointer)
+
+    def _attach_save_stall(self, checkpointer) -> None:
+        """Wrap the checkpointer's per-file write hook so every file
+        after the plan's Nth sleeps first — pins a concurrent SIGKILL
+        mid-stream (deterministic partial covering set)."""
+        plan = self.plan
+        real = checkpointer._write_part
+        state = {"files": 0}
+
+        def stalled(path, tree, topology, shard_part):
+            if state["files"] >= plan.save_stall_after_files:
+                self.fired.append(("save_stall", state["files"]))
+                time.sleep(plan.save_stall_seconds)
+            real(path, tree, topology, shard_part)
+            state["files"] += 1
+
+        checkpointer._write_part = stalled
 
     def _rank(self) -> int:
         return getattr(self.comm, "inter_rank", 0) if self.comm else 0
@@ -307,6 +349,15 @@ class FaultInjector:
             corrupt_file(plan.corrupt_path, plan.corrupt_n_bytes,
                          seed=plan.seed)
             self.fired.append(("corrupt", it))
+        if plan.resize_live_at_iteration == it:
+            if self.resize_controller is None:
+                raise RuntimeError(
+                    "FaultPlan.resize_live_at_iteration needs "
+                    "FaultInjector(resize_controller=...) — the live "
+                    "resize is performed by a ResizeController "
+                    "extension on the same tick")
+            self.resize_controller.request(plan.resize_live_to)
+            self.fired.append(("resize_live", it, plan.resize_live_to))
         if plan.resize_at_iteration == it:
             if self.checkpointer is None:
                 raise RuntimeError(

@@ -60,9 +60,11 @@ __all__ = [
     "ElasticMembership",
     "MembershipRecord",
     "RelayoutError",
+    "ResizeController",
     "StaleGenerationError",
     "TOPOLOGY_FORMAT",
     "gather_zero1_leaves",
+    "post_resize_intent",
     "relayout_state",
     "same_topology",
     "shard_zero1_leaves",
@@ -560,3 +562,286 @@ class ElasticMembership:
         payload["stopped"] = {"reason": reason, "iteration": iteration,
                               "ts": time.time()}
         self._write_file(payload)
+
+
+# --------------------------------------------------------------------- #
+# live in-run resize
+# --------------------------------------------------------------------- #
+
+#: KV prefix a resize intent is posted under (`post_resize_intent`).
+RESIZE_KV_PREFIX = "elastic/resize"
+
+
+def post_resize_intent(world_size: int, reason: str = "") -> None:
+    """Post a resize intent on the coordination-service KV store for a
+    running job's :class:`ResizeController` to pick up (external
+    tooling's entry point; in-process callers can use
+    ``controller.request`` directly).  Overwrite-in-place, so repeated
+    posts converge on the newest intent."""
+    from jax._src import distributed
+
+    from chainermn_tpu.communicators._obj_channel import kv_overwrite
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "post_resize_intent needs the JAX distributed runtime "
+            "(init_distributed) — single-controller jobs call "
+            "ResizeController.request instead")
+    kv_overwrite(client, f"{RESIZE_KV_PREFIX}/intent",
+                 json.dumps({"world_size": int(world_size),
+                             "reason": reason, "ts": time.time()}))
+
+
+class ResizeController:
+    """Trainer extension: resize a LIVE job at a step boundary —
+    training continues in the same processes, no restart.
+
+    The between-run path (PR 10) pays a full save + relaunch; this
+    controller performs the identical state transformation IN PLACE:
+
+    1. **Intent** — ``request(world)`` (host-side), a KV-posted intent
+       (:func:`post_resize_intent`, for external tooling), or whatever
+       arms the flag from a signal handler.  Every tick (on the shared
+       ``check_interval`` cadence, exactly the
+       ``PreemptionCheckpointer`` discipline) the locally-seen intent
+       is OR-agreed across processes, so every rank pauses at the SAME
+       step boundary — conflicting concurrent intents resolve to the
+       largest world.
+    2. **Pause** — extensions run between steps, so the boundary is
+       free; in-flight dispatched windows are drained first.
+    3. **State out** — the exact checkpointer state dict is collected
+       and copied to host (collective gather for process-spanning
+       leaves), stamped with the OLD topology signature.
+    4. **Epoch** — ``membership.agree()`` bumps the epoch and
+       ``fence()`` rolls channel generations so pre-resize traffic is
+       rejected (:class:`StaleGenerationError`); without a membership,
+       a local epoch counter still increments.  Serving engines passed
+       in ``drain_engines`` are drained BEFORE the world moves
+       (admission stops, active rows retire or timeout-evict) — see
+       docs/SERVING.md "Epoch drains".
+    5. **Re-form** — ``comm_factory(world)`` builds the new
+       communicator over the surviving in-process devices (the
+       8-device CPU mesh shrink/grow is the tested path; re-forming a
+       mesh across a CHANGED process set — and redistributing with
+       real collectives instead of the host-side pass — stays
+       TPU-gated), ``optimizer_factory(new_comm)`` the new optimizer.
+    6. **Re-lay** — :func:`relayout_state` re-slices the saved state
+       onto the new world (bitwise what a save/restart at this
+       boundary would restore; same topology skips it), the step cache
+       and snapshot-riding exchange plan are dropped so the new world
+       re-tunes, and ``updater.rebind_world`` installs everything.
+       Training continues with the next ``update()``.
+
+    ``on_resize(controller, new_comm, epoch)`` (optional) runs last —
+    the hook where a serving fleet rebuilds its engines under the new
+    epoch and re-imports its carried-over queue
+    (``ServingEngine.export_queue`` / ``import_queue``).
+    """
+
+    trigger = (1, "iteration")
+    # priority 0: the VERY last extension on its tick — log writers,
+    # checkpointers and fault injectors all land before the world
+    # changes, so a resize at iteration N is indistinguishable from a
+    # stop-after-N (the trajectory-equivalence drills pin this)
+    priority = 0
+
+    def __init__(self, comm_factory, optimizer_factory, *,
+                 membership: Optional[ElasticMembership] = None,
+                 coord_comm=None, check_interval: int = 1,
+                 drain_engines=(), drain_timeout: Optional[float] = None,
+                 fence_targets=(), on_resize=None):
+        self.comm_factory = comm_factory
+        self.optimizer_factory = optimizer_factory
+        self.membership = membership
+        self.coord_comm = coord_comm
+        self._check_interval = max(int(check_interval), 1)
+        self.drain_engines = tuple(drain_engines)
+        self.drain_timeout = drain_timeout
+        self.fence_targets = tuple(fence_targets)
+        self.on_resize = on_resize
+        self.epoch = 0              # local counter without a membership
+        self._requested: Optional[int] = None
+        self._calls = 0
+        self.resizes: List[dict] = []
+        self.drained: List[Any] = []
+
+    # -- intent ---------------------------------------------------------- #
+
+    def request(self, world_size: int) -> None:
+        """Arm a resize to ``world_size`` — acted on at the next step
+        boundary on the shared cadence (signal-handler safe: only sets
+        a flag)."""
+        if int(world_size) < 1:
+            raise ValueError(f"world_size={world_size} must be >= 1")
+        self._requested = int(world_size)
+
+    def _kv(self, comm):
+        if int(getattr(comm, "inter_size", 1)) <= 1:
+            return None
+        from jax._src import distributed
+
+        return distributed.global_state.client
+
+    def _kv_intent(self, comm) -> Optional[int]:
+        kv = self._kv(comm)
+        if kv is None:
+            return None
+        try:
+            rows = kv.key_value_dir_get(f"{RESIZE_KV_PREFIX}/")
+        except Exception:
+            return None             # no intent posted (or flaky store)
+        for key, value in rows:
+            if key.rstrip("/").endswith("intent"):
+                try:
+                    return int(json.loads(value)["world_size"])
+                except (ValueError, KeyError, TypeError):
+                    _LOG.warning(
+                        "ignoring malformed resize intent %r", value)
+        return None
+
+    def _clear_kv_intent(self, comm) -> None:
+        kv = self._kv(comm)
+        if kv is None:
+            return
+        try:
+            kv.key_value_delete(f"{RESIZE_KV_PREFIX}/intent")
+        except Exception:
+            pass                    # best-effort; overwrite converges
+
+    # -- the extension --------------------------------------------------- #
+
+    def __call__(self, trainer) -> None:
+        self._calls += 1
+        # shared cadence only: every process must make the same
+        # enter/skip decision for the agreement allgather below (the
+        # PreemptionCheckpointer contract)
+        if self._calls % self._check_interval:
+            return
+        comm = self.coord_comm or trainer.updater.comm
+        mine = self._requested
+        if mine is None:
+            mine = self._kv_intent(comm)
+        if int(getattr(comm, "inter_size", 1)) > 1:
+            rows = comm.allgather_obj(mine)
+            seen = [r for r in rows if r is not None]
+            agreed = max(seen) if seen else None
+        else:
+            agreed = mine
+        if agreed is None:
+            return
+        self.resize(trainer, agreed)
+
+    # -- the resize ------------------------------------------------------ #
+
+    def resize(self, trainer, world_size: int) -> None:
+        """Perform the live resize NOW (normally reached through the
+        agreed intent; callable directly in single-controller jobs and
+        drills)."""
+        from chainermn_tpu.training._resume import (
+            collect_train_state,
+            restore_train_state,
+        )
+        from chainermn_tpu.utils.metrics import get_registry
+        from chainermn_tpu.utils.serialization import _host_view
+        from chainermn_tpu.utils.telemetry import get_recorder
+
+        import jax
+
+        upd = trainer.updater
+        it = int(upd.iteration)
+        t0 = time.time()
+        with get_recorder().span("elastic/live_resize", cat="elastic",
+                                 step=it, world=int(world_size)):
+            # 0. consume the intent FIRST, on EVERY rank (the KV delete
+            #    is idempotent).  The clear must precede the resize's
+            #    collectives: were it deferred to the end, a fast rank
+            #    could finish, reach its next cadence tick, and re-read
+            #    the still-posted intent while a slow rank is mid-
+            #    relayout — and the OR-agreement would force a duplicate
+            #    resize (spurious epoch bump, re-fence, serving drain)
+            #    on everyone.  An operator intent posted DURING the
+            #    resize may be consumed with it; repost after the epoch
+            #    bump.
+            self._requested = None
+            self._clear_kv_intent(self.coord_comm or upd.comm)
+            # 1. drain: the old mesh's in-flight windows must retire
+            #    before its buffers are abandoned
+            for pending in list(upd._inflight):
+                jax.block_until_ready(pending)
+            for eng in self.drain_engines:
+                self.drained.extend(
+                    eng.drain(timeout=self.drain_timeout))
+            # 2. state out, stamped with the OLD topology (exactly the
+            #    checkpointer's save dict — the trajectory-equivalence
+            #    contract: live resize == save/restart at this boundary)
+            topo_old = topology_signature(
+                upd.comm, params=upd.params, opt_state=upd.opt_state,
+                zero1=bool(getattr(upd, "zero1", False)))
+            state = {
+                "iteration": it,
+                "world_size": int(getattr(upd.comm, "inter_size", 1)),
+                "params": upd.params,
+                "opt_state": upd.opt_state,
+                "train_state": collect_train_state(upd, trainer),
+            }
+            if getattr(upd, "state", None) is not None:
+                state["model_state"] = upd.state
+            state = jax.tree.map(
+                np.array,
+                jax.device_get(jax.tree.map(_host_view, state)))
+            # 3. epoch: agree membership (KV-only collective — the data
+            #    plane may be mid-reconfiguration) and fence channels
+            if self.membership is not None:
+                rec = self.membership.agree()
+                epoch = rec.epoch
+            else:
+                self.epoch += 1
+                epoch = self.epoch
+            # 4. re-form the mesh + optimizer over the survivors
+            new_comm = self.comm_factory(int(world_size))
+            new_opt = self.optimizer_factory(new_comm)
+            if self.membership is not None:
+                targets = [t for t in (new_comm, *self.fence_targets)
+                           if hasattr(
+                               getattr(t, "_obj_channel", t),
+                               "set_generation")]
+                if targets:
+                    self.membership.fence(*targets)
+            # 5. re-lay the state for the new world (bitwise the
+            #    save/restart path: relayout only on a real topology
+            #    change, exchange plan dropped so the new world
+            #    re-tunes)
+            topo_new = topology_signature(
+                new_comm, params=state["params"],
+                opt_state=state["opt_state"],
+                zero1=bool(getattr(upd, "zero1", False)))
+            if not same_topology(topo_old, topo_new):
+                state = relayout_state(state, topo_old, topo_new)
+            # 6. install and continue in the same process
+            upd.rebind_world(new_comm, new_opt)
+            upd.params = state["params"]
+            upd.opt_state = state["opt_state"]
+            if "model_state" in state:
+                upd.state = state["model_state"]
+            restore_train_state(state.get("train_state"), upd, trainer)
+            # every registered extension still holding the old world's
+            # communicator follows (checkpointers stamp topology and
+            # write shard-only part sets with THEIR comm — a stale one
+            # would label post-resize saves with the pre-resize world).
+            # Any in-flight async write is joined/agreed under the old
+            # comm inside the extension's own rebind.
+            for entry in getattr(trainer, "_extensions", []):
+                hook = getattr(entry.ext, "rebind_world", None)
+                if hook is not None and entry.ext is not self:
+                    hook(new_comm)
+            if self.on_resize is not None:
+                self.on_resize(self, new_comm, epoch)
+        pause = time.time() - t0
+        self.resizes.append({"iteration": it, "world": int(world_size),
+                             "epoch": epoch, "pause_s": pause})
+        get_registry().inc("elastic/live_resizes")
+        _LOG.info(
+            "live resize at iteration %d: world -> %d (epoch %d, "
+            "pause %.3fs) — training continues in-process",
+            it, world_size, epoch, pause)

@@ -487,6 +487,66 @@ class StandardUpdater:
     def epoch(self) -> int:
         return getattr(self.iterator, "epoch", 0)
 
+    def rebind_world(self, comm, optimizer) -> None:
+        """Re-bind this updater to a NEW communicator/mesh mid-run — the
+        live-resize half of ``training/elastic.py`` (the
+        ``ResizeController`` calls this at the paused step boundary,
+        after re-laying the train state for the new world).
+
+        Everything derived from the old mesh is rebuilt or dropped: the
+        compiled step cache (its programs baked the old mesh), the batch
+        shardings, the exchange-probe program, and the plan-generation
+        watermark (the fresh optimizer re-tunes for the new topology).
+        A prefetching feed is closed — returning its unconsumed
+        lookahead to the base iterator — and re-wrapped over the new
+        communicator, so the data position is exactly where a
+        save/restart at this boundary would resume.  The caller owes:
+        draining in-flight windows FIRST (the old mesh's buffers must
+        retire before the world changes) and installing the re-laid
+        ``params`` / ``opt_state`` / ``state`` afterwards."""
+        from .optimizers import Zero1Transformation
+
+        if isinstance(self.iterator, PrefetchIterator):
+            base = self.iterator._base
+            depth = self.iterator.depth
+            # the prefetcher's RESOLVED converter, not the updater's: a
+            # pre-built feed may carry its own (e.g. a custom
+            # StagingConverter) while self.converter sits at the
+            # default — rebuilding with the wrong one would convert
+            # post-resize batches differently and break trajectory
+            # equivalence.  Reuse is safe: in-flight windows are
+            # drained by the caller and close() joins the worker.
+            conv = self.iterator._converter
+            self.iterator.close()
+            self.iterator = PrefetchIterator(
+                base, comm,
+                converter=conv,
+                steps_per_execution=self.window_steps,
+                depth=depth,
+                drop_remainder=self.drop_remainder)
+        self.comm = comm
+        self.optimizer = optimizer
+        was_zero1 = self.zero1
+        self.zero1 = isinstance(optimizer, Zero1Transformation)
+        if self.zero1 != was_zero1:
+            raise ValueError(
+                "rebind_world cannot switch zero1 mode mid-run: the "
+                "carried optimizer state's layout would not match the "
+                "new transformation")
+        cell = getattr(optimizer, "plan_cell", None)
+        if self.exchange_probe_every and cell is None:
+            raise ValueError(
+                "rebind_world: exchange_probe_every is set but the new "
+                "optimizer is not a planned one "
+                "(create_multi_node_optimizer(plan=...))")
+        self._plan_generation = None if cell is None else cell.generation
+        self._exchange_probe = None
+        self._step_cache = {}
+        self._inflight.clear()
+        self._batch_sharding = NamedSharding(comm.mesh, P(comm.axis_name))
+        self._stacked_sharding = NamedSharding(
+            comm.mesh, P(None, comm.axis_name))
+
     def finalize(self):
         """Release the feed: joins a prefetching iterator's worker and
         returns its unconsumed lookahead to the base iterator.  The

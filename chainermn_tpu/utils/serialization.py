@@ -18,6 +18,23 @@ without unpickling leaf data into a tree; :func:`load_state` checks the
 same CRCs on its real read path.  Corruption raises the typed
 :class:`SnapshotCorruptError` — the checkpointer's fallback-resume path
 catches exactly that (docs/RESILIENCE.md).
+
+Shard-only save sets (docs/RESILIENCE.md "Scale-free snapshots"): the
+full-state-per-rank layout ``_host_view`` documents costs N× disk on an
+N-process world.  A shard-only set instead splits one logical snapshot
+into per-mesh-member PART files: part ``m`` holds member ``m``'s rows of
+every world-stacked ZeRO-1 "shard" leaf (identified by the topology
+signature's per-leaf layout — exactly the metadata
+``training/elastic.relayout_state`` already consumes), and the ROOT part
+(member 0's) additionally holds every replicated entry (params,
+train_state, stack/rep optimizer leaves) ONCE.  Aggregate set cost is
+therefore ~1× the state regardless of world size.  The primitives here
+are pure and format-level: :func:`build_shard_part` slices one part,
+:func:`assemble_shard_state` rebuilds the full state from a COVERING
+set (every member present exactly once, verified), and the part record
+rides the same CRC-guarded ``__meta__`` as the topology stamp
+(:func:`load_state_with_stamps` / :func:`read_shard_part`).  The
+checkpointer owns set naming, agreement, quarantine and GC.
 """
 
 from __future__ import annotations
@@ -29,8 +46,10 @@ import zlib
 import jax
 import numpy as np
 
-__all__ = ["SnapshotCorruptError", "load_state",
-           "load_state_with_topology", "read_topology", "save_state",
+__all__ = ["SHARD_PART_FORMAT", "ShardSetError", "SnapshotCorruptError",
+           "assemble_shard_state", "build_shard_part", "load_state",
+           "load_state_with_stamps", "load_state_with_topology",
+           "read_shard_part", "read_topology", "save_state",
            "verify_state"]
 
 
@@ -39,6 +58,20 @@ class SnapshotCorruptError(RuntimeError):
     leaf, undecodable meta, truncated archive).  Typed so recovery code
     (``MultiNodeCheckpointer.maybe_load`` fallback) can distinguish
     "this file is damaged" from programming errors."""
+
+
+class ShardSetError(RuntimeError):
+    """A collection of shard-only part files does not form a valid
+    covering set (missing/duplicate members, mismatched worlds or leaf
+    indices, no root part).  Typed so the checkpointer's fallback path
+    treats it like corruption — skip the set, try the next — instead of
+    crashing resume on a half-written set."""
+
+
+#: Version of the ``shard_part`` meta record.  A reader that does not
+#: recognise the version must refuse the part (conservative, like the
+#: topology format).
+SHARD_PART_FORMAT = 1
 
 
 def _host_view(x):
@@ -69,7 +102,7 @@ def _leaf_crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
-def save_state(path: str, pytree, topology=None) -> None:
+def save_state(path: str, pytree, topology=None, shard_part=None) -> None:
     """Atomically write ``pytree`` (arrays / numeric scalars) to ``path``.
 
     ``topology`` (optional, a JSON-safe dict — see
@@ -77,7 +110,11 @@ def save_state(path: str, pytree, topology=None) -> None:
     into the ``__meta__`` record so a resume at a DIFFERENT world size can
     probe what layout the shard was written under (:func:`read_topology`)
     without unpickling leaf data into a tree.  Snapshots without it load
-    exactly as before — the stamp is additive."""
+    exactly as before — the stamp is additive.
+
+    ``shard_part`` (optional, the record :func:`build_shard_part`
+    returns) marks this file as ONE PART of a shard-only covering set;
+    it rides the same CRC-guarded meta (:func:`read_shard_part`)."""
     from chainermn_tpu.utils.telemetry import get_recorder
 
     with get_recorder().span("checkpoint/save", cat="checkpoint",
@@ -96,6 +133,8 @@ def save_state(path: str, pytree, topology=None) -> None:
                 "meta_crc_excluded": True}
         if topology is not None:
             meta["topology"] = topology
+        if shard_part is not None:
+            meta["shard_part"] = shard_part
         meta_bytes = pickle.dumps(meta)
         # the meta record guards itself too: its own CRC rides in a
         # separate tiny array, so a flipped bit inside the pickle is a
@@ -187,12 +226,10 @@ def verify_state(path: str) -> None:
             pass
 
 
-def read_topology(path: str):
-    """The topology signature stamped into ``path``'s ``__meta__`` (or
-    ``None`` for snapshots written before the elastic-resume layer).
-    Reads and CRC-checks only the meta record — leaf payloads are never
-    touched, so probing every candidate shard of a resize resume costs
-    one small read per file, not a full load.  Raises
+def _read_meta_stamp(path: str, key: str):
+    """One CRC-checked ``__meta__`` field of ``path`` — leaf payloads
+    are never touched, so probing every candidate file of a resume
+    costs one small read per file, not a full load.  Raises
     :class:`SnapshotCorruptError` on a damaged archive/meta;
     ``FileNotFoundError`` propagates ("gone" is not "damaged")."""
     try:
@@ -204,13 +241,27 @@ def read_topology(path: str):
             f"{path}: not a readable npz archive "
             f"({type(e).__name__}: {e})") from e
     with z:
-        return _read_meta(z, path).get("topology")
+        return _read_meta(z, path).get(key)
+
+
+def read_topology(path: str):
+    """The topology signature stamped into ``path``'s ``__meta__`` (or
+    ``None`` for snapshots written before the elastic-resume layer).
+    Meta-only read — see :func:`_read_meta_stamp`."""
+    return _read_meta_stamp(path, "topology")
+
+
+def read_shard_part(path: str):
+    """The ``shard_part`` record stamped into ``path``'s ``__meta__``
+    (``None`` for ordinary full snapshots).  Meta-only read, like
+    :func:`read_topology`."""
+    return _read_meta_stamp(path, "shard_part")
 
 
 def load_state(path: str):
     """Inverse of :func:`save_state`; returns the restored pytree.
     Raises :class:`SnapshotCorruptError` on any integrity failure."""
-    return load_state_with_topology(path)[0]
+    return load_state_with_stamps(path)[0]
 
 
 def load_state_with_topology(path: str):
@@ -218,6 +269,14 @@ def load_state_with_topology(path: str):
     the stamped signature comes from the same already-verified
     ``__meta__`` record, so the elastic resume path pays no second
     archive open (``None`` for pre-elastic snapshots)."""
+    tree, topology, _ = load_state_with_stamps(path)
+    return tree, topology
+
+
+def load_state_with_stamps(path: str):
+    """One checked read returning ``(pytree, topology, shard_part)`` —
+    every stamp the multi-file resume path needs comes off the same
+    verified ``__meta__`` record."""
     import ml_dtypes  # noqa: F401  (registers bfloat16/fp8 with numpy)
 
     from chainermn_tpu.utils.telemetry import get_recorder
@@ -241,4 +300,155 @@ def load_state_with_topology(path: str):
             leaves.append(arr)
         sp.set(n_leaves=len(leaves))
     return (jax.tree.unflatten(meta["treedef"], leaves),
-            meta.get("topology"))
+            meta.get("topology"), meta.get("shard_part"))
+
+
+# --------------------------------------------------------------------- #
+# shard-only save sets
+# --------------------------------------------------------------------- #
+
+def shard_leaf_indices(topology) -> list:
+    """Flat ``opt_state`` leaf indices the topology signature's per-leaf
+    layout marks as world-stacked parameter shards (``kind ==
+    "shard"``) — the only leaves a shard-only set splits; everything
+    else is replicated and rides the root part once."""
+    layouts = (topology or {}).get("opt_leaves") or []
+    return [i for i, spec in enumerate(layouts)
+            if spec.get("kind") == "shard"]
+
+
+def _member_rows(leaf, lo: int, hi: int, world: int):
+    """Host copy of member rows ``[lo, hi)`` of a world-stacked leaf.
+
+    For a process-spanning (not fully addressable) array the rows are
+    extracted from this process's addressable shards — the point of
+    shard-only saves is that nobody gathers the full state; a request
+    for rows this process does not hold is a caller bug and raises."""
+    shape = tuple(np.shape(leaf))
+    if not shape or shape[0] != world:
+        raise ValueError(
+            f"shard leaf has shape {shape}; expected a leading "
+            f"world axis of {world}")
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        out = np.empty((hi - lo,) + shape[1:],
+                       dtype=np.dtype(leaf.dtype))
+        have = np.zeros((hi - lo,), bool)
+        for sh in leaf.addressable_shards:
+            idx = sh.index[0]
+            start = 0 if idx.start is None else idx.start
+            stop = shape[0] if idx.stop is None else idx.stop
+            a, b = max(start, lo), min(stop, hi)
+            if a < b:
+                data = np.asarray(sh.data)
+                out[a - lo:b - lo] = data[a - start:b - start]
+                have[a - lo:b - lo] = True
+        if not have.all():
+            raise ValueError(
+                f"member rows [{lo}, {hi}) are not addressable from "
+                "this process — shard-only saves write only locally "
+                "held rows")
+        return out
+    return np.asarray(np.asarray(leaf)[lo:hi])
+
+
+def build_shard_part(state: dict, topology: dict, lo: int, hi: int,
+                     *, root: bool):
+    """One part of a shard-only covering set: ``(part_state,
+    shard_part_record)`` for member rows ``[lo, hi)``.
+
+    The ROOT part is the full checkpointer state dict with every
+    "shard"-kind ``opt_state`` leaf sliced down to its own rows; a
+    non-root part carries ONLY ``{"shards": {leaf_XXXXX: rows}}``.
+    The record names the covered range, the world, and the shard leaf
+    indices, so :func:`assemble_shard_state` is self-describing —
+    assembly never re-derives the layout from live code that may have
+    moved on."""
+    world = int(topology["world_size"])
+    if not 0 <= lo < hi <= world:
+        raise ValueError(f"member range [{lo}, {hi}) not in [0, {world})")
+    idxs = shard_leaf_indices(topology)
+    leaves, treedef = jax.tree.flatten(state["opt_state"])
+    if root:
+        new = list(leaves)
+        for i in idxs:
+            new[i] = _member_rows(leaves[i], lo, hi, world)
+        part = dict(state)
+        part["opt_state"] = jax.tree.unflatten(treedef, new)
+    else:
+        part = {"shards": {f"leaf_{i:05d}":
+                           _member_rows(leaves[i], lo, hi, world)
+                           for i in idxs}}
+    record = {"format": SHARD_PART_FORMAT, "members": [int(lo), int(hi)],
+              "world": world, "root": bool(root),
+              "shard_leaves": [int(i) for i in idxs]}
+    return part, record
+
+
+def assemble_shard_state(parts) -> dict:
+    """Rebuild the full state dict from a COVERING set of shard-only
+    parts (``(shard_part_record, part_state)`` pairs, any order).
+
+    Verifies the set actually covers: exactly one root, member ranges
+    tiling ``[0, world)`` with no gap or overlap, every part agreeing
+    on world/format/leaf indices.  The result is BITWISE the state a
+    full save would have written — each world-stacked shard leaf is the
+    member-order concatenation of the parts' rows."""
+    parts = list(parts)
+    if not parts:
+        raise ShardSetError("no shard parts to assemble")
+    roots = [(rec, st) for rec, st in parts if rec.get("root")]
+    if len(roots) != 1:
+        raise ShardSetError(
+            f"covering set needs exactly one root part, got "
+            f"{len(roots)}")
+    root_rec, root_state = roots[0]
+    if int(root_rec.get("format", -1)) != SHARD_PART_FORMAT:
+        raise ShardSetError(
+            f"unknown shard_part format {root_rec.get('format')!r} "
+            f"(this reader speaks {SHARD_PART_FORMAT})")
+    world = int(root_rec["world"])
+    idxs = [int(i) for i in root_rec["shard_leaves"]]
+    ranges = []
+    for rec, _ in parts:
+        if int(rec.get("world", -1)) != world \
+                or [int(i) for i in rec.get("shard_leaves", [])] != idxs \
+                or int(rec.get("format", -1)) != SHARD_PART_FORMAT:
+            raise ShardSetError(
+                "shard parts disagree on world/leaf layout — files "
+                "from different sets were mixed")
+        ranges.append((int(rec["members"][0]), int(rec["members"][1])))
+    order = sorted(range(len(parts)), key=lambda k: ranges[k])
+    cursor = 0
+    for k in order:
+        lo, hi = ranges[k]
+        if lo != cursor:
+            raise ShardSetError(
+                f"member ranges do not tile [0, {world}): gap or "
+                f"overlap at member {cursor} (next part covers "
+                f"[{lo}, {hi}))")
+        cursor = hi
+    if cursor != world:
+        raise ShardSetError(
+            f"member ranges stop at {cursor}, but the set's world is "
+            f"{world} — the covering set is incomplete")
+    leaves, treedef = jax.tree.flatten(root_state["opt_state"])
+    new = list(leaves)
+    for i in idxs:
+        key = f"leaf_{i:05d}"
+        rows = []
+        for k in order:
+            rec, st = parts[k]
+            if rec.get("root"):
+                sub, _ = jax.tree.flatten(st["opt_state"])
+                rows.append(np.asarray(sub[i]))
+            else:
+                try:
+                    rows.append(np.asarray(st["shards"][key]))
+                except KeyError:
+                    raise ShardSetError(
+                        f"part covering {rec['members']} is missing "
+                        f"shard leaf {key}") from None
+        new[i] = np.concatenate(rows, axis=0)
+    out = dict(root_state)
+    out["opt_state"] = jax.tree.unflatten(treedef, new)
+    return out

@@ -1,7 +1,7 @@
 """Worker for the kill→resume fault drills: one deterministic training
 job per invocation, driven by a FaultPlan JSON.
 
-    python _fault_worker.py <phase> <workdir> <plan_json>
+    python _fault_worker.py <phase> <workdir> <plan_json> [<mode>]
 
 Phases:
   ref    — run 6 epochs uninterrupted, write final params to ref.npz
@@ -9,6 +9,14 @@ Phases:
            dies mid-run; the parent asserts the SIGKILL exit)
   resume — maybe_load from the checkpoint, finish the 6 epochs, write
            final params to resumed.npz
+
+``mode`` (default "full") selects the checkpoint flavour:
+  full        — sync full-state-per-rank files (the PR 3 drills)
+  shard_async — ZeRO-1 optimizer + shard-only covering sets streamed by
+                the async background writer, so a SIGKILL can land
+                MID-SET with the writer stalled by the plan's
+                ``save_stall_after_files`` (docs/RESILIENCE.md
+                "Scale-free snapshots")
 
 ``ref`` and ``resume`` must be BITWISE identical — the resilience
 layer's whole claim (docs/RESILIENCE.md).
@@ -51,26 +59,36 @@ def _loss_fn(params, x, y):
     return jnp.mean((pred - y) ** 2)
 
 
-def _build(comm, workdir):
+def _build(comm, workdir, mode="full"):
     import jax.numpy as jnp
 
     it = cmn.SerialIterator(_dataset(), batch_size=16, shuffle=True,
                             seed=5)
-    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+    # shard_async drills ZeRO-1: covering sets need real shard leaves
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(0.05), comm, zero1=(mode == "shard_async"))
     params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
     up = cmn.StandardUpdater(it, opt, _loss_fn, params, comm)
     trainer = cmn.Trainer(up, stop_trigger=(6, "epoch"),
                           out=os.path.join(workdir, "out"))
     log = LogReport(trigger=(1, "epoch"))
     trainer.extend(log)
-    # sync writes: a SIGKILL one iteration after a save must find that
-    # save durable (async overlap would race the kill — its join-on-
-    # crash path is drilled separately by the SIGTERM-mid-write test).
-    # history=2: the corrupted-latest drill needs the previous complete
-    # set still on disk to fall back to.
-    cp = create_multi_node_checkpointer(
-        comm, os.path.join(workdir, "ckpt"), async_write=False,
-        history=2)
+    if mode == "shard_async":
+        # the scale-free flavour: shard-only covering sets streamed by
+        # the background writer — the SIGKILL drill stalls the stream
+        # (FaultPlan.save_stall_after_files) so the kill lands MID-SET
+        cp = create_multi_node_checkpointer(
+            comm, os.path.join(workdir, "ckpt"), async_write=True,
+            shard_only=True, history=2)
+    else:
+        # sync writes: a SIGKILL one iteration after a save must find
+        # that save durable (async overlap would race the kill — its
+        # join-on-crash path is drilled separately by the
+        # SIGTERM-mid-write test).  history=2: the corrupted-latest
+        # drill needs the previous complete set on disk to fall back to.
+        cp = create_multi_node_checkpointer(
+            comm, os.path.join(workdir, "ckpt"), async_write=False,
+            history=2)
     # save every 3 iterations — NOT aligned with the 4-iteration epoch,
     # so the kill lands mid-epoch, mid-shuffle
     trainer.extend(cp, trigger=(3, "iteration"))
@@ -79,11 +97,12 @@ def _build(comm, workdir):
 
 def main():
     phase, workdir, plan_json = sys.argv[1], sys.argv[2], sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "full"
     comm = cmn.create_communicator("tpu_xla")
-    trainer, up, cp, log = _build(comm, workdir)
+    trainer, up, cp, log = _build(comm, workdir, mode)
     if phase == "train":
         plan = FaultPlan.from_json(plan_json)
-        trainer.extend(FaultInjector(plan, comm))
+        trainer.extend(FaultInjector(plan, comm, checkpointer=cp))
     elif phase == "resume":
         resumed = cp.maybe_load(up, trainer)
         print(f"RESUMED_AT {resumed}", flush=True)

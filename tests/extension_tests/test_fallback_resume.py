@@ -134,11 +134,12 @@ class TestFallbackResume:
         cp._cleanup = stash
         # simulate a peer whose iteration-10 shard was quarantined: the
         # presence agreement excludes 10, so protection must fall on
-        # {15, 5} — NOT this rank's local {15, 10}
+        # {15, 5} — NOT this rank's local {15, 10}.  The agreement rows
+        # are (inventory, streaming) pairs since the async-GC fix.
         monkeypatch.setattr(
             cp.comm, "allgather_obj",
-            lambda obj: ([obj, obj - {10}] if isinstance(obj, set)
-                         else [obj]))
+            lambda obj: ([obj, (obj[0] - {10}, obj[1])]
+                         if isinstance(obj, tuple) else [obj]))
         up.iteration = 15
         cp.save(up)
         names = sorted(f for f in os.listdir(tmp_path)

@@ -28,7 +28,8 @@ _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def _run_phase(phase, workdir, plan=None, expect_kill=False, timeout=240):
+def _run_phase(phase, workdir, plan=None, expect_kill=False, timeout=240,
+               mode="full"):
     env = dict(os.environ)
     for k in list(env):
         if k.startswith(("TPU_", "LIBTPU", "PJRT_", "JAX_", "XLA_")):
@@ -37,7 +38,7 @@ def _run_phase(phase, workdir, plan=None, expect_kill=False, timeout=240):
     env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     plan_json = (plan or FaultPlan()).to_json()
     proc = subprocess.run(
-        [sys.executable, _WORKER, phase, str(workdir), plan_json],
+        [sys.executable, _WORKER, phase, str(workdir), plan_json, mode],
         capture_output=True, text=True, timeout=timeout, env=env,
         cwd=_REPO_ROOT)
     if expect_kill:
@@ -100,6 +101,77 @@ class TestKillResumeBitwise:
         out = _run_phase("resume", kill_dir)
         assert "RESUMED_AT 6" in out.stdout
         assert (kill_dir / "ckpt" / "snapshot_iter_9.0.corrupt").exists()
+        ref = _final_state(ref_dir, "ref.npz")
+        got = _final_state(kill_dir, "resumed.npz")
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(
+                np.asarray(got["params"][k]), np.asarray(ref["params"][k]))
+        np.testing.assert_array_equal(
+            np.asarray(got["log_losses"]), np.asarray(ref["log_losses"]))
+
+
+@pytest.mark.slow
+class TestKillMidShardOnlyAsyncSave:
+    """The crash-during-shard-only-save drill (docs/RESILIENCE.md
+    "Scale-free snapshots"): the background writer is stalled mid-SET
+    (``save_stall_after_files``), a REAL SIGKILL lands while the
+    covering set is partially on disk, and resume must treat the
+    partial set as nonexistent — falling back to the previous complete
+    set and finishing bitwise-identical to the uninterrupted run."""
+
+    # sets at iterations 3/6/9 hold 8 parts each; files 0-16 (sets 3, 6
+    # and the root part of set 9) land unstalled, every later part of
+    # set 9 sleeps far longer than the two iterations the kill needs
+    _PLAN = dict(kill_at_iteration=11, save_stall_after_files=17,
+                 save_stall_seconds=120.0)
+
+    @staticmethod
+    def _set_parts(workdir, it):
+        ckpt = workdir / "ckpt"
+        return sorted(f for f in os.listdir(ckpt)
+                      if f.startswith(f"snapshot_iter_{it}.s"))
+
+    def test_partial_covering_set_falls_back_bitwise(self, tmp_path):
+        ref_dir, kill_dir = tmp_path / "ref", tmp_path / "kill"
+        ref_dir.mkdir(), kill_dir.mkdir()
+        _run_phase("ref", ref_dir, mode="shard_async")
+        proc = _run_phase("train", kill_dir, FaultPlan(**self._PLAN),
+                          expect_kill=True, mode="shard_async")
+        assert "PHASE_OK" not in proc.stdout
+        # the kill landed MID-stream: set 9 is on disk but incomplete
+        parts9 = self._set_parts(kill_dir, 9)
+        assert 1 <= len(parts9) < 8, (
+            f"expected a partial covering set, found {parts9}")
+        assert len(self._set_parts(kill_dir, 6)) == 8
+        out = _run_phase("resume", kill_dir, mode="shard_async")
+        assert "RESUMED_AT 6" in out.stdout
+        ref = _final_state(ref_dir, "ref.npz")
+        got = _final_state(kill_dir, "resumed.npz")
+        assert int(got["iteration"]) == int(ref["iteration"]) == 24
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(
+                np.asarray(got["params"][k]), np.asarray(ref["params"][k]),
+                err_msg=f"resumed {k} differs from uninterrupted run")
+        np.testing.assert_array_equal(
+            np.asarray(got["log_losses"]), np.asarray(ref["log_losses"]),
+            err_msg="resumed loss log differs bitwise")
+
+    def test_composes_with_corrupt_newest_complete_set(self, tmp_path):
+        """The PR 3 composition: partial set 9 AND a corrupt part in
+        complete set 6 — resume quarantines the damaged part, votes set
+        6 down, and restores set 3, still bitwise."""
+        from chainermn_tpu.testing import corrupt_file
+
+        ref_dir, kill_dir = tmp_path / "ref", tmp_path / "kill"
+        ref_dir.mkdir(), kill_dir.mkdir()
+        _run_phase("ref", ref_dir, mode="shard_async")
+        _run_phase("train", kill_dir, FaultPlan(**self._PLAN),
+                   expect_kill=True, mode="shard_async")
+        victim = self._set_parts(kill_dir, 6)[3]
+        corrupt_file(str(kill_dir / "ckpt" / victim), seed=6)
+        out = _run_phase("resume", kill_dir, mode="shard_async")
+        assert "RESUMED_AT 3" in out.stdout
+        assert (kill_dir / "ckpt" / f"{victim}.corrupt").exists()
         ref = _final_state(ref_dir, "ref.npz")
         got = _final_state(kill_dir, "resumed.npz")
         for k in ("w", "b"):

@@ -150,7 +150,7 @@ class TestPreemption:
         trainer.extend(fake_preemption)
         trainer.run()
         assert trainer.updater.iteration == 3
-        assert cp._common_iterations() == [3]
+        assert cp._agreed_inventory()[0] == [3]
 
     def test_no_spurious_trigger_fire_after_resume(self, comm, tmp_path):
         # (period=100, 'iteration') with a run resumed at iteration 4:

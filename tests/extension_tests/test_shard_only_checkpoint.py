@@ -1,0 +1,387 @@
+"""Shard-only covering sets + async streaming through the REAL
+checkpointer (docs/RESILIENCE.md "Scale-free snapshots"): a ZeRO-1
+job's shard-only set must resume BITWISE-identical to a full per-rank
+save, async + shard-only must load bitwise-equal to a sync full save,
+aggregate set bytes must stop scaling with world size, a partial or
+corrupt set must fall back to the newest set that covers, and a set the
+background writer is still streaming must never count toward — nor be
+evicted by — ``history=N`` (the GC × async-save race).  8-device CPU
+mesh (tests/conftest.py)."""
+
+import logging
+import os
+import threading
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.extensions import create_multi_node_checkpointer
+from chainermn_tpu.models import init_mlp, mlp_apply, softmax_cross_entropy
+from chainermn_tpu.testing import corrupt_file
+
+_N, _DIM, _CLASSES, _BATCH = 96, 6, 3, 16
+
+
+def _dataset():
+    rng = np.random.RandomState(0)
+    return [(rng.randn(_DIM).astype(np.float32), np.int32(i % _CLASSES))
+            for i in range(_N)]
+
+
+def _make_updater(comm, zero1=True):
+    it = cmn.SerialIterator(_dataset(), _BATCH, shuffle=True, seed=7)
+    params = init_mlp(jax.random.PRNGKey(0), [_DIM, 12, _CLASSES])
+    opt = cmn.create_multi_node_optimizer(
+        optax.adam(5e-2), comm, zero1=zero1)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    return cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+
+
+def _world_comm(n):
+    return cmn.create_communicator("tpu_xla", devices=jax.devices()[:n])
+
+
+def _host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def _assert_tree_equal(a, b, msg=""):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=msg), a, b)
+
+
+def _part_files(path, it):
+    return sorted(f for f in os.listdir(path)
+                  if f.startswith(f"snapshot_iter_{it}.s"))
+
+
+def _trained(comm, steps=3):
+    upd = _make_updater(comm)
+    for _ in range(steps):
+        upd.update()
+    return upd
+
+
+class TestShardOnlySets:
+    def test_resume_bitwise_equal_to_full_save(self, tmp_path):
+        """One trained state saved both ways must restore identically:
+        the covering set IS the snapshot, just laid out differently."""
+        comm = _world_comm(8)
+        upd = _trained(comm)
+        full_dir, shard_dir = tmp_path / "full", tmp_path / "shard"
+        create_multi_node_checkpointer(
+            comm, str(full_dir), elastic=True).save(upd)
+        cp_s = create_multi_node_checkpointer(
+            comm, str(shard_dir), elastic=True, shard_only=True)
+        cp_s.save(upd)
+        # the set really is per-member parts, not per-rank full files
+        assert len(_part_files(shard_dir, upd.iteration)) == 8
+
+        ref, got = _make_updater(comm), _make_updater(comm)
+        assert create_multi_node_checkpointer(
+            comm, str(full_dir), elastic=True).maybe_load(ref) == 3
+        cp2 = create_multi_node_checkpointer(
+            comm, str(shard_dir), elastic=True, shard_only=True)
+        assert cp2.maybe_load(got) == 3
+        assert cp2.last_resume_mode == "exact"
+        _assert_tree_equal(got.params, _host(ref.params),
+                           "shard-set params differ from full save")
+        _assert_tree_equal(got.opt_state, _host(ref.opt_state),
+                           "shard-set opt_state differs from full save")
+
+    def test_set_bytes_scale_free(self, tmp_path):
+        """Aggregate covering-set bytes must be ~1x the state — the
+        full-state-per-rank layout costs ~world x (the ROADMAP's
+        'snapshot cost stops scaling' claim, asserted not plotted).
+        A model big enough that state, not per-file npz overhead,
+        carries the bytes."""
+        comm = _world_comm(8)
+        it = cmn.SerialIterator(_dataset(), _BATCH, shuffle=True, seed=7)
+        params = init_mlp(jax.random.PRNGKey(0), [_DIM, 512, _CLASSES])
+        opt = cmn.create_multi_node_optimizer(
+            optax.adam(5e-2), comm, zero1=True)
+
+        def loss_fn(p, x, y):
+            return softmax_cross_entropy(mlp_apply(p, x), y)
+
+        upd = cmn.StandardUpdater(it, opt, loss_fn, params, comm)
+        upd.update()
+        shard_dir, full_dir = tmp_path / "s", tmp_path / "f"
+        cp = create_multi_node_checkpointer(
+            comm, str(shard_dir), elastic=True, shard_only=True)
+        cp.save(upd)
+        # what an 8-process world writes today: the complete state per
+        # rank — one file of it is the 1x yardstick, the 8-process
+        # aggregate is 8x that
+        cp_f = create_multi_node_checkpointer(comm, str(full_dir),
+                                              elastic=True)
+        cp_f.save(upd)
+        full_one = os.path.getsize(
+            os.path.join(full_dir, f"snapshot_iter_{upd.iteration}.0"))
+        shard_total = sum(
+            os.path.getsize(os.path.join(shard_dir, f))
+            for f in _part_files(shard_dir, upd.iteration))
+        # covering set ~ one full file (+ small per-part meta); the
+        # per-rank layout would be 8 * full_one
+        assert shard_total < 1.5 * full_one, (
+            f"covering set costs {shard_total} bytes vs {full_one} for "
+            "ONE full file — shard-only sets should not duplicate state")
+        assert shard_total < 0.25 * 8 * full_one
+
+    def test_async_shard_only_bitwise_equal_to_sync_full(self, tmp_path):
+        """The acceptance pin: async + shard-only loads bitwise-equal
+        to a sync full save of the same state."""
+        comm = _world_comm(8)
+        upd = _trained(comm)
+        sync_dir, async_dir = tmp_path / "sync", tmp_path / "async"
+        create_multi_node_checkpointer(
+            comm, str(sync_dir), elastic=True).save(upd)
+        cp_a = create_multi_node_checkpointer(
+            comm, str(async_dir), elastic=True, shard_only=True,
+            async_write=True)
+        cp_a.save(upd)
+        assert upd.iteration in cp_a._streaming  # still in flight
+        cp_a.finalize()                          # join: set complete
+        assert upd.iteration not in cp_a._streaming
+
+        ref, got = _make_updater(comm), _make_updater(comm)
+        create_multi_node_checkpointer(
+            comm, str(sync_dir), elastic=True).maybe_load(ref)
+        assert create_multi_node_checkpointer(
+            comm, str(async_dir), elastic=True,
+            shard_only=True).maybe_load(got) == 3
+        _assert_tree_equal(got.params, _host(ref.params))
+        _assert_tree_equal(got.opt_state, _host(ref.opt_state),
+                           "async shard-only differs from sync full")
+
+    def test_shrink_resume_relayouts_from_covering_set(self, tmp_path):
+        """The elastic composition: a world-8 covering set re-lays onto
+        world=4 exactly like a full snapshot would."""
+        from chainermn_tpu.training.elastic import (
+            gather_zero1_leaves,
+            shard_zero1_leaves,
+            topology_signature,
+        )
+
+        comm8 = _world_comm(8)
+        upd8 = _trained(comm8)
+        cp8 = create_multi_node_checkpointer(
+            comm8, str(tmp_path), elastic=True, shard_only=True)
+        cp8.save(upd8)
+        layouts8 = topology_signature(
+            comm8, params=upd8.params, opt_state=upd8.opt_state,
+            zero1=True)["opt_leaves"]
+        full8 = gather_zero1_leaves(_host(upd8.opt_state), layouts8)
+
+        comm4 = _world_comm(4)
+        upd4 = _make_updater(comm4)
+        cp4 = create_multi_node_checkpointer(
+            comm4, str(tmp_path), elastic=True, shard_only=True)
+        assert cp4.maybe_load(upd4) == 3
+        assert cp4.last_resume_mode == "relayout"
+        _assert_tree_equal(upd4.params, _host(upd8.params))
+        _assert_tree_equal(
+            _host(upd4.opt_state),
+            shard_zero1_leaves(full8, layouts8, 4),
+            "covering-set relayout differs from a from-scratch shard")
+
+
+class TestShardSetFallback:
+    def _two_sets(self, comm, tmp_path):
+        upd = _make_updater(comm)
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True, shard_only=True,
+            history=2)
+        upd.update()
+        cp.save(upd)            # set 1
+        upd.update()
+        upd.update()
+        cp.save(upd)            # set 3
+        return cp, upd
+
+    def test_partial_set_falls_back_to_previous_complete(
+            self, comm, tmp_path, caplog):
+        """A missing member part (the crash-mid-stream shape) makes the
+        set invisible to the inventory — resume restores the previous
+        complete set without even reading the partial one."""
+        _, upd = self._two_sets(comm, tmp_path)
+        ref3 = _host(upd.params)
+        os.remove(tmp_path / _part_files(tmp_path, 3)[5])
+        got = _make_updater(comm)
+        cp2 = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True, shard_only=True)
+        assert cp2.maybe_load(got) == 1
+        got_leaf = np.asarray(jax.tree.leaves(got.params)[0])
+        ref_leaf = np.asarray(jax.tree.leaves(ref3)[0])
+        assert not np.array_equal(got_leaf, ref_leaf), (
+            "fallback restored set 3's params — the partial set was "
+            "treated as complete")
+
+    def test_corrupt_part_quarantined_and_falls_back(
+            self, comm, tmp_path, caplog):
+        """A corrupt part fails the whole set (zero redundancy): the
+        damaged file is quarantined ``*.corrupt`` and resume falls back
+        — the PR 3 semantics, multi-file."""
+        self._two_sets(comm, tmp_path)
+        victim = _part_files(tmp_path, 3)[2]
+        corrupt_file(str(tmp_path / victim), seed=9)
+        got = _make_updater(comm)
+        cp2 = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True, shard_only=True)
+        with caplog.at_level(logging.WARNING,
+                             "chainermn_tpu.extensions.checkpoint"):
+            assert cp2.maybe_load(got) == 1
+        assert (tmp_path / f"{victim}.corrupt").exists()
+        assert not (tmp_path / victim).exists()
+
+    def test_iteration_shards_skips_part_files(self, comm, tmp_path):
+        """The elastic borrow path reads FULL per-rank shards only: a
+        shard-only part file sharing the iteration (a mode switch, or a
+        peer's mid-quarantine rescan) must be skipped by the scan, not
+        crash it with ``int(None)`` mid-agreement."""
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True)
+        for fn in ("snapshot_iter_3.0", "snapshot_iter_3.s1of8",
+                   "snapshot_iter_3.s0of8"):
+            (tmp_path / fn).write_bytes(b"x")
+        assert [r for r, _ in cp._iteration_shards(3)] == [0]
+
+    def test_mixed_full_and_shard_sets_interoperate(self, comm,
+                                                    tmp_path):
+        """A directory holding a full set AND a newer covering set
+        resumes from the newest loadable one of either shape — the two
+        layouts share one namespace and one agreement."""
+        upd = _make_updater(comm)
+        cp_full = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True, history=2)
+        upd.update()
+        cp_full.save(upd)                       # full set @1
+        upd.update()
+        cp_shard = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True, shard_only=True,
+            history=2)
+        cp_shard.save(upd)                      # covering set @2
+        ref2 = _host(upd.params)
+        got = _make_updater(comm)
+        cp2 = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True)
+        assert cp2.maybe_load(got) == 2
+        _assert_tree_equal(got.params, ref2)
+        # and with the newest set crippled, the FULL set still covers
+        os.remove(tmp_path / _part_files(tmp_path, 2)[0])
+        got1 = _make_updater(comm)
+        cp3 = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True)
+        assert cp3.maybe_load(got1) == 1
+
+
+class TestStreamingGCProtection:
+    """The GC × async-save race (ISSUE 12 satellite): a set the
+    background writer is still streaming must never count toward — nor
+    be evicted by — ``history=N``, with the protection agreed
+    collectively (the streaming sets ride the same allgather as the
+    inventory)."""
+
+    def _stalled_checkpointer(self, comm, tmp_path, history=2):
+        cp = create_multi_node_checkpointer(
+            comm, str(tmp_path), elastic=True, shard_only=True,
+            async_write=True, history=history)
+        gate = threading.Event()
+        first_landed = threading.Event()
+        real = cp._write_part
+        state = {"files": 0}
+
+        def stalled(path, tree, topology, shard_part):
+            if state["files"] >= 1:      # first file lands, rest wait
+                gate.wait(timeout=30)
+            real(path, tree, topology, shard_part)
+            state["files"] += 1
+            first_landed.set()
+
+        cp._write_part = stalled
+        return cp, gate, first_landed, state
+
+    def test_streaming_set_neither_counts_nor_evicts(self, comm,
+                                                     tmp_path):
+        comm8 = _world_comm(8)
+        upd = _make_updater(comm8)
+        cp, gate, first_landed, wstate = self._stalled_checkpointer(
+            comm8, tmp_path)
+        upd.update()
+        gate.set()
+        cp.save(upd)                 # set 1 (completes: gate open)
+        cp._join_pending(barrier_and_gc=True)
+        gate.clear()
+        first_landed.clear()
+        wstate["files"] = 0          # the stall is per-SET
+        upd.update()
+        upd.update()
+        cp.save(upd)                 # set 3: writer stalls mid-stream
+        try:
+            assert first_landed.wait(timeout=30), (
+                "writer thread never landed the first part file")
+            assert 3 in cp._streaming
+            # a streaming set is invisible to the inventory: a resume
+            # scan right now must not see a half-renamed set as real
+            assert 3 not in cp._local_iterations()
+            common, streaming = cp._agreed_inventory()
+            assert 3 in streaming and 3 not in common
+            # GC under the race: set 3 must not count toward history=2
+            # (that would displace complete set 1) and must not be
+            # evicted (that would race the writer's renames)
+            cp._cleanup(keep=3)
+            assert _part_files(tmp_path, 1), (
+                "GC evicted the only complete fallback set while the "
+                "newer set was still streaming")
+            assert _part_files(tmp_path, 3), (
+                "GC deleted files out from under the background writer")
+        finally:
+            gate.set()
+        cp.finalize()                # join: set 3 agreed complete
+        assert 3 not in cp._streaming
+        assert 3 in cp._local_iterations()
+        # both sets survive under history=2; a third save now reaps 1
+        upd.update()
+        cp.save(upd)
+        cp.finalize()
+        assert not _part_files(tmp_path, 1)
+        assert _part_files(tmp_path, 3) and _part_files(tmp_path, 4)
+
+    def test_streaming_set_not_resumable_until_joined(self, comm,
+                                                      tmp_path):
+        """A SECOND process (simulated: a fresh checkpointer over the
+        same directory) must not resume from a set whose completion was
+        never agreed — completeness comes from the agreement, not from
+        squinting at the directory mid-rename."""
+        comm8 = _world_comm(8)
+        upd = _make_updater(comm8)
+        cp, gate, first_landed, wstate = self._stalled_checkpointer(
+            comm8, tmp_path)
+        upd.update()
+        gate.set()
+        cp.save(upd)
+        cp._join_pending(barrier_and_gc=True)
+        gate.clear()
+        first_landed.clear()
+        wstate["files"] = 0          # the stall is per-SET
+        upd.update()
+        upd.update()
+        cp.save(upd)                 # set 3 streaming, stalled
+        try:
+            assert first_landed.wait(timeout=30)
+            got = _make_updater(comm8)
+            cp2 = create_multi_node_checkpointer(
+                comm8, str(tmp_path), elastic=True, shard_only=True)
+            # the fresh checkpointer's scan sees set 3's partial files
+            # but the set does not tile -> not in its inventory
+            assert cp2.maybe_load(got) == 1
+        finally:
+            gate.set()
+        cp.finalize()

@@ -627,7 +627,7 @@ def scenario_zero1_checkpoint(comm):
         comm, path, name="async", async_write=True)
     cp_async.save(upd2)
     cp_async.finalize()
-    assert cp_async._common_iterations() == [4]
+    assert cp_async._agreed_inventory()[0] == [4]
 
     # writer-only snapshot: ALL ranks join the collective gather before
     # rank 0 writes (a writer-only gather would deadlock the barrier)
@@ -694,7 +694,7 @@ def scenario_preemption(comm):
     assert upd.iteration == 3, upd.iteration
     assert "preemption" in (trainer.stop_reason or ""), trainer.stop_reason
     # all processes agreed on the checkpointed iteration
-    iters = comm.allgather_obj(cp._common_iterations())
+    iters = comm.allgather_obj(cp._agreed_inventory()[0])
     assert all(x == [3] for x in iters), iters
 
 
@@ -1462,6 +1462,82 @@ def scenario_preemption_sigterm(comm):
             a, b, err_msg="resumed params differ from the "
                           "uninterrupted run")
     kv2.barrier()
+
+
+def scenario_resize_live(comm):
+    """The LIVE-resize control plane across REAL processes, KV-only
+    (the data plane may be mid-reconfiguration, so nothing here may
+    ride an array collective): an intent posted by ONE rank
+    (``post_resize_intent``) is seen by every rank, the OR-agreement
+    resolves identically everywhere, the membership epoch bumps and
+    fences channel generations so pre-resize traffic is REJECTED, and
+    the consumed intent is cleared.  The mesh re-formation itself is
+    single-process (tests/extension_tests/test_live_resize.py) or
+    TPU-gated — this drill is the cross-process half."""
+    from chainermn_tpu.communicators._obj_channel import (
+        KVObjectChannel,
+        StaleGenerationError,
+    )
+    from chainermn_tpu.training.elastic import (
+        ElasticMembership,
+        ResizeController,
+        post_resize_intent,
+    )
+
+    me, n = comm.inter_rank, comm.inter_size
+    boot = KVObjectChannel(tag="resize-boot")
+    path = boot.allgather(
+        tempfile.mkdtemp(prefix="resize_mp_") if me == 0 else None,
+        list(range(n)), me)[0]
+    membership = ElasticMembership(comm, path=path)
+    ctrl = ResizeController(
+        comm_factory=lambda w: comm, optimizer_factory=lambda c: None,
+        membership=membership)
+
+    # only the LAST rank posts the intent — every rank must still see
+    # it (external tooling posts from wherever it runs)
+    assert ctrl._kv_intent(comm) is None
+    if me == n - 1:
+        post_resize_intent(n, reason="mp drill")
+    _kv_barrier(comm, boot)
+    assert ctrl._kv_intent(comm) == n
+
+    # the controller's boundary agreement: a rank with NO local intent
+    # resolves to the same world as the poster.  KV-only here — this
+    # container's CPU backend has no cross-process array collectives,
+    # which is exactly the situation the control plane must survive
+    mine = ctrl._kv_intent(comm) if me == n - 1 else None
+    rows = boot.allgather(mine, list(range(n)), me)
+    seen = [r for r in rows if r is not None]
+    assert seen and max(seen) == n, rows
+
+    # epoch + fence: the step the live resize performs before the mesh
+    # re-forms — stale-generation traffic must bounce afterwards
+    rec = membership.agree()
+    assert rec.epoch == 1 and rec.members == list(range(n)), rec
+    chan = KVObjectChannel(tag="resize-data")
+    if me == 0:
+        chan.send("pre-resize", src=0, dst=1)   # old-generation traffic
+    membership.fence(chan)
+    assert chan.generation == rec.epoch
+    if me == 0:
+        chan.send({"epoch": rec.epoch}, src=0, dst=1)
+    if me == 1:
+        try:
+            got = chan.recv(src=0, dst=1)
+            raise AssertionError(
+                f"pre-resize message survived the fence: {got!r}")
+        except StaleGenerationError:
+            pass
+        assert chan.recv(src=0, dst=1) == {"epoch": 1}
+
+    # the agreed intent is consumed by EVERY rank (idempotent delete —
+    # the controller clears before its collectives so no rank can
+    # re-read a stale intent on its next cadence tick)
+    ctrl._clear_kv_intent(comm)
+    _kv_barrier(comm, boot)
+    assert ctrl._kv_intent(comm) is None
+    _kv_barrier(comm, boot)
 
 
 SCENARIOS = {

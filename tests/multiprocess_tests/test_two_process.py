@@ -33,6 +33,7 @@ class TestTwoProcess:
         # the previous verified set; damaged file quarantined
         mp_run("fallback_resume")
 
+    @pytest.mark.drill
     def test_watchdog_stall(self, mp_run):
         # rank 1 stalls past the threshold: self-report + survivor
         # detection through the cross-process KV heartbeats
@@ -74,15 +75,24 @@ class TestTwoProcess:
     def test_preemption_collective_flag(self, mp_run):
         mp_run("preemption")
 
+    @pytest.mark.drill
     def test_elastic_membership(self, mp_run):
         # epoch-numbered membership agreement + generation fencing over
         # the KV store only; a stale-generation message is REJECTED
         mp_run("elastic_membership", timeout=240)
 
+    @pytest.mark.drill
     def test_preemption_sigterm_drill(self, mp_run):
         # real SIGTERM on one process -> OR-reduced collective save ->
         # both ranks stop clean -> resume bitwise-matches uninterrupted
         mp_run("preemption_sigterm", timeout=300)
+
+    @pytest.mark.drill
+    def test_resize_live_control_plane(self, mp_run):
+        # live-resize coordination KV-only: one rank's posted intent
+        # agreed by all -> epoch bump + generation fence rejects
+        # pre-resize traffic -> intent consumed once
+        mp_run("resize_live", timeout=240)
 
     def test_zero1_checkpoint(self, mp_run):
         mp_run("zero1_checkpoint")

@@ -69,6 +69,23 @@ class TestPredictor:
         snap = p.snapshot()
         assert snap["tpot"] == 0.1 and snap["ttft_count"] == 0
 
+    def test_predict_queue_drain(self):
+        """The retry-after estimate (ROADMAP admission open end #3):
+        backlog tokens over the aggregate decode rate ``n_slots /
+        TPOT`` — TTFT deliberately amortised away, cold stays None."""
+        p = ServiceTimePredictor()
+        assert p.predict_queue_drain(100, 8) is None   # no evidence
+        p = ServiceTimePredictor(default_ttft=9.9, default_tpot=0.01)
+        assert p.predict_queue_drain(800, 8) == pytest.approx(1.0)
+        assert p.predict_queue_drain(0, 8) == 0.0
+        assert p.predict_queue_drain(-5, 8) == 0.0     # clamped
+        # degenerate slot counts never divide by zero
+        assert p.predict_queue_drain(80, 0) == pytest.approx(0.8)
+        # the controller surface is a pass-through of the same estimate
+        c = AdmissionController(predictor=p)
+        assert c.retry_after(800, 8) == pytest.approx(1.0)
+        assert AdmissionController().retry_after(800, 8) is None
+
 
 class TestControllerVerdicts:
     def test_unbounded_admits_everything(self):

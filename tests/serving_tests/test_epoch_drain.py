@@ -1,0 +1,266 @@
+"""Serving epoch drains (docs/SERVING.md "Epoch drains"): ahead of a
+live resize the engine must stop admission (shedding with a predicted
+``retry_after``), retire or timeout-evict its active rows with
+oracle-prefix partials, keep queued requests' places, and re-open under
+the NEW epoch — while a front-end that slept through the resize gets a
+typed ``stale_epoch`` reject instead of service under moved
+assumptions.  Token identity stays pinned by the conftest oracle
+throughout: a drain changes WHEN rows are served, never WHAT."""
+
+import time
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import (
+    AdmissionController,
+    ServiceTimePredictor,
+    ServingEngine,
+    ShedCompletion,
+)
+from chainermn_tpu.utils.metrics import MetricsRegistry, set_registry
+
+
+@pytest.fixture()
+def engine(mini_adapter, mini_params):
+    return ServingEngine(mini_adapter, mini_params, n_slots=8,
+                         horizon=160, max_prompt=16, block=8,
+                         round_tokens=4)
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _warm_admission(tpot=0.01):
+    """A controller whose predictor answers from defaults — drain
+    sheds need a retry_after without waiting for live observations."""
+    return AdmissionController(
+        predictor=ServiceTimePredictor(default_ttft=0.1,
+                                       default_tpot=tpot))
+
+
+class TestStaleEpoch:
+    def test_stale_submit_shed_current_admitted(self, engine, registry):
+        rng = np.random.RandomState(0)
+        engine.epoch = 3
+        s = engine.submit(rng.randint(0, 64, 6), max_new=4, epoch=2)
+        assert isinstance(s, ShedCompletion)
+        assert s.reason == "stale_epoch" and "3" in s.detail
+        # retrying is pointless until the caller re-learns the world
+        assert s.retry_after is None
+        assert engine.stats()["shed"] == {"stale_epoch": 1}
+        assert registry.counter(
+            "serve/shed_stale_epoch").value == 1
+        # the correct epoch — and no epoch at all (opt-in check) — admit
+        assert isinstance(
+            engine.submit(rng.randint(0, 64, 6), max_new=4, epoch=3),
+            str)
+        assert isinstance(
+            engine.submit(rng.randint(0, 64, 6), max_new=4), str)
+
+    def test_newer_epoch_is_transient_not_stale(self, engine):
+        """A front-end that already learned the NEW epoch while this
+        engine's ``complete_drain`` hasn't run yet is EARLY, not wrong:
+        it must get the transient ``"draining"`` verdict (retry), never
+        the terminal re-learn-the-world ``"stale_epoch"``."""
+        rng = np.random.RandomState(2)
+        engine.epoch = 3
+        s = engine.submit(rng.randint(0, 64, 6), max_new=4, epoch=4)
+        assert isinstance(s, ShedCompletion)
+        assert s.reason == "draining" and "behind" in s.detail
+        # and DURING a drain, any epoch mismatch is the drain's shed
+        engine._draining = True
+        s2 = engine.submit(rng.randint(0, 64, 6), max_new=4, epoch=2)
+        assert s2.reason == "draining"
+
+    def test_epoch_rides_stats_and_persists_reset(self, engine):
+        engine.epoch = 5
+        assert engine.stats()["epoch"] == 5
+        engine.reset()
+        assert engine.epoch == 5        # the world didn't change back
+
+
+class TestDrain:
+    def test_drain_retires_active_reopens_under_new_epoch(
+            self, engine, oracle, registry):
+        rng = np.random.RandomState(1)
+        reqs = [(rng.randint(0, 64, rng.randint(2, 10)),
+                 int(rng.randint(4, 10))) for _ in range(10)]
+        rids = [engine.submit(p, max_new=m) for p, m in reqs]
+        engine.step()                   # 8 slots fill, 2 stay queued
+        assert engine.n_active == 8 and len(engine._queue) == 2
+        done = engine.drain()
+        # every active row retired naturally — "ok", oracle-identical
+        assert engine.n_active == 0 and engine.draining
+        ok = {c.rid: c for c in done if c.status == "ok"}
+        assert len(ok) == 8
+        for rid, (p, m) in zip(rids, reqs):
+            if rid in ok:
+                np.testing.assert_array_equal(
+                    ok[rid].tokens, oracle(p, m))
+        # queued rows held their place, nothing admitted during drain
+        assert len(engine._queue) == 2
+        assert engine.stats()["drains"] == 1
+        assert registry.counter("serve/drains").value == 1
+        # a submit mid-drain is shed "draining"
+        s = engine.submit(rng.randint(0, 64, 6), max_new=4)
+        assert isinstance(s, ShedCompletion) and s.reason == "draining"
+        # re-open under the new epoch: the held queue serves, tokens
+        # oracle-identical — the drain changed nothing about WHAT
+        engine.complete_drain(epoch=1)
+        assert not engine.draining and engine.epoch == 1
+        out = {c.rid: c for c in engine.run(max_steps=500)}
+        for rid, (p, m) in zip(rids, reqs):
+            if rid not in ok:
+                assert out[rid].status == "ok"
+                np.testing.assert_array_equal(
+                    out[rid].tokens, oracle(p, m))
+
+    def test_drain_timeout_evicts_oracle_prefix_partials(
+            self, engine, oracle, registry):
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 64, 8) for _ in range(4)]
+        rids = [engine.submit(p, max_new=60) for p in prompts]
+        engine.step()
+        assert engine.n_active == 4
+        t0 = time.perf_counter()
+        done = engine.drain(timeout=0.005)
+        assert time.perf_counter() - t0 < 30.0
+        by_rid = {c.rid: c for c in done}
+        assert set(by_rid) == set(rids)
+        n_timeout = sum(1 for c in done if c.status == "timeout")
+        assert n_timeout >= 1           # 60-token budgets can't finish
+        for rid, p in zip(rids, prompts):
+            c = by_rid[rid]
+            # partials are a verified PREFIX of the solo decode
+            np.testing.assert_array_equal(
+                c.tokens, oracle(p, 60)[:c.n_generated])
+        assert engine.n_active == 0
+
+    def test_drain_max_steps_bounds_the_loop(self, engine):
+        rng = np.random.RandomState(3)
+        engine.submit(rng.randint(0, 64, 6), max_new=50)
+        engine.step()
+        engine.drain(max_steps=2)       # returns without retiring
+        assert engine.n_active == 1 and engine.draining
+        engine.drain(timeout=0.01)      # second call finishes the job
+        assert engine.n_active == 0
+
+    def test_drain_shed_carries_predicted_retry_after(self, engine,
+                                                      registry):
+        rng = np.random.RandomState(4)
+        engine.admission = _warm_admission(tpot=0.01)
+        rid = engine.submit(rng.randint(0, 64, 6), max_new=20)
+        engine.step()
+        engine.drain(timeout=0.01)
+        backlog = engine._backlog_tokens()
+        s = engine.submit(rng.randint(0, 64, 6), max_new=4)
+        assert s.reason == "draining"
+        assert s.retry_after == pytest.approx(
+            0.01 * backlog / engine.n_slots)
+        engine.complete_drain()
+        engine.run(max_steps=200)
+        del rid
+
+    def test_drain_shed_retry_after_none_when_cold(self, engine):
+        rng = np.random.RandomState(5)
+        engine.admission = AdmissionController()   # cold predictor
+        engine.drain()
+        s = engine.submit(rng.randint(0, 64, 6), max_new=4)
+        assert s.reason == "draining" and s.retry_after is None
+        # and with no admission controller at all
+        engine.admission = None
+        s2 = engine.submit(rng.randint(0, 64, 6), max_new=4)
+        assert s2.reason == "draining" and s2.retry_after is None
+
+    def test_queue_full_shed_carries_retry_after(self, engine):
+        """The ROADMAP admission open end: capacity sheds quote the
+        predictor's queue-drain estimate, not just drain-mode ones."""
+        rng = np.random.RandomState(6)
+        ctrl = _warm_admission(tpot=0.02)
+        ctrl.max_queue = 1
+        engine.admission = ctrl
+        engine.submit(rng.randint(0, 64, 6), max_new=8)   # queued
+        backlog = engine._backlog_tokens() + 4
+        s = engine.submit(rng.randint(0, 64, 6), max_new=4)
+        assert s.reason == "queue_full"
+        assert s.retry_after == pytest.approx(
+            0.02 * backlog / engine.n_slots, rel=0.5)
+
+    def test_complete_drain_epoch_monotonic(self, engine):
+        engine.epoch = 4
+        engine.drain()
+        with pytest.raises(ValueError, match="backwards"):
+            engine.complete_drain(epoch=3)
+        assert engine.draining          # the bad call changed nothing
+        engine.complete_drain(epoch=4)  # same epoch is fine
+        assert not engine.draining and engine.epoch == 4
+
+
+class TestQueueCarryOver:
+    def test_export_import_preserves_order_and_timestamps(
+            self, mini_adapter, mini_params, engine, oracle):
+        rng = np.random.RandomState(7)
+        reqs = [(rng.randint(0, 64, rng.randint(2, 10)),
+                 int(rng.randint(4, 10))) for _ in range(10)]
+        rids = [engine.submit(p, max_new=m, tenant="t") for p, m in reqs]
+        engine.step()
+        engine.drain()                  # 8 served, 2 still queued
+        carried = engine.export_queue()
+        assert [r.rid for r in carried] == rids[8:]
+        assert all(r.t_submit > 0 for r in carried)
+        assert len(engine._queue) == 0
+        # staged pool rows were freed with the queue
+        assert engine._alloc.n_free == engine._alloc.n_blocks
+
+        new_engine = ServingEngine(
+            mini_adapter, mini_params, n_slots=8, horizon=160,
+            max_prompt=16, block=8, round_tokens=4, epoch=1)
+        new_engine.import_queue(carried)
+        # tenant in-flight accounting moved with the queue
+        assert new_engine._tenant_tokens["t"] == sum(
+            m for _, m in reqs[8:])
+        out = {c.rid: c for c in new_engine.run(max_steps=500)}
+        for rid, (p, m) in zip(rids[8:], reqs[8:]):
+            assert out[rid].status == "ok"
+            np.testing.assert_array_equal(out[rid].tokens, oracle(p, m))
+        # queue-wait stayed honest: served under the new engine, waited
+        # since the ORIGINAL submit
+        assert all(out[r].queue_wait > 0 for r in rids[8:])
+
+    def test_import_rejects_duplicate_rid(self, engine):
+        rng = np.random.RandomState(8)
+        rid = engine.submit(rng.randint(0, 64, 6), max_new=4)
+        (req,) = engine.export_queue()
+        engine.import_queue([req])      # round-trips fine
+        with pytest.raises(ValueError, match="already live"):
+            engine.import_queue([req])
+        del rid
+
+    def test_import_advances_auto_rid_counter(
+            self, mini_adapter, mini_params, engine):
+        """Imported auto rids ("r<n>") join the new engine's namespace:
+        the rid counter must advance past them, or the n-th NATIVE
+        submit after the handover regenerates an imported id and raises
+        "already live" at an ordinary caller."""
+        rng = np.random.RandomState(9)
+        for _ in range(10):
+            engine.submit(rng.randint(0, 64, 6), max_new=4)
+        engine.step()
+        engine.drain()                  # 8 served; r8, r9 still queued
+        carried = engine.export_queue()
+        assert [r.rid for r in carried] == ["r8", "r9"]
+        new_engine = ServingEngine(
+            mini_adapter, mini_params, n_slots=8, horizon=160,
+            max_prompt=16, block=8, round_tokens=4, epoch=1)
+        new_engine.import_queue(carried)
+        native = [new_engine.submit(rng.randint(0, 64, 6), max_new=4)
+                  for _ in range(12)]
+        assert all(isinstance(r, str) for r in native)
+        assert len(set(native) | {r.rid for r in carried}) == \
+            len(native) + len(carried)

@@ -197,10 +197,13 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
     ("bench_elastic.py",
      ["--dim", "64", "--hidden", "64", "--batch", "16",
       "--rounds", "1"], "x"),
+    ("bench_live_elastic.py",
+     ["--dim", "64", "--hidden", "64", "--batch", "16",
+      "--iters", "3", "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
         "autotune", "telemetry", "metrics_registry", "overlap",
-        "serving", "overload", "elastic"])
+        "serving", "overload", "elastic", "live_elastic"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

@@ -196,3 +196,137 @@ class TestTopologyStamp:
 
         with pytest.raises(FileNotFoundError):
             read_topology(str(tmp_path / "nope"))
+
+
+class TestShardParts:
+    """Shard-only covering-set primitives (``build_shard_part`` /
+    ``assemble_shard_state``): a set of per-member parts must reassemble
+    BITWISE into the state a full save would have written, and every
+    malformed collection (missing root, gaps, mixed sets, wrong format)
+    must surface as the typed ``ShardSetError`` the checkpointer's
+    fallback path is built on."""
+
+    WORLD = 4
+
+    def _state(self):
+        rng = np.random.RandomState(3)
+        return {
+            "iteration": np.int64(7),
+            "params": {"w": rng.randn(6, 5).astype(np.float32)},
+            "opt_state": {
+                "mu": rng.randn(self.WORLD, 8).astype(np.float32),
+                "nu": rng.randn(self.WORLD, 8).astype(np.float32),
+                "count": np.int32(7),
+            },
+        }
+
+    def _topology(self):
+        # per-leaf layout in opt_state flatten order: count, mu, nu
+        return {"world_size": self.WORLD, "opt_leaves": [
+            {"kind": "rep"}, {"kind": "shard"}, {"kind": "shard"}]}
+
+    def _parts(self, state=None):
+        state = state or self._state()
+        topo = self._topology()
+        out = []
+        for m in range(self.WORLD):
+            part, rec = ser.build_shard_part(state, topo, m, m + 1,
+                                             root=(m == 0))
+            out.append((rec, part))
+        return state, out
+
+    def test_round_trip_bitwise(self):
+        state, parts = self._parts()
+        got = ser.assemble_shard_state(parts)
+        import jax
+
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), got, state)
+
+    def test_assembly_order_independent(self):
+        state, parts = self._parts()
+        got = ser.assemble_shard_state(parts[::-1])
+        np.testing.assert_array_equal(
+            np.asarray(got["opt_state"]["mu"]),
+            np.asarray(state["opt_state"]["mu"]))
+
+    def test_non_root_parts_carry_only_shard_rows(self):
+        _, parts = self._parts()
+        for rec, part in parts[1:]:
+            assert set(part) == {"shards"}
+            assert all(v.shape == (1, 8)
+                       for v in part["shards"].values())
+        # root carries the replicated entries once
+        assert "params" in parts[0][1]
+
+    def test_rides_save_state_meta(self, tmp_path):
+        _, parts = self._parts()
+        rec, part = parts[1]
+        p = str(tmp_path / "part")
+        save_state(p, part, topology=self._topology(), shard_part=rec)
+        assert ser.read_shard_part(p) == rec
+        got_tree, got_topo, got_rec = ser.load_state_with_stamps(p)
+        assert got_rec == rec and got_topo == self._topology()
+        np.testing.assert_array_equal(
+            np.asarray(got_tree["shards"]["leaf_00001"]),
+            np.asarray(part["shards"]["leaf_00001"]))
+        # a plain snapshot reads None
+        q = str(tmp_path / "plain")
+        save_state(q, _tree())
+        assert ser.read_shard_part(q) is None
+
+    def test_missing_member_is_typed(self):
+        _, parts = self._parts()
+        with pytest.raises(ser.ShardSetError, match="stop at 3"):
+            ser.assemble_shard_state(parts[:-1])
+
+    def test_gap_is_typed(self):
+        _, parts = self._parts()
+        with pytest.raises(ser.ShardSetError, match="gap or"):
+            ser.assemble_shard_state([parts[0]] + parts[2:])
+
+    def test_no_root_is_typed(self):
+        _, parts = self._parts()
+        with pytest.raises(ser.ShardSetError, match="exactly one root"):
+            ser.assemble_shard_state(parts[1:])
+
+    def test_mixed_worlds_is_typed(self):
+        _, parts = self._parts()
+        bad_rec = dict(parts[1][0], world=8)
+        with pytest.raises(ser.ShardSetError, match="disagree"):
+            ser.assemble_shard_state(
+                [parts[0], (bad_rec, parts[1][1])] + parts[2:])
+
+    def test_unknown_format_is_typed(self):
+        _, parts = self._parts()
+        root_rec = dict(parts[0][0], format=ser.SHARD_PART_FORMAT + 1)
+        with pytest.raises(ser.ShardSetError, match="format"):
+            ser.assemble_shard_state(
+                [(root_rec, parts[0][1])] + parts[1:])
+
+    def test_missing_shard_leaf_is_typed(self):
+        _, parts = self._parts()
+        rec1, part1 = parts[1]
+        crippled = {"shards": dict(part1["shards"])}
+        del crippled["shards"]["leaf_00002"]
+        with pytest.raises(ser.ShardSetError, match="leaf_00002"):
+            ser.assemble_shard_state(
+                [parts[0], (rec1, crippled)] + parts[2:])
+
+    def test_empty_set_is_typed(self):
+        with pytest.raises(ser.ShardSetError, match="no shard parts"):
+            ser.assemble_shard_state([])
+
+    def test_bad_member_range_rejected(self):
+        state = self._state()
+        with pytest.raises(ValueError, match="member range"):
+            ser.build_shard_part(state, self._topology(), 3, 9,
+                                 root=False)
+
+    def test_shard_leaf_without_world_axis_rejected(self):
+        state = self._state()
+        state["opt_state"]["mu"] = np.zeros((3, 8), np.float32)
+        with pytest.raises(ValueError, match="world axis"):
+            ser.build_shard_part(state, self._topology(), 0, 1,
+                                 root=True)
