@@ -423,6 +423,16 @@ class TrainingWatchdog:
             report["metrics"] = {}
             report["metrics_prom"] = ""
             report["metrics_enabled"] = False
+        # the installed burn-rate alert state (utils/alerts.py): a
+        # stall that follows minutes of SLO burn should say so in the
+        # same document as the stacks
+        try:
+            from chainermn_tpu.utils.alerts import get_installed
+
+            mgr = get_installed()
+            report["alerts"] = None if mgr is None else mgr.state()
+        except Exception:
+            report["alerts"] = None
         self.last_report = report
         path = self.report_path or "stall_report.json"
         try:

@@ -62,6 +62,9 @@ SHED_REASONS = (
     "quarantined",    # staging/prefill failed for THIS request
     "draining",       # engine is draining for an epoch change (resize)
     "stale_epoch",    # submit carried an epoch the engine has moved past
+    "overload",       # protective shed: an SLO burn-rate alert is
+                      # firing and the request's class is below the
+                      # protected tier (utils/alerts.py advisory)
 )
 
 
@@ -94,6 +97,10 @@ class ShedCompletion:
     # queue-drain estimate; ``None`` while the predictor is cold, and
     # for reasons where retrying is pointless (deadline, stale_epoch).
     retry_after: Optional[float] = None
+    # The request's causal-trace identity (engine-generated or caller-
+    # propagated) — resolves against the engine's RequestTraceStore,
+    # where shed traces are ALWAYS retained.
+    trace_id: Optional[str] = None
 
     status = "shed"              # class attr: never "ok"
 
@@ -264,15 +271,36 @@ class AdmissionController:
         prediction breaches it is shed at the next admit scan rather
         than aging further.  Expired deadlines (``"timeout"``) are
         enforced by the engine regardless.
+      alert_advisor: the PROTECTIVE-shedding hook closing the alerting
+        loop (docs/OBSERVABILITY.md "Burn-rate alerts"): an object
+        with ``.protective()`` (an
+        :class:`~chainermn_tpu.utils.alerts.AlertManager`) or any
+        callable returning truthy while protection should be on.
+        While it is, arriving requests whose priority class is
+        NUMERICALLY GREATER than ``protect_priority`` (less important)
+        are shed ``"overload"`` at submit — the error budget is
+        burning, so below-tier traffic is turned away before it makes
+        the tail worse.  Advisory only: a raising/broken advisor
+        degrades to "not protective", never to a crash.
+      protect_priority: the most-important class still SHELTERED from
+        protective shedding (default 0: class 0 is never overload-shed,
+        everything else is while an alert fires).
     """
 
     def __init__(self, *, max_queue: Optional[int] = None,
                  quotas: Optional[Dict[Optional[str], float]] = None,
                  default_quota: Optional[float] = None,
                  predictor: Optional[ServiceTimePredictor] = None,
-                 shed_on_deadline: bool = True):
+                 shed_on_deadline: bool = True,
+                 alert_advisor=None, protect_priority: int = 0,
+                 overload_retry_after: Optional[float] = None):
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue={max_queue} must be >= 1")
+        if overload_retry_after is not None \
+                and overload_retry_after <= 0:
+            raise ValueError(
+                f"overload_retry_after={overload_retry_after} "
+                "must be > 0 seconds")
         for t, q in (quotas or {}).items():
             if q is not None and q < 1:
                 raise ValueError(
@@ -285,9 +313,32 @@ class AdmissionController:
         self.default_quota = default_quota
         self.predictor = predictor or ServiceTimePredictor()
         self.shed_on_deadline = shed_on_deadline
+        self.alert_advisor = alert_advisor
+        self.protect_priority = int(protect_priority)
+        #: the come-back hint an ``"overload"`` shed carries.  The
+        #: queue-drain predictor is the WRONG signal here — protective
+        #: shedding resolves with the burn-rate alert's short window,
+        #: not the backlog (an empty queue would hint ~0 and invite a
+        #: retry storm mid-protection) — so this is an operator knob,
+        #: e.g. the protect rules' short-window length; ``None`` = no
+        #: hint (clients apply their own backoff).
+        self.overload_retry_after = overload_retry_after
 
     def quota_for(self, tenant: Optional[str]) -> Optional[float]:
         return self.quotas.get(tenant, self.default_quota)
+
+    def protective(self) -> bool:
+        """Whether the alert advisory currently calls for protective
+        shedding (False without an advisor, and on ANY advisor
+        failure — advice must never become an outage)."""
+        adv = self.alert_advisor
+        if adv is None:
+            return False
+        try:
+            fn = getattr(adv, "protective", adv)
+            return bool(fn())
+        except Exception:       # noqa: BLE001 — advisory only
+            return False
 
     def check_submit(self, req, queue: Sequence,
                      inflight: Dict[Optional[str], int]
@@ -301,10 +352,13 @@ class AdmissionController:
           displace ``victim`` (a queued request) to make room; the
           engine sheds the victim ``"queue_full"``.
 
-        Check order: quota (cheapest, per-tenant fairness first),
-        predicted deadline (no point queueing the hopeless), then the
-        queue bound.
+        Check order: protective overload advisory (fleet health beats
+        any one request), quota (per-tenant fairness), predicted
+        deadline (no point queueing the hopeless), then the queue
+        bound.
         """
+        if req.priority > self.protect_priority and self.protective():
+            return False, "overload", None
         quota = self.quota_for(req.tenant)
         if quota is not None and \
                 inflight.get(req.tenant, 0) + req.max_new > quota:

@@ -59,8 +59,10 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import re
 import time
+import uuid
 from typing import Callable, List, Optional, Sequence, Union
 
 import jax
@@ -72,7 +74,7 @@ from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.parallel._compat import pcast, typeof
 from chainermn_tpu.utils.metrics import get_registry
-from chainermn_tpu.utils.telemetry import get_recorder
+from chainermn_tpu.utils.telemetry import RequestTraceStore, get_recorder
 
 from . import kv_blocks as kvb
 from .admission import AdmissionController, ShedCompletion
@@ -94,7 +96,17 @@ class Request:
     ``priority`` is a smaller-is-more-important class index (0 is the
     most important); ``deadline`` is an ABSOLUTE ``time.perf_counter``
     timestamp (``submit(timeout=...)`` converts); ``tenant`` names the
-    quota bucket the request's ``max_new`` tokens count against."""
+    quota bucket the request's ``max_new`` tokens count against.
+
+    ``trace_id`` is the request's causal-trace identity: caller-
+    propagated through ``submit(trace_id=...)`` (a front-end carrying
+    a distributed-tracing id) or engine-generated when request tracing
+    is on; it rides every ``serve/*`` histogram observation as the
+    exemplar and names the retained timeline in the engine's
+    :class:`~chainermn_tpu.utils.telemetry.RequestTraceStore`.
+    ``spans`` is that timeline while the request is live — ``None``
+    whenever tracing is off (the disabled path allocates nothing
+    per request, pinned by test)."""
 
     rid: str
     prompt: np.ndarray          # (P,) int32
@@ -105,6 +117,8 @@ class Request:
     priority: int = 0
     tenant: Optional[str] = None
     deadline: Optional[float] = None
+    trace_id: Optional[str] = None
+    spans: Optional[list] = None
 
 
 @dataclasses.dataclass(eq=False)
@@ -135,6 +149,7 @@ class Completion:
     slot: int
     status: str = "ok"
     detail: str = ""
+    trace_id: Optional[str] = None
 
     @property
     def n_generated(self) -> int:
@@ -282,6 +297,28 @@ def _deadline(queue: Sequence[Request], engine) -> Request:
 _POLICIES = {"fcfs": _fcfs, "spf": _spf, "deadline": _deadline}
 
 
+def _trace_store_from_env() -> Optional[RequestTraceStore]:
+    """The env-gated default request-trace store (the TraceRecorder /
+    MetricsRegistry discipline: off unless ``CHAINERMN_TPU_REQUEST_
+    TRACE=1``; a typo'd knob degrades to the default, never crashes)."""
+    if os.environ.get("CHAINERMN_TPU_REQUEST_TRACE", "") in ("", "0"):
+        return None
+
+    def _num(name, default, conv):
+        try:
+            return conv(os.environ[name])
+        except (KeyError, ValueError, TypeError):
+            return default
+
+    cap = max(_num("CHAINERMN_TPU_REQUEST_TRACE_CAPACITY", 256, int), 1)
+    rate = min(max(
+        _num("CHAINERMN_TPU_REQUEST_TRACE_SAMPLE", 0.05, float), 0.0),
+        1.0)
+    slo = _num("CHAINERMN_TPU_REQUEST_TRACE_SLO", None, float)
+    return RequestTraceStore(capacity=cap, sample_rate=rate,
+                             slo_e2e=slo)
+
+
 class ServingEngine:
     """Continuous-batching scheduler around one decode adapter.
 
@@ -340,6 +377,24 @@ class ServingEngine:
         :meth:`drain` every submit is shed ``"draining"`` with a
         ``retry_after`` from the predictor's queue-drain estimate;
         :meth:`complete_drain` re-opens admission under the new epoch.
+      traces: a
+        :class:`~chainermn_tpu.utils.telemetry.RequestTraceStore` —
+        turns ON per-request causal tracing: every request gets a
+        ``trace_id`` (caller-propagated or generated), its lifecycle
+        spans (``queue_wait``/``admit``/``prefill``/sampled
+        ``decode_round``/``rebase``/terminal) are assembled into a
+        timeline offered to the store at eviction/shed (tail-based
+        retention there), and every ``serve/*`` histogram observation
+        carries the trace id as its EXEMPLAR — a p99 on the dashboard
+        resolves to the offending request's trace.  Default ``None``
+        (off; the per-request cost is zero allocations, pinned by
+        test) unless ``CHAINERMN_TPU_REQUEST_TRACE=1`` is set, which
+        builds a store from ``CHAINERMN_TPU_REQUEST_TRACE_CAPACITY``
+        / ``_SAMPLE`` / ``_SLO``.
+      trace_decode_every: per-request decode-round span sampling — a
+        traced request's FIRST round is always in its timeline (the
+        TTFT cause), later rounds every N-th (a 1000-token decode must
+        not be a 1000-span trace).
     """
 
     def __init__(self, adapter, params, *, n_slots: int, horizon: int,
@@ -352,7 +407,9 @@ class ServingEngine:
                  default_max_new: int = 32,
                  record_history: int = 4096,
                  admission: Optional[AdmissionController] = None,
-                 epoch: int = 0):
+                 epoch: int = 0,
+                 traces: Optional[RequestTraceStore] = None,
+                 trace_decode_every: int = 4):
         mesh = adapter.mesh_cfg.mesh
         shards = 1
         for a in adapter.batch_axes:
@@ -396,6 +453,13 @@ class ServingEngine:
         self.default_max_new = default_max_new
         self.admission = admission
         self.epoch = int(epoch)
+        if traces is None:
+            traces = _trace_store_from_env()
+        self.traces = traces
+        if trace_decode_every < 1:
+            raise ValueError(
+                f"trace_decode_every={trace_decode_every} must be >= 1")
+        self.trace_decode_every = int(trace_decode_every)
         if record_history < 0:
             raise ValueError(
                 f"record_history={record_history} must be >= 0")
@@ -623,7 +687,8 @@ class ServingEngine:
                priority: int = 0, tenant: Optional[str] = None,
                deadline: Optional[float] = None,
                timeout: Optional[float] = None,
-               epoch: Optional[int] = None
+               epoch: Optional[int] = None,
+               trace_id: Optional[str] = None
                ) -> Union[str, ShedCompletion]:
         """Queue one request; returns its id — or, when the attached
         admission controller rejects it (queue full, tenant over
@@ -643,7 +708,14 @@ class ServingEngine:
         must re-learn the world, not have its request served under
         assumptions that moved.  While :meth:`drain` is in progress
         every submit is shed ``"draining"`` with the predicted
-        ``retry_after``."""
+        ``retry_after``.
+
+        ``trace_id`` propagates a caller-side causal-trace identity
+        (a distributed-tracing id from the front-end); with request
+        tracing enabled (``traces=``) one is generated when absent.
+        It becomes the exemplar on every ``serve/*`` histogram
+        observation this request feeds and names its retained
+        timeline in ``engine.traces``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not 1 <= prompt.shape[0] <= self.max_prompt:
             raise ValueError(
@@ -671,9 +743,25 @@ class ServingEngine:
         req = Request(request_id, prompt, max_new, t_submit=now,
                       priority=int(priority), tenant=tenant,
                       deadline=deadline)
+        if self.traces is not None:
+            req.trace_id = (str(trace_id) if trace_id is not None
+                            else uuid.uuid4().hex[:16])
+            req.spans = []
+        elif trace_id is not None:
+            # no retention, but the identity still rides the records
+            # and exemplars — a front-end's trace id is never dropped
+            req.trace_id = str(trace_id)
         reg = get_registry()
-        reg.inc("serve/submitted")
+        # serve/submitted counts the SCORED request stream — it is
+        # the burn-rate rules' total feed, so protective "overload"
+        # sheds (excluded from serve/shed_total below for the same
+        # reason) must not dilute it either: counting them as
+        # zero-bad traffic would drive the bad fraction down and
+        # self-extinguish the alert mid-burst (protection flapping at
+        # the short-window period).  It is incremented on every path
+        # out of this method EXCEPT the overload shed.
         if self._draining:
+            reg.inc("serve/submitted")
             # checked FIRST: during the handover window a front-end
             # that already learned the NEW epoch is early, not wrong —
             # it gets the transient "draining" + retry_after, never the
@@ -681,6 +769,7 @@ class ServingEngine:
             return self._finish_shed(req, "draining",
                                      retry_after=self._retry_after())
         if epoch is not None and int(epoch) != self.epoch:
+            reg.inc("serve/submitted")
             if int(epoch) < self.epoch:
                 return self._finish_shed(
                     req, "stale_epoch",
@@ -701,10 +790,25 @@ class ServingEngine:
                 self._shed_from_queue(victim, "queue_full",
                                       detail=f"displaced by {req.rid}")
             if not admit:
-                return self._finish_shed(
-                    req, reason,
-                    retry_after=(self._retry_after()
-                                 if reason == "queue_full" else None))
+                # transient rejects carry a come-back hint, each from
+                # its own clock: queue_full drains with the backlog
+                # (predictor estimate), an "overload" protective shed
+                # resolves with the burn-rate alert's window (the
+                # operator-configured hint — the backlog estimate
+                # would read ~0 off an empty queue and invite a retry
+                # storm mid-protection).  Neither is a terminal
+                # verdict (deadline/over_quota ARE).
+                if reason == "queue_full":
+                    after = self._retry_after()
+                elif reason == "overload":
+                    after = self.admission.overload_retry_after
+                else:
+                    after = None
+                if reason != "overload":
+                    reg.inc("serve/submitted")
+                return self._finish_shed(req, reason,
+                                         retry_after=after)
+        reg.inc("serve/submitted")
         self._queue.append(req)
         self._tenant_tokens[tenant] += max_new
         self._charged.add(request_id)
@@ -772,6 +876,7 @@ class ServingEngine:
         live = any(self._slot_req[s] is not None and not self._done[s]
                    for s in range(self.n_slots))
         if live:
+            rt0 = time.perf_counter()
             try:
                 with rec.span("serve/decode_round", cat="serve",
                               step=int(self._clock),
@@ -790,13 +895,30 @@ class ServingEngine:
                 self._clock += self.round_tokens
                 self.n_rounds += 1
                 now = time.perf_counter()
+                if self.traces is not None:
+                    # per-round spans are SAMPLED into request
+                    # timelines (every Nth round), except a request's
+                    # first round — the TTFT cause is always on its
+                    # trace
+                    sampled = (self.n_rounds
+                               % self.trace_decode_every == 0)
+                    for s in range(self.n_slots):
+                        r = self._slot_req[s]
+                        if r is None or r.spans is None:
+                            continue
+                        if sampled or s in self._pending_first:
+                            self._rspan(r, "decode_round", rt0,
+                                        now - rt0,
+                                        round=self.n_rounds,
+                                        tokens=self.round_tokens)
                 reg = get_registry()
                 for s in self._pending_first:
                     req = self._slot_req[s]
                     req.t_first = now
                     # TTFT lands here — the first moment the request's
                     # first generated token is host-observable
-                    reg.observe("serve/ttft", now - req.t_submit)
+                    reg.observe("serve/ttft", now - req.t_submit,
+                                exemplar=req.trace_id)
                     if self.admission is not None:
                         self.admission.predictor.observe_ttft(
                             now - req.t_submit)
@@ -988,6 +1110,43 @@ class ServingEngine:
         return get_registry().snapshot(prefix="serve/")
 
     # ------------------------------------------------------------------ #
+    # request-scoped tracing (docs/OBSERVABILITY.md "Request tracing")
+    # ------------------------------------------------------------------ #
+
+    def _rspan(self, req: Request, name: str, t0: float, dur: float,
+               **meta) -> None:
+        """Append one span to a TRACED request's timeline.  Untraced
+        requests (``spans is None`` — tracing off) fall through the
+        first check with zero allocations."""
+        if req.spans is None:
+            return
+        span = {"name": name, "t0": t0, "dur": dur}
+        if meta:
+            span.update(meta)
+        req.spans.append(span)
+
+    def _offer_trace(self, req: Request, comp) -> None:
+        """Hand a finished request's timeline to the trace store —
+        tail-based retention there decides whether it survives
+        (non-ok and SLO-violating always, ok sampled)."""
+        if req.spans is None or self.traces is None:
+            return
+        trace = {
+            "trace_id": req.trace_id,
+            "rid": req.rid,
+            "status": comp.status,
+            "queue_wait": getattr(comp, "queue_wait", None),
+            "ttft": getattr(comp, "ttft", None),
+            "e2e": getattr(comp, "e2e", None),
+            "n_generated": comp.n_generated,
+            "spans": req.spans,
+        }
+        reason = getattr(comp, "reason", None)
+        if reason is not None:
+            trace["reason"] = reason
+        self.traces.offer(trace)
+
+    # ------------------------------------------------------------------ #
     # phases
     # ------------------------------------------------------------------ #
 
@@ -1007,6 +1166,7 @@ class ServingEngine:
                 continue
             status = self._slot_status[s]
             detail = self._slot_detail[s]
+            et0 = time.perf_counter()
             with rec.span("serve/evict", cat="serve", rid=req.rid,
                           slot=s, status=status):
                 row = np.asarray(self._buf[s])
@@ -1033,9 +1193,22 @@ class ServingEngine:
                 rid=req.rid, prompt=req.prompt, tokens=np.array(gen),
                 t_submit=req.t_submit, t_admit=req.t_admit,
                 t_first=req.t_first, t_done=time.perf_counter(),
-                slot=s, status=status, detail=detail)
+                slot=s, status=status, detail=detail,
+                trace_id=req.trace_id)
             self._release_tokens(req)
             self._records.append(comp)
+            if req.spans is not None:
+                if status != "ok":
+                    # the terminal cause gets its own mark on the
+                    # timeline (the span a "why did this time out"
+                    # reader looks for first)
+                    self._rspan(req, status, comp.t_done, 0.0,
+                                **({"detail": detail} if detail
+                                   else {}))
+                self._rspan(req, "evict", et0, comp.t_done - et0,
+                            slot=s, status=status,
+                            tokens=comp.n_generated)
+                self._offer_trace(req, comp)
             reg = get_registry()
             reg.inc("serve/evictions")
             reg.inc("serve/generated_tokens", comp.n_generated)
@@ -1043,8 +1216,10 @@ class ServingEngine:
                 # only fully-served rows feed the latency
                 # distributions — a truncated timeout row would bias
                 # the predictor (and the dashboard) optimistic
-                reg.observe("serve/tpot", comp.tpot)
-                reg.observe("serve/e2e", comp.e2e)
+                reg.observe("serve/tpot", comp.tpot,
+                            exemplar=req.trace_id)
+                reg.observe("serve/e2e", comp.e2e,
+                            exemplar=req.trace_id)
                 if self.admission is not None:
                     self.admission.predictor.observe_tpot(comp.tpot)
             elif status == "timeout":
@@ -1095,15 +1270,29 @@ class ServingEngine:
             rid=req.rid, prompt=req.prompt, reason=reason,
             t_submit=req.t_submit, t_shed=time.perf_counter(),
             max_new=req.max_new, priority=req.priority,
-            tenant=req.tenant, detail=detail, retry_after=retry_after)
+            tenant=req.tenant, detail=detail, retry_after=retry_after,
+            trace_id=req.trace_id)
+        if req.spans is not None:
+            self._rspan(req, "queue_wait", req.t_submit,
+                        shed.t_shed - req.t_submit)
+            self._rspan(req, "shed", shed.t_shed, 0.0, reason=reason,
+                        **({"detail": detail} if detail else {}))
+            self._offer_trace(req, shed)
         self._records.append(shed)
         self.n_shed[reason] += 1
         reg = get_registry()
-        reg.inc("serve/shed_total")
         # the taxonomy is DISJOINT: queue-side terminations count in
         # serve/shed_<reason> only; serve/timeouts / serve/cancelled /
         # serve/quarantined count mid-stream evictions only — their
-        # sum with serve/shed_total is every unserved request once
+        # sum with serve/shed_total is every unserved request once.
+        # Protective "overload" sheds are EXCLUDED from shed_total:
+        # that counter is the burn-rate rules' documented bad feed,
+        # and counting the alert's own deliberate sheds into it would
+        # make the alert self-sustaining (below-tier traffic keeps
+        # arriving → keeps being shed → keeps burning the budget),
+        # never auto-resolving after the real cause stops
+        if reason != "overload":
+            reg.inc("serve/shed_total")
         reg.inc("serve/shed_" + reason)
         return shed
 
@@ -1181,6 +1370,7 @@ class ServingEngine:
             self._queue.remove(req)
             dst0 = a + 1 - self._pq
             assert dst0 >= 0, (a, self._pq)   # clock >= Pq-1 invariant
+            at0 = time.perf_counter()
             try:
                 with rec.span("serve/admit", cat="serve", rid=req.rid,
                               slot=slot, step=int(a)):
@@ -1205,11 +1395,17 @@ class ServingEngine:
             self._pending_first.add(slot)
             req.t_admit = time.perf_counter()
             self.admit_log.append(req.rid)
+            if req.spans is not None:
+                self._rspan(req, "queue_wait", req.t_submit,
+                            req.t_admit - req.t_submit)
+                self._rspan(req, "admit", at0, req.t_admit - at0,
+                            slot=slot)
             rec.counter("serve/queue_depth", len(self._queue),
                         cat="serve")
             reg = get_registry()
             reg.inc("serve/admits")
-            reg.observe("serve/queue_wait", req.t_admit - req.t_submit)
+            reg.observe("serve/queue_wait", req.t_admit - req.t_submit,
+                        exemplar=req.trace_id)
             reg.set("serve/queue_depth", len(self._queue))
         if self.prefill_ahead:
             budget = self.prefill_ahead
@@ -1271,6 +1467,7 @@ class ServingEngine:
             ids = self._alloc.alloc(req.rid, n_real)
         if ids is None:
             return False
+        pt0 = time.perf_counter()
         with rec.span("serve/prefill", cat="serve", rid=req.rid,
                       blocks=n_real):
             st = self._prompt_staging
@@ -1285,6 +1482,8 @@ class ServingEngine:
                 np.int32(self._pq - req.prompt.shape[0]), ids_row,
                 ids_row >= 0)
             self._staged[req.rid] = (ids_row, prompt_row)
+        self._rspan(req, "prefill", pt0, time.perf_counter() - pt0,
+                    blocks=n_real)
         return True
 
     def _ensure_staged(self, req: Request, rec) -> bool:
@@ -1309,10 +1508,16 @@ class ServingEngine:
                      self._clock - (self._pq - 1))
                  // self.block) * self.block
         if delta > 0:
+            bt0 = time.perf_counter()
             with rec.span("serve/rebase", cat="serve", delta=delta,
                           step=int(self._clock)):
                 self._caches, self._buf = self._rebase_fn(
                     self._caches, self._buf, np.int32(delta))
+            if self.traces is not None:
+                bdur = time.perf_counter() - bt0
+                for s in active:
+                    self._rspan(self._slot_req[s], "rebase", bt0, bdur,
+                                delta=delta)
             for s in active:
                 self._offsets[s] -= delta
                 self._end_t[s] -= delta

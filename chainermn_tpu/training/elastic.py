@@ -666,6 +666,30 @@ class ResizeController:
         self.resizes: List[dict] = []
         self.drained: List[Any] = []
 
+    # -- introspection --------------------------------------------------- #
+
+    def status(self) -> dict:
+        """The live-elastic block for a ``/statusz`` surface
+        (``StatuszServer.add_section("resize", controller)``): the
+        membership epoch the job currently runs under, any pending
+        intent, and the resize history — so an operator sees a resize
+        land (epoch bump, pause cost) without grepping logs."""
+        epoch = self.resizes[-1]["epoch"] if self.resizes \
+            else self.epoch
+        if self.membership is not None:
+            try:
+                epoch = max(epoch, self.membership.stored_epoch())
+            except Exception:   # noqa: BLE001 — introspection only
+                pass
+        return {
+            "epoch": epoch,
+            "requested_world": self._requested,
+            "resizes": len(self.resizes),
+            "last_resize": (dict(self.resizes[-1]) if self.resizes
+                            else None),
+            "draining_engines": len(self.drain_engines),
+        }
+
     # -- intent ---------------------------------------------------------- #
 
     def request(self, world_size: int) -> None:

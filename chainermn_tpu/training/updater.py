@@ -487,6 +487,21 @@ class StandardUpdater:
     def epoch(self) -> int:
         return getattr(self.iterator, "epoch", 0)
 
+    def status(self) -> dict:
+        """The training-progress block for a ``/statusz`` surface
+        (``StatuszServer.add_section("train", updater)``): where the
+        loop is — iteration/epoch, the world it runs over, and how
+        much work is in flight — read-only and cheap enough to serve
+        per scrape."""
+        return {
+            "iteration": int(self.iteration),
+            "epoch": int(self.epoch),
+            "world_size": int(getattr(self.comm, "inter_size", 1)),
+            "steps_per_execution": int(self.steps_per_execution),
+            "inflight_windows": len(self._inflight),
+            "zero1": bool(self.zero1),
+        }
+
     def rebind_world(self, comm, optimizer) -> None:
         """Re-bind this updater to a NEW communicator/mesh mid-run — the
         live-resize half of ``training/elastic.py`` (the

@@ -1,0 +1,445 @@
+"""SLO burn-rate alerting over the metrics registry.
+
+The metrics layer (:mod:`chainermn_tpu.utils.metrics`) makes latency
+and failure *distributions* readable; the SLO report scores a run
+after the fact.  Nothing WATCHES those distributions while the job
+runs: an overload that torches the error budget is only visible when
+an operator reads a report.  This module is the watching half —
+multi-window **burn-rate** rules in the SRE-workbook formulation,
+evaluated over the same registry counters/histograms the dashboards
+scrape, with the same measured-not-modeled stance as the autotuner:
+alerts fire off observed ratios, never off a capacity model.
+
+**Burn rate.**  An SLO grants an error budget: ``budget`` is the
+allowed bad fraction (0.001 = 99.9%).  Over a trailing window, the
+burn rate is ``(bad / total) / budget`` — 1.0 spends the budget
+exactly at its sustainable pace, 14.4 exhausts a 30-day budget in 2
+days.  A rule fires when BOTH windows of any configured
+``(long_s, short_s, factor)`` pair exceed ``factor``: the long window
+proves the burn is material, the short window proves it is STILL
+happening (so alerts auto-resolve quickly once the cause stops —
+the classic multi-window multi-burn-rate construction).
+
+Two signal shapes:
+
+- :class:`RatioRule` — bad/total from counters (e.g. ``serve/
+  shed_total`` + ``serve/timeouts`` over ``serve/submitted``).
+- :class:`LatencyRule` — bad = observations ABOVE a latency threshold,
+  read from a lattice histogram's buckets (e.g. ``serve/ttft`` above
+  500 ms).  The threshold rounds UP to its lattice edge, so the
+  bad-count is exact, never interpolated.
+
+:class:`AlertManager` samples rules on :meth:`~AlertManager.tick`
+(injectable clock — window math is unit-testable without sleeping),
+tracks per-rule firing state, counts transitions into the registry
+(``alerts/fired`` / ``alerts/resolved`` counters, ``alerts/firing``
+gauge), appends each transition to an alert log (atomic per line —
+:func:`~chainermn_tpu.utils.metrics.append_jsonl`), and exposes:
+
+- :meth:`~AlertManager.protective` — the advisory hint an
+  :class:`~chainermn_tpu.serving.admission.AdmissionController`
+  consumes (``alert_advisor=``) to shed below-tier traffic
+  ``"overload"`` while the budget burns;
+- :meth:`~AlertManager.state` — the JSON block ``/statusz`` serves and
+  the :class:`~chainermn_tpu.extensions.TrainingWatchdog` embeds in
+  stall reports (:func:`install` / :func:`get_installed` is the
+  no-argument discovery point those consumers use).
+
+Pure stdlib, importable without jax, and quiet by construction: a
+broken rule degrades to an ``"error"`` state, a disabled registry
+reads as no-evidence (burn ``None``), and nothing here ever raises
+into the serving/training loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from chainermn_tpu.utils.metrics import (
+    append_jsonl,
+    bucket_index,
+    get_registry,
+)
+
+__all__ = [
+    "AlertManager",
+    "BurnRateRule",
+    "DEFAULT_WINDOWS",
+    "LatencyRule",
+    "RatioRule",
+    "get_installed",
+    "install",
+]
+
+#: The SRE-workbook page/ticket pair: a 1h/5m window firing at 14.4×
+#: burn (2-day budget exhaustion) and a 6h/30m window at 6× (5-day).
+DEFAULT_WINDOWS: Tuple[Tuple[float, float, float], ...] = (
+    (3600.0, 300.0, 14.4),
+    (21600.0, 1800.0, 6.0),
+)
+
+
+def _names(spec: Union[str, Sequence[str]]) -> Tuple[str, ...]:
+    return (spec,) if isinstance(spec, str) else tuple(spec)
+
+
+class BurnRateRule:
+    """Base rule: identity, budget, windows, the protective flag.
+
+    Args:
+      name: rule identity (alert log / statusz / transition key).
+      budget: the allowed bad fraction of the SLO (0 < budget < 1);
+        burn rate = observed bad fraction / budget.
+      windows: ``(long_s, short_s, factor)`` triples; the rule fires
+        while ANY triple has BOTH trailing windows burning at >=
+        ``factor``.
+      protect: whether this rule's firing should count toward
+        :meth:`AlertManager.protective` (the admission advisory).
+    """
+
+    def __init__(self, name: str, *, budget: float,
+                 windows: Sequence[Tuple[float, float, float]]
+                 = DEFAULT_WINDOWS,
+                 protect: bool = True):
+        if not 0.0 < budget < 1.0:
+            raise ValueError(f"budget={budget} not in (0, 1)")
+        wins = tuple((float(l), float(s), float(f))
+                     for l, s, f in windows)
+        if not wins:
+            raise ValueError("windows must not be empty")
+        for l, s, f in wins:
+            if not 0 < s <= l:
+                raise ValueError(
+                    f"window pair ({l}, {s}): short must satisfy "
+                    "0 < short <= long")
+            if f <= 0:
+                raise ValueError(f"burn factor {f} must be > 0")
+        self.name = str(name)
+        self.budget = float(budget)
+        self.windows = wins
+        self.protect = bool(protect)
+
+    def read(self, registry) -> Tuple[float, float]:
+        """Cumulative ``(bad, total)`` as of now (both monotonic —
+        the manager differences consecutive reads)."""
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"budget": self.budget,
+                "windows": [list(w) for w in self.windows],
+                "protect": self.protect}
+
+
+class RatioRule(BurnRateRule):
+    """Bad fraction from counters: ``bad`` / ``total`` name(s), each a
+    counter or a list of counters summed (e.g. ``bad=["serve/
+    shed_total", "serve/timeouts"], total="serve/submitted"``)."""
+
+    def __init__(self, name: str, *, bad: Union[str, Sequence[str]],
+                 total: Union[str, Sequence[str]], budget: float,
+                 **kwargs):
+        super().__init__(name, budget=budget, **kwargs)
+        self.bad = _names(bad)
+        self.total = _names(total)
+
+    def read(self, registry) -> Tuple[float, float]:
+        def total(names):
+            return float(sum(registry.counter(n).value
+                             for n in names))
+
+        return total(self.bad), total(self.total)
+
+    def describe(self) -> dict:
+        return {**super().describe(), "kind": "ratio",
+                "bad": list(self.bad), "total": list(self.total)}
+
+
+class LatencyRule(BurnRateRule):
+    """Bad fraction from a lattice histogram: observations ABOVE
+    ``above`` seconds are bad (``above`` rounds UP to the edge of the
+    lattice bucket containing it — the count of strictly-higher
+    buckets is exact, so no interpolation enters an alerting
+    decision), total is the histogram's count."""
+
+    def __init__(self, name: str, *, histogram: str, above: float,
+                 budget: float, **kwargs):
+        super().__init__(name, budget=budget, **kwargs)
+        if above <= 0:
+            raise ValueError(f"above={above} must be > 0 seconds")
+        self.histogram = str(histogram)
+        self.above = float(above)
+        self._edge_idx = bucket_index(self.above)
+
+    def read(self, registry) -> Tuple[float, float]:
+        h = registry.histogram(self.histogram)
+        above = getattr(h, "count_above", None)
+        if above is None:           # a foreign/legacy histogram object
+            counts = h.bucket_counts()
+            bad = sum(c for i, c in counts.items()
+                      if i > self._edge_idx)
+        else:
+            bad = above(self._edge_idx)
+        return float(bad), float(h.count)
+
+    def describe(self) -> dict:
+        return {**super().describe(), "kind": "latency",
+                "histogram": self.histogram, "above": self.above}
+
+
+class AlertManager:
+    """Evaluate burn-rate rules over the registry and track alert
+    state.
+
+    Args:
+      rules: the :class:`BurnRateRule`\\ s to watch (unique names).
+      registry: metrics registry to read AND count transitions into
+        (default the process-global one, resolved per tick so
+        ``set_registry`` swaps are honored).
+      clock: the time source for window math (default
+        ``time.monotonic``).  Injectable: the unit tests drive hours
+        of window history in microseconds, and the overload drill
+        replays a recorded trace on a fake clock.
+      log_path: append one JSON line per alert TRANSITION (fire and
+        resolve) — atomic per line, never a torn tail.
+      min_total: evidence floor — a window whose total delta is below
+        this reports burn ``None`` (no traffic is not an outage).
+      min_interval: evaluation rate limit in clock seconds (default 0
+        = evaluate every tick).  The burn windows are minutes-to-hours
+        long, so rule evaluation gains nothing from sub-second
+        cadence; with a ``min_interval``, :meth:`tick` called from a
+        tight loop (every serving scheduler step, say) is one clock
+        read + compare until the interval elapses — the
+        evaluate-on-an-interval shape every rule engine (Prometheus
+        included) uses.
+
+    Drive it by calling :meth:`tick` on any cadence (a trainer
+    extension trigger, the serving loop, a monitor thread); each tick
+    samples every rule's cumulative ``(bad, total)``, prunes history
+    past the longest window, and recomputes firing state.
+    """
+
+    def __init__(self, rules: Sequence[BurnRateRule], *,
+                 registry=None, clock=time.monotonic,
+                 log_path: Optional[str] = None, min_total: int = 1,
+                 min_interval: float = 0.0):
+        rules = tuple(rules)
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names in {names}")
+        if min_total < 1:
+            raise ValueError(f"min_total={min_total} must be >= 1")
+        if min_interval < 0:
+            raise ValueError(
+                f"min_interval={min_interval} must be >= 0")
+        self.rules = rules
+        self.registry = registry
+        self.clock = clock
+        self.log_path = log_path
+        self.min_total = int(min_total)
+        self.min_interval = float(min_interval)
+        self._last_eval: Optional[float] = None
+        self._samples: Dict[str, collections.deque] = {
+            r.name: collections.deque() for r in rules}
+        # sample-retention resolution floor: a new tick REPLACES the
+        # newest sample unless at least shortest_window/64 clock
+        # seconds have passed, so the deque holds O(longest/gap)
+        # entries however fast the caller ticks (a 100 Hz scheduler
+        # loop over a 6 h window would otherwise retain millions) —
+        # window baselines shift by < the gap, far inside burn noise
+        self._min_gap: Dict[str, float] = {
+            r.name: min(s for _l, s, _f in r.windows) / 64.0
+            for r in rules}
+        # the last APPEND time (replacements don't move it — the gap
+        # must accumulate against the anchor, or a fast ticker would
+        # replace the same sample forever and retain no history)
+        self._anchor: Dict[str, Optional[float]] = {
+            r.name: None for r in rules}
+        self._state: Dict[str, str] = {r.name: "ok" for r in rules}
+        # the firing flag survives read errors: an evaluation error
+        # must neither resolve an active alert (protective shedding
+        # would silently drop mid-overload) nor double-count its
+        # eventual transitions
+        self._firing: Dict[str, bool] = {r.name: False for r in rules}
+        self._since: Dict[str, Optional[float]] = {
+            r.name: None for r in rules}
+        self._burn: Dict[str, dict] = {r.name: {} for r in rules}
+        self._detail: Dict[str, str] = {}
+        self.fired = 0
+        self.resolved = 0
+        self.ticks = 0
+        self.evals = 0
+
+    # -- evaluation ---------------------------------------------------- #
+
+    @staticmethod
+    def _window_burn(dq, now: float, window: float, budget: float,
+                     min_total: int) -> Optional[float]:
+        """Burn rate over the trailing ``window``: delta bad fraction
+        vs the newest sample at or before ``now - window`` (the
+        window's baseline), divided by the budget.  ``None`` while the
+        evidence is thinner than ``min_total`` observations — or while
+        the history does not yet REACH back a full window: a partial
+        long window would degenerate to the short window and let a
+        startup blip fire the sustained-burn rule (and its protective
+        shedding) off seconds of data, defeating the multi-window
+        construction."""
+        if not dq:
+            return None
+        t_now, bad_now, total_now = dq[-1]
+        base = None
+        for t, bad, total in dq:        # oldest-first
+            if t <= now - window:
+                base = (t, bad, total)
+            else:
+                break
+        if base is None:
+            return None                 # window not yet covered
+        d_total = total_now - base[2]
+        if d_total < min_total:
+            return None
+        d_bad = bad_now - base[1]
+        return (d_bad / d_total) / budget
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """Sample every rule and update alert state; returns this
+        tick's TRANSITIONS (fired/resolved events, empty most ticks).
+        Never raises: a broken rule parks in state ``"error"`` until
+        it reads again."""
+        if now is None:
+            now = self.clock()
+        now = float(now)
+        self.ticks += 1
+        if self._last_eval is not None and self.min_interval > 0.0 \
+                and now - self._last_eval < self.min_interval:
+            return []               # rate-limited: nothing re-read
+        self._last_eval = now
+        self.evals += 1
+        reg = self.registry if self.registry is not None \
+            else get_registry()
+        events: List[dict] = []
+        for rule in self.rules:
+            prev_firing = self._firing[rule.name]
+            try:
+                bad, total = rule.read(reg)
+            except Exception as err:    # noqa: BLE001 — never raise out
+                self._state[rule.name] = "error"
+                self._detail[rule.name] = \
+                    f"{type(err).__name__}: {err}"
+                continue            # firing flag HELD until it reads
+            self._detail.pop(rule.name, None)
+            dq = self._samples[rule.name]
+            anchor = self._anchor[rule.name]
+            if dq and anchor is not None \
+                    and now - anchor < self._min_gap[rule.name]:
+                dq[-1] = (now, float(bad), float(total))
+            else:
+                dq.append((now, float(bad), float(total)))
+                self._anchor[rule.name] = now
+            longest = max(w[0] for w in rule.windows)
+            # keep ONE sample at/behind the longest window's baseline
+            while len(dq) >= 2 and dq[1][0] <= now - longest:
+                dq.popleft()
+            burn: Dict[str, Optional[float]] = {}
+            firing = False
+            for long_s, short_s, factor in rule.windows:
+                bl = self._window_burn(dq, now, long_s, rule.budget,
+                                       self.min_total)
+                bs = self._window_burn(dq, now, short_s, rule.budget,
+                                       self.min_total)
+                burn[f"{long_s:g}s"] = bl
+                burn[f"{short_s:g}s"] = bs
+                if bl is not None and bs is not None \
+                        and bl >= factor and bs >= factor:
+                    firing = True
+            self._burn[rule.name] = burn
+            self._state[rule.name] = "firing" if firing else "ok"
+            if firing == prev_firing:
+                continue
+            self._firing[rule.name] = firing
+            self._since[rule.name] = now if firing else None
+            if firing:
+                self.fired += 1
+            else:
+                self.resolved += 1
+            event = {
+                "ts": time.time(),
+                "t": now,
+                "rule": rule.name,
+                "transition": "fired" if firing else "resolved",
+                "burn": burn,
+                "bad": bad,
+                "total": total,
+                **rule.describe(),
+            }
+            events.append(event)
+            reg.inc("alerts/fired" if firing else "alerts/resolved")
+            if self.log_path is not None:
+                try:
+                    append_jsonl(self.log_path, event)
+                except OSError:
+                    pass    # alerting must never kill the job
+        reg.set("alerts/firing", len(self.firing()))
+        return events
+
+    # -- read surface -------------------------------------------------- #
+
+    def firing(self) -> Tuple[str, ...]:
+        """Names of the rules currently firing (a rule whose read is
+        erroring HOLDS its last evaluated firing state — an evaluation
+        error is not evidence the overload stopped)."""
+        return tuple(name for name, f in self._firing.items() if f)
+
+    def protective(self) -> bool:
+        """The admission advisory: True while any ``protect=True``
+        rule fires (the ``AdmissionController.alert_advisor``
+        contract)."""
+        by_name = {r.name: r for r in self.rules}
+        return any(by_name[name].protect for name in self.firing())
+
+    def state(self) -> dict:
+        """The full JSON-safe state block (``/statusz`` ``alerts``
+        section; embedded in watchdog stall reports)."""
+        rules = {}
+        for rule in self.rules:
+            rules[rule.name] = {
+                "state": self._state[rule.name],
+                "since": self._since[rule.name],
+                "burn": self._burn[rule.name],
+                **rule.describe(),
+            }
+            if rule.name in self._detail:
+                rules[rule.name]["detail"] = self._detail[rule.name]
+        return {
+            "ticks": self.ticks,
+            "evals": self.evals,
+            "fired": self.fired,
+            "resolved": self.resolved,
+            "firing": list(self.firing()),
+            "protective": self.protective(),
+            "rules": rules,
+        }
+
+
+# ---------------------------------------------------------------------- #
+# process-global discovery (the watchdog / statusz hookup)
+# ---------------------------------------------------------------------- #
+
+_INSTALLED: Optional[AlertManager] = None
+
+
+def install(manager: Optional[AlertManager]) -> Optional[AlertManager]:
+    """Install ``manager`` as the process's discoverable alert manager
+    (``None`` uninstalls); returns the previous one.  The watchdog
+    embeds the installed manager's :meth:`~AlertManager.state` in
+    stall reports, and ``statusz`` serves it when not given one
+    explicitly — neither takes a constructor argument hostage."""
+    global _INSTALLED
+    prev = _INSTALLED
+    _INSTALLED = manager
+    return prev
+
+
+def get_installed() -> Optional[AlertManager]:
+    return _INSTALLED

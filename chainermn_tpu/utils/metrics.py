@@ -69,6 +69,7 @@ __all__ = [
     "LATTICE_EDGES",
     "MetricsRegistry",
     "MetricsTextfile",
+    "append_jsonl",
     "export_jsonl",
     "export_prometheus",
     "get_registry",
@@ -186,12 +187,21 @@ class Histogram:
     Merge (:meth:`merge`) is bucket-wise sum + count/sum/min/max
     folds; exactness survives a merge whenever the combined sample
     count still fits the cap.
+
+    **Exemplars.**  ``observe(value, exemplar="<trace_id>")`` retains
+    ONE exemplar per lattice bucket (newest wins — bounded by the
+    bucket count, never by traffic), so a percentile resolves to a
+    concrete causal trace: :meth:`exemplar_for` maps the bucket a
+    quantile lands in back to the retained ``(trace_id, value, ts)``.
+    Exemplars ride snapshots, merges and the Prometheus exposition
+    (OpenMetrics ``# {trace_id="..."} value ts`` suffix on ``_bucket``
+    rows); observations without an exemplar cost nothing extra.
     """
 
     SAMPLE_CAP = 512
 
     __slots__ = ("count", "sum", "min", "max", "_counts", "_samples",
-                 "sample_cap")
+                 "sample_cap", "_exemplars")
 
     def __init__(self, sample_cap: Optional[int] = None):
         self.sample_cap = (self.SAMPLE_CAP if sample_cap is None
@@ -202,8 +212,13 @@ class Histogram:
         self.max: Optional[float] = None
         self._counts = [0] * _N_BUCKETS
         self._samples: Optional[List[float]] = []
+        # {bucket_index: [exemplar_id, value, wall_ts]} — allocated on
+        # the first exemplar-carrying observe, so exemplar-free
+        # histograms pay one None check
+        self._exemplars: Optional[Dict[int, list]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         value = float(value)
         self.count += 1
         self.sum += value
@@ -211,7 +226,12 @@ class Histogram:
             self.min = value
         if self.max is None or value > self.max:
             self.max = value
-        self._counts[bucket_index(value)] += 1
+        idx = bucket_index(value)
+        self._counts[idx] += 1
+        if exemplar is not None:
+            if self._exemplars is None:
+                self._exemplars = {}
+            self._exemplars[idx] = [str(exemplar), value, time.time()]
         if self._samples is not None:
             if len(self._samples) < self.sample_cap:
                 self._samples.append(value)
@@ -272,8 +292,41 @@ class Histogram:
         form; index ``len(LATTICE_EDGES)`` is the overflow bucket)."""
         return {i: c for i, c in enumerate(self._counts) if c}
 
+    def count_above(self, index: int) -> int:
+        """Exact count of observations in buckets STRICTLY above
+        ``index`` — the burn-rate bad-count read (a latency SLO's
+        threshold rounds to a lattice edge, so this is never
+        interpolated).  O(buckets) over the raw counts list; the
+        alert-evaluation hot path, so no dict is built."""
+        return sum(self._counts[index + 1:])
+
+    def exemplars(self) -> Dict[int, tuple]:
+        """``{bucket_index: (exemplar_id, value, wall_ts)}`` for every
+        bucket holding a retained exemplar."""
+        if not self._exemplars:
+            return {}
+        return {i: tuple(e) for i, e in dict(self._exemplars).items()}
+
+    def exemplar_for(self, q: float) -> Optional[tuple]:
+        """The retained exemplar nearest the ``q``-th percentile:
+        the bucket that percentile lands in, else the closest bucket
+        ABOVE it (a p99 inquiry wants the offending tail request, so
+        ties resolve upward), else the closest below.  Returns
+        ``(exemplar_id, value, wall_ts)`` or ``None`` when no exemplar
+        was ever retained."""
+        if not self._exemplars:
+            return None
+        p = self.percentile(q)
+        if p is None:
+            return None
+        idx = bucket_index(p)
+        held = sorted(self._exemplars)
+        above = [i for i in held if i >= idx]
+        best = above[0] if above else held[-1]
+        return tuple(self._exemplars[best])
+
     def to_snapshot(self) -> dict:
-        return {
+        snap = {
             "type": "histogram",
             "count": self.count,
             "sum": self.sum,
@@ -283,6 +336,15 @@ class Histogram:
             "samples": (list(self._samples)
                         if self._samples is not None else None),
         }
+        if self._exemplars:
+            # dict() is a single C-level copy under the GIL — a
+            # concurrent observe() landing a first exemplar in a new
+            # bucket (serving thread vs a statusz scrape) can never
+            # surface as dictionary-changed-size mid-iteration
+            snap["exemplars"] = {i: list(e)
+                                 for i, e
+                                 in dict(self._exemplars).items()}
+        return snap
 
     @classmethod
     def from_snapshot(cls, d: dict) -> "Histogram":
@@ -303,6 +365,22 @@ class Histogram:
                 setattr(self, attr, v if cur is None else fold(cur, v))
         for i, c in (d.get("counts") or {}).items():
             self._counts[int(i)] += int(c)     # str keys post-JSON
+        for i, e in (d.get("exemplars") or {}).items():
+            idx = int(i)
+            if self._exemplars is None:
+                self._exemplars = {}
+            cur = self._exemplars.get(idx)
+            # newest wall timestamp wins per bucket (a None ts — a
+            # wire round trip that lost it — loses to any real one);
+            # EQUAL timestamps tie-break on the exemplar id so the
+            # merged winner is identical whatever order ranks fold in
+            ts_new, ts_cur = ((e[2] or 0.0),
+                              0.0 if cur is None else (cur[2] or 0.0))
+            if cur is None or ts_new > ts_cur or (
+                    ts_new == ts_cur and str(e[0]) > str(cur[0])):
+                self._exemplars[idx] = [str(e[0]), float(e[1]),
+                                        e[2] if e[2] is None
+                                        else float(e[2])]
         other = d.get("samples")
         if (self._samples is not None and other is not None
                 and len(self._samples) + len(other) <= self.sample_cap):
@@ -341,11 +419,21 @@ class _NullInstrument:
     def set(self, value: float) -> None:
         pass
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         pass
 
     def percentile(self, q: float) -> None:
         return None
+
+    def count_above(self, index: int) -> int:
+        return 0
+
+    def exemplar_for(self, q: float) -> None:
+        return None
+
+    def exemplars(self) -> dict:
+        return {}
 
 
 _NULL_INSTRUMENT = _NullInstrument()
@@ -422,10 +510,11 @@ class MetricsRegistry:
             return
         self.gauge(name).set(value)
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float,
+                exemplar: Optional[str] = None) -> None:
         if not self.enabled:
             return
-        self.histogram(name).observe(value)
+        self.histogram(name).observe(value, exemplar=exemplar)
 
     # snapshot / lifecycle ------------------------------------------- #
 
@@ -436,6 +525,21 @@ class MetricsRegistry:
             items = list(self._metrics.items())
         return {name: inst.to_snapshot() for name, inst in items
                 if prefix is None or name.startswith(prefix)}
+
+    def digest(self) -> Dict[str, Optional[float]]:
+        """Counter values and gauge lasts only — the cheap live read
+        a status page wants per scrape (a full :meth:`snapshot` would
+        also serialize every histogram's retained samples and
+        exemplars just to be discarded)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, Optional[float]] = {}
+        for name, inst in items:
+            if isinstance(inst, Counter):
+                out[name] = inst.value
+            elif isinstance(inst, Gauge):
+                out[name] = inst.last
+        return out
 
     def load(self, snapshot: Dict[str, dict]) -> None:
         """Fold a snapshot into this registry (merge semantics per
@@ -527,10 +631,22 @@ def _prom_float(v: float) -> str:
     return format(float(v), ".17g")     # round-trips doubles exactly
 
 
-def to_prometheus(snapshot, labels: Optional[Dict[str, str]] = None
-                  ) -> str:
+def to_prometheus(snapshot, labels: Optional[Dict[str, str]] = None,
+                  openmetrics: bool = False) -> str:
     """Render a registry (or a :meth:`MetricsRegistry.snapshot` dict)
     as Prometheus exposition text, node-exporter-textfile style.
+
+    ``openmetrics=True`` emits the OpenMetrics dialect: exemplar
+    suffixes on bucket rows that hold one, and counter samples under
+    the mandatory ``_total`` name (a strict OM parser — Prometheus's
+    own when the scrape negotiated openmetrics — rejects both missing
+    ``_total`` and, in the classic dialect, the exemplar grammar).
+    The default stays classic ``text/plain; version=0.0.4`` with
+    neither (every pre-exemplar caller keeps emitting parseable
+    0.0.4: :func:`export_prometheus` / ``MetricsTextfile`` / watchdog
+    stall reports); the negotiating pull surface (``/metricsz``) opts
+    in per scrape, and :func:`parse_prometheus_text` accepts both
+    dialects.
 
     Histograms emit cumulative ``_bucket{le=...}`` rows for every
     NON-EMPTY lattice bucket plus the mandatory ``le="+Inf"``, and
@@ -549,7 +665,8 @@ def to_prometheus(snapshot, labels: Optional[Dict[str, str]] = None
         kind = d.get("type")
         if kind == "counter":
             lines.append(f"# TYPE {pname} counter")
-            lines.append(f"{pname}{lab} {_prom_float(d['value'])}")
+            sample = f"{pname}_total" if openmetrics else pname
+            lines.append(f"{sample}{lab} {_prom_float(d['value'])}")
         elif kind == "gauge":
             if d.get("last") is None:
                 continue
@@ -559,32 +676,61 @@ def to_prometheus(snapshot, labels: Optional[Dict[str, str]] = None
             lines.append(f"# TYPE {pname} histogram")
             counts = {int(i): int(c)
                       for i, c in (d.get("counts") or {}).items()}
+            exes = ({} if not openmetrics else
+                    {int(i): e
+                     for i, e in (d.get("exemplars") or {}).items()})
             cum = 0
             for i in sorted(counts):
                 cum += counts[i]
                 le = ("+Inf" if i >= len(LATTICE_EDGES)
                       else _prom_float(LATTICE_EDGES[i]))
                 blab = _prom_labels(dict(labels or {}, le=le))
-                lines.append(f"{pname}_bucket{blab} {cum}")
+                row = f"{pname}_bucket{blab} {cum}"
+                ex = exes.get(i)
+                if ex is not None:
+                    # OpenMetrics exemplar syntax: the bucket row links
+                    # straight to the causal trace of one observation
+                    # that landed in it.  Caller-propagated trace ids
+                    # are arbitrary strings — sanitize to the label
+                    # charset so a quote/brace can never corrupt the
+                    # exposition (or defeat the parser's round-trip)
+                    exid = re.sub(r"[^A-Za-z0-9_.:\-]", "_",
+                                  str(ex[0]))
+                    row += (f' # {{trace_id="{exid}"}} '
+                            f"{_prom_float(ex[1])}")
+                    if ex[2] is not None:
+                        row += f" {_prom_float(ex[2])}"
+                lines.append(row)
             if not counts or max(counts) < len(LATTICE_EDGES):
                 blab = _prom_labels(dict(labels or {}, le="+Inf"))
                 lines.append(f"{pname}_bucket{blab} {cum}")
             lines.append(f"{pname}_sum{lab} {_prom_float(d['sum'])}")
             lines.append(f"{pname}_count{lab} {int(d['count'])}")
+    if openmetrics:
+        # the mandatory document terminator — a strict OM parser
+        # rejects an exposition without it as truncated
+        lines.append("# EOF")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
 _PROM_LINE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)"
+    # optional OpenMetrics exemplar suffix: # {labels} value [ts]
+    r"(?:\s+#\s+\{(?P<exlabels>[^}]*)\}\s+(?P<exvalue>\S+)"
+    r"(?:\s+(?P<exts>\S+))?)?$")
 
 
 def parse_prometheus_text(text: str) -> Dict[str, dict]:
     """Parse :func:`to_prometheus` output back into snapshot-shaped
     dicts: ``{name: {"type", "value"|"last"|("count","sum","buckets")}}``
     where histogram ``buckets`` is ``[(le, cumulative_count), ...]`` in
-    emission order (``le`` is ``math.inf`` for ``+Inf``).  The
-    round-trip half the tests pin."""
+    emission order (``le`` is ``math.inf`` for ``+Inf``) and
+    ``exemplars`` (when present) maps ``le`` to
+    ``[trace_id, value, ts]`` parsed from the OpenMetrics exemplar
+    suffix.  Pre-exemplar text parses identically to before — the
+    suffix is optional in both the grammar and the output (the
+    back-compat half the tests pin, both directions)."""
     types: Dict[str, str] = {}
     out: Dict[str, dict] = {}
     for raw in text.splitlines():
@@ -607,6 +753,10 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
                     == "histogram":
                 base, suffix = name[: -len(suf)], suf
                 break
+        # the OpenMetrics dialect samples counters under _total
+        if suffix is None and name.endswith("_total") \
+                and types.get(name[: -len("_total")]) == "counter":
+            base = name[: -len("_total")]
         kind = types.get(base)
         if kind == "histogram":
             entry = out.setdefault(base, {"type": "histogram",
@@ -618,6 +768,14 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
                     le = (math.inf if le_m.group(1) == "+Inf"
                           else float(le_m.group(1)))
                     entry["buckets"].append((le, int(float(value))))
+                    if m.group("exvalue") is not None:
+                        ex_id = re.search(r'trace_id="([^"]*)"',
+                                          m.group("exlabels") or "")
+                        ts = m.group("exts")
+                        entry.setdefault("exemplars", {})[le] = [
+                            ex_id.group(1) if ex_id else "",
+                            float(m.group("exvalue")),
+                            float(ts) if ts is not None else None]
             elif suffix == "_sum":
                 entry["sum"] = float(value)
             elif suffix == "_count":
@@ -634,37 +792,51 @@ def histogram_from_prometheus(entry: dict) -> Histogram:
     entry.  Bucket counts are exact (cumulative differences mapped back
     to lattice indices by float-equal ``le`` match); raw samples and
     min/max do not survive the wire, so percentiles come from the
-    interpolated-bucket path."""
+    interpolated-bucket path.  Exemplars round-trip onto their lattice
+    buckets (the exemplar→trace link survives exposition)."""
     h = Histogram()
     h._samples = None
     h.count = int(entry.get("count", 0))
     h.sum = float(entry.get("sum", 0.0))
+    exemplars = entry.get("exemplars") or {}
+
+    def lattice_idx(le: float) -> int:
+        if math.isinf(le):
+            return len(LATTICE_EDGES)
+        idx = bisect_left(LATTICE_EDGES, le)
+        if idx >= len(LATTICE_EDGES) or LATTICE_EDGES[idx] != le:
+            raise ValueError(
+                f"le={le!r} is not a lattice edge — was this text "
+                "produced by a different lattice version?")
+        return idx
+
     prev = 0
     for le, cum in entry.get("buckets", []):
         c = cum - prev
         prev = cum
         if c <= 0:
             continue
-        if math.isinf(le):
-            idx = len(LATTICE_EDGES)
-        else:
-            idx = bisect_left(LATTICE_EDGES, le)
-            if idx >= len(LATTICE_EDGES) \
-                    or LATTICE_EDGES[idx] != le:
-                raise ValueError(
-                    f"le={le!r} is not a lattice edge — was this text "
-                    "produced by a different lattice version?")
-        h._counts[idx] += c
+        h._counts[lattice_idx(le)] += c
+    for le, ex in exemplars.items():
+        if h._exemplars is None:
+            h._exemplars = {}
+        h._exemplars[lattice_idx(le)] = [str(ex[0]), float(ex[1]),
+                                         None if ex[2] is None
+                                         else float(ex[2])]
     return h
 
 
 def export_prometheus(path: str, registry=None,
-                      labels: Optional[Dict[str, str]] = None) -> str:
+                      labels: Optional[Dict[str, str]] = None,
+                      openmetrics: bool = False) -> str:
     """Write the exposition text atomically (tmp + rename — the
     node-exporter textfile-collector contract: a scraper must never
-    read a half-written file)."""
+    read a half-written file).  The OpenMetrics dialect (exemplars,
+    ``_total`` counters) defaults OFF here: the textfile collector
+    speaks classic 0.0.4, whose parsers reject the OM grammar —
+    turning request tracing on must never break an existing scrape."""
     reg = registry if registry is not None else get_registry()
-    text = to_prometheus(reg, labels=labels)
+    text = to_prometheus(reg, labels=labels, openmetrics=openmetrics)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(text)
@@ -672,15 +844,36 @@ def export_prometheus(path: str, registry=None,
     return path
 
 
+def append_jsonl(path: str, entry: dict) -> str:
+    """Append ``entry`` as ONE JSON line, crash-atomically: the line is
+    fully serialized first and lands via a single ``O_APPEND`` write
+    syscall, so a SIGKILL (or a concurrent appender) can never leave a
+    TORN last line — a reader sees the line entirely or not at all.
+    The JSONL sibling of :func:`export_prometheus`'s tmp+rename
+    contract; every ``*.jsonl`` report in the stack (metrics/straggler/
+    goodput/alert logs) flushes through here."""
+    data = (json.dumps(entry, default=float) + "\n").encode()
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    try:
+        view = memoryview(data)
+        while view:
+            # a short write (ENOSPC mid-line, signal) would be exactly
+            # the torn tail this function promises away — finish or
+            # raise, never return with bytes unwritten
+            view = view[os.write(fd, view):]
+    finally:
+        os.close(fd)
+    return path
+
+
 def export_jsonl(path: str, registry=None, **extra) -> str:
     """Append ONE JSON line ``{"ts", ..., "metrics": snapshot}`` — the
     time-series form (each flush is a point; dashboards diff
-    counters/buckets between lines)."""
+    counters/buckets between lines).  Atomic per line
+    (:func:`append_jsonl`)."""
     reg = registry if registry is not None else get_registry()
     entry = {"ts": time.time(), **extra, "metrics": reg.snapshot()}
-    with open(path, "a") as f:
-        f.write(json.dumps(entry, default=float) + "\n")
-    return path
+    return append_jsonl(path, entry)
 
 
 # ---------------------------------------------------------------------- #
@@ -818,9 +1011,7 @@ class GoodputReport:
             try:
                 path = os.path.join(getattr(trainer, "out", "."),
                                     "goodput.jsonl")
-                with open(path, "a") as f:
-                    f.write(json.dumps(self.last_report, default=float)
-                            + "\n")
+                append_jsonl(path, self.last_report)
             except OSError:
                 pass            # observability must never kill training
 

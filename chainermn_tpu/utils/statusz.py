@@ -1,0 +1,428 @@
+"""Live introspection — an opt-in HTTP surface for a running job.
+
+Every observability layer so far writes FILES (Prometheus textfiles,
+JSONL series, trace shards) an operator reads after the fact.  A
+production serving fleet also needs the live question answered NOW:
+is this process healthy, what is its queue depth, which epoch is it
+serving, which alerts are firing, and show me the trace of that slow
+request.  This module is that surface — a stdlib-only
+(:mod:`http.server`) daemon thread serving four endpoints:
+
+- ``/healthz`` — liveness + registered health checks; HTTP 200 while
+  every check passes, 503 otherwise (the load-balancer probe).
+- ``/metricsz`` — the metrics registry as Prometheus exposition text
+  (the pull-scrape twin of the ``MetricsTextfile`` push); exemplars
+  ride when negotiated (openmetrics ``Accept`` or ``?exemplars=1``),
+  classic 0.0.4 stays clean.
+- ``/statusz`` — one JSON document of live state *sections*: engine
+  queue depth / active slots / shed taxonomy / serving epoch + drain
+  state (:meth:`attach_engine`), live-resize epochs
+  (``ResizeController.status``), updater progress
+  (``StandardUpdater.status``), burn-rate alert state, and a compact
+  counter/gauge digest (plan-cache hits, goodput) — plus any section
+  a caller registers.
+- ``/tracez`` — the retained request traces
+  (:class:`~chainermn_tpu.utils.telemetry.RequestTraceStore`):
+  newest-first summaries, ``?trace_id=`` resolves one full timeline
+  (the last hop of the exemplar link), ``?chrome=1`` renders the
+  Perfetto document.
+
+Discipline matches the rest of the stack: OFF by default, explicitly
+constructed (or env-gated — ``CHAINERMN_TPU_STATUSZ=1`` serves on an
+ephemeral port, ``=<port>`` on a fixed one, via
+:func:`start_from_env`), binds loopback unless told otherwise (this
+is an introspection port, not a public API), and no handler exception
+can ever propagate into the serving/training loop — a broken section
+renders as its error string.  Pure stdlib; importable without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+__all__ = ["StatuszServer", "start_from_env"]
+
+
+def _json_safe(obj):
+    """Best-effort JSON coercion for section payloads (numpy scalars,
+    tuples, stray objects) — an introspection page must render what it
+    can, not 500 on one exotic value."""
+    return json.loads(json.dumps(obj, default=str))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "chainermn-tpu-statusz/1"
+
+    def log_message(self, format, *args):   # noqa: A002 — stdlib name
+        pass        # no stderr spam from scrapers
+
+    # -- plumbing ------------------------------------------------------ #
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(code, json.dumps(payload, indent=1, default=str),
+                   "application/json")
+
+    # -- routes -------------------------------------------------------- #
+
+    def do_GET(self) -> None:           # noqa: N802 — stdlib protocol
+        try:
+            owner: "StatuszServer" = self.server.statusz
+            path, _, query = self.path.partition("?")
+            params = urllib.parse.parse_qs(query)
+            if path == "/healthz":
+                self._healthz(owner)
+            elif path in ("/metricsz", "/metrics"):
+                self._metricsz(owner, params)
+            elif path == "/statusz":
+                self._send_json(200, owner.statusz())
+            elif path == "/tracez":
+                self._tracez(owner, params)
+            else:
+                self._send_json(404, {
+                    "error": f"no route {path!r}",
+                    "routes": ["/healthz", "/metricsz", "/statusz",
+                               "/tracez"]})
+        except Exception as err:        # noqa: BLE001 — introspection
+            try:                        # must never kill the server
+                self._send_json(500, {"error": f"{type(err).__name__}: "
+                                               f"{err}"})
+            except Exception:
+                pass
+
+    def _healthz(self, owner: "StatuszServer") -> None:
+        checks, healthy = owner.health()
+        self._send_json(200 if healthy else 503, {
+            "status": "ok" if healthy else "unhealthy",
+            "uptime_s": round(time.monotonic() - owner._t_start, 3),
+            "checks": checks,
+        })
+
+    def _metricsz(self, owner: "StatuszServer", params) -> None:
+        from chainermn_tpu.utils.metrics import to_prometheus
+
+        reg = owner._registry()
+        # exemplar suffixes are OPENMETRICS grammar — classic 0.0.4
+        # parsers reject the row — so they ride only a negotiated
+        # openmetrics exposition (Accept header, the scrape protocol)
+        # or an explicit ?exemplars=1 (the human/debug opt-in)
+        want_om = ("openmetrics"
+                   in (self.headers.get("Accept") or "")) \
+            or (params.get("exemplars") or ["0"])[0] not in ("", "0")
+        text = to_prometheus(reg, labels=owner.labels,
+                             openmetrics=want_om)
+        if want_om:
+            self._send(200, text,
+                       "application/openmetrics-text; version=1.0.0; "
+                       "charset=utf-8")
+        else:
+            self._send(200, text, "text/plain; version=0.0.4")
+
+    def _tracez(self, owner: "StatuszServer", params) -> None:
+        trace_id = (params.get("trace_id") or [None])[0]
+        chrome = (params.get("chrome") or ["0"])[0] not in ("", "0")
+        if trace_id is not None:
+            for store in owner.trace_stores:
+                tr = store.get(trace_id)
+                if tr is not None:
+                    if chrome:
+                        self._send_json(200, store.to_chrome(trace_id))
+                    else:
+                        self._send_json(200, {"trace": _json_safe(tr)})
+                    return
+            self._send_json(404, {"error": f"trace {trace_id!r} not "
+                                           "retained"})
+            return
+        if chrome and owner.trace_stores:
+            # every registered store rides one document; lanes are
+            # (pid, tid) so later stores' request tids are offset past
+            # the earlier ones to keep them distinct under a shared pid
+            doc = owner.trace_stores[0].to_chrome()
+            for store in owner.trace_stores[1:]:
+                offset = 1 + max(
+                    (ev.get("tid", 0) for ev in doc["traceEvents"]),
+                    default=0)
+                extra = store.to_chrome()
+                for ev in extra["traceEvents"]:
+                    ev["tid"] = ev.get("tid", 0) + offset
+                doc["traceEvents"].extend(extra["traceEvents"])
+            self._send_json(200, doc)
+            return
+        try:
+            n = int((params.get("n") or ["64"])[0])
+        except ValueError:
+            n = 64          # typo'd knob degrades, never a 500
+        if n < 0:
+            n = 64
+        stores = []
+        traces = []
+        for store in owner.trace_stores:
+            stores.append(store.snapshot())
+            # store.traces(n) is the newest n in oldest-first order;
+            # the page serves newest FIRST (the incident-reading order
+            # the module docstring promises)
+            for tr in reversed(store.traces(n)):
+                traces.append({
+                    "trace_id": tr.get("trace_id"),
+                    "rid": tr.get("rid"),
+                    "status": tr.get("status"),
+                    "reason": tr.get("reason"),
+                    "slo_violated": tr.get("slo_violated"),
+                    "e2e": tr.get("e2e"),
+                    "ttft": tr.get("ttft"),
+                    "spans": len(tr.get("spans", ())),
+                })
+        self._send_json(200, {"stores": stores,
+                              "traces": _json_safe(traces)})
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class StatuszServer:
+    """The ops-plane HTTP thread (see module docstring).
+
+    Args:
+      port: TCP port; 0 (the default) binds an ephemeral one —
+        :meth:`start` returns the real port.
+      host: bind address; loopback by default.
+      registry: metrics registry ``/metricsz`` renders (default: the
+        process-global one, resolved per request so ``set_registry``
+        swaps are honored).
+      alerts: an :class:`~chainermn_tpu.utils.alerts.AlertManager`
+        whose state becomes the ``alerts`` statusz section (default:
+        whatever :func:`~chainermn_tpu.utils.alerts.get_installed`
+        finds at request time).
+      labels: extra Prometheus labels on every ``/metricsz`` sample
+        (e.g. ``{"rank": "0"}``).
+
+    Sections are ``name -> zero-arg callable`` returning a JSON-safe
+    dict; register with :meth:`add_section` (or :meth:`attach_engine`
+    / any object exposing ``.status()`` — ``ResizeController`` and
+    ``StandardUpdater`` do).  A section that raises renders as its
+    error string: one broken producer must not blank the page.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1", *,
+                 registry=None, alerts=None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.requested_port = int(port)
+        self.host = host
+        self.registry = registry
+        self.alerts = alerts
+        self.labels = labels
+        self._sections: Dict[str, Callable[[], dict]] = {}
+        self._health: Dict[str, Callable[[], bool]] = {}
+        self._trace_sources: list = []
+        self._server: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+        self._t_start = time.monotonic()
+
+    # -- wiring -------------------------------------------------------- #
+
+    def add_section(self, name: str, source) -> "StatuszServer":
+        """Register a ``/statusz`` section: an object exposing
+        ``.status()`` (preferred — trainer extensions are themselves
+        callable, with the wrong signature), or a zero-arg callable."""
+        fn = getattr(source, "status", None)
+        if not callable(fn):
+            fn = source if callable(source) else None
+        if fn is None:
+            raise TypeError(
+                f"section {name!r}: need a callable or an object with "
+                f".status(), got {type(source).__name__}")
+        self._sections[str(name)] = fn
+        return self
+
+    def add_health(self, name: str,
+                   check: Callable[[], bool]) -> "StatuszServer":
+        """Register a ``/healthz`` check (truthy = healthy; raising =
+        unhealthy with the exception as detail)."""
+        self._health[str(name)] = check
+        return self
+
+    def add_traces(self, store) -> "StatuszServer":
+        """Serve retained request traces on ``/tracez``.  ``store`` is
+        a :class:`~chainermn_tpu.utils.telemetry.RequestTraceStore` or
+        a zero-arg callable resolved PER REQUEST (how
+        :meth:`attach_engine` binds — tracing enabled mid-incident is
+        picked up by the very next scrape)."""
+        if store is not None and store not in self._trace_sources:
+            self._trace_sources.append(store)
+        return self
+
+    @property
+    def trace_stores(self) -> list:
+        """The live trace stores, resolved at request time (callable
+        sources re-read, ``None`` results dropped, duplicates folded)."""
+        stores = []
+        for src in self._trace_sources:
+            store = src() if callable(src) else src
+            if store is not None and store not in stores:
+                stores.append(store)
+        return stores
+
+    def attach_engine(self, engine,
+                      name: str = "serving") -> "StatuszServer":
+        """Wire a :class:`~chainermn_tpu.serving.ServingEngine`: its
+        ``stats()`` (+ active slots and trace-store retention counters)
+        becomes a section, its trace store feeds ``/tracez`` (resolved
+        per request — a store installed on the engine AFTER attach is
+        served too), and a health check asserts the engine still
+        answers."""
+
+        def section():
+            st = engine.stats()
+            st["active_slots"] = engine.n_active
+            traces = getattr(engine, "traces", None)
+            if traces is not None:
+                st["traces"] = traces.snapshot()
+            return st
+
+        self.add_section(name, section)
+        self.add_traces(lambda: getattr(engine, "traces", None))
+        self.add_health(name, lambda: engine.stats() is not None)
+        return self
+
+    # -- request-time state -------------------------------------------- #
+
+    def _registry(self):
+        if self.registry is not None:
+            return self.registry
+        from chainermn_tpu.utils.metrics import get_registry
+
+        return get_registry()
+
+    def _alerts(self):
+        if self.alerts is not None:
+            return self.alerts
+        from chainermn_tpu.utils.alerts import get_installed
+
+        return get_installed()
+
+    def health(self):
+        checks = {}
+        healthy = True
+        for name, fn in self._health.items():
+            try:
+                ok = bool(fn())
+            except Exception as err:    # noqa: BLE001
+                ok = False
+                checks[name] = f"error: {type(err).__name__}: {err}"
+            else:
+                checks[name] = "ok" if ok else "failing"
+            healthy &= ok
+        return checks, healthy
+
+    def statusz(self) -> dict:
+        reg = self._registry()
+        # counters/gauges only — a full reg.snapshot() would also
+        # serialize every histogram's retained samples + exemplars
+        # per scrape just to be thrown away here
+        fn = getattr(reg, "digest", None)
+        digest = fn() if callable(fn) else {}
+        doc = {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "metrics_enabled": bool(getattr(reg, "enabled", False)),
+            "counters": digest,
+            "sections": {},
+        }
+        mgr = self._alerts()
+        if mgr is not None:
+            try:
+                doc["alerts"] = mgr.state()
+            except Exception as err:    # noqa: BLE001
+                doc["alerts"] = {"error": f"{type(err).__name__}: "
+                                          f"{err}"}
+        for name, fn in self._sections.items():
+            try:
+                doc["sections"][name] = _json_safe(fn())
+            except Exception as err:    # noqa: BLE001
+                doc["sections"][name] = {
+                    "error": f"{type(err).__name__}: {err}"}
+        return doc
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port (``None`` before :meth:`start`)."""
+        return (self._server.server_address[1]
+                if self._server is not None else None)
+
+    def url(self, path: str = "/statusz") -> str:
+        if self._server is None:
+            raise RuntimeError("statusz server not started")
+        return f"http://{self.host}:{self.port}{path}"
+
+    def start(self) -> int:
+        """Bind and serve on a daemon thread; returns the bound port.
+        Idempotent."""
+        if self._server is not None:
+            return self.port
+        server = _Server((self.host, self.requested_port), _Handler)
+        server.statusz = self
+        self._server = server
+        self._t_start = time.monotonic()
+        self._thread = threading.Thread(
+            target=server.serve_forever, name="statusz",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def start_from_env(**kwargs) -> Optional[StatuszServer]:
+    """The env opt-in: ``CHAINERMN_TPU_STATUSZ`` unset/``0`` → no
+    server (returns ``None``); ``1``/``auto`` → start on an ephemeral
+    port; any other integer → that port.  Extra kwargs (sections,
+    registry, ...) pass through to :class:`StatuszServer`."""
+    raw = os.environ.get("CHAINERMN_TPU_STATUSZ", "")
+    if raw in ("", "0"):
+        return None
+    try:
+        port = 0 if raw in ("1", "auto") else int(raw)
+    except ValueError:
+        # the typo'd-knob-degrades discipline (engine's
+        # _trace_store_from_env): the operator clearly wanted the
+        # surface on — serve on an ephemeral port, never crash the job
+        port = 0
+    if not 0 <= port <= 65535:
+        port = 0
+    srv = StatuszServer(port=port, **kwargs)
+    try:
+        srv.start()
+    except OSError:
+        if port == 0:
+            return None     # can't bind at all: introspection only
+        srv = StatuszServer(port=0, **kwargs)   # port taken: degrade
+        try:
+            srv.start()
+        except OSError:
+            return None
+    return srv

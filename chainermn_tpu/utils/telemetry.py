@@ -66,10 +66,11 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
-from chainermn_tpu.utils.metrics import Histogram
+from chainermn_tpu.utils.metrics import Histogram, append_jsonl
 
 __all__ = [
     "MetricsExport",
+    "RequestTraceStore",
     "SpanEvent",
     "StragglerReport",
     "TraceRecorder",
@@ -583,6 +584,203 @@ def merge_traces(paths, out: Optional[str] = None) -> dict:
 
 
 # ---------------------------------------------------------------------- #
+# per-request causal traces
+# ---------------------------------------------------------------------- #
+
+class RequestTraceStore:
+    """Tail-based retention of per-request causal traces.
+
+    The flight recorder's ring answers *"what was this process doing"*;
+    a serving operator's question is *"what happened to THIS request"*.
+    The engine assembles one span timeline per request (``queue_wait``,
+    ``admit``, ``prefill``, sampled ``decode_round``\\ s, ``rebase``,
+    the terminal ``evict``/``shed``) and OFFERS the finished trace
+    here.  Retention is tail-based — the retention the exemplar link
+    needs, because exemplars point at tails:
+
+    - any non-``"ok"`` terminal status (shed / timeout / cancelled /
+      quarantined) is ALWAYS kept;
+    - an ok request that violated its end-to-end SLO target
+      (``slo_e2e``) is ALWAYS kept;
+    - remaining ok requests are kept at ``sample_rate``, decided
+      DETERMINISTICALLY from the trace id (crc32 hash — the same
+      request keeps or drops identically on every rank and replay).
+
+    Capacity-bounded (oldest retained trace drops first), thread-safe,
+    and exportable: :meth:`to_chrome` renders retained traces as a
+    Chrome/Perfetto document on the same wall-anchored timeline as
+    :meth:`TraceRecorder.export_chrome`, so :func:`merge_traces` fuses
+    request lanes with the process timeline.  ``/tracez``
+    (:mod:`chainermn_tpu.utils.statusz`) serves :meth:`traces` live.
+    """
+
+    def __init__(self, capacity: int = 256, sample_rate: float = 0.0,
+                 slo_e2e: Optional[float] = None,
+                 rank: Optional[int] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError(
+                f"sample_rate={sample_rate} not in [0, 1]")
+        self.capacity = int(capacity)
+        self.sample_rate = float(sample_rate)
+        self.slo_e2e = slo_e2e
+        self._rank = rank
+        self._traces: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.offered = 0
+        self.kept = 0
+        # wall anchor for Chrome export (the TraceRecorder convention:
+        # span t0 is on the perf_counter clock, exports are wall-based)
+        self._anchor_wall = time.time()
+        self._anchor_perf = time.perf_counter()
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+    @property
+    def rank(self) -> int:
+        if self._rank is None:
+            self._rank = _default_rank()
+        return self._rank
+
+    def would_sample(self, trace_id: str) -> bool:
+        """The deterministic ok-path sampling decision for
+        ``trace_id`` (hash-based, not RNG-based — replayable)."""
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        import zlib
+
+        h = zlib.crc32(str(trace_id).encode()) % 1_000_000
+        return h / 1_000_000.0 < self.sample_rate
+
+    def offer(self, trace: dict) -> bool:
+        """Offer a finished request trace ``{"trace_id", "rid",
+        "status", "spans": [{"name", "t0", "dur", ...}], ...}``;
+        returns whether it was retained.  The tail-based verdict and
+        its inputs are stamped onto the trace (``slo_violated``,
+        ``sampled``) so a reader knows WHY a trace is present."""
+        status = trace.get("status", "ok")
+        e2e = trace.get("e2e")
+        violated = bool(self.slo_e2e is not None and e2e is not None
+                        and e2e > self.slo_e2e)
+        trace["slo_violated"] = violated
+        keep = status != "ok" or violated
+        if not keep:
+            keep = self.would_sample(trace.get("trace_id", ""))
+            trace["sampled"] = keep
+        if not keep:
+            with self._lock:
+                self.offered += 1
+            return False
+        with self._lock:
+            # the retention counters share the lock with the dict:
+            # two engines may offer into one store concurrently
+            self.offered += 1
+            self.kept += 1
+            self._traces[str(trace.get("trace_id"))] = trace
+            while len(self._traces) > self.capacity:
+                self._traces.popitem(last=False)
+        return True
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        """The retained trace for ``trace_id`` (``None`` if it was
+        dropped, sampled out, or never offered) — the resolution step
+        of the exemplar link: histogram p99 → exemplar trace id →
+        this."""
+        with self._lock:
+            return self._traces.get(str(trace_id))
+
+    def traces(self, n: Optional[int] = None) -> List[dict]:
+        """The newest ``n`` retained traces (all by default), oldest
+        first.  A negative ``n`` reads as "all" — never the
+        everything-BUT-the-oldest slice ``vals[-n:]`` would give."""
+        with self._lock:
+            vals = list(self._traces.values())
+        if n is None or int(n) < 0:
+            return vals
+        return vals[len(vals) - min(int(n), len(vals)):]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    def snapshot(self) -> dict:
+        """Retention counters for ``/statusz``."""
+        return {
+            "capacity": self.capacity,
+            "sample_rate": self.sample_rate,
+            "slo_e2e": self.slo_e2e,
+            "offered": self.offered,
+            "kept": self.kept,
+            "retained": len(self._traces),
+        }
+
+    # -- export -------------------------------------------------------- #
+
+    def _ts_us(self, t0: float) -> float:
+        return (t0 - self._anchor_perf + self._anchor_wall) * 1e6
+
+    def to_chrome(self, trace_id: Optional[str] = None) -> dict:
+        """Retained traces (or just ``trace_id``) as a Chrome
+        trace-event document: pid = rank (the process's lane, same as
+        the TraceRecorder export), one tid LANE PER REQUEST labelled
+        with its rid/trace id, spans wall-anchored — feed it to
+        :func:`merge_traces` next to the recorder shards and the
+        request rows line up under the engine timeline."""
+        pid = self.rank
+        with self._lock:
+            if trace_id is not None:
+                # an exemplar can outlive its trace (capacity
+                # eviction) — the export degrades to an empty
+                # document, the get()-returns-None contract
+                tr = self._traces.get(str(trace_id))
+                rows = [] if tr is None else [tr]
+            else:
+                rows = list(self._traces.values())
+        events: List[dict] = [{
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": f"rank {pid} requests"},
+        }]
+        for tid, tr in enumerate(rows, start=1):
+            events.append({
+                "ph": "M", "pid": pid, "tid": tid,
+                "name": "thread_name",
+                "args": {"name": f"req {tr.get('rid')} "
+                                 f"[{tr.get('trace_id')}]"},
+            })
+            for span in tr.get("spans", ()):
+                rec = {
+                    "name": span["name"],
+                    "cat": "request",
+                    "ph": _PH_SPAN,
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": self._ts_us(span["t0"]),
+                    "dur": float(span.get("dur", 0.0)) * 1e6,
+                }
+                args = {k: v for k, v in span.items()
+                        if k not in ("name", "t0", "dur")}
+                args["trace_id"] = tr.get("trace_id")
+                rec["args"] = args
+                events.append(rec)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {"rank": pid, "request_traces": len(rows)},
+        }
+
+    def export_chrome(self, path: str,
+                      trace_id: Optional[str] = None) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(trace_id), f, default=str)
+        return path
+
+
+# ---------------------------------------------------------------------- #
 # global recorder
 # ---------------------------------------------------------------------- #
 
@@ -750,9 +948,9 @@ class StragglerReport:
             try:
                 path = os.path.join(getattr(trainer, "out", "."),
                                     "straggler.jsonl")
-                with open(path, "a") as f:
-                    f.write(json.dumps(self.last_report, default=float)
-                            + "\n")
+                # atomic per line (metrics.append_jsonl): a SIGKILL
+                # mid-flush must never tear the series' last line
+                append_jsonl(path, self.last_report)
             except OSError:
                 pass
 
@@ -762,11 +960,14 @@ class MetricsExport:
 
     Each trigger appends ONE line — iteration, epoch, elapsed wall
     clock, wall timestamp, and every float-coercible observation
-    (optionally filtered by ``keys``) — to ``<trainer.out>/<filename>``,
-    flushed per line so the series survives a crash.  The structured,
-    machine-readable sibling of LogReport's interval-averaged ``log``
-    (which rewrites the whole file each fire): this one is append-only
-    and per-tick, the format scrapers and dashboards want.
+    (optionally filtered by ``keys``) — to ``<trainer.out>/<filename>``.
+    Each line lands via the atomic single-write append
+    (:func:`chainermn_tpu.utils.metrics.append_jsonl`), so the series
+    survives a crash — including a SIGKILL mid-write — with no torn
+    last line.  The structured, machine-readable sibling of LogReport's
+    interval-averaged ``log`` (which rewrites the whole file each
+    fire): this one is append-only and per-tick, the format scrapers
+    and dashboards want.
     """
 
     trigger = (1, "iteration")
@@ -779,18 +980,12 @@ class MetricsExport:
         self.path = path
         self.filename = filename
         self.keys = None if keys is None else list(keys)
-        self._file = None
+        self._dir_made = False
 
     def initialize(self, trainer) -> None:
         if self.path is None:
             self.path = os.path.join(
                 getattr(trainer, "out", "."), self.filename)
-
-    def _ensure_file(self):
-        if self._file is None:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            self._file = open(self.path, "a")
-        return self._file
 
     def __call__(self, trainer) -> None:
         if self.path is None:       # used without initialize()
@@ -811,16 +1006,13 @@ class MetricsExport:
             except (TypeError, ValueError):
                 continue
         try:
-            f = self._ensure_file()
-            f.write(json.dumps(entry) + "\n")
-            f.flush()
+            if not self._dir_made:
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                self._dir_made = True
+            append_jsonl(self.path, entry)
         except OSError:
             pass                    # observability must never kill training
 
     def finalize(self, trainer=None) -> None:
-        if self._file is not None:
-            try:
-                self._file.close()
-            except OSError:
-                pass
-            self._file = None
+        pass                        # nothing held open between lines

@@ -1,0 +1,374 @@
+"""The request-scoped ops plane end to end (ISSUE 13 acceptance):
+
+- exemplar→trace round trip: drive the engine with a FaultPlan slow
+  round, read ``serve/ttft`` p99's exemplar from the registry, and
+  resolve it to a retained request trace holding that request's
+  prefill/decode spans;
+- timeout traces always retained, with the terminal ``timeout`` span
+  on the timeline;
+- the disabled path (no store) allocates nothing per request;
+- ``request_records()`` ring overflow at the ``record_history`` cap:
+  oldest dropped, derived latency fields intact, ``SLOReport`` over
+  the overflowed ring correct;
+- ``/statusz`` + ``/tracez`` served from a LIVE engine mid-decode.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import (
+    AdmissionController,
+    ServingEngine,
+    SLOReport,
+)
+from chainermn_tpu.testing import FaultInjector, FaultPlan
+from chainermn_tpu.utils.metrics import (
+    MetricsRegistry,
+    set_registry,
+)
+from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _engine(mini_adapter, mini_params, warm=False, **kw):
+    eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                        horizon=160, max_prompt=16, block=8,
+                        round_tokens=4, **kw)
+    if warm:
+        rng = np.random.RandomState(99)
+        for _ in range(2):
+            eng.submit(rng.randint(0, 64, 8), max_new=4)
+        eng.run(max_steps=200)
+        eng.warm()
+        eng.reset()
+    return eng
+
+
+class TestTracingDisabledPath:
+    def test_no_store_no_trace_allocations(self, mini_adapter,
+                                           mini_params, registry):
+        eng = _engine(mini_adapter, mini_params)
+        assert eng.traces is None
+        rid = eng.submit(np.arange(2, 8), max_new=4)
+        req = eng._queue[0]
+        assert req.trace_id is None and req.spans is None
+        comps = eng.run(max_steps=200)
+        assert comps[0].trace_id is None
+        # exemplar=None rides the observe path without retaining one
+        assert registry.histogram("serve/ttft").exemplar_for(99) is None
+        assert rid == comps[0].rid
+
+    def test_env_gate_builds_store(self, mini_adapter, mini_params,
+                                   monkeypatch):
+        from chainermn_tpu.serving.engine import _trace_store_from_env
+
+        monkeypatch.delenv("CHAINERMN_TPU_REQUEST_TRACE",
+                           raising=False)
+        assert _trace_store_from_env() is None
+        monkeypatch.setenv("CHAINERMN_TPU_REQUEST_TRACE", "1")
+        monkeypatch.setenv("CHAINERMN_TPU_REQUEST_TRACE_SAMPLE", "0.5")
+        monkeypatch.setenv("CHAINERMN_TPU_REQUEST_TRACE_CAPACITY", "32")
+        monkeypatch.setenv("CHAINERMN_TPU_REQUEST_TRACE_SLO", "0.25")
+        store = _trace_store_from_env()
+        assert store is not None
+        assert (store.capacity, store.sample_rate, store.slo_e2e) \
+            == (32, 0.5, 0.25)
+        # a typo'd knob degrades to the default, never crashes
+        monkeypatch.setenv("CHAINERMN_TPU_REQUEST_TRACE_SAMPLE", "oops")
+        assert _trace_store_from_env().sample_rate == 0.05
+
+
+class TestTracedLifecycle:
+    def test_ok_request_timeline(self, mini_adapter, mini_params,
+                                 registry):
+        store = RequestTraceStore(capacity=64, sample_rate=1.0)
+        eng = _engine(mini_adapter, mini_params, traces=store)
+        rid = eng.submit(np.arange(2, 9), max_new=6)
+        comps = eng.run(max_steps=200)
+        assert len(comps) == 1 and comps[0].rid == rid
+        tid = comps[0].trace_id
+        assert tid is not None
+        tr = store.get(tid)
+        assert tr is not None and tr["status"] == "ok"
+        names = [s["name"] for s in tr["spans"]]
+        for expected in ("prefill", "queue_wait", "admit",
+                         "decode_round", "evict"):
+            assert expected in names, names
+        # spans are time-ordered enough to read causally: queue_wait
+        # starts at submit, evict ends last
+        by = {s["name"]: s for s in tr["spans"]}
+        assert by["queue_wait"]["t0"] <= by["admit"]["t0"]
+        assert tr["e2e"] == pytest.approx(comps[0].e2e)
+        # the exemplar on every serve/* histogram resolves to a trace
+        for metric in ("serve/ttft", "serve/queue_wait", "serve/e2e",
+                       "serve/tpot"):
+            ex = registry.histogram(metric).exemplar_for(99)
+            assert ex is not None
+            assert store.get(ex[0]) is not None
+
+    def test_caller_trace_id_propagates(self, mini_adapter,
+                                        mini_params, registry):
+        store = RequestTraceStore(capacity=16, sample_rate=1.0)
+        eng = _engine(mini_adapter, mini_params, traces=store)
+        eng.submit(np.arange(2, 8), max_new=4, trace_id="front-42")
+        comps = eng.run(max_steps=200)
+        assert comps[0].trace_id == "front-42"
+        assert store.get("front-42")["rid"] == comps[0].rid
+        assert registry.histogram("serve/ttft").exemplar_for(99)[0] \
+            == "front-42"
+
+    def test_decode_round_spans_sampled(self, mini_adapter,
+                                        mini_params):
+        store = RequestTraceStore(capacity=16, sample_rate=1.0)
+        eng = _engine(mini_adapter, mini_params, traces=store,
+                      trace_decode_every=1000)
+        eng.submit(np.arange(2, 8), max_new=20)
+        comps = eng.run(max_steps=400)
+        tr = store.get(comps[0].trace_id)
+        rounds = [s for s in tr["spans"] if s["name"] == "decode_round"]
+        # a 20-token decode takes 5 rounds of 4; with the sampling
+        # cadence out of reach only the FIRST round (the TTFT cause)
+        # is on the timeline
+        assert len(rounds) == 1
+
+    def test_shed_trace_always_kept(self, mini_adapter, mini_params,
+                                    registry):
+        store = RequestTraceStore(capacity=16, sample_rate=0.0)
+        ctrl = AdmissionController(max_queue=1)
+        eng = _engine(mini_adapter, mini_params, traces=store,
+                      admission=ctrl, gang=True)
+        # fill the queue, then overflow it: the overflow is shed
+        # "queue_full" at submit and its trace retained despite rate 0
+        eng.submit(np.arange(2, 8), max_new=4)
+        eng.submit(np.arange(2, 8), max_new=4)      # queued (slots free)
+        shed = eng.submit(np.arange(2, 9), max_new=4)
+        assert not isinstance(shed, str)
+        assert shed.reason == "queue_full"
+        assert shed.trace_id is not None
+        tr = store.get(shed.trace_id)
+        assert tr is not None and tr["status"] == "shed"
+        assert tr["reason"] == "queue_full"
+        names = [s["name"] for s in tr["spans"]]
+        assert names == ["queue_wait", "shed"]
+        eng.run(max_steps=400)
+
+
+class TestExemplarTraceRoundTrip:
+    """The acceptance drill: FaultPlan slow round → serve/ttft p99
+    exemplar → retained trace with the request's actual spans."""
+
+    def test_slow_round_p99_resolves_to_victim_trace(
+            self, mini_adapter, mini_params, registry):
+        # tail-only retention: ok traces are kept ONLY when they
+        # violate the e2e SLO — which the delay victims do
+        store = RequestTraceStore(capacity=64, sample_rate=0.0,
+                                  slo_e2e=0.2)
+        eng = _engine(mini_adapter, mini_params, warm=True)
+        eng.traces = store          # armed AFTER the warm run
+        registry.clear()            # drop warm-run compile latencies
+        inj = FaultInjector(FaultPlan(serve_delay_at_round=1,
+                                      serve_delay_seconds=0.5))
+        inj.attach_engine(eng)
+        rng = np.random.RandomState(3)
+        # wave 1 fills every slot for ONE fast round; wave 2 queues
+        # behind it and gets admitted into round 1 — the delayed one —
+        # so the delay lands exactly on wave 2's first tokens
+        for _ in range(8):
+            eng.submit(rng.randint(0, 64, rng.randint(2, 16)),
+                       max_new=4)
+        for _ in range(2):
+            eng.submit(rng.randint(0, 64, rng.randint(2, 16)),
+                       max_new=8)
+        comps = eng.run(max_steps=400)
+        assert ("serve_delay", 1) in inj.fired
+        assert all(c.status == "ok" for c in comps)
+        # the p99 exemplar is a delay victim...
+        ex = registry.histogram("serve/ttft").exemplar_for(99)
+        assert ex is not None
+        trace_id, ttft_value, _ = ex
+        assert ttft_value > 0.5
+        # ...and resolves to its retained causal timeline
+        tr = store.get(trace_id)
+        assert tr is not None
+        assert tr["slo_violated"] is True
+        names = [s["name"] for s in tr["spans"]]
+        for expected in ("prefill", "queue_wait", "admit",
+                         "decode_round", "evict"):
+            assert expected in names, names
+        # the slow round itself is on the timeline (the victim's first
+        # round was the delayed one)
+        slow = max(s["dur"] for s in tr["spans"]
+                   if s["name"] == "decode_round")
+        assert slow > 0.5
+        # fast requests were NOT retained (tail-based, not keep-all)
+        fast = [c for c in comps if c.e2e < 0.2]
+        assert fast, "expected some fast completions"
+        assert all(store.get(c.trace_id) is None for c in fast)
+
+    def test_timeout_trace_contains_terminal_span(
+            self, mini_adapter, mini_params, registry):
+        store = RequestTraceStore(capacity=64, sample_rate=0.0)
+        eng = _engine(mini_adapter, mini_params, warm=True,
+                      traces=store)
+        inj = FaultInjector(FaultPlan(serve_delay_at_round=1,
+                                      serve_delay_seconds=0.5))
+        inj.attach_engine(eng)
+        eng.submit(np.arange(2, 10), max_new=12, timeout=0.25)
+        comps = eng.run(max_steps=400)
+        victim = [c for c in comps if c.status == "timeout"]
+        assert victim, [c.status for c in comps]
+        tr = store.get(victim[0].trace_id)
+        assert tr is not None and tr["status"] == "timeout"
+        names = [s["name"] for s in tr["spans"]]
+        for expected in ("prefill", "decode_round", "timeout",
+                         "evict"):
+            assert expected in names, names
+
+
+class TestProtectiveOverloadShed:
+    def test_overload_shed_outside_shed_total(self, mini_adapter,
+                                              mini_params, registry):
+        """Protective sheds count in serve/shed_overload only:
+        serve/shed_total is the burn-rate rules' bad feed, and the
+        alert's own deliberate sheds must not keep the alert burning
+        after the real cause stops (the self-sustain loop).  They are
+        also transient — the reject carries retry_after semantics,
+        not a terminal verdict."""
+        eng = _engine(
+            mini_adapter, mini_params,
+            admission=AdmissionController(alert_advisor=lambda: True,
+                                          overload_retry_after=30.0))
+        shed = eng.submit(np.arange(4), max_new=4, priority=1)
+        assert shed.status == "shed" and shed.reason == "overload"
+        # the hint is the operator's alert-window figure, never the
+        # backlog estimate (an empty queue would hint ~0 and invite a
+        # retry storm mid-protection)
+        assert shed.retry_after == 30.0
+        assert registry.counter("serve/shed_overload").value == 1
+        assert registry.counter("serve/shed_total").value == 0
+        # ...and out of serve/submitted (the rules' total feed):
+        # counting protective sheds as zero-bad traffic would dilute
+        # the bad fraction and self-extinguish the alert mid-burst
+        assert registry.counter("serve/submitted").value == 0
+        # a cold predictor has no estimate, but the class 0 request
+        # still passes while the advisory fires
+        rid = eng.submit(np.arange(4), max_new=4, priority=0)
+        assert isinstance(rid, str)
+
+
+class TestRecordRingOverflow:
+    """Satellite: request_records() at the record_history cap."""
+
+    def test_oldest_dropped_derived_fields_intact(
+            self, mini_adapter, mini_params):
+        eng = _engine(mini_adapter, mini_params, record_history=6)
+        rng = np.random.RandomState(11)
+        comps = []
+        for _ in range(10):
+            eng.submit(rng.randint(0, 64, rng.randint(2, 12)),
+                       max_new=int(rng.randint(4, 10)))
+        comps = eng.run(max_steps=800)
+        assert len(comps) == 10
+        recs = eng.request_records()
+        assert len(recs) == 6
+        assert [r.rid for r in recs] == [c.rid for c in comps[-6:]]
+        for r in recs:
+            assert r.queue_wait == pytest.approx(
+                r.t_admit - r.t_submit)
+            assert r.ttft == pytest.approx(r.t_first - r.t_submit)
+            assert r.e2e == pytest.approx(r.t_done - r.t_submit)
+            assert r.tpot == pytest.approx(
+                (r.t_done - r.t_first) / max(r.n_generated - 1, 1))
+
+    def test_slo_report_over_overflowed_ring(self, mini_adapter,
+                                             mini_params):
+        eng = _engine(mini_adapter, mini_params, record_history=6)
+        rng = np.random.RandomState(12)
+        for _ in range(10):
+            eng.submit(rng.randint(0, 64, rng.randint(2, 12)),
+                       max_new=int(rng.randint(4, 10)))
+        comps = eng.run(max_steps=800)
+        report = SLOReport()
+        report.add_arm("ring", eng.request_records(), slo=1e9)
+        s = report.summary()["ring"]
+        # the report covers exactly the ring's survivors...
+        assert s["e2e"]["count"] == 6
+        assert s["slo"]["scored"] == 6
+        assert s["slo"]["attained"] == 6
+        # ...and its percentiles equal raw numpy over those survivors
+        tail = [c.e2e for c in comps[-6:]]
+        for q in (50, 95, 99):
+            assert s["e2e"][f"p{q:g}"] == pytest.approx(
+                float(np.percentile(tail, q)))
+
+    def test_sheds_count_in_ring_and_skip_in_report(
+            self, mini_adapter, mini_params):
+        ctrl = AdmissionController(max_queue=2)
+        eng = _engine(mini_adapter, mini_params, record_history=4,
+                      admission=ctrl, gang=True)
+        rng = np.random.RandomState(13)
+        sheds = 0
+        for _ in range(8):
+            out = eng.submit(rng.randint(0, 64, 6), max_new=4)
+            sheds += not isinstance(out, str)
+        assert sheds > 0
+        eng.run(max_steps=400)
+        recs = eng.request_records()
+        assert len(recs) == 4       # ring holds completions AND sheds
+        report = SLOReport()
+        report.add_arm("mix", recs, slo=1e9)
+        s = report.summary()["mix"]
+        n_shed = sum(1 for r in recs if r.status == "shed")
+        assert s["slo"]["shed"] == n_shed
+        assert s["skipped"]["ttft"] >= n_shed
+
+
+class TestStatuszLiveEngine:
+    def test_endpoints_reflect_live_engine(self, mini_adapter,
+                                           mini_params, registry):
+        from chainermn_tpu.utils.statusz import StatuszServer
+
+        store = RequestTraceStore(capacity=16, sample_rate=1.0)
+        eng = _engine(mini_adapter, mini_params, traces=store)
+        srv = StatuszServer(registry=registry).attach_engine(eng)
+        try:
+            srv.start()
+            rng = np.random.RandomState(5)
+            for _ in range(4):
+                eng.submit(rng.randint(0, 64, 8), max_new=8)
+            # a few steps in: slots live, decode mid-flight
+            for _ in range(2):
+                eng.step()
+            assert eng.n_active > 0
+            doc = json.load(urllib.request.urlopen(
+                srv.url("/statusz"), timeout=5))
+            serving = doc["sections"]["serving"]
+            assert serving["active_slots"] == eng.n_active
+            assert serving["epoch"] == 0
+            assert serving["draining"] is False
+            assert serving["traces"]["capacity"] == 16
+            assert doc["counters"]["serve/submitted"] == 4.0
+            with urllib.request.urlopen(srv.url("/healthz"),
+                                        timeout=5) as r:
+                assert r.status == 200
+            eng.run(max_steps=400)
+            tz = json.load(urllib.request.urlopen(
+                srv.url("/tracez"), timeout=5))
+            assert tz["stores"][0]["retained"] == 4
+            one = tz["traces"][0]["trace_id"]
+            full = json.load(urllib.request.urlopen(
+                srv.url(f"/tracez?trace_id={one}"), timeout=5))
+            assert any(s["name"] == "evict"
+                       for s in full["trace"]["spans"])
+        finally:
+            srv.stop()

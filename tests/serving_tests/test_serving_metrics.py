@@ -134,9 +134,12 @@ class TestSLOReport:
         doc = json.load(open(path))
         assert set(doc["arms"]) == {"continuous", "static"}
         assert doc["arms"]["static"]["ttft"]["count"] == 6
-        # gang mode queues harder: its mean queue wait is no better
-        cont = slo.summary()["continuous"]["queue_wait"]["mean"]
-        stat = slo.summary()["static"]["queue_wait"]["mean"]
+        # gang mode queues harder: its typical queue wait is no
+        # better.  MEDIANS, not means — one loaded-host scheduling
+        # burst against a single continuous-arm request skews a
+        # 6-sample mean past any margin (observed in CI)
+        cont = slo.summary()["continuous"]["queue_wait"]["p50"]
+        stat = slo.summary()["static"]["queue_wait"]["p50"]
         assert stat >= cont * 0.5   # sanity, not a perf claim
 
     def test_dict_records_accepted(self):

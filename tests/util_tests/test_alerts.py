@@ -1,0 +1,517 @@
+"""SLO burn-rate alerting (utils/alerts.py): deterministic window math
+on an injected clock, fire/resolve transitions counted and JSONL'd,
+quiet-on-baseline, the latency-rule lattice read, and the protective
+advisory the AdmissionController consumes."""
+
+import json
+
+import pytest
+
+from chainermn_tpu.utils.alerts import (
+    AlertManager,
+    LatencyRule,
+    RatioRule,
+    get_installed,
+    install,
+)
+from chainermn_tpu.utils.metrics import (
+    LATTICE_EDGES,
+    MetricsRegistry,
+    bucket_index,
+)
+
+WINDOWS = ((60.0, 5.0, 10.0),)      # one page-style pair, test-sized
+
+
+def _mgr(reg, **kw):
+    rule = RatioRule("shed-burn", bad="serve/shed_total",
+                     total="serve/submitted", budget=0.01,
+                     windows=WINDOWS)
+    return AlertManager([rule], registry=reg, **kw), rule
+
+
+def _cover(mgr, reg, t=0.0, seconds=61):
+    """Healthy traffic long enough to cover the 60s long window — a
+    partial window reads as no-evidence, so drills that expect to
+    fire must first span it."""
+    for _ in range(int(seconds)):
+        reg.inc("serve/submitted", 10)
+        t += 1.0
+        mgr.tick(t)
+    return t
+
+
+class TestRuleValidation:
+    def test_budget_bounds(self):
+        for bad in (0.0, 1.0, -0.1, 2.0):
+            with pytest.raises(ValueError):
+                RatioRule("r", bad="b", total="t", budget=bad)
+
+    def test_window_shape(self):
+        with pytest.raises(ValueError):
+            RatioRule("r", bad="b", total="t", budget=0.01,
+                      windows=((5.0, 60.0, 10.0),))   # short > long
+        with pytest.raises(ValueError):
+            RatioRule("r", bad="b", total="t", budget=0.01,
+                      windows=((60.0, 5.0, 0.0),))    # factor <= 0
+        with pytest.raises(ValueError):
+            RatioRule("r", bad="b", total="t", budget=0.01, windows=())
+
+    def test_duplicate_rule_names_rejected(self):
+        reg = MetricsRegistry(enabled=True)
+        r1 = RatioRule("same", bad="b", total="t", budget=0.01)
+        r2 = RatioRule("same", bad="b", total="t", budget=0.02)
+        with pytest.raises(ValueError):
+            AlertManager([r1, r2], registry=reg)
+
+    def test_latency_rule_above_positive(self):
+        with pytest.raises(ValueError):
+            LatencyRule("r", histogram="h", above=0.0, budget=0.01)
+
+
+class TestWindowMath:
+    """Injectable-clock determinism: the same (t, bad, total) series
+    always produces the same transitions at the same ticks."""
+
+    def test_quiet_on_baseline(self):
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        t = 0.0
+        for _ in range(120):
+            reg.inc("serve/submitted", 10)
+            t += 1.0
+            assert mgr.tick(t) == []
+        assert mgr.firing() == ()
+        assert mgr.fired == 0
+        # burn is computed and ~0, not None — there IS evidence
+        burn = mgr.state()["rules"]["shed-burn"]["burn"]
+        assert burn["60s"] == 0.0
+
+    def test_fires_when_both_windows_burn(self):
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        t = 0.0
+        for _ in range(30):             # healthy history
+            reg.inc("serve/submitted", 10)
+            t += 1.0
+            mgr.tick(t)
+        fired_at = None
+        for _ in range(70):             # 50% shed: burn = 50 >> 10
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 5)
+            t += 1.0
+            ev = mgr.tick(t)
+            if ev:
+                fired_at = t
+                assert ev[0]["transition"] == "fired"
+                assert ev[0]["rule"] == "shed-burn"
+                break
+        # the short window saturates fast; the long window must cross
+        # factor 10 before the pair agrees — deterministically
+        assert fired_at is not None
+        assert mgr.firing() == ("shed-burn",)
+        assert mgr.fired == 1
+        # deterministic replay: same series, same fire tick
+        reg2 = MetricsRegistry(enabled=True)
+        mgr2, _ = _mgr(reg2)
+        t2 = 0.0
+        refire = None
+        for _ in range(30):
+            reg2.inc("serve/submitted", 10)
+            t2 += 1.0
+            mgr2.tick(t2)
+        for _ in range(70):
+            reg2.inc("serve/submitted", 10)
+            reg2.inc("serve/shed_total", 5)
+            t2 += 1.0
+            if mgr2.tick(t2):
+                refire = t2
+                break
+        assert refire == fired_at
+
+    def test_short_window_recovery_resolves(self):
+        """The multi-window point: once the burn STOPS, the short
+        window clears within ~its own length even though the long
+        window still remembers the incident."""
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        t = _cover(mgr, reg)
+        for _ in range(30):
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 8)
+            t += 1.0
+            mgr.tick(t)
+        burn_end = t
+        assert mgr.firing() == ("shed-burn",)
+        resolved_at = None
+        for _ in range(30):
+            reg.inc("serve/submitted", 10)     # burn stops
+            t += 1.0
+            ev = mgr.tick(t)
+            if ev:
+                assert ev[0]["transition"] == "resolved"
+                resolved_at = t
+                break
+        assert resolved_at is not None
+        # resolved within a handful of short windows, long before the
+        # 60s long window forgets
+        assert resolved_at <= burn_end + 3 * 5.0
+        assert mgr.resolved == 1
+
+    def test_no_evidence_is_not_an_alert(self):
+        """Zero traffic (delta total < min_total) → burn None → quiet,
+        whatever the ratio would divide to."""
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        for t in range(1, 200):
+            assert mgr.tick(float(t)) == []
+        assert mgr.state()["rules"]["shed-burn"]["burn"]["60s"] is None
+
+    def test_disabled_registry_reads_as_no_evidence(self):
+        reg = MetricsRegistry(enabled=False)
+        mgr, _ = _mgr(reg)
+        for t in range(1, 100):
+            assert mgr.tick(float(t)) == []
+        assert mgr.firing() == ()
+
+    def test_min_interval_rate_limits_evaluation(self):
+        """tick() from a tight loop is one clock compare until the
+        interval elapses — and window math over the sparser samples
+        still fires at the same clock time."""
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg, min_interval=1.0)
+        t = 0.0
+        for _ in range(100):            # 10 ticks per clock second
+            reg.inc("serve/submitted", 1)
+            t += 0.1
+            mgr.tick(t)
+        assert mgr.ticks == 100
+        assert mgr.evals == 10          # one per elapsed interval
+        # burn goes bad: every evaluated window must still catch it
+        # (900 × 0.1s reaches past the 60s long window's coverage)
+        for _ in range(900):
+            reg.inc("serve/submitted", 1)
+            reg.inc("serve/shed_total", 1)
+            t += 0.1
+            mgr.tick(t)
+        assert mgr.firing() == ("shed-burn",)
+
+    def test_sample_retention_bounded_under_fast_ticks(self):
+        """A scheduler-loop ticking far faster than the resolution
+        floor (shortest_window/64) must not grow the sample deque
+        without bound — the newest sample is replaced instead."""
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)              # windows (60, 5): gap 5/64 s
+        t = 0.0
+        for _ in range(20_000):         # 100 Hz for 200 s
+            reg.inc("serve/submitted", 1)
+            t += 0.01
+            mgr.tick(t)
+        dq = mgr._samples["shed-burn"]
+        # 60 s retained span / (5/64 s) ≈ 768 samples + slack
+        assert len(dq) < 1000
+        # and the window math still reads the live totals
+        burn = mgr.state()["rules"]["shed-burn"]["burn"]
+        assert burn["60s"] == 0.0
+
+    def test_min_interval_zero_evaluates_every_tick(self):
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        for t in range(1, 20):
+            mgr.tick(float(t))
+        assert mgr.evals == mgr.ticks == 19
+
+    def test_min_interval_validation(self):
+        reg = MetricsRegistry(enabled=True)
+        with pytest.raises(ValueError, match="min_interval"):
+            _mgr(reg, min_interval=-0.5)
+
+
+class TestLatencyRule:
+    def test_bad_counts_strictly_above_lattice_edge(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("serve/ttft")
+        edge = LATTICE_EDGES[bucket_index(0.1)]
+        rule = LatencyRule("slow-ttft", histogram="serve/ttft",
+                           above=0.1, budget=0.1, windows=WINDOWS)
+        h.observe(edge)                 # ON the edge: not bad
+        h.observe(edge * 1.5)           # above: bad
+        h.observe(0.001)                # fast: not bad
+        bad, total = rule.read(reg)
+        assert (bad, total) == (1.0, 3.0)
+
+    def test_fires_on_slow_tail_quiet_on_fast(self):
+        reg = MetricsRegistry(enabled=True)
+        rule = LatencyRule("slow-ttft", histogram="serve/ttft",
+                           above=0.1, budget=0.02, windows=WINDOWS)
+        mgr = AlertManager([rule], registry=reg)
+        h = reg.histogram("serve/ttft")
+        t = 0.0
+        for _ in range(20):             # fast baseline
+            for _ in range(5):
+                h.observe(0.01)
+            t += 1.0
+            assert mgr.tick(t) == []
+        for _ in range(70):             # tail goes bad: 40% slow
+            for _ in range(3):
+                h.observe(0.01)
+            h.observe(0.5)
+            h.observe(0.5)
+            t += 1.0
+            mgr.tick(t)
+        assert mgr.firing() == ("slow-ttft",)
+
+
+class TestTransitionsAndLog:
+    def test_transition_counters_and_gauge(self):
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        t = _cover(mgr, reg)
+        for _ in range(30):
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 9)
+            t += 1.0
+            mgr.tick(t)
+        assert reg.counter("alerts/fired").value == 1
+        assert reg.gauge("alerts/firing").last == 1
+        for _ in range(60):
+            reg.inc("serve/submitted", 10)
+            t += 1.0
+            mgr.tick(t)
+        assert reg.counter("alerts/resolved").value == 1
+        assert reg.gauge("alerts/firing").last == 0
+
+    def test_alert_log_jsonl(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        path = str(tmp_path / "alerts.jsonl")
+        mgr, _ = _mgr(reg, log_path=path)
+        t = _cover(mgr, reg)
+        for _ in range(30):
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 9)
+            t += 1.0
+            mgr.tick(t)
+        for _ in range(60):
+            reg.inc("serve/submitted", 10)
+            t += 1.0
+            mgr.tick(t)
+        lines = [json.loads(l) for l in open(path)]
+        assert [l["transition"] for l in lines] == ["fired", "resolved"]
+        assert lines[0]["rule"] == "shed-burn"
+        assert lines[0]["budget"] == 0.01
+        assert "burn" in lines[0]
+
+    def test_broken_rule_parks_in_error_state(self):
+        reg = MetricsRegistry(enabled=True)
+
+        class Broken(RatioRule):
+            def read(self, registry):
+                raise RuntimeError("boom")
+
+        rule = Broken("bad", bad="x", total="y", budget=0.01,
+                      windows=WINDOWS)
+        mgr = AlertManager([rule], registry=reg)
+        assert mgr.tick(1.0) == []      # never raises
+        st = mgr.state()["rules"]["bad"]
+        assert st["state"] == "error"
+        assert "boom" in st["detail"]
+
+    def test_read_error_holds_firing_no_double_count(self):
+        """An evaluation error is not evidence the overload stopped:
+        a FIRING rule whose read starts raising keeps firing (and
+        protective shedding), and recovery-while-still-burning does
+        not re-count the fire transition."""
+        reg = MetricsRegistry(enabled=True)
+
+        class Flaky(RatioRule):
+            broken = False
+
+            def read(self, registry):
+                if self.broken:
+                    raise RuntimeError("scrape down")
+                return super().read(registry)
+
+        rule = Flaky("shed-burn", bad="serve/shed_total",
+                     total="serve/submitted", budget=0.01,
+                     windows=WINDOWS)
+        mgr = AlertManager([rule], registry=reg)
+        t = _cover(mgr, reg)
+        for _ in range(30):             # burn it into firing
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 9)
+            t += 1.0
+            mgr.tick(t)
+        assert mgr.firing() == ("shed-burn",)
+        assert mgr.fired == 1
+        rule.broken = True
+        for _ in range(10):
+            t += 1.0
+            assert mgr.tick(t) == []    # errors emit no transitions
+        assert mgr.state()["rules"]["shed-burn"]["state"] == "error"
+        assert mgr.firing() == ("shed-burn",)   # HELD
+        assert mgr.protective() is True
+        rule.broken = False             # recovers, still burning
+        for _ in range(3):
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 9)
+            t += 1.0
+            mgr.tick(t)
+        assert mgr.firing() == ("shed-burn",)
+        assert mgr.fired == 1           # no double count
+        assert mgr.resolved == 0
+
+
+class TestAdvisory:
+    def test_protective_follows_protect_flag(self):
+        reg = MetricsRegistry(enabled=True)
+        loud = RatioRule("loud", bad="b", total="t", budget=0.01,
+                         windows=WINDOWS, protect=False)
+        mgr = AlertManager([loud], registry=reg)
+        t = 0.0
+        for _ in range(70):             # spans the 60s long window
+            reg.inc("t", 10)
+            reg.inc("b", 9)
+            t += 1.0
+            mgr.tick(t)
+        assert mgr.firing() == ("loud",)
+        assert mgr.protective() is False    # protect=False: page only
+
+    def test_admission_controller_sheds_overload_while_protective(self):
+        from chainermn_tpu.serving.admission import AdmissionController
+
+        class FakeReq:
+            def __init__(self, priority):
+                self.priority = priority
+                self.tenant = None
+                self.max_new = 8
+                self.deadline = None
+                self.t_submit = 0.0
+
+        state = {"on": True}
+        ctrl = AdmissionController(
+            alert_advisor=lambda: state["on"])
+        # below-tier class shed "overload"; protected class 0 passes
+        assert ctrl.check_submit(FakeReq(1), [], {}) == \
+            (False, "overload", None)
+        assert ctrl.check_submit(FakeReq(0), [], {}) == \
+            (True, None, None)
+        state["on"] = False
+        assert ctrl.check_submit(FakeReq(1), [], {}) == \
+            (True, None, None)
+
+    def test_admission_manager_advisor_object(self):
+        from chainermn_tpu.serving.admission import AdmissionController
+
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        ctrl = AdmissionController(alert_advisor=mgr)
+        assert ctrl.protective() is False
+        t = 0.0
+        for _ in range(70):             # spans the 60s long window
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 9)
+            t += 1.0
+            mgr.tick(t)
+        assert ctrl.protective() is True
+
+    def test_broken_advisor_degrades_to_open(self):
+        from chainermn_tpu.serving.admission import AdmissionController
+
+        def bad():
+            raise RuntimeError("advisor down")
+
+        ctrl = AdmissionController(alert_advisor=bad)
+        assert ctrl.protective() is False
+
+
+class TestOverloadDrill:
+    """The bench_overload-shaped acceptance drill, replayed on the
+    injectable clock: an open-loop arrival trace against a fixed
+    decode capacity — at 0.5× capacity the rules stay quiet, at 2×
+    the queue grows without bound, TTFT blows through the latency
+    rule and sheds burn the ratio rule; back at 0.5× both resolve."""
+
+    WINDOWS = ((30.0, 5.0, 5.0),)
+
+    def _rules(self):
+        return [
+            RatioRule("shed-burn", bad="serve/shed_total",
+                      total="serve/submitted", budget=0.02,
+                      windows=self.WINDOWS),
+            LatencyRule("slow-ttft", histogram="serve/ttft",
+                        above=0.5, budget=0.05,
+                        windows=self.WINDOWS),
+        ]
+
+    def _replay(self, reg, mgr, t0, seconds, arrival_rate,
+                service_rate, max_queue=40):
+        """Deterministic fluid replay: each clock second,
+        ``arrival_rate`` requests arrive, ``service_rate`` depart;
+        TTFT observed = queue delay at admission; arrivals beyond
+        ``max_queue`` shed (the AdmissionController's bounded queue)."""
+        t, queue = t0, 0.0
+        for _ in range(int(seconds)):
+            t += 1.0
+            queue += arrival_rate
+            reg.inc("serve/submitted", arrival_rate)
+            if queue > max_queue:
+                reg.inc("serve/shed_total", queue - max_queue)
+                queue = max_queue
+            served = min(queue, service_rate)
+            queue -= served
+            for _ in range(int(served)):
+                reg.observe("serve/ttft", 0.02 + queue / service_rate)
+            mgr.tick(t)
+        return t
+
+    def test_fires_at_2x_capacity_quiet_unloaded(self):
+        reg = MetricsRegistry(enabled=True)
+        mgr = AlertManager(self._rules(), registry=reg)
+        # unloaded baseline: 0.5x capacity, queue never forms
+        t = self._replay(reg, mgr, 0.0, 120, arrival_rate=5,
+                         service_rate=10)
+        assert mgr.firing() == ()
+        assert mgr.fired == 0
+        # injected overload: 2x capacity
+        t = self._replay(reg, mgr, t, 120, arrival_rate=20,
+                         service_rate=10)
+        assert set(mgr.firing()) == {"shed-burn", "slow-ttft"}
+        assert mgr.protective() is True
+        # cause stops: the short window resolves both
+        self._replay(reg, mgr, t, 120, arrival_rate=5,
+                     service_rate=10)
+        assert mgr.firing() == ()
+        assert mgr.resolved >= 2
+
+
+class TestInstall:
+    def test_install_and_watchdog_discovery(self):
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        prev = install(mgr)
+        try:
+            assert get_installed() is mgr
+        finally:
+            install(prev)
+
+    def test_watchdog_report_embeds_alert_state(self, tmp_path):
+        from chainermn_tpu.extensions.watchdog import TrainingWatchdog
+
+        reg = MetricsRegistry(enabled=True)
+        mgr, _ = _mgr(reg)
+        t = _cover(mgr, reg)
+        for _ in range(30):
+            reg.inc("serve/submitted", 10)
+            reg.inc("serve/shed_total", 9)
+            t += 1.0
+            mgr.tick(t)
+        prev = install(mgr)
+        try:
+            wd = TrainingWatchdog(
+                stall_timeout=0.05, check_interval=0.02,
+                report_path=str(tmp_path / "stall.json"))
+            wd._fire(True, 1.0, {}, {})
+            assert wd.last_report["alerts"]["firing"] == ["shed-burn"]
+            assert wd.last_report["alerts"]["protective"] is True
+        finally:
+            install(prev)

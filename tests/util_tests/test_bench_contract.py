@@ -200,10 +200,16 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
     ("bench_live_elastic.py",
      ["--dim", "64", "--hidden", "64", "--batch", "16",
       "--iters", "3", "--rounds", "1"], "x"),
+    ("bench_obs_plane.py",
+     ["--requests", "8", "--slots", "8", "--horizon", "128",
+      "--max-prompt", "16", "--block", "8", "--min-new", "4",
+      "--max-new", "12", "--round-tokens", "2", "--rounds", "1",
+      "--reps", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
         "autotune", "telemetry", "metrics_registry", "overlap",
-        "serving", "overload", "elastic", "live_elastic"])
+        "serving", "overload", "elastic", "live_elastic",
+        "obs_plane"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),

@@ -1,0 +1,75 @@
+"""CI satellite (ISSUE 13): every metric name the stack registers at
+runtime must appear in docs/OBSERVABILITY.md's metric-name table — a
+counter that ships without documentation is a dashboard nobody can
+interpret.  The scan is static over the package source (the same
+names the runtime registers: every ``reg.inc/observe/set("...")``
+call site), plus the one dynamic family (``serve/shed_<reason>``,
+expanded over ``SHED_REASONS``)."""
+
+import os
+import re
+
+_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+_PKG = os.path.join(_ROOT, "chainermn_tpu")
+_DOC = os.path.join(_ROOT, "docs", "OBSERVABILITY.md")
+
+# a registry record call with a literal slash-namespaced name:
+# reg.inc("serve/admits"), registry.observe('comm/kv_wait', ...), ...
+_CALL = re.compile(
+    r"\.(?:inc|observe|set)\(\s*\n?\s*['\"]"
+    r"([a-z_]+/[a-z0-9_]+)['\"]")
+# the dynamic family: reg.inc("serve/shed_" + reason)
+_DYNAMIC_SHED = re.compile(r"['\"]serve/shed_['\"]\s*\+\s*reason")
+
+
+def _registered_names():
+    names = set()
+    saw_dynamic_shed = False
+    for dirpath, _dirnames, filenames in os.walk(_PKG):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(dirpath, fn)).read()
+            names.update(_CALL.findall(src))
+            if _DYNAMIC_SHED.search(src):
+                saw_dynamic_shed = True
+    assert saw_dynamic_shed, (
+        "the serve/shed_<reason> call site moved — update this test's "
+        "dynamic-name handling alongside it")
+    from chainermn_tpu.serving.admission import SHED_REASONS
+
+    names.discard("serve/shed_")    # the concat prefix, not a name
+    names.update(f"serve/shed_{r}" for r in SHED_REASONS)
+    return names
+
+
+def test_scan_finds_the_known_core():
+    """The scanner itself must keep working: a regression that finds
+    nothing would vacuously pass the coverage check below."""
+    names = _registered_names()
+    for expected in ("serve/ttft", "serve/shed_total",
+                     "serve/shed_overload", "train/step_time",
+                     "checkpoint/snapshots_written", "comm/kv_retries",
+                     "watchdog/stalls", "alerts/fired",
+                     "elastic/live_resizes"):
+        assert expected in names
+    assert len(names) > 35
+
+
+def test_every_runtime_metric_name_is_documented():
+    doc = open(_DOC).read()
+    missing = []
+    for name in sorted(_registered_names()):
+        if name in doc:
+            continue
+        # the doc may list a dynamic family by its template row
+        if name.startswith("serve/shed_") \
+                and "serve/shed_<reason>" in doc:
+            continue
+        missing.append(name)
+    assert not missing, (
+        "metric names registered at runtime but absent from "
+        f"docs/OBSERVABILITY.md's name table: {missing}")

@@ -87,6 +87,20 @@ class TestLattice:
         h.observe(edge)
         assert h.bucket_counts() == {17: 1}
 
+    def test_count_above_is_strict_and_exact(self):
+        """The burn-rate bad-count read: strictly-above buckets only,
+        identical to the sparse bucket_counts sum."""
+        h = Histogram()
+        edge = LATTICE_EDGES[17]
+        h.observe(edge)                 # IN bucket 17: not above it
+        h.observe(edge * 1.01)          # bucket 18
+        h.observe(float(LATTICE_EDGES[-1]) * 2)     # overflow bucket
+        h.observe(1e-9)                 # bucket 0
+        assert h.count_above(17) == 2
+        assert h.count_above(17) == sum(
+            c for i, c in h.bucket_counts().items() if i > 17)
+        assert h.count_above(len(LATTICE_EDGES)) == 0
+
 
 # ---------------------------------------------------------------------- #
 # histogram percentiles
@@ -529,3 +543,241 @@ class TestMetricsTextfile:
             assert all(0 <= l["goodput"] <= 1 for l in lines)
         finally:
             set_recorder(prev)
+
+
+class TestExemplars:
+    """PR 13: bounded per-bucket exemplars link a histogram percentile
+    to the causal trace of a concrete observation."""
+
+    def test_one_exemplar_per_bucket_newest_wins(self):
+        h = Histogram()
+        h.observe(0.0101, exemplar="first")
+        h.observe(0.0102, exemplar="second")     # same lattice bucket
+        h.observe(0.5, exemplar="tail")
+        assert bucket_index(0.0101) == bucket_index(0.0102)
+        ex = h.exemplars()
+        assert len(ex) == 2                      # bounded by buckets
+        same_bucket = ex[bucket_index(0.0101)]
+        assert same_bucket[0] == "second"
+        assert same_bucket[1] == pytest.approx(0.0102)
+
+    def test_exemplar_free_observe_allocates_no_table(self):
+        h = Histogram()
+        h.observe(0.010)
+        assert h._exemplars is None
+        assert h.exemplars() == {}
+        assert h.exemplar_for(99) is None
+
+    def test_exemplar_for_resolves_percentile_to_tail(self):
+        h = Histogram()
+        for _ in range(99):
+            h.observe(0.001, exemplar="fast")
+        h.observe(1.0, exemplar="slow")
+        assert h.exemplar_for(99)[0] == "slow"
+        assert h.exemplar_for(50)[0] == "fast"
+
+    def test_exemplar_for_prefers_bucket_above(self):
+        # no exemplar in the p99 bucket itself: the nearest ABOVE wins
+        # (the offending request lives in the tail)
+        h = Histogram()
+        for _ in range(100):
+            h.observe(0.001)
+        h.observe(2.0, exemplar="outlier")
+        assert h.exemplar_for(50)[0] == "outlier"
+
+    def test_snapshot_merge_keeps_newest_ts(self):
+        a, b = Histogram(), Histogram()
+        a.observe(0.0101, exemplar="old")
+        b.observe(0.0102, exemplar="new")       # same lattice bucket
+        a._exemplars[bucket_index(0.0101)][2] = 1.0     # force ordering
+        b._exemplars[bucket_index(0.0102)][2] = 2.0
+        merged = Histogram()
+        merged.merge(a.to_snapshot())
+        merged.merge(b.to_snapshot())
+        assert merged.exemplars()[bucket_index(0.0101)][0] == "new"
+        # reversed fold order: same winner (deterministic)
+        m2 = Histogram()
+        m2.merge(b.to_snapshot())
+        m2.merge(a.to_snapshot())
+        assert m2.exemplars()[bucket_index(0.0101)][0] == "new"
+
+    def test_registry_observe_exemplar_and_disabled_noop(self, registry):
+        registry.observe("serve/ttft", 0.25, exemplar="tr-1")
+        assert registry.histogram("serve/ttft").exemplar_for(99)[0] \
+            == "tr-1"
+        off = MetricsRegistry(enabled=False)
+        off.observe("serve/ttft", 0.25, exemplar="tr-1")    # no-op
+        assert len(off) == 0
+        null = off.histogram("serve/ttft")
+        assert null.exemplar_for(99) is None
+        assert null.exemplars() == {}
+        assert null.count_above(0) == 0
+
+    def test_prometheus_round_trip_with_exemplars(self):
+        h = Histogram()
+        h.observe(0.01, exemplar="fast-trace")
+        h.observe(0.8, exemplar="slow-trace")
+        h.observe(0.011)
+        text = to_prometheus({"serve/ttft": h.to_snapshot()},
+                             openmetrics=True)
+        assert ' # {trace_id="slow-trace"} ' in text
+        # the DEFAULT is exemplar-free: classic 0.0.4 consumers
+        # (textfile, watchdog stall reports) must never see the suffix
+        assert "trace_id=" not in to_prometheus(
+            {"serve/ttft": h.to_snapshot()})
+        parsed = parse_prometheus_text(text)
+        h2 = histogram_from_prometheus(parsed["serve_ttft"])
+        assert h2.count == h.count
+        assert h2.exemplar_for(99)[0] == "slow-trace"
+        assert h2.exemplar_for(99)[1] == pytest.approx(0.8)
+        # bucket counts identical to the exemplar-free round trip
+        assert h2.bucket_counts() == h.bucket_counts()
+
+    def test_digest_is_counters_and_gauges_only(self):
+        """The /statusz per-scrape read: counter values + gauge
+        lasts, histograms omitted (their samples/exemplars never
+        serialized)."""
+        reg = MetricsRegistry(enabled=True)
+        reg.inc("serve/admits", 3)
+        reg.set("serve/queue_depth", 7)
+        reg.observe("serve/ttft", 0.2)
+        assert reg.digest() == {"serve/admits": 3.0,
+                                "serve/queue_depth": 7.0}
+        assert MetricsRegistry(enabled=False).digest() == {}
+
+    def test_textfile_export_is_exemplar_free_by_default(self,
+                                                         tmp_path):
+        """The node-exporter textfile collector speaks classic 0.0.4,
+        whose parsers reject the OpenMetrics exemplar suffix — turning
+        tracing on must never break an existing scrape."""
+        reg = MetricsRegistry(enabled=True)
+        reg.observe("serve/ttft", 0.8, exemplar="tr-1")
+        path = str(tmp_path / "m.prom")
+        export_prometheus(path, reg)
+        text = open(path).read()
+        assert "trace_id=" not in text and " # {" not in text
+        export_prometheus(path, reg, openmetrics=True)  # the opt-in
+        assert 'trace_id="tr-1"' in open(path).read()
+
+    def test_exemplar_id_sanitized_in_exposition(self):
+        """Caller-propagated trace ids are arbitrary strings; a quote
+        or brace must not corrupt the exposition or break the
+        round-trip."""
+        h = Histogram()
+        h.observe(0.8, exemplar='ab"cd}ef gh')
+        text = to_prometheus({"serve/ttft": h.to_snapshot()},
+                             openmetrics=True)
+        assert '"' not in text.split(' # {trace_id="', 1)[1] \
+            .split('"', 1)[1].split("}")[0]
+        parsed = parse_prometheus_text(text)
+        h2 = histogram_from_prometheus(parsed["serve_ttft"])
+        assert h2.count == 1
+        assert h2.bucket_counts() == h.bucket_counts()
+        assert h2.exemplar_for(99)[0] == "ab_cd_ef_gh"
+
+    def test_pre_exemplar_text_still_parses(self):
+        """Back-compat both directions: exemplar-free emission has no
+        suffix, and text from a pre-exemplar emitter parses cleanly."""
+        h = Histogram()
+        h.observe(0.01)
+        h.observe(0.8)
+        text = to_prometheus({"serve/ttft": h.to_snapshot()})
+        assert " # {" not in text           # no suffix when none held
+        # simulate pre-exemplar text by stripping any suffix form
+        legacy = "\n".join(l.split(" # ")[0]
+                           for l in text.splitlines()) + "\n"
+        h2 = histogram_from_prometheus(
+            parse_prometheus_text(legacy)["serve_ttft"])
+        assert h2.count == 2
+        assert h2.bucket_counts() == h.bucket_counts()
+        assert h2.exemplar_for(99) is None
+
+
+class TestAppendJsonl:
+    """The atomic JSONL append every report flushes through: one
+    O_APPEND write per line, so no crash — SIGKILL included — can
+    leave a torn last line."""
+
+    def test_appends_parseable_lines(self, tmp_path):
+        path = str(tmp_path / "x.jsonl")
+        M.append_jsonl(path, {"a": 1})
+        M.append_jsonl(path, {"b": [1, 2]})
+        lines = [json.loads(l) for l in open(path)]
+        assert lines == [{"a": 1}, {"b": [1, 2]}]
+
+    def test_sigkill_mid_stream_never_tears_a_line(self, tmp_path):
+        """The kill drill the satellite demands: a child appends fat
+        JSON lines in a tight loop, SIGKILL lands mid-stream, and
+        every line on disk still parses — the last one included."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import time as _time
+
+        path = str(tmp_path / "killed.jsonl")
+        metrics_py = os.path.abspath(M.__file__)
+        child_src = (
+            "import importlib.util, sys\n"
+            f"spec = importlib.util.spec_from_file_location("
+            f"'m', {metrics_py!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "pad = 'x' * 700\n"
+            "i = 0\n"
+            "while True:\n"
+            f"    m.append_jsonl({path!r}, "
+            "{'i': i, 'pad': pad})\n"
+            "    i += 1\n")
+        proc = subprocess.Popen([sys.executable, "-c", child_src])
+        try:
+            deadline = _time.monotonic() + 30
+            while _time.monotonic() < deadline:
+                if os.path.exists(path) \
+                        and os.path.getsize(path) > 50_000:
+                    break
+                _time.sleep(0.01)
+            assert os.path.exists(path), "child never wrote"
+            os.kill(proc.pid, signal.SIGKILL)
+        finally:
+            proc.wait(timeout=30)
+        raw = open(path, "rb").read()
+        assert len(raw) > 50_000
+        assert raw.endswith(b"\n"), "torn final line"
+        lines = raw.decode().splitlines()
+        parsed = [json.loads(l) for l in lines]     # every line whole
+        assert [p["i"] for p in parsed] == list(range(len(parsed)))
+
+    def test_goodput_report_uses_atomic_append(self, tmp_path):
+        # the write path is append_jsonl (single whole-line writes):
+        # pin by checking the file grows one complete line per call
+        rep = GoodputReport(write=True)
+
+        class FakeUpdater:
+            iteration = 3
+
+        class FakeTrainer:
+            out = str(tmp_path)
+            updater = FakeUpdater()
+            observation = {}
+
+        from chainermn_tpu.utils.telemetry import (
+            TraceRecorder,
+            set_recorder,
+        )
+
+        prev = set_recorder(TraceRecorder(enabled=True))
+        try:
+            rep.initialize()
+            rec = M.get_registry()
+            from chainermn_tpu.utils.telemetry import get_recorder
+
+            get_recorder().record("step/dispatch", 0.01)
+            rep(FakeTrainer())
+            rep(FakeTrainer())
+        finally:
+            set_recorder(prev)
+        lines = [json.loads(l)
+                 for l in open(tmp_path / "goodput.jsonl")]
+        assert len(lines) == 2
+        assert all("window_s" in l for l in lines)

@@ -1,0 +1,317 @@
+"""The statusz surface (utils/statusz.py): route behaviour over a live
+ephemeral-port server — health checks and the 503 flip, Prometheus
+text with exemplars on /metricsz, section rendering (including a
+raising section degrading to its error string), /tracez listing and
+trace-id resolution, env opt-in semantics.  Pure host-side: fake
+sections and real RequestTraceStore/MetricsRegistry, no jax."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from chainermn_tpu.utils.metrics import MetricsRegistry
+from chainermn_tpu.utils.statusz import StatuszServer, start_from_env
+from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    reg.inc("serve/submitted", 3)
+    reg.set("serve/queue_depth", 2)
+    reg.observe("serve/ttft", 0.25, exemplar="tr-slow")
+    return reg
+
+
+@pytest.fixture()
+def server(registry):
+    srv = StatuszServer(registry=registry)
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(srv.url(path), timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def _get_json(srv, path):
+    code, body = _get(srv, path)
+    return code, json.loads(body)
+
+
+class TestLifecycle:
+    def test_ephemeral_port_and_idempotent_start(self, server):
+        port = server.start()
+        assert port > 0
+        assert server.start() == port       # idempotent
+        code, doc = _get_json(server, "/healthz")
+        assert code == 200 and doc["status"] == "ok"
+        server.stop()
+        assert server.port is None
+
+    def test_unknown_route_404(self, server):
+        server.start()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/nope")
+        assert err.value.code == 404
+        assert "/statusz" in json.loads(err.value.read())["routes"]
+
+
+class TestHealthz:
+    def test_failing_check_flips_503(self, server):
+        state = {"ok": True}
+        server.add_health("engine", lambda: state["ok"])
+        server.start()
+        code, doc = _get_json(server, "/healthz")
+        assert code == 200 and doc["checks"]["engine"] == "ok"
+        state["ok"] = False
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/healthz")
+        assert err.value.code == 503
+        doc = json.loads(err.value.read())
+        assert doc["status"] == "unhealthy"
+        assert doc["checks"]["engine"] == "failing"
+
+    def test_raising_check_is_unhealthy_with_detail(self, server):
+        def boom():
+            raise RuntimeError("dead device")
+
+        server.add_health("device", boom)
+        server.start()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/healthz")
+        assert err.value.code == 503
+        doc = json.loads(err.value.read())
+        assert "dead device" in doc["checks"]["device"]
+
+
+class TestMetricsz:
+    def test_prometheus_text_with_exemplars(self, server):
+        """Exemplar suffixes are OpenMetrics grammar: a plain scrape
+        gets clean 0.0.4 text (a classic parser must never see the
+        suffix); ``?exemplars=1`` (or an openmetrics Accept header)
+        negotiates them in."""
+        server.start()
+        code, text = _get(server, "/metricsz")
+        assert code == 200
+        assert "# TYPE serve_submitted counter" in text
+        assert "serve_submitted 3" in text
+        assert "trace_id=" not in text      # classic text stays clean
+        code, text = _get(server, "/metricsz?exemplars=1")
+        assert code == 200
+        # the exemplar link rides the negotiated scrape — in the full
+        # OpenMetrics dialect (counter samples under _total, EOF)
+        assert 'trace_id="tr-slow"' in text
+        assert "serve_submitted_total 3" in text
+        assert text.endswith("# EOF\n")
+        # round-trips through the stack's own parser
+        from chainermn_tpu.utils.metrics import parse_prometheus_text
+
+        parsed = parse_prometheus_text(text)
+        assert parsed["serve_submitted"]["value"] == 3.0
+        assert any(e[0] == "tr-slow" for e in
+                   parsed["serve_ttft"]["exemplars"].values())
+        # a real scraper negotiates via the Accept header
+        req = urllib.request.Request(
+            server.url("/metricsz"),
+            headers={"Accept": "application/openmetrics-text; "
+                               "version=1.0.0"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert "openmetrics" in r.headers["Content-Type"]
+            assert 'trace_id="tr-slow"' in r.read().decode()
+
+
+class TestStatusz:
+    def test_sections_counters_and_broken_section(self, server):
+        server.add_section("fake", lambda: {"depth": 7})
+
+        class WithStatus:
+            def status(self):
+                return {"epoch": 3}
+
+            def __call__(self):     # trainer-extension shape: .status
+                raise AssertionError("must prefer .status()")
+
+        server.add_section("resize", WithStatus())
+
+        def broken():
+            raise RuntimeError("section down")
+
+        server.add_section("bad", broken)
+        server.start()
+        code, doc = _get_json(server, "/statusz")
+        assert code == 200
+        assert doc["sections"]["fake"] == {"depth": 7}
+        assert doc["sections"]["resize"] == {"epoch": 3}
+        assert "section down" in doc["sections"]["bad"]["error"]
+        # the counter/gauge digest (plan-cache stats, goodput ride here)
+        assert doc["counters"]["serve/submitted"] == 3.0
+        assert doc["counters"]["serve/queue_depth"] == 2.0
+        assert doc["metrics_enabled"] is True
+
+    def test_bad_section_source_rejected(self, server):
+        with pytest.raises(TypeError):
+            server.add_section("x", object())
+
+    def test_alerts_section_from_installed_manager(self, registry):
+        from chainermn_tpu.utils.alerts import (
+            AlertManager,
+            RatioRule,
+            install,
+        )
+
+        rule = RatioRule("burn", bad="b", total="t", budget=0.01,
+                         windows=((60.0, 5.0, 10.0),))
+        mgr = AlertManager([rule], registry=registry)
+        mgr.tick(1.0)
+        prev = install(mgr)
+        srv = StatuszServer(registry=registry)
+        try:
+            srv.start()
+            _, doc = _get_json(srv, "/statusz")
+            assert doc["alerts"]["rules"]["burn"]["state"] == "ok"
+        finally:
+            srv.stop()
+            install(prev)
+
+
+class TestTracez:
+    def _store(self):
+        store = RequestTraceStore(capacity=8, sample_rate=0.0)
+        store.offer({"trace_id": "t-slow", "rid": "r1",
+                     "status": "timeout", "e2e": 1.5,
+                     "spans": [{"name": "prefill", "t0": 0.0,
+                                "dur": 0.1}]})
+        return store
+
+    def test_listing_and_resolution(self, server):
+        store = self._store()
+        server.add_traces(store)
+        server.start()
+        code, doc = _get_json(server, "/tracez")
+        assert code == 200
+        assert doc["stores"][0]["retained"] == 1
+        assert doc["traces"][0]["trace_id"] == "t-slow"
+        assert doc["traces"][0]["status"] == "timeout"
+        code, doc = _get_json(server, "/tracez?trace_id=t-slow")
+        assert doc["trace"]["spans"][0]["name"] == "prefill"
+        # the Perfetto form of one trace
+        code, doc = _get_json(server, "/tracez?trace_id=t-slow&chrome=1")
+        assert any(ev.get("name") == "prefill"
+                   for ev in doc["traceEvents"])
+
+    def test_unknown_trace_404(self, server):
+        server.add_traces(self._store())
+        server.start()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/tracez?trace_id=missing")
+        assert err.value.code == 404
+
+    def test_store_installed_after_attach_is_served(self, server,
+                                                    registry):
+        """attach_engine resolves the trace store per request: boot
+        the server with tracing off, enable tracing mid-incident, and
+        /tracez serves the new store without re-attaching."""
+        class FakeEngine:
+            traces = None
+
+            def stats(self):
+                return {"queue_depth": 0}
+
+            n_active = 0
+
+        eng = FakeEngine()
+        server.attach_engine(eng)
+        server.start()
+        _, doc = _get_json(server, "/tracez")
+        assert doc["stores"] == [] and doc["traces"] == []
+        eng.traces = self._store()          # tracing turned on LATE
+        _, doc = _get_json(server, "/tracez")
+        assert doc["traces"][0]["trace_id"] == "t-slow"
+        _, doc = _get_json(server, "/tracez?trace_id=t-slow")
+        assert doc["trace"]["spans"][0]["name"] == "prefill"
+
+    def test_summaries_newest_first(self, server):
+        store = RequestTraceStore(capacity=8, sample_rate=0.0)
+        for i in range(3):
+            store.offer({"trace_id": f"t-{i}", "rid": f"r{i}",
+                         "status": "timeout", "e2e": 0.1 * i,
+                         "spans": []})
+        server.add_traces(store)
+        server.start()
+        _, doc = _get_json(server, "/tracez")
+        assert [t["trace_id"] for t in doc["traces"]] \
+            == ["t-2", "t-1", "t-0"]
+
+    def test_chrome_merges_every_store(self, server):
+        a = RequestTraceStore(capacity=4, sample_rate=0.0)
+        a.offer({"trace_id": "t-a", "rid": "ra", "status": "timeout",
+                 "spans": [{"name": "prefill", "t0": 0.0, "dur": 0.1}]})
+        b = RequestTraceStore(capacity=4, sample_rate=0.0)
+        b.offer({"trace_id": "t-b", "rid": "rb", "status": "timeout",
+                 "spans": [{"name": "evict", "t0": 0.2, "dur": 0.1}]})
+        server.add_traces(a)
+        server.add_traces(b)
+        server.start()
+        _, doc = _get_json(server, "/tracez?chrome=1")
+        names = {ev.get("name") for ev in doc["traceEvents"]}
+        assert {"prefill", "evict"} <= names
+        # lanes stay distinct: no (pid, tid) pair carries spans from
+        # both stores
+        lanes = {}
+        for ev in doc["traceEvents"]:
+            if ev.get("cat") == "request":
+                lanes.setdefault(
+                    (ev["pid"], ev["tid"]),
+                    set()).add(ev["args"]["trace_id"])
+        assert all(len(ids) == 1 for ids in lanes.values())
+
+
+class TestEnvOptIn:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("CHAINERMN_TPU_STATUSZ", raising=False)
+        assert start_from_env() is None
+        monkeypatch.setenv("CHAINERMN_TPU_STATUSZ", "0")
+        assert start_from_env() is None
+
+    def test_auto_binds_ephemeral(self, monkeypatch, registry):
+        monkeypatch.setenv("CHAINERMN_TPU_STATUSZ", "1")
+        srv = start_from_env(registry=registry)
+        try:
+            assert srv is not None and srv.port > 0
+            code, _ = _get(srv, "/healthz")
+            assert code == 200
+        finally:
+            srv.stop()
+
+    def test_typod_knob_degrades_to_ephemeral(self, monkeypatch,
+                                              registry):
+        """The typo'd-knob-degrades discipline: a non-integer,
+        out-of-range, or already-bound port value still serves
+        (ephemeral) instead of crashing the job."""
+        for bad in ("true", "99999", "-5"):
+            monkeypatch.setenv("CHAINERMN_TPU_STATUSZ", bad)
+            srv = start_from_env(registry=registry)
+            try:
+                assert srv is not None and srv.port > 0, bad
+            finally:
+                srv.stop()
+
+    def test_taken_port_degrades_to_ephemeral(self, monkeypatch,
+                                              registry):
+        holder = StatuszServer(registry=registry)
+        holder.start()
+        try:
+            monkeypatch.setenv("CHAINERMN_TPU_STATUSZ",
+                               str(holder.port))
+            srv = start_from_env(registry=registry)
+            try:
+                assert srv is not None
+                assert srv.port not in (0, holder.port)
+            finally:
+                srv.stop()
+        finally:
+            holder.stop()

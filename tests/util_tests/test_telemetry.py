@@ -653,3 +653,116 @@ class TestMergeTraceDiscovery:
             merge_traces(str(tmp_path / "rnk*.json"))
         with pytest.raises(FileNotFoundError, match="no trace shards"):
             merge_traces(str(tmp_path / "does-not-exist"))
+
+
+class TestRequestTraceStore:
+    """PR 13: tail-based retention of per-request causal traces — the
+    trace half of the exemplar link."""
+
+    def _trace(self, tid, status="ok", e2e=0.05, spans=None):
+        return {"trace_id": tid, "rid": f"r-{tid}", "status": status,
+                "e2e": e2e,
+                "spans": spans if spans is not None else
+                [{"name": "prefill", "t0": 0.0, "dur": 0.01}]}
+
+    def test_non_ok_always_kept_ok_dropped_at_rate_zero(self):
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        store = RequestTraceStore(capacity=16, sample_rate=0.0)
+        assert store.offer(self._trace("a", status="timeout"))
+        assert store.offer(self._trace("b", status="shed"))
+        assert not store.offer(self._trace("c", status="ok"))
+        assert store.get("a")["status"] == "timeout"
+        assert store.get("c") is None
+        assert store.snapshot()["offered"] == 3
+        assert store.snapshot()["kept"] == 2
+
+    def test_slo_violating_ok_kept(self):
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        store = RequestTraceStore(capacity=16, sample_rate=0.0,
+                                  slo_e2e=0.1)
+        assert store.offer(self._trace("slow", e2e=0.5))
+        assert not store.offer(self._trace("fast", e2e=0.05))
+        tr = store.get("slow")
+        assert tr["slo_violated"] is True
+
+    def test_sampling_is_deterministic_and_near_rate(self):
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        store = RequestTraceStore(capacity=4096, sample_rate=0.3)
+        ids = [f"trace-{i}" for i in range(2000)]
+        picks = [store.would_sample(t) for t in ids]
+        assert picks == [store.would_sample(t) for t in ids]  # stable
+        frac = sum(picks) / len(picks)
+        assert 0.25 < frac < 0.35
+        # rate 1.0 keeps everything, 0.0 nothing
+        assert RequestTraceStore(sample_rate=1.0).would_sample("x")
+        assert not RequestTraceStore(sample_rate=0.0).would_sample("x")
+
+    def test_capacity_bound_drops_oldest(self):
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        store = RequestTraceStore(capacity=3, sample_rate=0.0)
+        for i in range(5):
+            store.offer(self._trace(f"t{i}", status="timeout"))
+        assert len(store) == 3
+        assert store.get("t0") is None and store.get("t1") is None
+        assert [t["trace_id"] for t in store.traces()] \
+            == ["t2", "t3", "t4"]
+        assert [t["trace_id"] for t in store.traces(2)] == ["t3", "t4"]
+
+    def test_chrome_export_merges_with_recorder_shards(self, tmp_path):
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        store = RequestTraceStore(capacity=8, sample_rate=0.0, rank=0)
+        store.offer(self._trace(
+            "victim", status="timeout",
+            spans=[{"name": "prefill", "t0": 1.0, "dur": 0.02},
+                   {"name": "decode_round", "t0": 1.1, "dur": 0.01},
+                   {"name": "timeout", "t0": 1.2, "dur": 0.0}]))
+        doc = store.to_chrome()
+        names = [e.get("name") for e in doc["traceEvents"]]
+        assert {"prefill", "decode_round", "timeout"} <= set(names)
+        # every span event carries its trace id for Perfetto search
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert all(e["args"]["trace_id"] == "victim" for e in spans)
+        # merge-compatible with a recorder shard: one fused document
+        rec = TraceRecorder(enabled=True, rank=0)
+        with rec.span("serve/decode_round", cat="serve"):
+            pass
+        p1 = str(tmp_path / "engine.json")
+        p2 = str(tmp_path / "requests.json")
+        rec.export_chrome(p1)
+        store.export_chrome(p2)
+        merged = merge_traces([p1, p2])
+        merged_names = [e.get("name") for e in merged["traceEvents"]]
+        assert "serve/decode_round" in merged_names
+        assert "timeout" in merged_names
+        # same-rank shards get distinct pid lanes (no overlay)
+        pid_shifts = [m["pid_shift"]
+                      for m in merged["metadata"]["merged_from"]]
+        assert pid_shifts[1] > 0
+
+    def test_single_trace_chrome_export(self):
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        store = RequestTraceStore(capacity=8, sample_rate=0.0)
+        store.offer(self._trace("a", status="timeout"))
+        store.offer(self._trace("b", status="timeout"))
+        doc = store.to_chrome("a")
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(spans) == 1
+        assert doc["metadata"]["request_traces"] == 1
+        # an exemplar can outlive its trace (capacity eviction):
+        # the export degrades to an empty document, never raises
+        doc = store.to_chrome("evicted-id")
+        assert doc["metadata"]["request_traces"] == 0
+
+    def test_validation(self):
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        with pytest.raises(ValueError):
+            RequestTraceStore(capacity=0)
+        with pytest.raises(ValueError):
+            RequestTraceStore(sample_rate=1.5)
