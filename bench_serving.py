@@ -29,6 +29,25 @@ machinery under test is identical).  Prints ONE JSON line {"metric",
 "value", "unit", "vs_baseline", ...}: value = continuous/static
 tokens-per-sec ratio (unit "x", >1 means continuous batching wins).
 Same hermetic child-process pattern as bench.py.
+
+**Decode-tier arms** (ISSUE 14; ``--decode-tier 0`` skips them) ride
+the same record:
+
+- *prefix-share*: a shared-system-prompt trace staged with prefix
+  sharing ON vs OFF — same engine, same programs, sharing is the only
+  difference; token identity between the modes is verified
+  per-request.  Reported: prefill-time ratio, row-held peak pool
+  blocks both ways, and the trie hit rate (also surfaced as an
+  ``SLOReport`` extras column).
+- *sampled*: the trace under per-request keyed temperature/top-k/top-p
+  — tokens/s plus a full second run asserting bit-identical keyed
+  replay.
+- *speculative*: MiniLM draft/verify vs target-only decode (single
+  device; CPU is compute-bound, so this is the MACHINERY-COST floor —
+  the HBM win needs hardware; bench_decode's lever table tells that
+  story).  Reported: tokens/s both ways, their ratio, and the
+  acceptance rate for a cheap random draft and the self-draft
+  ceiling.
 """
 
 import argparse
@@ -113,6 +132,195 @@ def _arm_stats(arm, completions, makespan):
     }
 
 
+def _prefix_arm(engine, args, rng):
+    """Prefix sharing ON vs OFF over a shared-system-prompt trace."""
+    import numpy as np
+
+    from chainermn_tpu.serving import SLOReport
+
+    n_shared = min(args.shared_prefix, args.max_prompt - 1)
+    shared = rng.randint(0, args.vocab, n_shared)
+    # the system-prompt workload: every prompt opens with the shared
+    # prefix; every third request is an exact repeat of one FULL
+    # (block-aligned) prompt — retry/dedup traffic, the full-hit case
+    # where sharing skips the prefill dispatch entirely
+    repeat = np.concatenate(
+        [shared, rng.randint(0, args.vocab,
+                             args.max_prompt - n_shared)]) \
+        .astype(np.int32)
+    trace = []
+    for i in range(args.prefix_requests):
+        if i and i % 3 == 0:
+            p = repeat
+        else:
+            extra = rng.randint(1, args.max_prompt - n_shared + 1)
+            p = np.concatenate(
+                [shared, rng.randint(0, args.vocab, extra)]) \
+                .astype(np.int32)
+        trace.append((p, int(rng.randint(args.min_new,
+                                         args.max_new // 2 + 1))))
+    out = {}
+    tokens_by_mode = {}
+    for mode in (True, False):
+        engine.prefix_sharing = mode
+        # warm pass compiles the per-split suffix programs; then
+        # best-of-rounds over the measured passes (the same
+        # scheduler-noise rejection the headline arms use)
+        for measured in (0, 1, 2):
+            engine.reset()
+            for p, n in trace:
+                engine.submit(p, max_new=n)
+            t0 = time.perf_counter()
+            comps = engine.run(max_steps=20000)
+            makespan = time.perf_counter() - t0
+            if not measured:
+                continue
+            s = engine.stats()
+            tokens = sum(c.n_generated for c in comps)
+            key = "share" if mode else "private"
+            if measured == 1 or s["prefill_seconds"] < \
+                    out[f"prefix_{key}_prefill_s"]:
+                out[f"prefix_{key}_prefill_s"] = round(
+                    s["prefill_seconds"], 4)
+                out[f"prefix_{key}_tokens_per_sec"] = round(
+                    tokens / makespan, 1)
+            tokens_by_mode[mode] = {
+                c.rid: np.asarray(c.tokens) for c in comps}
+            out[f"prefix_{key}_peak_row_blocks"] = s["peak_row_blocks"]
+            out[f"prefix_{key}_peak_staged"] = s["peak_staged"]
+            # pool pressure PER STAGED REQUEST — the sharing drop is
+            # ~P_shared/P; at a saturated pool the absolute peak
+            # instead converts into more requests staged ahead
+            out[f"prefix_{key}_blocks_per_staged"] = round(
+                s["peak_row_blocks"] / max(s["peak_staged"], 1), 3)
+            if mode:
+                out["prefix_hit_rate"] = round(s["prefix_hit_rate"], 4)
+                # the dashboard form: hit rate as an SLOReport extras
+                # column next to the latency percentiles
+                slo = SLOReport(percentiles=(50, 99)).add_arm(
+                    "prefix-share", engine.request_records(),
+                    extras={"prefix_hit_rate": s["prefix_hit_rate"]})
+                assert slo.summary()["prefix-share"]["extras"][
+                    "prefix_hit_rate"] == s["prefix_hit_rate"]
+    engine.prefix_sharing = True
+    engine.reset()
+    out["prefix_prefill_speedup"] = round(
+        out["prefix_private_prefill_s"]
+        / max(out["prefix_share_prefill_s"], 1e-9), 3)
+    out["prefix_pool_pressure_drop"] = round(
+        out["prefix_private_blocks_per_staged"]
+        / max(out["prefix_share_blocks_per_staged"], 1e-9), 3)
+    out["prefix_token_identity_mismatches"] = sum(
+        not np.array_equal(tokens_by_mode[True][r],
+                           tokens_by_mode[False][r])
+        for r in tokens_by_mode[True])
+    return out
+
+
+def _sampled_arm(engine, args, rng):
+    """Keyed sampling throughput + bit-identical replay."""
+    import numpy as np
+
+    from chainermn_tpu.serving import SamplingParams
+
+    trace = [(rng.randint(0, args.vocab,
+                          rng.randint(args.min_prompt,
+                                      args.max_prompt + 1)),
+              int(rng.randint(args.min_new, args.max_new // 2 + 1)))
+             for _ in range(args.prefix_requests)]
+    sps = [SamplingParams(temperature=0.8, top_k=min(32, args.vocab),
+                          top_p=0.95, seed=1000 + i)
+           for i in range(len(trace))]
+    runs = []
+    makespans = []
+    for _ in range(2):
+        engine.reset()
+        for (p, n), sp in zip(trace, sps):
+            engine.submit(p, max_new=n, sampling=sp)
+        t0 = time.perf_counter()
+        comps = engine.run(max_steps=20000)
+        makespans.append(time.perf_counter() - t0)
+        runs.append({c.rid: np.asarray(c.tokens) for c in comps})
+    tokens = sum(t.shape[0] for t in runs[1].values())
+    return {
+        "sampled_tokens_per_sec": round(tokens / min(makespans), 1),
+        "sampled_replay_mismatches": sum(
+            not np.array_equal(runs[0][r], runs[1][r])
+            for r in runs[0]),
+    }
+
+
+def _spec_arm(args, rng):
+    """Draft/verify speculative decode vs target-only, single device
+    (the machinery-cost floor on a compute-bound CPU)."""
+    import jax
+    import numpy as np
+
+    from chainermn_tpu.parallel import MeshConfig
+    from chainermn_tpu.serving import (
+        MiniLMAdapter, MiniLMConfig, SpeculativeDecoder, init_minilm,
+    )
+
+    # the decoder's own position span, NOT the serving engine's
+    # horizon — a clamped position table would silently degrade the
+    # model both arms run on
+    max_pos = args.max_prompt + args.spec_new + args.spec_k + 2
+    t_cfg = MiniLMConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.heads, d_head=args.d_model // args.heads,
+        d_ff=2 * args.d_model, n_layers=args.n_layers,
+        max_pos=max_pos)
+    d_cfg = MiniLMConfig(
+        vocab_size=args.vocab, d_model=max(args.d_model // 4, 8),
+        n_heads=2, d_head=max(args.d_model // 8, 4),
+        d_ff=args.d_model // 2, n_layers=1,
+        max_pos=max_pos)
+    mc = MeshConfig(data=1, devices=jax.devices()[:1])
+    t_params = init_minilm(jax.random.PRNGKey(0), t_cfg)
+    d_params = init_minilm(jax.random.PRNGKey(1), d_cfg)
+    target = MiniLMAdapter(mc, t_cfg)
+    prompts = [rng.randint(0, args.vocab,
+                           rng.randint(args.min_prompt,
+                                       args.max_prompt + 1))
+               for _ in range(args.spec_prompts)]
+    out = {}
+    for name, (da, dp) in (
+            ("spec", (MiniLMAdapter(mc, d_cfg), d_params)),
+            ("spec_selfdraft", (target, t_params))):
+        dec = SpeculativeDecoder(
+            da, dp, target, t_params, k=args.spec_k,
+            max_prompt=args.max_prompt,
+            horizon=args.max_prompt + args.spec_new)
+        dec.generate(prompts[0], 4)            # compile both paths
+        dec.target_decode(prompts[0], 4)
+        drafted = accepted = 0
+        t0 = time.perf_counter()
+        spec_tokens = []
+        for p in prompts:
+            res = dec.generate(p, args.spec_new)
+            spec_tokens.append(res.tokens)
+            drafted += res.drafted
+            accepted += res.accepted
+        t_spec = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ref_tokens = [dec.target_decode(p, args.spec_new)
+                      for p in prompts]
+        t_ref = time.perf_counter() - t0
+        n_tok = sum(t.shape[0] for t in spec_tokens)
+        out[f"{name}_tokens_per_sec"] = round(n_tok / t_spec, 1)
+        out[f"{name}_acceptance_rate"] = round(
+            accepted / max(drafted, 1), 4)
+        out[f"{name}_vs_target_only"] = round(
+            (n_tok / t_spec) / (n_tok / t_ref), 3)
+        out[f"{name}_identity_mismatches"] = sum(
+            not np.array_equal(a, b)
+            for a, b in zip(spec_tokens, ref_tokens))
+    out["spec_target_tokens_per_sec"] = round(
+        sum(t.shape[0] for t in ref_tokens) / t_ref, 1)
+    out["spec_k"] = args.spec_k
+    return out
+
+
 def run(args):
     import jax
     import numpy as np
@@ -183,9 +391,22 @@ def run(args):
                            per_arm_tokens["static"][r])
         for r in per_arm_tokens["continuous"])
 
+    extra = {}
+    if args.decode_tier:
+        # the headline loop leaves whichever arm ran LAST on the
+        # engine — the decode-tier arms measure CONTINUOUS batching
+        engine.gang = False
+        extra.update(_prefix_arm(engine, args,
+                                 np.random.RandomState(args.seed + 1)))
+        extra.update(_sampled_arm(engine, args,
+                                  np.random.RandomState(args.seed + 2)))
+        extra.update(_spec_arm(args,
+                               np.random.RandomState(args.seed + 3)))
+
     ratio = arms["continuous"]["tokens_per_sec"] \
         / arms["static"]["tokens_per_sec"]
     return {
+        **extra,
         "metric": METRIC,
         "value": round(ratio, 3),
         "unit": UNIT,
@@ -267,6 +488,19 @@ def main(argv):
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--decode-tier", type=int, default=1,
+                   help="run the ISSUE 14 arms (prefix-share, "
+                        "sampled, speculative); 0 skips them")
+    p.add_argument("--prefix-requests", type=int, default=24,
+                   help="requests in the shared-prefix and sampled "
+                        "arms")
+    p.add_argument("--shared-prefix", type=int, default=16,
+                   help="tokens of common system prompt in the "
+                        "prefix-share arm (block-aligned shares best)")
+    p.add_argument("--spec-k", type=int, default=4)
+    p.add_argument("--spec-prompts", type=int, default=6)
+    p.add_argument("--spec-new", type=int, default=48,
+                   help="tokens per prompt in the speculative arm")
     p.add_argument("--rounds", type=int, default=3,
                    help="interleaved replay rounds per arm (best round "
                         "counts — scheduler-noise rejection)")
@@ -285,7 +519,9 @@ def main(argv):
     for name in ("requests", "slots", "horizon", "block", "max_prompt",
                  "min_prompt", "min_new", "max_new", "round_tokens",
                  "vocab", "d_model", "heads", "n_layers", "seed",
-                 "rounds", "devices"):
+                 "rounds", "devices", "decode_tier", "prefix_requests",
+                 "shared_prefix", "spec_k", "spec_prompts",
+                 "spec_new"):
         cmd += [f"--{name.replace('_', '-')}",
                 str(getattr(args, name))]
     cmd += ["--arrival-ms", str(args.arrival_ms)]

@@ -1,21 +1,33 @@
 """Serving subsystem: slot-based continuous batching over a
-block-paged KV cache, with SLO-driven admission control.
+block-paged KV cache, with SLO-driven admission control and a
+production decode tier (prefix sharing, keyed sampling, speculative
+decoding).
 
 ``engine`` schedules requests onto decode slots (queue, admission into
 freed slots mid-stream, per-row EOS eviction, FCFS/shortest-prompt/
-deadline policies, per-request deadlines + ``cancel()``, decode-round
-quarantine); ``admission`` supplies the overload layer (service-time
-prediction from the live TTFT/TPOT lattice histograms, bounded queue
-with priority displacement, per-tenant token quotas, reason-coded
-``ShedCompletion`` fast rejects); ``kv_blocks`` supplies the paging
-layer (free-list block allocator, prefill-to-pool scatter,
-copy-on-admit gather, horizon rebase) that keeps the decode step one
-compiled program over the dense static cache; ``slo`` scores request
-records (percentiles + SLO attainment/goodput); ``minilm`` is the
-portable reference decode backend (and adapter-protocol example) —
-the flagship transformer rides the same engine through
-:class:`TransformerAdapter`.  See docs/SERVING.md ("Serving at
-scale", "Overload and admission"), ``bench_serving.py`` and
+deadline/WFQ policies, per-request deadlines + ``cancel()``,
+decode-round quarantine); ``admission`` supplies the overload layer
+(service-time prediction from the live TTFT/TPOT lattice histograms,
+bounded queue with priority displacement, per-tenant token quotas with
+deficit-round-robin WFQ scheduling, reason-coded ``ShedCompletion``
+fast rejects); ``kv_blocks`` supplies the paging layer (free-list
+block allocator, prefill-to-pool scatter, copy-on-admit gather,
+horizon rebase) that keeps the decode step one compiled program over
+the dense static cache; ``prefix_cache`` adds copy-on-write prefix
+sharing over it (refcounted blocks, a prefix trie keyed by token-id
+chunks — N requests sharing a system prompt hold ONE physical copy
+and stage only their divergent suffix); ``sampling`` threads
+per-request keyed temperature/top-k/top-p streams through the decode
+round (greedy stays the byte-identical exactness oracle, sampled runs
+pin by keyed replay); ``speculative`` drafts k tokens with a cheap
+adapter and verifies them in one target pass (greedy output exactly
+the target-only decode); ``slo`` scores request records (percentiles
++ SLO attainment/goodput + extra columns like acceptance/hit rates);
+``minilm`` is the portable reference decode backend (and
+adapter-protocol example) — the flagship transformer rides the same
+engine through :class:`TransformerAdapter`.  See docs/SERVING.md
+("Serving at scale", "Overload and admission", "Prefix sharing",
+"Sampling", "Speculative serving"), ``bench_serving.py`` and
 ``bench_overload.py``.
 """
 
@@ -28,7 +40,10 @@ from .admission import (
 from .engine import Completion, Request, ServingEngine, TransformerAdapter
 from .kv_blocks import BlockAllocator, blocks_needed
 from .minilm import MiniLMAdapter, MiniLMConfig, init_minilm
+from .prefix_cache import PrefixTrie, RefcountedBlockPool, StagePlan
+from .sampling import SamplingParams
 from .slo import SLOReport
+from .speculative import SpecResult, SpeculativeDecoder
 
 __all__ = [
     "AdmissionController",
@@ -36,12 +51,18 @@ __all__ = [
     "Completion",
     "MiniLMAdapter",
     "MiniLMConfig",
+    "PrefixTrie",
+    "RefcountedBlockPool",
     "Request",
     "SHED_REASONS",
     "SLOReport",
+    "SamplingParams",
     "ServiceTimePredictor",
     "ServingEngine",
     "ShedCompletion",
+    "SpecResult",
+    "SpeculativeDecoder",
+    "StagePlan",
     "TransformerAdapter",
     "blocks_needed",
     "init_minilm",

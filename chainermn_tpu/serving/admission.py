@@ -91,11 +91,13 @@ class ShedCompletion:
     priority: int = 0
     tenant: Optional[str] = None
     detail: str = ""
-    # Predicted seconds until the backlog that caused this shed drains
-    # (the retry-after header a front-end should quote).  Populated for
-    # CAPACITY sheds — queue_full and drain-mode — from the predictor's
-    # queue-drain estimate; ``None`` while the predictor is cold, and
-    # for reasons where retrying is pointless (deadline, stale_epoch).
+    # Predicted seconds until the condition that caused this shed
+    # clears (the retry-after header a front-end should quote).
+    # Populated for CAPACITY sheds — queue_full and drain-mode from
+    # the predictor's queue-drain estimate, over_quota from the
+    # TENANT's predicted in-flight drain — ``None`` while the
+    # predictor is cold, and for reasons where retrying is pointless
+    # (deadline, stale_epoch).
     retry_after: Optional[float] = None
     # The request's causal-trace identity (engine-generated or caller-
     # propagated) — resolves against the engine's RequestTraceStore,
@@ -293,9 +295,23 @@ class AdmissionController:
                  predictor: Optional[ServiceTimePredictor] = None,
                  shed_on_deadline: bool = True,
                  alert_advisor=None, protect_priority: int = 0,
-                 overload_retry_after: Optional[float] = None):
+                 overload_retry_after: Optional[float] = None,
+                 tenant_weights: Optional[Dict[Optional[str],
+                                               float]] = None,
+                 default_weight: float = 1.0,
+                 wfq_quantum: Optional[float] = None):
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue={max_queue} must be >= 1")
+        for t, w in (tenant_weights or {}).items():
+            if w <= 0:
+                raise ValueError(
+                    f"weight for tenant {t!r} must be > 0, got {w}")
+        if default_weight <= 0:
+            raise ValueError(
+                f"default_weight={default_weight} must be > 0")
+        if wfq_quantum is not None and wfq_quantum <= 0:
+            raise ValueError(
+                f"wfq_quantum={wfq_quantum} must be > 0")
         if overload_retry_after is not None \
                 and overload_retry_after <= 0:
             raise ValueError(
@@ -323,9 +339,97 @@ class AdmissionController:
         #: e.g. the protect rules' short-window length; ``None`` = no
         #: hint (clients apply their own backoff).
         self.overload_retry_after = overload_retry_after
+        self.tenant_weights = dict(tenant_weights or {})
+        self.default_weight = float(default_weight)
+        self.wfq_quantum = wfq_quantum
+        # deficit-round-robin state (the engine's "wfq" policy):
+        # per-tenant token credit, whose turn the rotation is on, and
+        # whether that turn's quantum was already granted
+        self._wfq_deficit: Dict[Optional[str], float] = {}
+        self._wfq_turn: Optional[str] = None
+        self._wfq_in_turn: Dict[Optional[str], bool] = {}
 
     def quota_for(self, tenant: Optional[str]) -> Optional[float]:
         return self.quotas.get(tenant, self.default_quota)
+
+    def weight_for(self, tenant: Optional[str]) -> float:
+        return self.tenant_weights.get(tenant, self.default_weight)
+
+    def wfq_pick(self, queue: Sequence):
+        """Deficit-round-robin tenant scheduling (the engine's
+        ``policy="wfq"``): within the most important priority class
+        present, tenants take turns accruing token credit
+        (``quantum × weight`` per lap of the rotation) and a tenant's
+        head-of-line request admits once its credit covers the
+        request's ``max_new`` cost — so a tenant with weight 2 is
+        served about twice the TOKENS of a weight-1 tenant, a flood
+        from one tenant cannot starve another (every lap credits
+        everyone — starvation-freedom is structural), and within a
+        tenant order stays FCFS.
+
+        Quotas bound how much of a tenant can be IN FLIGHT; WFQ
+        decides who goes NEXT — the scheduling half the ROADMAP's
+        admission item called out as missing.  The quantum defaults
+        to the largest head-of-line cost so every lap can serve at
+        least one request (no busy idling); state (deficits, whose
+        turn) persists across picks and resets only for tenants with
+        NOTHING queued in any class, the classic DRR contract.
+        Deterministic: ties break by the rotation, which follows
+        first-arrival order.
+
+        The pick does NOT debit the winner's credit — an admission
+        can still fail downstream (pool full, horizon full) with the
+        request left queued, and charging per attempt would skew the
+        weighted shares.  The engine settles the cost at SUCCESSFUL
+        admission via :meth:`wfq_charge`; a retried pick meanwhile
+        re-selects the same tenant (its credit still covers the same
+        head) without granting fresh quanta."""
+        if not queue:
+            raise ValueError("wfq_pick on an empty queue")
+        cls = min(r.priority for r in queue)
+        queued_tenants = {r.tenant for r in queue}
+        heads: Dict[Optional[str], object] = {}
+        for r in queue:
+            if r.priority == cls and r.tenant not in heads:
+                heads[r.tenant] = r
+        ring = list(heads)
+        # classic DRR: a flow that EMPTIES loses its deficit — judged
+        # against the whole queue, not this class's heads, so a
+        # transient high-priority arrival cannot zero waiting
+        # lower-class tenants' accrued credit
+        self._wfq_deficit = {t: d for t, d in self._wfq_deficit.items()
+                             if t in queued_tenants}
+        self._wfq_in_turn = {t: v for t, v in self._wfq_in_turn.items()
+                             if t in queued_tenants}
+        quantum = self.wfq_quantum or max(
+            float(h.max_new) for h in heads.values())
+        idx = ring.index(self._wfq_turn) if self._wfq_turn in ring \
+            else 0
+        min_w = min(self.weight_for(t) for t in ring)
+        max_cost = max(float(h.max_new) for h in heads.values())
+        laps = int(max_cost / max(quantum * min_w, 1e-9)) + 2
+        for _ in range(laps * len(ring) + 1):
+            t = ring[idx]
+            if not self._wfq_in_turn.get(t, False):
+                self._wfq_deficit[t] = (self._wfq_deficit.get(t, 0.0)
+                                        + quantum * self.weight_for(t))
+                self._wfq_in_turn[t] = True
+            head = heads[t]
+            if self._wfq_deficit[t] >= head.max_new:
+                self._wfq_turn = t
+                return head
+            self._wfq_in_turn[t] = False
+            idx = (idx + 1) % len(ring)
+        return heads[ring[0]]      # unreachable: laps bound the credit
+
+    def wfq_charge(self, req) -> None:
+        """Settle a served pick's cost against its tenant's DRR
+        credit — called by the engine at SUCCESSFUL admission (the
+        pick itself never debits; see :meth:`wfq_pick`).  No-op for
+        tenants without DRR state (non-WFQ policies admit through the
+        same path)."""
+        if req.tenant in self._wfq_deficit:
+            self._wfq_deficit[req.tenant] -= float(req.max_new)
 
     def protective(self) -> bool:
         """Whether the alert advisory currently calls for protective

@@ -25,13 +25,20 @@ replaces the batch with SLOTS:
   shift when it nears the horizon) keeps the buffer static forever.
 
 The engine is MODEL-AGNOSTIC: a decode adapter supplies
-``make_cache`` / ``prefill`` / ``step`` plus sharding specs (see
+``make_cache`` / ``prefill`` / ``step`` (plus ``verify`` for the
+chunk-attends-cache paths) and sharding specs (see
 :class:`~chainermn_tpu.serving.minilm.MiniLMAdapter` for the protocol
 example and :class:`TransformerAdapter` for the flagship).  Decoding
-is greedy — which is what makes the engine's exactness guarantee
-testable: every admitted request's tokens are token-identical to its
-solo static decode, independent of what shares its rounds (pinned in
-``tests/serving_tests/test_engine.py``).
+is greedy by default — which is what makes the engine's exactness
+guarantee testable: every admitted request's tokens are
+token-identical to its solo static decode, independent of what shares
+its rounds (pinned in ``tests/serving_tests/test_engine.py``).  That
+guarantee survives the production decode tier: PREFIX SHARING
+(``prefix_sharing=True``) changes which physical blocks hold the KV,
+never its attended content, and per-request KEYED SAMPLING
+(``submit(sampling=...)``) moves only the opted-in rows off argmax —
+greedy rows stay the pinned oracle while sampled rows pin by
+(key, params) replay instead (:mod:`~chainermn_tpu.serving.sampling`).
 
 Single-controller: results are fetched by host indexing into the
 sharded token buffer, so every shard must be addressable from this
@@ -78,6 +85,8 @@ from chainermn_tpu.utils.telemetry import RequestTraceStore, get_recorder
 
 from . import kv_blocks as kvb
 from .admission import AdmissionController, ShedCompletion
+from .prefix_cache import RefcountedBlockPool
+from .sampling import SamplingParams, fold_keys, sample_tokens
 
 __all__ = ["Completion", "Request", "ServingEngine", "TransformerAdapter"]
 
@@ -119,6 +128,9 @@ class Request:
     deadline: Optional[float] = None
     trace_id: Optional[str] = None
     spans: Optional[list] = None
+    #: per-request sampling policy (``None`` = greedy, the exactness
+    #: oracle; see :mod:`~chainermn_tpu.serving.sampling`)
+    sampling: Optional[SamplingParams] = None
 
 
 @dataclasses.dataclass(eq=False)
@@ -253,6 +265,21 @@ class TransformerAdapter:
                                  pos_offset=pos_offset)
         return caches
 
+    def verify(self, params, caches, tok_chunk, t, pos_offset,
+               with_logits=True):
+        """Chunk step at positions ``[t, t+C)`` attending the cache —
+        the speculative verify pass (logits for every chunk position)
+        and, without logits, the prefix-sharing suffix prefill.  Rides
+        ``_decode_step``'s chunk path, so it carries the same vma
+        requirement as every ``TransformerConfig`` program."""
+        from chainermn_tpu.models.decoding import _decode_step
+
+        logits, caches = _decode_step(
+            self.cfg, params, caches, tok_chunk, t,
+            all_logits=with_logits, with_logits=with_logits,
+            chunk_attends_cache=True, pos_offset=pos_offset)
+        return (logits if with_logits else None), caches
+
 
 def _fcfs(queue: Sequence[Request], engine) -> Request:
     return queue[0]
@@ -294,7 +321,22 @@ def _deadline(queue: Sequence[Request], engine) -> Request:
     return min(enumerate(queue), key=key)[1]
 
 
-_POLICIES = {"fcfs": _fcfs, "spf": _spf, "deadline": _deadline}
+def _wfq(queue: Sequence[Request], engine) -> Request:
+    """Weighted fair queuing across tenants: the attached admission
+    controller's deficit-round-robin pick (tenant weights, quantum
+    state) within the most important priority class present.  Requires
+    a controller — WFQ without per-tenant state is FCFS wearing a
+    costume."""
+    ctrl = getattr(engine, "admission", None)
+    if ctrl is None:
+        raise ValueError(
+            "policy 'wfq' needs an AdmissionController attached "
+            "(engine.admission) to hold the per-tenant DRR state")
+    return ctrl.wfq_pick(queue)
+
+
+_POLICIES = {"fcfs": _fcfs, "spf": _spf, "deadline": _deadline,
+             "wfq": _wfq}
 
 
 def _trace_store_from_env() -> Optional[RequestTraceStore]:
@@ -395,6 +437,15 @@ class ServingEngine:
         traced request's FIRST round is always in its timeline (the
         TTFT cause), later rounds every N-th (a 1000-token decode must
         not be a 1000-span trace).
+      prefix_sharing: copy-on-write prefix sharing over the staging
+        pool (docs/SERVING.md "Prefix sharing"; default ON).  Staged
+        blocks are refcounted and content-addressed by token prefix:
+        requests sharing a prompt prefix hold ONE physical copy of
+        its full blocks and prefill only their divergent suffix, and
+        a completed request's full blocks stay cached for the next
+        arrival (LRU-reclaimed under pool pressure).  Greedy decode
+        stays token-bitwise identical to the private-KV path (pinned);
+        ``False`` restores strictly private per-request blocks.
     """
 
     def __init__(self, adapter, params, *, n_slots: int, horizon: int,
@@ -409,7 +460,8 @@ class ServingEngine:
                  admission: Optional[AdmissionController] = None,
                  epoch: int = 0,
                  traces: Optional[RequestTraceStore] = None,
-                 trace_decode_every: int = 4):
+                 trace_decode_every: int = 4,
+                 prefix_sharing: bool = True):
         mesh = adapter.mesh_cfg.mesh
         shards = 1
         for a in adapter.batch_axes:
@@ -471,7 +523,13 @@ class ServingEngine:
             params, jax.tree.map(
                 lambda s: NamedSharding(mesh, s), adapter.param_specs(),
                 is_leaf=lambda x: isinstance(x, P)))
-        self._alloc = kvb.BlockAllocator(pool_blocks, block)
+        self.prefix_sharing = bool(prefix_sharing)
+        # suffix-only prefill on a partial prefix hit needs the
+        # adapter's chunk-attends-cache verify surface; without it a
+        # hit still shares blocks, it just re-prefills the whole chunk
+        self._can_suffix = hasattr(adapter, "verify")
+        self._alloc = RefcountedBlockPool(pool_blocks, block,
+                                          share=self.prefix_sharing)
         self._build_programs()
         # reusable host staging for the admit path.  These buffers are
         # REWRITTEN per admission; everything handed to a jitted call
@@ -481,6 +539,7 @@ class ServingEngine:
         # hazard), so the transfer could still be reading the buffer
         # when the next admission rewrites it.
         self._prompt_staging = np.zeros((self._pq,), np.int32)
+        self._lprompt_staging = np.zeros((self._pq,), np.int32)
         self._ids_staging = np.zeros((self._w,), np.int32)
         self.reset()
 
@@ -582,13 +641,16 @@ class ServingEngine:
                 out_specs=pool_specs),
             donate_argnums=(1,))
 
-        def admit_body(caches, buf, pools, ids, prompt, slot, dst0):
+        def admit_body(caches, buf, pools, flat, prompt, slot, dst0):
+            # position-level gather: a LEFT-aligned staged prompt
+            # (shareable block identity) lands RIGHT-aligned in its
+            # lane; the sub-block shift rides the flat index
             ls = slot - self._shard_base()
             ok = (ls >= 0) & (ls < S)
             lsc = jnp.clip(ls, 0, S - 1)
             caches = tuple(
-                kvb.insert_chunk(c, kvb.gather_blocks(pc, ids), lsc,
-                                 dst0, ok)
+                kvb.insert_chunk(c, kvb.gather_positions(pc, flat),
+                                 lsc, dst0, ok)
                 for c, pc in zip(caches, pools))
             cur = lax.dynamic_slice(buf, (lsc, dst0), (1, pq))
             row = jnp.where(ok, prompt[None], cur)
@@ -602,6 +664,98 @@ class ServingEngine:
                           P()),
                 out_specs=(cspecs, row_spec)),
             donate_argnums=(0, 1))
+
+        def suffix_prefill_body(params, pools, prefix_flat, toks, ids,
+                                valid):
+            # prefill ONLY the divergent suffix of a prefix-cache hit:
+            # gather the shared prefix K/V ([0, start) positions, one
+            # physical copy in the pool), chunk-step the suffix tokens
+            # against it, scatter just the fresh suffix blocks
+            start = prefix_flat.shape[0]
+            width = toks.shape[0]
+            comps = ad.make_cache(1, start + width,
+                                  batch_varying=False)
+            caches = tuple(
+                lax.dynamic_update_slice(
+                    c, kvb.gather_positions(pc, prefix_flat)
+                    .astype(c.dtype),
+                    (0,) * c.ndim)
+                for c, pc in zip(comps, pools))
+            _, caches = ad.verify(
+                params, caches, toks[None], jnp.int32(start),
+                jnp.zeros((1,), jnp.int32), with_logits=False)
+            return tuple(
+                kvb.scatter_chunk(
+                    pc,
+                    kvb.chunk_to_blocks(
+                        lax.dynamic_slice_in_dim(
+                            c, start, width, axis=kvb.POS_AXIS),
+                        self.block),
+                    ids, valid)
+                for pc, c in zip(pools, caches))
+
+        if self._can_suffix:
+            # shapes vary per (prefix, suffix) block split — jit
+            # retraces per split, the specs are split-invariant
+            self._suffix_prefill_fn = jax.jit(
+                jax.shard_map(
+                    suffix_prefill_body, mesh=mesh,
+                    in_specs=(pspecs, pool_specs, P(), P(), P(), P()),
+                    out_specs=pool_specs),
+                donate_argnums=(1,))
+
+        def fork_body(pools, src, dst):
+            # copy-on-write: duplicate one physical block so a row can
+            # write privately while other holders keep the original
+            return tuple(kvb.copy_block(pc, src, dst, jnp.asarray(True))
+                         for pc in pools)
+
+        self._fork_fn = jax.jit(
+            jax.shard_map(
+                fork_body, mesh=mesh,
+                in_specs=(pool_specs, P(), P()), out_specs=pool_specs),
+            donate_argnums=(0,))
+
+        def round_sampled_body(params, caches, buf, offsets, done,
+                               end_t, t0, temp, topk, topp, keys):
+            # the greedy round plus per-request keyed sampling: rows
+            # with temperature 0 take the argmax values the greedy
+            # program computes; sampled rows draw with the key folded
+            # by their OWN token index (t + 1 - offset) — schedule-
+            # independent, so a (key, params) replay pins the tokens
+            def one(carry, r):
+                caches, buf, done = carry
+                t = t0 + r
+                tok = lax.dynamic_slice(
+                    buf, (0, jnp.minimum(t, H - 1)), (S, 1))[:, 0]
+                logits, caches = ad.step(
+                    params, caches, tok, jnp.minimum(t, H - 1),
+                    offsets)
+                step_keys = fold_keys(keys, t + 1 - offsets)
+                nxt = sample_tokens(logits, step_keys, temp, topk,
+                                    topp)
+                nxt = jnp.where(done, pad if pad >= 0 else 0, nxt)
+                if eos >= 0:
+                    done = done | (nxt == eos)
+                done = done | ((t + 1) >= end_t)
+                wpos = jnp.minimum(t + 1, H - 1)
+                cur = lax.dynamic_slice(buf, (0, wpos), (S, 1))
+                val = jnp.where(t + 1 < H, nxt[:, None], cur)
+                buf = lax.dynamic_update_slice(buf, val, (0, wpos))
+                return (caches, buf, done), None
+
+            (caches, buf, done), _ = lax.scan(
+                one, (caches, buf, done), jnp.arange(R))
+            return caches, buf, done
+
+        self._round_sampled_fn = jax.jit(
+            jax.shard_map(
+                round_sampled_body, mesh=mesh,
+                in_specs=(pspecs, cspecs, row_spec, row_spec, row_spec,
+                          row_spec, P(), row_spec, row_spec, row_spec,
+                          row_spec),
+                out_specs=(cspecs, row_spec, row_spec)),
+            donate_argnums=(1, 2))
 
         def rebase_body(caches, buf, delta):
             caches = tuple(kvb.shift_positions(c, delta) for c in caches)
@@ -629,13 +783,22 @@ class ServingEngine:
                 "ServingEngine needs every shard addressable from this "
                 "process (single-controller serving); multi-host result "
                 "fetch is not implemented")
-        self._alloc = kvb.BlockAllocator(self._alloc.n_blocks, self.block)
+        self._alloc = RefcountedBlockPool(self._alloc.n_blocks,
+                                          self.block,
+                                          share=self.prefix_sharing)
         self._queue: collections.deque = collections.deque()
-        self._staged = {}           # rid -> (ids (W,), prompt_row (Pq,))
+        self._staged = {}           # rid -> (flat (Pq,), prompt_row (Pq,))
         self._slot_req: List[Optional[Request]] = [None] * self.n_slots
         self._offsets = np.full((self.n_slots,), self.horizon, np.int32)
         self._done = np.ones((self.n_slots,), bool)
         self._end_t = np.zeros((self.n_slots,), np.int32)
+        # per-slot sampling state (zeros = greedy row); the sampled
+        # round program runs only while a sampled row is live
+        self._s_temp = np.zeros((self.n_slots,), np.float32)
+        self._s_topk = np.zeros((self.n_slots,), np.int32)
+        self._s_topp = np.ones((self.n_slots,), np.float32)
+        self._s_keys = np.zeros((self.n_slots, 2), np.uint32)
+        self._n_sampled_active = 0
         self._slot_status: List[str] = ["ok"] * self.n_slots
         self._slot_detail: List[str] = [""] * self.n_slots
         self._clock = self._pq - 1
@@ -651,6 +814,8 @@ class ServingEngine:
         self.n_rounds = 0
         self.useful_tokens = 0
         self.wasted_tokens = 0          # partial tokens of non-ok rows
+        self.prefill_seconds = 0.0      # staging wall time (bench lever)
+        self.peak_staged = 0            # concurrently staged rows HWM
         self.n_shed: collections.Counter = collections.Counter()
         self.n_timeouts = 0
         self.n_cancelled = 0
@@ -688,7 +853,8 @@ class ServingEngine:
                deadline: Optional[float] = None,
                timeout: Optional[float] = None,
                epoch: Optional[int] = None,
-               trace_id: Optional[str] = None
+               trace_id: Optional[str] = None,
+               sampling: Optional[SamplingParams] = None
                ) -> Union[str, ShedCompletion]:
         """Queue one request; returns its id — or, when the attached
         admission controller rejects it (queue full, tenant over
@@ -715,7 +881,15 @@ class ServingEngine:
         tracing enabled (``traces=``) one is generated when absent.
         It becomes the exemplar on every ``serve/*`` histogram
         observation this request feeds and names its retained
-        timeline in ``engine.traces``."""
+        timeline in ``engine.traces``.
+
+        ``sampling`` (a
+        :class:`~chainermn_tpu.serving.sampling.SamplingParams`)
+        switches THIS request to keyed temperature/top-k/top-p
+        sampling; ``None`` keeps the greedy path — the exactness
+        oracle — even when sampled requests share its rounds.  A
+        sampled request replays bit-identically from its
+        ``(seed, params, prompt)`` under any scheduling."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not 1 <= prompt.shape[0] <= self.max_prompt:
             raise ValueError(
@@ -740,9 +914,14 @@ class ServingEngine:
                 or any(r is not None and r.rid == request_id
                        for r in self._slot_req):
             raise ValueError(f"request id {request_id!r} already live")
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingParams):
+            raise ValueError(
+                f"sampling= takes a SamplingParams, got "
+                f"{type(sampling).__name__}")
         req = Request(request_id, prompt, max_new, t_submit=now,
                       priority=int(priority), tenant=tenant,
-                      deadline=deadline)
+                      deadline=deadline, sampling=sampling)
         if self.traces is not None:
             req.trace_id = (str(trace_id) if trace_id is not None
                             else uuid.uuid4().hex[:16])
@@ -792,14 +971,19 @@ class ServingEngine:
             if not admit:
                 # transient rejects carry a come-back hint, each from
                 # its own clock: queue_full drains with the backlog
-                # (predictor estimate), an "overload" protective shed
-                # resolves with the burn-rate alert's window (the
-                # operator-configured hint — the backlog estimate
-                # would read ~0 off an empty queue and invite a retry
-                # storm mid-protection).  Neither is a terminal
-                # verdict (deadline/over_quota ARE).
+                # (predictor estimate), over_quota with the TENANT's
+                # own in-flight drain (how long until enough of its
+                # budget retires for this request to fit), and an
+                # "overload" protective shed resolves with the
+                # burn-rate alert's window (the operator-configured
+                # hint — the backlog estimate would read ~0 off an
+                # empty queue and invite a retry storm
+                # mid-protection).  Only deadline is a terminal
+                # verdict with no clock at all.
                 if reason == "queue_full":
                     after = self._retry_after()
+                elif reason == "over_quota":
+                    after = self._quota_retry_after(req)
                 elif reason == "overload":
                     after = self.admission.overload_retry_after
                 else:
@@ -882,10 +1066,29 @@ class ServingEngine:
                               step=int(self._clock),
                               tokens=self.round_tokens,
                               active=self.n_active):
-                    self._caches, self._buf, done_dev = self._round_fn(
-                        self._params, self._caches, self._buf,
-                        self._offsets, self._done, self._end_t,
-                        np.int32(self._clock))
+                    if self._n_sampled_active:
+                        # keyed-sampling round; greedy rows inside it
+                        # still take the argmax values.  The sampling
+                        # arrays are rewritten per admission, so the
+                        # jitted call gets copies (the staging-buffer
+                        # aliasing discipline)
+                        self._caches, self._buf, done_dev = \
+                            self._round_sampled_fn(
+                                self._params, self._caches, self._buf,
+                                self._offsets, self._done,
+                                self._end_t, np.int32(self._clock),
+                                self._staging_copy(self._s_temp),
+                                self._staging_copy(self._s_topk),
+                                self._staging_copy(self._s_topp),
+                                self._staging_copy(self._s_keys))
+                    else:
+                        # all-greedy: the ORIGINAL compiled program,
+                        # byte-identical to the pre-sampling engine
+                        self._caches, self._buf, done_dev = \
+                            self._round_fn(
+                                self._params, self._caches, self._buf,
+                                self._offsets, self._done,
+                                self._end_t, np.int32(self._clock))
                     # np.array, not asarray: the host mirror is mutated
                     # by admissions, and jax arrays view out read-only
                     self._done = np.array(done_dev)  # the round's sync
@@ -1072,7 +1275,7 @@ class ServingEngine:
 
     def stats(self) -> dict:
         issued = self.n_rounds * self.round_tokens * self.n_slots
-        return {
+        out = {
             "rounds": self.n_rounds,
             "rebases": self.n_rebases,
             "useful_tokens": self.useful_tokens,
@@ -1088,7 +1291,11 @@ class ServingEngine:
             "epoch": self.epoch,
             "draining": self._draining,
             "drains": self.n_drains,
+            "prefill_seconds": self.prefill_seconds,
+            "peak_staged": self.peak_staged,
         }
+        out.update(self._alloc.stats())    # prefix_* / peak_blocks_used
+        return out
 
     def request_records(self) -> List[Completion]:
         """The newest completed requests (up to ``record_history``,
@@ -1182,6 +1389,12 @@ class ServingEngine:
                 self._slot_req[s] = None
                 self._offsets[s] = self.horizon     # mask-all sentinel
                 self._end_t[s] = 0
+                if req.sampling is not None:
+                    self._s_temp[s] = 0.0
+                    self._s_topk[s] = 0
+                    self._s_topp[s] = 1.0
+                    self._s_keys[s] = 0
+                    self._n_sampled_active -= 1
                 self._slot_status[s] = "ok"
                 self._slot_detail[s] = ""
                 self._pending_first.discard(s)
@@ -1257,6 +1470,23 @@ class ServingEngine:
             return None
         return self.admission.retry_after(self._backlog_tokens(),
                                           self.n_slots)
+
+    def _quota_retry_after(self, req: Request) -> Optional[float]:
+        """The quota shed's come-back hint: predicted seconds until
+        enough of the TENANT's in-flight budget drains for this
+        request to fit under its quota.  The drain rate is the pool's
+        aggregate (``n_slots / TPOT``) — an upper bound on how fast
+        the tenant's own rows can retire, so the hint errs early, not
+        late.  ``None`` while the predictor is cold."""
+        if self.admission is None:
+            return None
+        quota = self.admission.quota_for(req.tenant)
+        if quota is None:
+            return None
+        over = self._tenant_tokens[req.tenant] + req.max_new - quota
+        if over <= 0:
+            return None
+        return self.admission.retry_after(int(over), self.n_slots)
 
     def _finish_shed(self, req: Request, reason: str,
                      detail: str = "",
@@ -1374,10 +1604,13 @@ class ServingEngine:
             try:
                 with rec.span("serve/admit", cat="serve", rid=req.rid,
                               slot=slot, step=int(a)):
-                    ids, prompt_row = self._staged.pop(req.rid)
+                    flat, prompt_row = self._staged.pop(req.rid)
                     self._caches, self._buf = self._admit_fn(
-                        self._caches, self._buf, self._pools, ids,
+                        self._caches, self._buf, self._pools, flat,
                         prompt_row, np.int32(slot), np.int32(dst0))
+                    # refcount-aware: the row lets go, but blocks the
+                    # trie (or other rows) hold stay resident — that
+                    # retention IS the prefix cache
                     self._alloc.free_row(req.rid)
             except Exception as err:    # noqa: BLE001 — harden
                 self._check_state_alive(err)
@@ -1392,8 +1625,20 @@ class ServingEngine:
             self._end_t[slot] = a + req.max_new
             self._done[slot] = False
             self._slot_req[slot] = req
+            if req.sampling is not None:
+                sp = req.sampling
+                self._s_temp[slot] = sp.temperature
+                self._s_topk[slot] = sp.top_k
+                self._s_topp[slot] = sp.top_p
+                self._s_keys[slot] = np.asarray(sp.key())
+                self._n_sampled_active += 1
             self._pending_first.add(slot)
             req.t_admit = time.perf_counter()
+            if self.admission is not None:
+                # settle the WFQ pick's token cost only now that the
+                # admission actually LANDED (a failed stage leaves the
+                # request queued and must not be charged twice)
+                self.admission.wfq_charge(req)
             self.admit_log.append(req.rid)
             if req.spans is not None:
                 self._rspan(req, "queue_wait", req.t_submit,
@@ -1449,14 +1694,20 @@ class ServingEngine:
         return np.array(buf)
 
     def _stage(self, req: Request, rec, steal: bool) -> bool:
-        """Prefill ``req``'s prompt into pool blocks.  ``steal`` frees
-        queue-tail stagings to make room (used on the admission path,
-        where the request must land NOW; prefill-ahead never steals)."""
-        # the right-aligned prompt's real content lives in the chunk's
-        # LAST ceil(P/block) blocks; only those need pool backing
-        n_real = kvb.blocks_needed(req.prompt.shape[0], self.block)
-        ids = self._alloc.alloc(req.rid, n_real)
-        while ids is None and steal:
+        """Prefill ``req``'s prompt into pool blocks — or, with prefix
+        sharing, REFERENCE the cached leading full blocks and prefill
+        only the divergent suffix (the first divergent write forks
+        onto fresh blocks; the shared prefix is never written).
+        ``steal`` frees queue-tail stagings to make room (used on the
+        admission path, where the request must land NOW; prefill-ahead
+        never steals).  Staging is LEFT-aligned — token ``i`` in block
+        ``i // block`` — which is what makes block content addressable
+        by token prefix; the admit gather restores the lane's
+        right-aligned layout."""
+        P_len = int(req.prompt.shape[0])
+        n_real = kvb.blocks_needed(P_len, self.block)
+        plan = self._alloc.stage(req.rid, req.prompt)
+        while plan is None and steal:
             victims = [r for r in reversed(list(self._queue))
                        if r.rid in self._staged and r is not req]
             if not victims:
@@ -1464,31 +1715,108 @@ class ServingEngine:
             victim = victims[0]
             self._alloc.free_row(victim.rid)
             del self._staged[victim.rid]
-            ids = self._alloc.alloc(req.rid, n_real)
-        if ids is None:
+            plan = self._alloc.stage(req.rid, req.prompt)
+        if plan is None:
             return False
+        reg = get_registry()
         pt0 = time.perf_counter()
         with rec.span("serve/prefill", cat="serve", rid=req.rid,
-                      blocks=n_real):
+                      blocks=plan.n_new, shared=plan.n_shared):
             st = self._prompt_staging
             st[:] = max(self.pad_id, 0)
-            st[self._pq - req.prompt.shape[0]:] = req.prompt
+            st[self._pq - P_len:] = req.prompt
             prompt_row = self._staging_copy(st)
-            ids_np = self._ids_staging
-            ids_np[:] = self._alloc.padded_table(req.rid, self._w)
-            ids_row = self._staging_copy(ids_np)
-            self._pools = self._prefill_fn(
-                self._params, self._pools, prompt_row,
-                np.int32(self._pq - req.prompt.shape[0]), ids_row,
-                ids_row >= 0)
-            self._staged[req.rid] = (ids_row, prompt_row)
-        self._rspan(req, "prefill", pt0, time.perf_counter() - pt0,
-                    blocks=n_real)
+            if plan.n_new and (plan.n_shared == 0
+                               or not self._can_suffix):
+                # cold path (or no chunk-verify surface): prefill the
+                # whole left-aligned chunk, scatter only this row's
+                # fresh blocks (never a shared one — the refcount
+                # contract the fork primitive enforces elsewhere)
+                lst = self._lprompt_staging
+                lst[:] = max(self.pad_id, 0)
+                lst[:P_len] = req.prompt
+                lrow = self._staging_copy(lst)
+                ids_np = self._ids_staging
+                ids_np[:] = -1
+                ids_np[plan.n_shared:n_real] = \
+                    plan.table[plan.n_shared:]
+                ids_row = self._staging_copy(ids_np)
+                self._pools = self._prefill_fn(
+                    self._params, self._pools, lrow, np.int32(0),
+                    ids_row, ids_row >= 0)
+            elif plan.n_new:
+                # copy-on-write fork: the row leaves the shared chain
+                # at token n_shared*block; only the suffix computes
+                start = plan.n_shared * self.block
+                width = n_real * self.block - start
+                ft0 = time.perf_counter()
+                with rec.span("serve/fork", cat="serve", rid=req.rid,
+                              shared=plan.n_shared, new=plan.n_new):
+                    pf = np.empty((start,), np.int32)
+                    intra = np.arange(self.block, dtype=np.int32)
+                    for j in range(plan.n_shared):
+                        pf[j * self.block:(j + 1) * self.block] = \
+                            plan.table[j] * self.block + intra
+                    toks = np.full((width,), max(self.pad_id, 0),
+                                   np.int32)
+                    toks[:P_len - start] = req.prompt[start:]
+                    sids = np.asarray(plan.table[plan.n_shared:],
+                                      np.int32)
+                    self._pools = self._suffix_prefill_fn(
+                        self._params, self._pools,
+                        self._staging_copy(pf),
+                        self._staging_copy(toks), sids, sids >= 0)
+                self._rspan(req, "fork", ft0,
+                            time.perf_counter() - ft0,
+                            shared=plan.n_shared, new=plan.n_new)
+            # plan.n_new == 0: the whole prompt is cached full blocks —
+            # no prefill compute at all, admission is just the gather
+            if self.prefix_sharing:
+                self._alloc.insert_cached(req.rid, req.prompt)
+            flat = self._alloc.flat_gather_index(req.rid, self._pq,
+                                                P_len)
+            self._staged[req.rid] = (flat, prompt_row)
+        dur = time.perf_counter() - pt0
+        self.prefill_seconds += dur
+        self.peak_staged = max(self.peak_staged, len(self._staged))
+        if plan.n_shared:
+            reg.inc("serve/prefix_hits", plan.n_shared)
+            reg.set("serve/prefix_blocks_shared",
+                    self._alloc.n_shared_blocks)
+        self._rspan(req, "prefill", pt0, dur, blocks=plan.n_new,
+                    shared=plan.n_shared)
         return True
 
     def _ensure_staged(self, req: Request, rec) -> bool:
         return req.rid in self._staged or self._stage(req, rec,
                                                       steal=True)
+
+    def fork_block(self, row_id, idx: int) -> int:
+        """Copy-on-write fork of a STAGED row's ``idx``-th block: if
+        the block has other holders (the trie, another row) the row
+        gets a fresh physical copy — device content duplicated, table
+        and staged gather index repointed — and the shared original is
+        never written.  Already-private blocks are left alone.
+        Returns the block id the row holds afterwards.  This is the
+        write-path guard primitive; the steady-state staging plan
+        forks implicitly (divergent suffixes always land on fresh
+        blocks), so the engine itself only needs this when a caller
+        mutates staged content in place."""
+        src = self._alloc.table(row_id)[idx]
+        new = self._alloc.fork_for_write(row_id, idx)
+        if new is None:
+            return src
+        self._pools = self._fork_fn(self._pools, np.int32(src),
+                                    np.int32(new))
+        if row_id in self._staged:
+            req = next((r for r in self._queue if r.rid == row_id),
+                       None)
+            if req is not None:
+                flat = self._alloc.flat_gather_index(
+                    row_id, self._pq, req.prompt.shape[0])
+                self._staged[row_id] = (flat, self._staged[row_id][1])
+        get_registry().inc("serve/prefix_forks")
+        return new
 
     def _maybe_rebase(self, needed_new: int, rec) -> bool:
         """Shift every lane down by a block-aligned delta so an

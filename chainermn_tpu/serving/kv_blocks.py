@@ -58,6 +58,8 @@ __all__ = [
     "chunk_to_blocks",
     "scatter_chunk",
     "gather_blocks",
+    "gather_positions",
+    "copy_block",
     "insert_chunk",
     "shift_positions",
 ]
@@ -200,6 +202,45 @@ def gather_blocks(pool_comp, ids):
     shape = (picked.shape[0], 1, picked.shape[1] * picked.shape[2]) \
         + picked.shape[3:]
     return picked.reshape(shape)
+
+
+def gather_positions(pool_comp, flat_idx):
+    """Assemble individual pool POSITIONS into a contiguous one-row
+    chunk ``(D0, 1, Pq, *rest)``.  ``flat_idx`` (Pq,) int32 addresses
+    ``block_id * block + intra`` over the flattened pool; entries are
+    clamped, so invalid (-1) entries produce garbage positions the
+    caller must keep outside every attention validity window.
+
+    This is the position-granular sibling of :func:`gather_blocks` —
+    the prefix-sharing admit path uses it because a LEFT-aligned
+    staged prompt lands RIGHT-aligned in its slot lane: the shift
+    between the two layouts is sub-block whenever the prompt length is
+    not a block multiple, which a block-granular gather cannot
+    express."""
+    import jax.numpy as jnp
+
+    nb, blk = pool_comp.shape[1], pool_comp.shape[2]
+    flat = pool_comp.reshape((pool_comp.shape[0], nb * blk)
+                             + pool_comp.shape[3:])
+    idx = jnp.clip(flat_idx, 0, nb * blk - 1)
+    picked = jnp.take(flat, idx, axis=1)        # (D0, Pq, *rest)
+    return picked.reshape((picked.shape[0], 1, picked.shape[1])
+                          + picked.shape[2:])
+
+
+def copy_block(pool_comp, src, dst, ok):
+    """Copy-on-write fork: duplicate physical block ``src`` into
+    ``dst`` (scalars; ``ok`` gates the write like
+    :func:`insert_chunk`).  The fork is how a row gains a PRIVATE copy
+    of a block it currently shares — the shared original is never
+    written through."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    blk = lax.dynamic_slice_in_dim(pool_comp, src, 1, axis=1)
+    cur = lax.dynamic_slice_in_dim(pool_comp, dst, 1, axis=1)
+    new = jnp.where(ok, blk, cur)
+    return lax.dynamic_update_slice_in_dim(pool_comp, new, dst, axis=1)
 
 
 def insert_chunk(cache_comp, chunk_comp, row, dst, ok):

@@ -171,6 +171,57 @@ class MiniLMAdapter:
         logits = _rms(h, params["ln_f"]) @ params["embed"].T
         return logits.astype(jnp.float32), (ck, cv)
 
+    def verify(self, params, caches, tok_chunk, t, pos_offset,
+               with_logits=True):
+        """Chunk step — the speculative VERIFY pass (and, without
+        logits, the prefix-sharing suffix prefill): process
+        ``tok_chunk`` (B, C) at global positions ``[t, t+C)``, writing
+        each token's K/V and attending the FULL cache with the same
+        ``[offset, position]`` validity window as :meth:`step`, so
+        position ``t+i``'s logits condition on the cache through
+        ``t-1`` plus chunk tokens ``<= i`` — one weights read verifies
+        C draft positions.  Returns ``(logits (B, C, V) | None,
+        caches)``.
+
+        The key axis is the full cache buffer in both this and
+        :meth:`step` (masked positions underflow to exact zero), which
+        is what keeps chunk-verified logits token-compatible with the
+        step-by-step decode they stand in for."""
+        cfg = self.cfg
+        ck, cv = caches
+        B, C = tok_chunk.shape
+        T = ck.shape[POS_AXIS]
+        j = jnp.arange(C)
+        h = jnp.take(params["embed"], tok_chunk, axis=0) \
+            + self._positions(params,
+                              t + j[None, :] - pos_offset[:, None])
+        blk = params["blocks"]
+        kpos = jnp.arange(T)
+        allow = (kpos[None, None, :] <= (t + j)[None, :, None]) \
+            & (kpos[None, None, :] >= pos_offset[:, None, None])
+        for layer in range(cfg.n_layers):
+            x = _rms(h, blk["ln1"][layer])
+            q = (x @ blk["wq"][layer]).reshape(
+                B, C, cfg.n_heads, cfg.d_head)
+            k = x @ blk["wk"][layer]                     # (B, C, dh)
+            v = x @ blk["wv"][layer]
+            ck = lax.dynamic_update_slice(
+                ck, k[None], (layer, 0, t, 0))
+            cv = lax.dynamic_update_slice(
+                cv, v[None], (layer, 0, t, 0))
+            s = jnp.einsum("bchd,btd->bhct", q, ck[layer]) \
+                * (cfg.d_head ** -0.5)
+            s = jnp.where(allow[:, None], s, _NEG)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhct,btd->bchd", p, cv[layer])
+            h = h + o.reshape(B, C, -1) @ blk["wo"][layer]
+            x2 = _rms(h, blk["ln2"][layer])
+            h = h + jax.nn.relu(x2 @ blk["w1"][layer]) @ blk["w2"][layer]
+        if not with_logits:
+            return None, (ck, cv)
+        logits = _rms(h, params["ln_f"]) @ params["embed"].T
+        return logits.astype(jnp.float32), (ck, cv)
+
     def prefill(self, params, caches, toks, pos_offset):
         """Fill cache positions ``[0, Tq)`` from a ``(B, Tq)`` chunk in
         one causal pass (no logits — the cache fill is the product).

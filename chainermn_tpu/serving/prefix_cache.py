@@ -1,0 +1,445 @@
+"""Copy-on-write prefix sharing over the block-paged KV pool.
+
+N live requests that share a system prompt each pay full prefill and
+full pool pressure under the PR 8 staging layer — every staged row
+owns a private copy of KV the pool already holds N-1 times.  This
+module is the sharing layer the ROADMAP names: REFCOUNTED physical
+blocks plus a prefix trie keyed by token-id chunks, so requests whose
+prompts share a prefix hold ONE physical copy of the shared blocks and
+admission stages (prefills and allocates) only the divergent suffix.
+
+Design, in the terms the engine uses:
+
+- **Left-aligned block identity.**  A staged prompt's token ``i``
+  lives in block ``i // block`` at intra-block position ``i % block``
+  (the engine left-aligns staging prefills for exactly this reason).
+  K/V of token ``i`` is a pure function of ``tokens[:i+1]`` — position
+  embeddings index the token's own index, attention sees only earlier
+  prompt tokens — so a FULL block's content is content-addressed by
+  the token prefix through its end.  That prefix is the trie key
+  (:class:`PrefixTrie` realizes the chunked-token trie as a hash chain
+  over ``tokens[: (j+1) * block]``).
+- **Partial blocks never share.**  The last block of a prompt whose
+  length is not a block multiple holds garbage K/V past the prompt's
+  end; it stays private to its row.  Divergence INSIDE a block
+  therefore never aliases: the divergent suffix always forks onto
+  fresh blocks at stage time — copy-on-write at block granularity,
+  with :meth:`RefcountedBlockPool.fork_for_write` as the explicit
+  fork primitive guarding any write aimed at a block with other
+  holders.
+- **Refcounts, not ownership.**  A block's holders are the rows whose
+  tables contain it plus (at most once) the trie.  ``free_row`` only
+  decrements; a block returns to the free list when its last holder
+  lets go — so evicting or stealing a staged row never invalidates
+  the blocks other rows share with it, and a completed request's full
+  blocks REMAIN cached for the next arrival (that is the cache).
+  Under pool pressure :meth:`reclaim` drops least-recently-used
+  trie-only blocks; blocks any row still holds refuse eviction.
+
+The device arrays live with the engine (``kv_blocks`` pool ops); this
+module is host-side bookkeeping only, unit-testable without jax.  See
+docs/SERVING.md "Prefix sharing".
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["PrefixTrie", "RefcountedBlockPool", "StagePlan"]
+
+
+def _prefix_key(tokens: np.ndarray, end: int) -> bytes:
+    """The content address of the full block ending at token ``end``:
+    the whole token prefix through it (K/V inside the block depends on
+    every earlier token, so nothing shorter is sound)."""
+    return np.ascontiguousarray(tokens[:end], np.int32).tobytes()
+
+
+class PrefixTrie:
+    """Chunked-token prefix trie, realized as an LRU hash chain:
+    ``tokens[: (j+1) * block] -> block_id`` for every cached FULL
+    block.  A lookup walks leading full blocks until the first miss —
+    exactly the trie descent, one hash per chunk."""
+
+    def __init__(self, block: int):
+        self.block = int(block)
+        self._map: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._key_of: Dict[int, bytes] = {}
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, block_id: int) -> bool:
+        return block_id in self._key_of
+
+    def lookup_run(self, tokens: np.ndarray) -> List[int]:
+        """Block ids of the LONGEST cached leading run of full blocks
+        of ``tokens`` (possibly empty); hits are LRU-refreshed."""
+        run: List[int] = []
+        for j in range(len(tokens) // self.block):
+            key = _prefix_key(tokens, (j + 1) * self.block)
+            bid = self._map.get(key)
+            if bid is None:
+                break
+            self._map.move_to_end(key)
+            run.append(bid)
+        return run
+
+    def insert(self, tokens: np.ndarray, j: int, block_id: int) -> bool:
+        """Cache full block ``j`` of ``tokens`` as ``block_id``; False
+        when that prefix is already cached (first writer wins — the
+        content is identical by construction)."""
+        key = _prefix_key(tokens, (j + 1) * self.block)
+        if key in self._map:
+            return False
+        self._map[key] = block_id
+        self._key_of[block_id] = key
+        return True
+
+    def drop_block(self, block_id: int) -> bool:
+        key = self._key_of.pop(block_id, None)
+        if key is None:
+            return False
+        del self._map[key]
+        return True
+
+    def lru_blocks(self):
+        """Cached block ids, least recently used first."""
+        return list(self._map.values())
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlan:
+    """One staging decision: ``table`` is the row's physical blocks in
+    token order; the first ``n_shared`` came from the trie (their
+    prefill is skipped), the last ``n_new`` were freshly allocated and
+    must be prefilled + scattered.  ``n_shared > 0 and n_new > 0`` is
+    the copy-on-write FORK: the row's chain leaves the shared prefix
+    for private blocks at token ``n_shared * block``."""
+
+    table: List[int]
+    n_shared: int
+    n_new: int
+
+    def __post_init__(self):
+        assert self.n_shared + self.n_new == len(self.table)
+
+
+class RefcountedBlockPool:
+    """Refcounted free-list allocator with prefix-trie block sharing.
+
+    Drop-in for the engine half of
+    :class:`~chainermn_tpu.serving.kv_blocks.BlockAllocator` (same
+    ``free_row`` / ``padded_table`` / ``n_free`` / ``utilization``
+    surface) plus the sharing API: :meth:`stage` plans a row's blocks
+    against the trie, :meth:`insert_cached` publishes its full blocks
+    after prefill, :meth:`reclaim` drops LRU cache-only blocks under
+    pressure, :meth:`fork_for_write` is the copy-on-write escape
+    hatch, and :meth:`leak_report` audits the refcount invariants
+    (the suite-wide pool-leak fixture runs it after every serving
+    test).
+
+    ``share=False`` disables the trie entirely: every block then has
+    exactly one holder and the pool degenerates to the PR 8
+    allocator's behaviour.
+    """
+
+    def __init__(self, n_blocks: int, block: int, *, share: bool = True):
+        if n_blocks < 1 or block < 1:
+            raise ValueError(
+                f"n_blocks={n_blocks} and block={block} must be >= 1")
+        self.n_blocks = int(n_blocks)
+        self.block = int(block)
+        self.share = bool(share)
+        self._free: List[int] = list(range(n_blocks - 1, -1, -1))
+        self._refs: Dict[int, int] = {}
+        self._tables: Dict[object, List[int]] = {}
+        self._trie = PrefixTrie(block)
+        self.n_hits = 0             # blocks served from the trie
+        self.n_prefilled = 0        # blocks that needed prefill
+        self.n_forks = 0            # fork_for_write invocations that forked
+        self.n_reclaimed = 0        # cache blocks dropped under pressure
+        self.peak_blocks_used = 0   # physical residency (rows + cache)
+        self.peak_row_blocks = 0    # unreclaimable pressure (row-held)
+
+    # -- accounting ---------------------------------------------------- #
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_cached(self) -> int:
+        return len(self._trie)
+
+    @property
+    def n_shared_blocks(self) -> int:
+        """Blocks currently held by more than one holder — the
+        physical copies prefix sharing is saving."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    @property
+    def utilization(self) -> float:
+        """Fraction of pool blocks held by ROWS (cache-only blocks are
+        reclaimable on demand, so they don't count as pressure)."""
+        row_held = set()
+        for ids in self._tables.values():
+            row_held.update(ids)
+        return len(row_held) / self.n_blocks
+
+    def rows(self):
+        return list(self._tables)
+
+    def table(self, row_id) -> List[int]:
+        return list(self._tables[row_id])
+
+    def refcount(self, block_id: int) -> int:
+        return self._refs.get(block_id, 0)
+
+    # -- allocation ---------------------------------------------------- #
+
+    def _take(self, n: int) -> Optional[List[int]]:
+        shortfall = n - len(self._free)
+        if shortfall > 0 and self.reclaim(shortfall) < shortfall:
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        for b in ids:
+            assert b not in self._refs      # double-alloc guard
+            self._refs[b] = 1
+        return ids
+
+    def _note_peak(self):
+        self.peak_blocks_used = max(self.peak_blocks_used,
+                                    self.n_blocks - len(self._free))
+        held = set()
+        for ids in self._tables.values():
+            held.update(ids)
+        self.peak_row_blocks = max(self.peak_row_blocks, len(held))
+
+    def alloc(self, row_id, n: int) -> Optional[List[int]]:
+        """Share-oblivious allocation (the ``BlockAllocator``
+        contract): ``n`` fresh private blocks or ``None``, taking
+        nothing on failure."""
+        if row_id in self._tables:
+            raise ValueError(f"row {row_id!r} already holds blocks")
+        if n < 0:
+            raise ValueError(f"n={n} must be >= 0")
+        ids = self._take(n)
+        if ids is None:
+            return None
+        self._tables[row_id] = ids
+        self._note_peak()
+        return list(ids)
+
+    def stage(self, row_id, tokens) -> Optional[StagePlan]:
+        """Plan ``row_id``'s staging against the trie: reuse the
+        longest cached run of leading full blocks (refcount++), then
+        allocate the divergent suffix — ``ceil(P/block) - n_shared``
+        fresh blocks.  All-or-nothing like :meth:`alloc`: on an
+        unsatisfiable suffix nothing is taken and ``None`` returns
+        (the caller steals or backpressures)."""
+        if row_id in self._tables:
+            raise ValueError(f"row {row_id!r} already holds blocks")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n_real = -(-len(tokens) // self.block)
+        run = self._trie.lookup_run(tokens) if self.share else []
+        # reference the hits BEFORE allocating: the suffix allocation
+        # may reclaim cache-only blocks, and an unreferenced hit is
+        # exactly that
+        for b in run:
+            self._refs[b] += 1
+        new = self._take(n_real - len(run))
+        if new is None:
+            for b in run:
+                self._refs[b] -= 1
+            return None
+        self._tables[row_id] = list(run) + new
+        self.n_hits += len(run)
+        self.n_prefilled += len(new)
+        self._note_peak()
+        return StagePlan(table=list(run) + new, n_shared=len(run),
+                         n_new=len(new))
+
+    def insert_cached(self, row_id, tokens) -> int:
+        """Publish the row's FULL blocks into the trie (the trie holds
+        its own reference).  Partial last blocks stay private; already
+        cached prefixes are left to the first writer.  Returns how
+        many blocks were newly cached."""
+        if not self.share:
+            return 0
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        table = self._tables[row_id]
+        added = 0
+        for j in range(len(tokens) // self.block):
+            bid = table[j]
+            if bid in self._trie:
+                continue
+            if self._trie.insert(tokens, j, bid):
+                self._refs[bid] += 1
+                added += 1
+        return added
+
+    # -- release ------------------------------------------------------- #
+
+    def _decref(self, block_id: int) -> None:
+        r = self._refs.get(block_id)
+        if r is None:
+            raise RuntimeError(
+                f"double free: block {block_id} has no holders")
+        if r > 1:
+            self._refs[block_id] = r - 1
+            return
+        del self._refs[block_id]
+        self._free.append(block_id)
+
+    def free_row(self, row_id) -> int:
+        """Release the row's references; returns how many blocks
+        actually came FREE (shared blocks survive their other
+        holders).  Unknown rows free nothing — evictions are
+        idempotent, never a double free."""
+        ids = self._tables.pop(row_id, None)
+        if not ids:
+            return 0
+        before = len(self._free)
+        for b in reversed(ids):
+            self._decref(b)
+        return len(self._free) - before
+
+    def evict_block(self, block_id: int) -> None:
+        """Force a CACHE eviction of one block.  Refuses while any row
+        still holds it (refcount > 1): shared content under a live
+        table must never return to the free list."""
+        if block_id not in self._trie:
+            raise ValueError(f"block {block_id} is not cached")
+        if self._refs.get(block_id, 0) > 1:
+            raise RuntimeError(
+                f"block {block_id} is shared (refcount "
+                f"{self._refs[block_id]}): eviction refused while "
+                "other holders remain")
+        self._trie.drop_block(block_id)
+        self._decref(block_id)
+
+    def reclaim(self, n: int) -> int:
+        """Drop least-recently-used CACHE-ONLY blocks until ``n`` came
+        free (or no candidates remain); rows' blocks are untouchable.
+        Returns the number actually freed."""
+        freed = 0
+        for bid in self._trie.lru_blocks():
+            if freed >= n:
+                break
+            if self._refs.get(bid, 0) != 1:
+                continue                    # a row still holds it
+            self._trie.drop_block(bid)
+            self._decref(bid)
+            freed += 1
+            self.n_reclaimed += 1
+        return freed
+
+    def fork_for_write(self, row_id, idx: int) -> Optional[int]:
+        """Copy-on-write: make the row's ``idx``-th block privately
+        writable.  A block with other holders (another row or the
+        trie) is swapped for a fresh allocation — the caller owes the
+        device copy (:func:`~chainermn_tpu.serving.kv_blocks.
+        copy_block`) — and the shared original keeps its other
+        holders.  Returns the NEW block id, or ``None`` when the
+        block was already private (no fork needed).  Raises when the
+        pool cannot supply the copy even after reclaim."""
+        table = self._tables[row_id]
+        bid = table[idx]
+        if self._refs[bid] == 1 and bid not in self._trie:
+            return None
+        new = self._take(1)
+        if new is None:
+            raise RuntimeError(
+                f"copy-on-write fork of block {bid} needs a free "
+                "block and the pool has none")
+        table[idx] = new[0]
+        self._decref(bid)
+        self.n_forks += 1
+        self._note_peak()
+        return new[0]
+
+    # -- wire forms (the engine's program inputs) ---------------------- #
+
+    def padded_table(self, row_id, width: int, *,
+                     align: str = "right") -> np.ndarray:
+        """The row's table padded with -1 into ``width`` int32
+        entries.  ``align="left"`` (real ids first) is the scatter
+        form for left-aligned staging; ``align="right"`` keeps the
+        ``BlockAllocator`` wire contract."""
+        ids = self._tables[row_id]
+        if len(ids) > width:
+            raise ValueError(
+                f"row {row_id!r} holds {len(ids)} blocks > width {width}")
+        out = np.full((width,), -1, np.int32)
+        if ids:
+            if align == "left":
+                out[:len(ids)] = np.asarray(ids, np.int32)
+            elif align == "right":
+                out[width - len(ids):] = np.asarray(ids, np.int32)
+            else:
+                raise ValueError(f"align={align!r} not in left/right")
+        return out
+
+    def flat_gather_index(self, row_id, pq: int,
+                          prompt_len: int) -> np.ndarray:
+        """The admit gather's position-level index (``Pq``,): chunk
+        position ``p`` (right-aligned lane layout) reads pool position
+        ``table[i // block] * block + i % block`` for token
+        ``i = p - (pq - prompt_len)``; out-of-prompt positions are -1
+        (clamped garbage the attention window never reads)."""
+        table = self._tables[row_id]
+        out = np.full((pq,), -1, np.int32)
+        align = pq - prompt_len
+        i = np.arange(prompt_len)
+        out[align:] = (np.asarray(table, np.int32)[i // self.block]
+                       * self.block + i % self.block)
+        return out
+
+    # -- auditing ------------------------------------------------------ #
+
+    def stats(self) -> dict:
+        total = self.n_hits + self.n_prefilled
+        return {
+            "prefix_hits": self.n_hits,
+            "prefix_prefilled": self.n_prefilled,
+            "prefix_hit_rate": self.n_hits / total if total else 0.0,
+            "prefix_forks": self.n_forks,
+            "prefix_reclaimed": self.n_reclaimed,
+            "cached_blocks": self.n_cached,
+            "shared_blocks": self.n_shared_blocks,
+            "peak_blocks_used": self.peak_blocks_used,
+            "peak_row_blocks": self.peak_row_blocks,
+        }
+
+    def leak_report(self) -> List[str]:
+        """Refcount-invariant audit; empty means clean.  With no rows
+        live, every block must be either on the free list or cached
+        with exactly the trie's one reference — anything else is a
+        leaked or double-counted block."""
+        problems = []
+        held = collections.Counter()
+        for row, ids in self._tables.items():
+            held.update(ids)
+        for bid in self._trie.lru_blocks():
+            held[bid] += 1
+        for bid, r in self._refs.items():
+            if held[bid] != r:
+                problems.append(
+                    f"block {bid}: refcount {r} != {held[bid]} holders")
+            if bid in self._free:
+                problems.append(f"block {bid}: on free list while held")
+        for bid, n in held.items():
+            if bid not in self._refs:
+                problems.append(
+                    f"block {bid}: {n} holders but no refcount")
+        if len(self._free) + len(self._refs) != self.n_blocks:
+            problems.append(
+                f"pool imbalance: {len(self._free)} free + "
+                f"{len(self._refs)} held != {self.n_blocks}")
+        return problems

@@ -1,0 +1,131 @@
+"""Keyed sampling for the serving decode tier.
+
+The engine's decode round was argmax-only: exactness (every request's
+tokens identical to its solo decode, whatever shares its rounds) is
+the property the whole scheduler is pinned against, and sampling looks
+like it breaks the oracle.  It doesn't — it moves it:
+
+- **Greedy stays the exactness oracle.**  Requests without
+  ``SamplingParams`` take the argmax path, byte-identical to before
+  (the engine even keeps the original compiled round program for
+  all-greedy rounds), and stay pinned token-identical to the
+  engine-independent solo oracle — including when they share rounds
+  with sampled requests.
+- **Sampled requests are pinned by keyed replay.**  Every sampled
+  request carries its own ``jax.random`` key stream; the key for its
+  ``i``-th generated token is ``fold_in(request_key, i)`` — a pure
+  function of the REQUEST (seed and token index), never of the slot,
+  the global position clock, rebases, or what else is in the batch.
+  Two runs of the same request under any scheduling produce the same
+  tokens, and the test oracle replays them solo from ``(key,
+  params)`` alone.
+
+Filters follow the HF composition order the static decode paths
+already use: temperature scaling, then top-k, then top-p, each
+truncating the distribution the next one sees.  All functions are
+pure ``jnp``, equally callable inside the engine's ``shard_map``
+round program (vectorized over rows) and on plain arrays (the tests'
+replay oracle) — same code path, which is what makes the replay pin
+meaningful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingParams", "filter_logits", "fold_keys",
+           "sample_tokens"]
+
+_NEG = -1e30     # finite mask value (the ring_attention/minilm convention)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling policy: ``submit(..., sampling=...)``.
+
+    ``temperature`` must be > 0 — greedy is the ABSENCE of sampling
+    (``sampling=None``), not a zero temperature, so the exactness
+    oracle's population is unambiguous.  ``top_k=0`` / ``top_p=1.0``
+    disable the respective filter; both compose (temperature, then
+    top-k, then top-p — the HF order).  ``seed`` derives the
+    request's private key stream; the same ``(seed, params, prompt)``
+    replays bit-identically under ANY scheduling."""
+
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature <= 0.0:
+            raise ValueError(
+                f"temperature={self.temperature} must be > 0: greedy "
+                "decoding is sampling=None, not temperature 0")
+        if self.top_k < 0:
+            raise ValueError(f"top_k={self.top_k} must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p={self.top_p} not in (0, 1]")
+
+    def key(self):
+        """The request's root key (host-side convenience)."""
+        return jax.random.PRNGKey(self.seed)
+
+
+def filter_logits(logits, top_k, top_p):
+    """Truncate ``logits`` (..., V) to the top-k then top-p
+    candidates, per row; filtered entries drop to the finite mask
+    value.  ``top_k`` (int, <=0 disables) and ``top_p`` (float, >=1
+    disables) broadcast over the leading axes, so per-request values
+    ride as (S,) arrays through the engine's round program."""
+    v = logits.shape[-1]
+    top_k = jnp.asarray(top_k)
+    top_p = jnp.asarray(top_p)
+    if top_k.ndim:
+        top_k = top_k[..., None]
+    if top_p.ndim:
+        top_p = top_p[..., None]
+    desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    # -- top-k: keep entries >= the k-th largest (ties keep all) ------- #
+    kth = jnp.take_along_axis(
+        desc, jnp.broadcast_to(
+            jnp.clip(top_k - 1, 0, v - 1),
+            logits.shape[:-1] + (1,)).astype(jnp.int32), axis=-1)
+    keep = (logits >= kth) | (top_k <= 0)
+    out = jnp.where(keep, logits, _NEG)
+    # -- top-p over the k-truncated distribution ----------------------- #
+    # one permutation serves both the cumsum and the unsort, so tied
+    # values keep/drop consistently
+    order = jnp.argsort(-out, axis=-1, stable=True)
+    probs = jax.nn.softmax(
+        jnp.take_along_axis(out, order, axis=-1), axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    # keep while the mass BEFORE a token is < p (at least one survives)
+    keep_sorted = (csum - probs) < top_p
+    rank = jnp.argsort(order, axis=-1)
+    keep_p = jnp.take_along_axis(keep_sorted, rank, axis=-1)
+    return jnp.where(keep_p, out, _NEG)
+
+
+def fold_keys(keys, data):
+    """Per-row ``fold_in``: ``keys`` (S, 2) uint32 raw key data,
+    ``data`` (S,) int32 — the sampled token's own index within its
+    request, which is what makes the stream schedule-invariant."""
+    return jax.vmap(jax.random.fold_in)(keys, data)
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p):
+    """One token per row from ``logits`` (S, V): rows with
+    ``temperature > 0`` sample from their filtered distribution with
+    their own key; the rest take the argmax (the greedy oracle path —
+    same values the greedy program computes).  All parameters are
+    per-row arrays; returns (S,) int32."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) \
+        / jnp.maximum(temperature, 1e-6)[:, None]
+    filt = filter_logits(scaled, top_k, top_p)
+    drawn = jax.vmap(jax.random.categorical)(keys, filt) \
+        .astype(jnp.int32)
+    return jnp.where(temperature > 0.0, drawn, greedy)

@@ -84,9 +84,11 @@ class SLOReport:
         self._arms: Dict[str, Dict[str, Histogram]] = {}
         self._skipped: Dict[str, Dict[str, int]] = {}
         self._slo: Dict[str, Dict[str, float]] = {}
+        self._extras: Dict[str, Dict[str, float]] = {}
 
     def add_arm(self, name: str, records: Iterable,
-                slo: Optional[Union[float, Callable]] = None
+                slo: Optional[Union[float, Callable]] = None,
+                extras: Optional[Dict[str, float]] = None
                 ) -> "SLOReport":
         """Fold ``records`` (``Completion``/``ShedCompletion``s, or
         dicts with the same field names) into arm ``name``'s
@@ -100,9 +102,18 @@ class SLOReport:
         A record ATTAINS its SLO iff it was fully served
         (``status == "ok"``) and its ``e2e`` is within target; the
         arm's goodput column sums the generated tokens of attaining
-        records only.  Returns self for chaining."""
+        records only.
+
+        ``extras`` attaches scalar per-arm columns that are not
+        latencies — speculative acceptance rate, prefix-cache hit
+        rate — carried verbatim into :meth:`summary` (``"extras"``)
+        and the rendered table footer; repeated calls merge keys
+        (last wins).  Returns self for chaining."""
         hists = self._arms.setdefault(
             name, {f: Histogram() for f in _FIELDS})
+        if extras:
+            self._extras.setdefault(name, {}).update(
+                {str(k): float(v) for k, v in extras.items()})
         skipped = self._skipped.setdefault(
             name, {f: 0 for f in _FIELDS})
         # the slo block only ever reflects batches scored WITH slo= —
@@ -168,6 +179,9 @@ class SLOReport:
                 s["attainment"] = (s["attained"] / s["scored"]
                                    if s["scored"] else None)
                 out[arm]["slo"] = s
+            extras = self._extras.get(arm)
+            if extras:
+                out[arm]["extras"] = dict(extras)
         return out
 
     def to_dict(self) -> dict:
@@ -206,15 +220,19 @@ class SLOReport:
         lines = [fmt.format(*r) for r in [cols] + rows]
         for arm, fields in summary.items():
             score = fields.get("slo")
-            if score is None:
-                continue
-            att = score["attainment"]
-            lines.append(
-                f"{arm}  slo: {score['attained']}/{score['scored']} "
-                f"attained"
-                + (f" ({att * 100:.1f}%)" if att is not None else "")
-                + f"  goodput {score['goodput_tokens']} tok"
-                + f"  shed {score['shed']}")
+            if score is not None:
+                att = score["attainment"]
+                lines.append(
+                    f"{arm}  slo: {score['attained']}/{score['scored']}"
+                    f" attained"
+                    + (f" ({att * 100:.1f}%)" if att is not None
+                       else "")
+                    + f"  goodput {score['goodput_tokens']} tok"
+                    + f"  shed {score['shed']}")
+            extras = fields.get("extras")
+            if extras:
+                lines.append(f"{arm}  " + "  ".join(
+                    f"{k} {v:.4g}" for k, v in sorted(extras.items())))
         return "\n".join(lines)
 
     def __str__(self) -> str:

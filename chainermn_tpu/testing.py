@@ -430,6 +430,11 @@ class FaultInjector:
             state["ticks"] += 1
             if (plan.serve_exhaust_pool_at_admit == n
                     and self._FAULT_HOARD not in engine._alloc.rows()):
+                # cache-only prefix blocks are reclaimable on demand,
+                # so a faithful exhaustion drill must hoard them too
+                reclaim = getattr(engine._alloc, "reclaim", None)
+                if reclaim is not None:
+                    reclaim(engine._alloc.n_blocks)
                 engine._alloc.alloc(self._FAULT_HOARD,
                                     engine._alloc.n_free)
                 state["hoard_until"] = (

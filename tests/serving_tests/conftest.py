@@ -6,6 +6,8 @@ adapter's pure step/prefill functions — no shard_map, no engine code —
 so engine-vs-oracle token identity actually pins the scheduler, not
 two copies of one bug."""
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,6 +21,42 @@ from chainermn_tpu.serving import (
 )
 
 VOCAB = 64
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _engine_registry():
+    """Track every engine the suite constructs (weakly — fixtures may
+    outlive tests) so the leak guard below can audit them all."""
+    from chainermn_tpu.serving import engine as engine_mod
+
+    registry = weakref.WeakSet()
+    orig_init = engine_mod.ServingEngine.__init__
+
+    def tracked_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        registry.add(self)
+
+    engine_mod.ServingEngine.__init__ = tracked_init
+    try:
+        yield registry
+    finally:
+        engine_mod.ServingEngine.__init__ = orig_init
+
+
+@pytest.fixture(autouse=True)
+def pool_leak_guard(_engine_registry):
+    """Suite-wide refcount-leak fixture: after EVERY serving test,
+    every engine that is idle (nothing queued, active, or staged) must
+    account for all its pool blocks — free, or trie-cached with
+    exactly the trie's reference.  A fork/eviction path that drops or
+    double-counts a reference fails the suite here even if its own
+    test never looked."""
+    yield
+    for eng in list(_engine_registry):
+        if eng.idle and not eng._staged:
+            problems = eng._alloc.leak_report()
+            assert not problems, (
+                f"pool leak after test (engine {eng!r}): {problems}")
 
 
 @pytest.fixture(scope="session")

@@ -480,3 +480,195 @@ class TestDeterministicPolicies:
             assert logs[0] == logs[1]
         finally:
             engine.set_policy("fcfs")
+
+
+class TestWeightedFairQueuing:
+    """Deficit-round-robin tenant scheduling (ROADMAP admission open
+    end #2): quotas bound in-flight, WFQ decides who goes NEXT."""
+
+    def test_weighted_token_shares(self):
+        ctrl = AdmissionController(tenant_weights={"a": 2.0, "b": 1.0})
+        queue = [_req(f"a{i}", max_new=8, tenant="a") for i in range(30)]
+        queue += [_req(f"b{i}", max_new=8, tenant="b") for i in range(30)]
+        served = {"a": 0, "b": 0}
+        for _ in range(30):
+            pick = ctrl.wfq_pick(queue)
+            ctrl.wfq_charge(pick)           # the engine's admit step
+            queue.remove(pick)
+            served[pick.tenant] += pick.max_new
+        assert served["a"] == 2 * served["b"]
+
+    def test_failed_admission_is_not_charged(self):
+        """A pick whose admission fails downstream (pool full) leaves
+        the request queued and costs the tenant NOTHING: repeated
+        picks re-select the same head without debiting, and the
+        weighted shares stay intact once capacity frees."""
+        ctrl = AdmissionController(tenant_weights={"a": 1.0, "b": 1.0})
+        queue = [_req(f"a{i}", max_new=8, tenant="a") for i in range(4)]
+        queue += [_req(f"b{i}", max_new=8, tenant="b") for i in range(4)]
+        first = ctrl.wfq_pick(queue)
+        # admission fails repeatedly: same head, no deficit drain
+        for _ in range(5):
+            assert ctrl.wfq_pick(queue) is first
+        d_before = dict(ctrl._wfq_deficit)
+        assert ctrl.wfq_pick(queue) is first
+        assert ctrl._wfq_deficit == d_before
+        served = {"a": 0, "b": 0}
+        for _ in range(8):
+            pick = ctrl.wfq_pick(queue)
+            ctrl.wfq_charge(pick)
+            queue.remove(pick)
+            served[pick.tenant] += pick.max_new
+        assert served["a"] == served["b"]
+
+    def test_transient_high_priority_keeps_lower_class_credit(self):
+        """A passing priority-0 request must not wipe the DRR credit
+        of still-queued lower-class tenants."""
+        ctrl = AdmissionController()
+        lo = [_req(f"a{i}", max_new=8, priority=1, tenant="a")
+              for i in range(3)]
+        pick = ctrl.wfq_pick(lo)            # tenant a accrues credit
+        assert pick.tenant == "a"
+        hi = _req("hi", max_new=4, priority=0, tenant="c")
+        assert ctrl.wfq_pick(lo + [hi]) is hi
+        assert "a" in ctrl._wfq_deficit     # credit survived
+
+    def test_fcfs_within_tenant_and_priority_class_gate(self):
+        ctrl = AdmissionController()
+        hi = _req("hi", priority=0, tenant="b")
+        queue = [_req("a0", priority=1, tenant="a"),
+                 _req("a1", priority=1, tenant="a"), hi]
+        # class 0 present: only its requests are candidates
+        assert ctrl.wfq_pick(queue) is hi
+        queue.remove(hi)
+        first = ctrl.wfq_pick(queue)
+        assert first.rid == "a0"        # submit order within tenant
+
+    def test_deterministic_given_trace(self):
+        def run():
+            ctrl = AdmissionController(
+                tenant_weights={"a": 1.5, "b": 1.0})
+            queue = [_req(f"{t}{i}", max_new=4 + (i % 3) * 4, tenant=t)
+                     for i in range(10) for t in ("a", "b", "c")]
+            picks = []
+            while queue:
+                p = ctrl.wfq_pick(queue)
+                ctrl.wfq_charge(p)
+                queue.remove(p)
+                picks.append(p.rid)
+            return picks
+
+        assert run() == run()
+
+    def test_starvation_freedom_in_engine(self, engine):
+        """A flood from tenant A cannot starve tenant B: with WFQ,
+        B's first admission lands within one tenant rotation of the
+        first post-flood slot, not after A's whole backlog."""
+        engine.reset()
+        engine.admission = AdmissionController()
+        engine.set_policy("wfq")
+        try:
+            rng = np.random.RandomState(20)
+            # fill all slots, then flood the queue from tenant A
+            blockers = [engine.submit(rng.randint(0, 64, 6),
+                                      max_new=16, tenant="a")
+                        for _ in range(8)]
+            del blockers
+            engine.step()
+            flood = [engine.submit(rng.randint(0, 64, 6), max_new=8,
+                                   tenant="a") for _ in range(16)]
+            late = [engine.submit(rng.randint(0, 64, 6), max_new=8,
+                                  tenant="b") for _ in range(4)]
+            engine.run(max_steps=2000)
+            order = [r for r in engine.admit_log
+                     if r in set(flood) | set(late)]
+            # every B request admits before the flood's second half
+            worst_b = max(order.index(r) for r in late)
+            assert worst_b < len(order) - 1 and worst_b <= 9, order
+            # and interleaving really alternates near the front
+            assert any(r in set(late) for r in order[:3])
+        finally:
+            engine.set_policy("fcfs")
+            _clear_admission(engine)
+
+    def test_wfq_without_controller_raises(self, engine):
+        engine.reset()
+        engine.set_policy("wfq")
+        try:
+            engine.submit(np.arange(4) % 64, max_new=4)
+            with pytest.raises(ValueError, match="AdmissionController"):
+                engine.step()
+        finally:
+            engine.set_policy("fcfs")
+            engine.reset()
+
+    def test_weight_validation(self):
+        with pytest.raises(ValueError, match="weight"):
+            AdmissionController(tenant_weights={"a": 0.0})
+        with pytest.raises(ValueError, match="default_weight"):
+            AdmissionController(default_weight=-1.0)
+        with pytest.raises(ValueError, match="wfq_quantum"):
+            AdmissionController(wfq_quantum=0.0)
+
+
+class TestQuotaRetryAfter:
+    """ISSUE 14 satellite: over_quota sheds carry a retry_after from
+    the tenant's predicted in-flight drain — the same backoff hint
+    capacity sheds already quote — without disturbing the taxonomy."""
+
+    def test_over_quota_carries_drain_hint(self, engine, registry):
+        engine.reset()
+        pred = ServiceTimePredictor(quantile=50.0)
+        for _ in range(10):
+            pred.observe_tpot(0.01)          # 10 ms/token, warm
+        engine.admission = AdmissionController(quotas={"t": 8},
+                                               predictor=pred)
+        try:
+            rng = np.random.RandomState(21)
+            ok = engine.submit(rng.randint(0, 64, 6), max_new=8,
+                               tenant="t")
+            assert isinstance(ok, str)
+            shed = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                                 tenant="t")
+            assert isinstance(shed, ShedCompletion)
+            assert shed.reason == "over_quota"
+            # 4 tokens over quota across 8 slots at 10 ms/token
+            assert shed.retry_after == pytest.approx(
+                0.01 * 4 / 8, rel=1e-6)
+            # taxonomy intact: reason-coded AND totalled
+            snap = engine.metrics_snapshot()
+            assert snap["serve/shed_over_quota"]["value"] == 1
+            assert snap["serve/shed_total"]["value"] == 1
+            engine.run(max_steps=500)
+        finally:
+            _clear_admission(engine)
+
+    def test_cold_predictor_gives_no_hint(self, engine):
+        engine.reset()
+        engine.admission = AdmissionController(quotas={"t": 8})
+        try:
+            rng = np.random.RandomState(22)
+            engine.submit(rng.randint(0, 64, 6), max_new=8, tenant="t")
+            shed = engine.submit(rng.randint(0, 64, 6), max_new=4,
+                                 tenant="t")
+            assert isinstance(shed, ShedCompletion)
+            assert shed.reason == "over_quota"
+            assert shed.retry_after is None
+            engine.run(max_steps=500)
+        finally:
+            _clear_admission(engine)
+
+    def test_unlimited_tenant_never_hints(self, engine):
+        engine.reset()
+        pred = ServiceTimePredictor()
+        for _ in range(10):
+            pred.observe_tpot(0.01)
+        engine.admission = AdmissionController(predictor=pred)
+        try:
+            rng = np.random.RandomState(23)
+            r = engine.submit(rng.randint(0, 64, 6), max_new=8,
+                              tenant="t")
+            assert isinstance(r, str)      # no quota -> no shed at all
+            engine.run(max_steps=500)
+        finally:
+            _clear_admission(engine)

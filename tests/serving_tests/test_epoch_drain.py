@@ -215,8 +215,11 @@ class TestQueueCarryOver:
         assert [r.rid for r in carried] == rids[8:]
         assert all(r.t_submit > 0 for r in carried)
         assert len(engine._queue) == 0
-        # staged pool rows were freed with the queue
-        assert engine._alloc.n_free == engine._alloc.n_blocks
+        # staged pool rows were freed with the queue (trie-cached
+        # prefix blocks may stay resident — that retention is the
+        # prefix cache; the refcount audit proves nothing leaked)
+        assert not engine._alloc.rows()
+        assert not engine._alloc.leak_report()
 
         new_engine = ServingEngine(
             mini_adapter, mini_params, n_slots=8, horizon=160,

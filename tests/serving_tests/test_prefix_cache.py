@@ -1,0 +1,247 @@
+"""Copy-on-write prefix sharing: refcount lifecycle at the unit level
+(no jax) and the engine-level exactness ladder — greedy decode with
+sharing ON is token-identical to the engine-independent solo oracle
+AND to the sharing-OFF engine, while prefill compute and row-held pool
+pressure actually drop for shared-prefix traffic."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import ServingEngine
+from chainermn_tpu.serving.prefix_cache import RefcountedBlockPool
+from chainermn_tpu.utils.telemetry import get_recorder
+
+
+def _tok(*ids):
+    return np.asarray(ids, np.int32)
+
+
+class TestRefcountLifecycle:
+    def test_cold_stage_then_hit(self):
+        pool = RefcountedBlockPool(16, 4)
+        t = _tok(*range(10))            # 2 full blocks + 1 partial
+        plan = pool.stage("a", t)
+        assert plan.n_shared == 0 and plan.n_new == 3
+        assert pool.insert_cached("a", t) == 2     # partials never cache
+        plan_b = pool.stage("b", t)
+        assert plan_b.n_shared == 2 and plan_b.n_new == 1
+        # the shared blocks are the SAME physical ids
+        assert pool.table("b")[:2] == pool.table("a")[:2]
+        assert pool.table("b")[2] != pool.table("a")[2]
+        assert pool.n_hits == 2 and pool.n_prefilled == 4
+
+    def test_hit_across_lengths_and_divergence(self):
+        pool = RefcountedBlockPool(16, 4)
+        a = _tok(*range(8))
+        pool.stage("a", a)
+        pool.insert_cached("a", a)
+        # longer prompt sharing both full blocks
+        b = np.concatenate([a, _tok(50, 51, 52)])
+        plan = pool.stage("b", b)
+        assert plan.n_shared == 2 and plan.n_new == 1
+        # divergence INSIDE the second block: only block 0 shared
+        c = np.concatenate([a[:6], _tok(60, 61)])
+        plan = pool.stage("c", c)
+        assert plan.n_shared == 1 and plan.n_new == 1
+
+    def test_free_row_is_refcounted_and_idempotent(self):
+        pool = RefcountedBlockPool(8, 4)
+        t = _tok(*range(8))
+        pool.stage("a", t)
+        pool.insert_cached("a", t)
+        pool.stage("b", t)              # full hit, shares both blocks
+        shared = pool.table("a")[0]
+        assert pool.refcount(shared) == 3     # a + b + trie
+        assert pool.free_row("a") == 0        # nothing came FREE
+        assert pool.refcount(shared) == 2
+        # double free: unknown row frees nothing, refs untouched
+        assert pool.free_row("a") == 0
+        assert pool.refcount(shared) == 2
+        assert pool.free_row("b") == 0        # trie still holds them
+        assert pool.n_free == 6 and pool.n_cached == 2
+        assert not pool.leak_report()
+
+    def test_shared_block_eviction_refuses(self):
+        pool = RefcountedBlockPool(8, 4)
+        t = _tok(*range(8))
+        pool.stage("a", t)
+        pool.insert_cached("a", t)
+        bid = pool.table("a")[0]
+        with pytest.raises(RuntimeError, match="refcount"):
+            pool.evict_block(bid)             # a + trie hold it
+        pool.free_row("a")
+        pool.evict_block(bid)                 # trie-only now: allowed
+        assert pool.refcount(bid) == 0
+        assert bid in pool._free
+
+    def test_reclaim_drops_lru_cache_only(self):
+        pool = RefcountedBlockPool(2, 4)
+        old = _tok(*range(4))
+        pool.stage("old", old)
+        pool.insert_cached("old", old)
+        pool.free_row("old")                  # cache-only now
+        new = _tok(*range(40, 48))            # needs both blocks
+        plan = pool.stage("new", new)         # must reclaim the LRU one
+        assert plan is not None and pool.n_reclaimed == 1
+        assert pool.n_cached == 0
+        # blocks a live row holds are untouchable
+        assert pool.reclaim(10) == 0
+        assert pool.n_free == 0
+        pool.free_row("new")
+        assert not pool.leak_report()
+
+    def test_fork_on_write(self):
+        pool = RefcountedBlockPool(8, 4)
+        t = _tok(*range(8))
+        pool.stage("a", t)
+        pool.insert_cached("a", t)
+        pool.stage("b", t)
+        shared = pool.table("b")[0]
+        forked = pool.fork_for_write("b", 0)
+        assert forked is not None and forked != shared
+        assert pool.table("b")[0] == forked
+        assert pool.table("a")[0] == shared   # original undisturbed
+        assert pool.refcount(shared) == 2     # a + trie
+        assert pool.refcount(forked) == 1
+        # a private block needs no fork
+        assert pool.fork_for_write("b", 0) is None
+        assert pool.n_forks == 1
+        pool.free_row("a")
+        pool.free_row("b")
+        assert not pool.leak_report()
+
+    def test_leak_report_catches_imbalance(self):
+        pool = RefcountedBlockPool(4, 4)
+        pool.stage("a", _tok(*range(4)))
+        bid = pool.table("a")[0]
+        pool._refs[bid] += 1                  # simulate a leaked ref
+        assert any("refcount" in p for p in pool.leak_report())
+
+    def test_share_false_degenerates(self):
+        pool = RefcountedBlockPool(8, 4, share=False)
+        t = _tok(*range(8))
+        pool.stage("a", t)
+        assert pool.insert_cached("a", t) == 0
+        plan = pool.stage("b", t)
+        assert plan.n_shared == 0 and plan.n_new == 2
+        assert pool.free_row("a") == 2        # everything comes free
+
+
+def _shared_trace(rng, n, prefix, vocab=64, max_extra=8, min_new=4,
+                  max_new=12):
+    """Requests sharing a common system-prompt prefix with ragged
+    divergent suffixes (the workload prefix sharing exists for)."""
+    out = []
+    for _ in range(n):
+        extra = rng.randint(1, max_extra + 1)
+        p = np.concatenate([prefix, rng.randint(0, vocab, extra)]) \
+            .astype(np.int32)
+        out.append((p, int(rng.randint(min_new, max_new + 1))))
+    return out
+
+
+class TestEngineSharing:
+    @pytest.fixture()
+    def engines(self, mini_adapter, mini_params):
+        on = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                           horizon=160, max_prompt=16, block=8,
+                           round_tokens=4, pool_blocks=48,
+                           prefix_sharing=True)
+        off = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4, pool_blocks=48,
+                            prefix_sharing=False)
+        return on, off
+
+    def test_sharing_on_matches_oracle_and_off(self, engines, oracle):
+        on, off = engines
+        rng = np.random.RandomState(0)
+        prefix = rng.randint(0, 64, 8).astype(np.int32)  # one full block
+        trace = _shared_trace(rng, 10, prefix)
+        results = {}
+        for eng in (on, off):
+            rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+            comps = {c.rid: c for c in eng.run(max_steps=2000)}
+            for rid, p, n in rids:
+                np.testing.assert_array_equal(
+                    comps[rid].tokens, oracle(p, n),
+                    err_msg=f"{rid} (sharing={eng.prefix_sharing}) "
+                            "diverged from solo decode")
+            results[eng.prefix_sharing] = {
+                r: comps[r].tokens for r, _, _ in rids}
+        # ON ≡ OFF token-for-token (same rids across the two engines)
+        for rid in results[True]:
+            np.testing.assert_array_equal(results[True][rid],
+                                          results[False][rid])
+        # and sharing actually HAPPENED: hits, fewer prefilled blocks,
+        # lower row-held pool pressure
+        assert on.stats()["prefix_hits"] > 0
+        assert off.stats()["prefix_hits"] == 0
+        assert on.stats()["prefix_prefilled"] \
+            < off.stats()["prefix_prefilled"]
+        assert on._alloc.peak_row_blocks <= off._alloc.peak_row_blocks
+
+    def test_full_hit_skips_prefill_entirely(self, mini_adapter,
+                                             mini_params, oracle):
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4, prefix_sharing=True)
+        rng = np.random.RandomState(1)
+        p = rng.randint(0, 64, 16).astype(np.int32)   # 2 full blocks
+        r1 = eng.submit(p, max_new=6)
+        c1 = {c.rid: c for c in eng.run(max_steps=500)}
+        prefilled_after_first = eng.stats()["prefix_prefilled"]
+        r2 = eng.submit(p, max_new=6)
+        c2 = {c.rid: c for c in eng.run(max_steps=500)}
+        # identical prompt: zero new blocks prefilled the second time
+        assert eng.stats()["prefix_prefilled"] == prefilled_after_first
+        assert eng.stats()["prefix_hits"] >= 2
+        ref = oracle(p, 6)
+        np.testing.assert_array_equal(c1[r1].tokens, ref)
+        np.testing.assert_array_equal(c2[r2].tokens, ref)
+
+    def test_fork_block_device_copy_keeps_tokens_exact(
+            self, mini_adapter, mini_params, oracle):
+        """The COW fork primitive end-to-end: fork a staged request's
+        shared block, then admit — the forked copy must carry the same
+        K/V (tokens stay oracle-exact) while the original keeps its
+        other holders."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4, prefix_sharing=True)
+        rng = np.random.RandomState(2)
+        p = rng.randint(0, 64, 12).astype(np.int32)
+        r1 = eng.submit(p, max_new=6)
+        out1 = {c.rid: c for c in eng.run(max_steps=500)}
+        # second request hits the cached full block; fork it while
+        # staged, BEFORE admission
+        r2 = eng.submit(p, max_new=6)
+        req2 = eng._queue[0]
+        assert eng._stage(req2, get_recorder(), steal=False)
+        shared = eng._alloc.table(r2)[0]
+        assert eng._alloc.refcount(shared) > 1
+        forked = eng.fork_block(r2, 0)
+        assert forked != shared
+        out2 = {c.rid: c for c in eng.run(max_steps=500)}
+        ref = oracle(p, 6)
+        np.testing.assert_array_equal(out1[r1].tokens, ref)
+        np.testing.assert_array_equal(out2[r2].tokens, ref)
+
+    def test_steal_under_pressure_with_sharing(self, mini_adapter,
+                                               mini_params, oracle):
+        """Tight pool + shared prefixes: the steal/reclaim paths keep
+        every served request exact and leak nothing."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            pool_blocks=4, round_tokens=4,
+                            prefill_ahead=4, prefix_sharing=True)
+        rng = np.random.RandomState(3)
+        prefix = rng.randint(0, 64, 8).astype(np.int32)
+        trace = _shared_trace(rng, 12, prefix, min_new=8, max_new=16)
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+        comps = {c.rid: c for c in eng.run(max_steps=4000)}
+        for rid, p, n in rids:
+            assert comps[rid].status == "ok"
+            np.testing.assert_array_equal(comps[rid].tokens,
+                                          oracle(p, n))
+        assert not eng._alloc.leak_report()

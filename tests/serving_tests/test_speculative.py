@@ -1,0 +1,168 @@
+"""Speculative draft/verify serving decoder.
+
+The exactness ladder: greedy speculative output EXACTLY equals the
+target-only greedy decode (the draft changes speed, never content) —
+pinned both against the decoder's own target loop and against
+conftest's engine-independent oracle; sampled runs replay
+bit-identically from their seed; acceptance accounting is the honest
+observability (a self-draft accepts everything, a random draft
+almost nothing)."""
+
+import jax
+import numpy as np
+import pytest
+
+from chainermn_tpu.parallel import MeshConfig
+from chainermn_tpu.serving import (
+    MiniLMAdapter,
+    MiniLMConfig,
+    SamplingParams,
+    SpeculativeDecoder,
+    init_minilm,
+)
+
+
+@pytest.fixture(scope="module")
+def draft(mini_cfg):
+    cfg = MiniLMConfig(vocab_size=mini_cfg.vocab_size, d_model=16,
+                       n_heads=2, d_head=8, d_ff=32, n_layers=1,
+                       max_pos=mini_cfg.max_pos)
+    params = init_minilm(jax.random.PRNGKey(9), cfg)
+    return MiniLMAdapter(MeshConfig(data=1, devices=jax.devices()[:1]), cfg), params
+
+
+@pytest.fixture(scope="module")
+def solo_target(mini_cfg, mini_params):
+    return MiniLMAdapter(MeshConfig(data=1, devices=jax.devices()[:1]), mini_cfg), mini_params
+
+
+@pytest.fixture(scope="module")
+def decoder(draft, solo_target):
+    (da, dp), (ta, tp) = draft, solo_target
+    return SpeculativeDecoder(da, dp, ta, tp, k=3, max_prompt=16,
+                              horizon=96)
+
+
+class TestGreedyExactness:
+    def test_equals_target_only_decode(self, decoder):
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            p = rng.randint(0, 64, rng.randint(2, 17))
+            n = int(rng.randint(4, 25))
+            res = decoder.generate(p, n)
+            np.testing.assert_array_equal(
+                res.tokens, decoder.target_decode(p, n),
+                err_msg="speculative greedy diverged from target-only")
+            assert res.drafted == res.rounds * decoder.k
+            assert 0 <= res.accepted <= res.drafted
+
+    def test_equals_engine_oracle(self, decoder, oracle):
+        """The same tokens the serving suite's solo oracle produces —
+        the right-aligned layout changes nothing."""
+        rng = np.random.RandomState(1)
+        for _ in range(4):
+            p = rng.randint(0, 64, rng.randint(2, 17))
+            np.testing.assert_array_equal(decoder.generate(p, 12).tokens,
+                                          oracle(p, 12))
+
+    def test_self_draft_accepts_everything(self, solo_target):
+        ta, tp = solo_target
+        dec = SpeculativeDecoder(ta, tp, ta, tp, k=4, max_prompt=16,
+                                 horizon=96)
+        res = dec.generate(np.arange(8) % 64, 16)
+        assert res.acceptance_rate == 1.0
+        assert res.rounds == -(-16 // (dec.k + 1))   # k+1 per round
+
+    def test_eos_stops_early(self, decoder, oracle):
+        rng = np.random.RandomState(2)
+        # an eos that provably occurs mid-decode
+        p = rng.randint(0, 64, 8)
+        eos = int(oracle(p, 12)[4])
+        dec = SpeculativeDecoder(decoder.draft, decoder.d_params,
+                                 decoder.target, decoder.t_params,
+                                 k=3, max_prompt=16, horizon=96,
+                                 eos_id=eos)
+        res = dec.generate(p, 12)
+        ref = dec.target_decode(p, 12)
+        np.testing.assert_array_equal(res.tokens, ref)
+        assert res.tokens.shape[0] <= 12
+        if eos in ref:
+            assert res.tokens[-1] == eos
+
+    def test_validation(self, draft, solo_target):
+        (da, dp), (ta, tp) = draft, solo_target
+        with pytest.raises(ValueError, match="k="):
+            SpeculativeDecoder(da, dp, ta, tp, k=0, max_prompt=8,
+                               horizon=32)
+        with pytest.raises(ValueError, match="horizon"):
+            SpeculativeDecoder(da, dp, ta, tp, k=2, max_prompt=32,
+                               horizon=32)
+        bad_cfg = MiniLMConfig(vocab_size=32, d_model=16, n_heads=2,
+                               d_head=8, d_ff=32, n_layers=1)
+        bad = MiniLMAdapter(MeshConfig(data=1, devices=jax.devices()[:1]), bad_cfg)
+        with pytest.raises(ValueError, match="vocab"):
+            SpeculativeDecoder(bad, init_minilm(jax.random.PRNGKey(0),
+                                                bad_cfg),
+                               ta, tp, k=2, max_prompt=8, horizon=32)
+        dec = SpeculativeDecoder(da, dp, ta, tp, k=2, max_prompt=8,
+                                 horizon=32)
+        with pytest.raises(ValueError, match="max_new"):
+            dec.generate(np.arange(4), 100)
+
+
+class TestSampledSpeculation:
+    def test_replay_determinism(self, decoder):
+        rng = np.random.RandomState(3)
+        p = rng.randint(0, 64, 10)
+        sp = SamplingParams(temperature=0.9, top_k=16, top_p=0.95,
+                            seed=42)
+        a = decoder.generate(p, 16, sampling=sp)
+        b = decoder.generate(p, 16, sampling=sp)
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        assert a.rounds == b.rounds and a.accepted == b.accepted
+
+    def test_different_seeds_differ(self, decoder):
+        rng = np.random.RandomState(4)
+        p = rng.randint(0, 64, 10)
+        outs = [decoder.generate(
+            p, 16, sampling=SamplingParams(temperature=1.5, seed=s)
+        ).tokens for s in range(6)]
+        assert any(not np.array_equal(outs[0], o) for o in outs[1:])
+
+    def test_self_draft_sampled_accepts_everything(self, solo_target):
+        """Draft == target: p_d′ == p_t′, so the acceptance test
+        u < p_t/p_d = 1 always passes — the Leviathan identity's
+        degenerate corner is a sharp accounting check."""
+        ta, tp = solo_target
+        dec = SpeculativeDecoder(ta, tp, ta, tp, k=3, max_prompt=16,
+                                 horizon=96)
+        res = dec.generate(np.arange(8) % 64, 12,
+                           sampling=SamplingParams(temperature=1.0,
+                                                   seed=5))
+        assert res.acceptance_rate == 1.0
+
+
+class TestObservability:
+    def test_metrics_and_spans(self, decoder):
+        from chainermn_tpu.utils.metrics import get_registry
+        from chainermn_tpu.utils.telemetry import (
+            TraceRecorder,
+            get_recorder,
+            set_recorder,
+        )
+
+        reg = get_registry()
+        reg.enable()
+        prev = set_recorder(TraceRecorder(capacity=4096, enabled=True))
+        try:
+            reg.clear()
+            res = decoder.generate(np.arange(10) % 64, 12)
+            snap = reg.snapshot(prefix="serve/")
+            assert snap["serve/spec_drafted"]["value"] == res.drafted
+            assert snap["serve/spec_accepted"]["value"] == res.accepted
+            names = {e["name"] for e in get_recorder().events()}
+            assert "serve/draft" in names and "serve/verify" in names
+        finally:
+            set_recorder(prev)
+            reg.clear()
+            reg.disable()

@@ -182,12 +182,6 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
      ["--batch", "8", "--dim", "48", "--hidden", "48", "--n-layers",
       "4", "--accum-steps", "2", "--warmup", "1", "--iters", "4",
       "--rounds", "1", "--trials", "1", "--min-frac", "0.4"], "x"),
-    ("bench_serving.py",
-     ["--requests", "8", "--slots", "8", "--horizon", "128",
-      "--max-prompt", "16", "--block", "8", "--min-new", "4",
-      "--max-new", "24", "--round-tokens", "2", "--d-model", "32",
-      "--n-layers", "1", "--heads", "2", "--vocab", "64",
-      "--rounds", "1"], "x"),
     ("bench_overload.py",
      ["--requests", "12", "--slots", "8", "--horizon", "128",
       "--max-prompt", "16", "--block", "8", "--min-new", "4",
@@ -208,13 +202,45 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
         "autotune", "telemetry", "metrics_registry", "overlap",
-        "serving", "overload", "elastic", "live_elastic",
-        "obs_plane"])
+        "overload", "elastic", "live_elastic", "obs_plane"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
         expect_value=True)
     assert rec["unit"] == unit
+
+
+def test_serving_decode_tier_arms_contract():
+    """The serving bench's contract row (ONE child covers the generic
+    one-JSON-line contract AND the ISSUE 14 decode-tier arms —
+    prefix-share, sampled, speculative): exactness witnesses all
+    zero, rates within range, self-draft acceptance exactly 1 (the
+    machinery sanity anchor)."""
+    rec = _assert_contract(
+        _run("bench_serving.py",
+             ["--platform", "cpu", "--requests", "8", "--slots", "8",
+              "--horizon", "128", "--max-prompt", "16", "--block", "8",
+              "--min-new", "4", "--max-new", "24", "--round-tokens",
+              "2", "--d-model", "32", "--n-layers", "1", "--heads",
+              "2", "--vocab", "64", "--rounds", "1", "--decode-tier",
+              "1", "--prefix-requests", "8", "--shared-prefix", "8",
+              "--spec-prompts", "2", "--spec-new", "16",
+              "--timeouts", "420"]),
+        expect_value=True)
+    for field in ("prefix_prefill_speedup", "prefix_hit_rate",
+                  "prefix_pool_pressure_drop",
+                  "prefix_share_peak_row_blocks",
+                  "sampled_tokens_per_sec", "spec_tokens_per_sec",
+                  "spec_acceptance_rate", "spec_vs_target_only",
+                  "spec_selfdraft_acceptance_rate"):
+        assert field in rec, field
+    # the exactness ladder's bench-side witnesses
+    assert rec["prefix_token_identity_mismatches"] == 0
+    assert rec["sampled_replay_mismatches"] == 0
+    assert rec["spec_identity_mismatches"] == 0
+    assert rec["spec_selfdraft_identity_mismatches"] == 0
+    assert rec["spec_selfdraft_acceptance_rate"] == 1.0
+    assert 0.0 <= rec["prefix_hit_rate"] <= 1.0
 
 
 def test_breakdown_analyze_only_roofline():
