@@ -136,9 +136,32 @@ def freshest_cached(metric: str, match: dict | None = None,
     return None
 
 
+def run_check(record: dict, cache_match=None, direction="higher"):
+    """The perf-regression sentinel hook (``bench.py --check`` — any
+    bench script can pass ``check=True`` through
+    ``run_child_with_retries``): score ``record`` against the
+    measurement cache's PRIOR runs of the same metric and workload
+    (``utils/regression.py`` noise-aware bounds) and return the
+    machine-readable verdict block.  Called BEFORE the record is
+    appended, so a run never anchors its own bound.  The record's own
+    ``device_kind`` joins the workload match: a TPU run is never
+    scored against a CPU-measured baseline (or vice versa) — cross-
+    device numbers are different workloads, not history."""
+    from chainermn_tpu.utils import regression
+
+    match = dict(cache_match or {})
+    if record.get("device_kind") is not None:
+        match.setdefault("device_kind", record["device_kind"])
+    return regression.check_record(
+        record, regression.load_history(CACHE_PATH),
+        match=match or None, direction=direction)
+
+
 def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
                            use_cache=True, cache_match=None,
-                           fallback=True, cache_require=()) -> int:
+                           fallback=True, cache_require=(),
+                           check=False,
+                           check_direction="higher") -> int:
     """Run ``cmd`` under per-attempt timeouts until one prints a
     ``BENCH_RESULT`` line; always print exactly one JSON line.
 
@@ -158,6 +181,21 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
     failure as null instead of serving the cache — for live-ness
     probes (bench_session.py) where a cached value must not read as
     "the chip is awake".
+
+    ``check=True`` runs the perf-regression sentinel: the fresh
+    record is scored against the cache's prior same-workload runs
+    (:func:`run_check`) before being recorded, the verdict rides the
+    printed JSON under ``"check"``, and the exit code is 1 on a
+    ``"regression"`` verdict (0 otherwise — ``no_history`` is
+    evidence, not a failure).  A total failure is ``"no_result"`` +
+    exit 1; a CACHE-SERVED fallback is ``"cached"`` + exit 0 — not a
+    live measurement, so it is never scored against the history it
+    was copied from — and a platform-pinned smoke run
+    (``use_cache=False``) is ``"smoke"`` + exit 0, never scored
+    against the hardware history its records are excluded from (a
+    strict CI gate keys on ``pass``/``improved`` only).  ``check_direction`` names which way is
+    better for the metric: ``"higher"`` (throughput, speedup ratios —
+    the default) or ``"lower"`` (overhead ratios, latencies).
     """
     errors = []
     for attempt, budget in enumerate(timeouts):
@@ -173,15 +211,64 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
         for line in reversed(proc.stdout.splitlines()):
             if line.startswith("BENCH_RESULT "):
                 payload = line[len("BENCH_RESULT "):]
+                rc = 0
+                out_line = payload
+                verdict = None
+                if check:
+                    try:
+                        rec = json.loads(payload)
+                        if not use_cache:
+                            # a platform-pinned smoke run: its records
+                            # are deliberately kept OUT of the history
+                            # (a toy CPU number is not a hardware
+                            # measurement), so scoring it AGAINST that
+                            # history would gate smoke runs on a
+                            # foreign-device baseline — non-gating
+                            rec["check"] = {
+                                "verdict": "smoke",
+                                "metric": metric,
+                                "direction": check_direction,
+                                "note": "platform-pinned smoke run — "
+                                        "not scored against the "
+                                        "hardware history it is "
+                                        "excluded from",
+                            }
+                        else:
+                            # scored BEFORE record_measurement appends
+                            # it: a run must never anchor its own bound
+                            rec["check"] = run_check(
+                                rec, cache_match,
+                                direction=check_direction)
+                        verdict = rec["check"].get("verdict")
+                        out_line = json.dumps(rec)
+                        # no_result (a child that printed value:null)
+                        # is as red as a regression: a failed bench
+                        # cannot pass a perf gate — matching the
+                        # no-BENCH_RESULT branch below
+                        if verdict in ("regression", "no_result"):
+                            rc = 1
+                    except Exception:
+                        # the sentinel must never eat a measurement
+                        pass
                 if use_cache:
                     try:
-                        record_measurement(json.loads(payload))
+                        # the record without the full verdict block (a
+                        # cache entry is evidence, not a judgement) —
+                        # but a regression verdict is STAMPED so the
+                        # sentinel's history excludes the run: N CI
+                        # re-runs of a real regression must not pull
+                        # the baseline down until the gate
+                        # self-normalizes green
+                        entry = json.loads(payload)
+                        if verdict == "regression":
+                            entry["check_verdict"] = verdict
+                        record_measurement(entry)
                     except Exception:
                         # never lose a live result to a cache-write
                         # failure (read-only checkout, full disk)
                         pass
-                print(payload)
-                return 0
+                print(out_line)
+                return rc
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
         errors.append(
             f"attempt {attempt + 1}: rc={proc.returncode}, "
@@ -200,6 +287,21 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
         out["live_error"] = error
         if diagnosis:
             out["outage_diagnosis"] = diagnosis
+        if check:
+            # a cache-served record is not fresh evidence — it IS the
+            # history (scoring it against itself would always read
+            # "pass" and wave a real regression through a dead-chip
+            # window).  The sentinel reports the distinct non-gating
+            # verdict instead: exit 0 (the outage is not a perf
+            # regression), but a strict CI gate can key on
+            # verdict == "pass"/"improved" only.
+            out["check"] = {
+                "verdict": "cached",
+                "metric": metric,
+                "direction": check_direction,
+                "note": "live attempt failed; cache-served record is "
+                        "not scored against the history it came from",
+            }
         print(json.dumps(out))
         return 0
     rec = {
@@ -211,6 +313,13 @@ def run_child_with_retries(cmd, cwd, timeouts, metric, unit,
     }
     if diagnosis:
         rec["outage_diagnosis"] = diagnosis
+    if check:
+        # a failed bench cannot pass a perf gate: the sentinel reports
+        # no_result and the --check exit code goes red
+        rec["check"] = {"verdict": "no_result", "metric": metric,
+                        "direction": check_direction}
+        print(json.dumps(rec))
+        return 1
     print(json.dumps(rec))
     return 0
 

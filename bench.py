@@ -183,7 +183,8 @@ def _parent_main(args):
         cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
         use_cache=args.platform is None,
         cache_match={"batch": args.batch, "image": args.image},
-        fallback=not args.no_cache)
+        fallback=not args.no_cache,
+        check=args.check)
 
 
 def _parse_args(argv):
@@ -200,6 +201,13 @@ def _parse_args(argv):
     p.add_argument("--platform", default=None,
                    help="pin JAX platform in the child (e.g. cpu for a "
                         "smoke test)")
+    p.add_argument("--check", action="store_true",
+                   help="perf-regression sentinel: score the fresh "
+                        "record against BENCH_MEASURED.json's prior "
+                        "same-workload runs (noise-aware bounds, "
+                        "utils/regression.py); the verdict rides the "
+                        "JSON line under 'check' and the exit code is "
+                        "1 on a regression verdict")
     p.add_argument("--no-cache", action="store_true",
                    help="liveness-probe mode: record a success to the "
                         "cache but never SERVE the cache on failure "

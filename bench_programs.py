@@ -1,0 +1,248 @@
+"""Compile-and-memory plane overhead benchmark: program ledger +
+memory accountant ON vs OFF.
+
+The ledger (``utils/programs.py``) only earns riding EVERY jit call
+site in the stack — the updater step, all nine serving programs, the
+autotune probes — if the steady-state hit path (signature hash + one
+set lookup per call) is effectively free.  Both arms run the SAME
+StandardUpdater training loop on the 8-device mesh through the
+ledger-instrumented step program; the ON arm enables the
+ProgramLedger AND the metrics registry (so the ``compile/calls``
+counter bump per call is on the measured line), marks the loop
+steady after warmup, and samples a MemoryAccountant holding the
+params + optimizer-state roots every ``--sample-every`` steps (the
+statusz-scrape cadence, amortized the way production amortizes it);
+the OFF arm is the production default — disabled ledger (one
+attribute read, straight dispatch) and disabled registry.
+
+The ON arm also asserts the plane's own invariants every run: the
+warmup compiles are all attributed (ledger label stats carry
+``train/step``), and the steady timed loop records ZERO
+steady-retraces — the zero-steady-state-recompile invariant this PR
+pins, measured here on every bench run, not just in the test suite.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}:
+value = plane-off steps/sec ÷ plane-on steps/sec ("x"; 1.0 = free).
+``overhead_pct`` = (value − 1) × 100, ``within_bar`` reports the <1%
+bar (docs/OBSERVABILITY.md "Compile & memory").  Arms are interleaved
+timed back-to-back per round (order-alternating) and the value is
+the MEDIAN of per-round off/on ratios — this box's load comes in
+multi-second bursts, and a burst taxes both members of a pair while
+the median discards the pairs one straddled (the bench_obs_plane
+measurement shape); same hermetic child-process pattern as
+bench_metrics_registry.py.  ``--check`` runs the perf regression
+sentinel on the fresh record (``utils/regression.py``).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from _bench_common import pin_platform, run_child_with_retries
+
+METRIC = "program_ledger_overhead"
+UNIT = "x"
+BAR_PCT = 1.0
+
+
+def run(batch=8, dim=512, hidden=2048, classes=10, n_examples=4096,
+        warmup=3, iters=60, rounds=6, sample_every=16):
+    import jax
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import (init_mlp, mlp_apply,
+                                      softmax_cross_entropy)
+    from chainermn_tpu.utils.metrics import MetricsRegistry, set_registry
+    from chainermn_tpu.utils.programs import (
+        MemoryAccountant,
+        ProgramLedger,
+        get_ledger,
+        set_ledger,
+    )
+
+    comm = cmn.create_communicator("tpu_xla")
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_examples, dim).astype(np.float32)
+    Y = (rng.rand(n_examples) * classes).astype(np.int32)
+
+    def loss_fn(p, x, y):
+        return softmax_cross_entropy(mlp_apply(p, x), y)
+
+    params0 = init_mlp(jax.random.PRNGKey(0), [dim, hidden, classes])
+
+    def make(seed=11):
+        it = cmn.SerialIterator((X, Y), batch, shuffle=True, seed=seed)
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm)
+        return cmn.StandardUpdater(it, opt, loss_fn, params0, comm)
+
+    def timed_arm(enabled):
+        prev_reg = set_registry(MetricsRegistry(enabled=enabled))
+        prev_led = set_ledger(ProgramLedger(enabled=enabled))
+        acc = MemoryAccountant()
+        try:
+            upd = make()
+            if enabled:
+                upd.register_memory(accountant=acc)
+            for _ in range(warmup):
+                upd.update()
+            jax.block_until_ready(upd.params)
+            led = get_ledger()
+            if enabled:
+                # warmup compiled the steady program; from here on any
+                # train/ compile is a retrace-storm bug
+                upd.mark_steady()
+            start_iter = upd.iteration
+            t0 = time.perf_counter()
+            for i in range(iters):
+                upd.update()
+                if enabled and i % sample_every == 0:
+                    acc.sample()
+            jax.block_until_ready(upd.params)
+            dt = time.perf_counter() - t0
+            stats = led.label_stats()
+            return {
+                "steps_per_s": (upd.iteration - start_iter) / dt,
+                "compiles": led.compiles(),
+                "steady_retraces": led.steady_retraces(),
+                "labels": sorted(stats),
+                "memory_bytes": acc.table()[-1]["high_watermark"],
+            }
+        finally:
+            set_registry(prev_reg)
+            set_ledger(prev_led)
+
+    import statistics
+
+    # this box's load comes in multi-second bursts that swamp any
+    # single ~1s timed block, so best-of-rounds does not converge
+    # here (the bench_obs_plane lesson): each round times the two
+    # arms BACK-TO-BACK (order-alternating) and the reported value is
+    # the MEDIAN of the per-round off/on ratios — a burst taxes both
+    # members of a pair, and the median discards the pairs one
+    # straddled
+    best = {"on": 0.0, "off": 0.0}
+    ratios = []
+    on_info = None
+    for r in range(rounds):
+        order = (False, True) if r % 2 == 0 else (True, False)
+        rates = {}
+        for enabled in order:
+            res = timed_arm(enabled)
+            key = "on" if enabled else "off"
+            rates[key] = res["steps_per_s"]
+            best[key] = max(best[key], res["steps_per_s"])
+            if enabled:
+                on_info = res
+                # the plane's own invariants, asserted per run
+                assert "train/step" in res["labels"], res["labels"]
+                assert res["steady_retraces"] == 0, res
+                assert res["memory_bytes"] and res["memory_bytes"] > 0
+            else:
+                assert res["compiles"] == 0, \
+                    "disabled ledger recorded compiles"
+        ratios.append(rates["off"] / rates["on"])
+
+    ratio = statistics.median(ratios)
+    overhead_pct = (ratio - 1.0) * 100.0
+    return {
+        "metric": METRIC,
+        "value": round(ratio, 4),
+        "unit": UNIT,
+        "vs_baseline": round(ratio, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "bar_pct": BAR_PCT,
+        "within_bar": bool(overhead_pct < BAR_PCT),
+        "off_steps_per_s": round(best["off"], 2),
+        "on_steps_per_s": round(best["on"], 2),
+        "round_ratios": [round(x, 4) for x in ratios],
+        "compiles_on_arm": on_info["compiles"],
+        "steady_retraces_on_arm": on_info["steady_retraces"],
+        "ledger_labels": on_info["labels"],
+        "memory_high_watermark_bytes": on_info["memory_bytes"],
+        "batch": batch,
+        "dim": dim,
+        "hidden": hidden,
+        "iters": iters,
+        "sample_every": sample_every,
+        "n_devices": jax.device_count(),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def _child_main(args):
+    env_platform = os.environ.get("JAX_PLATFORMS", "")
+    if args.platform == "cpu" or (
+            args.platform is None and env_platform.startswith("cpu")):
+        # fake the multi-chip world BEFORE backend init (same trick as
+        # tests/conftest.py) so the step is a real sharded program
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={args.devices}").strip()
+    pin_platform(args.platform)
+    result = run(batch=args.batch, dim=args.dim, hidden=args.hidden,
+                 warmup=args.warmup, iters=args.iters,
+                 rounds=args.rounds, sample_every=args.sample_every)
+    print("BENCH_RESULT " + json.dumps(result))
+
+
+def _parent_main(args):
+    here = os.path.abspath(__file__)
+    cmd = [sys.executable, here, "--child",
+           "--batch", str(args.batch), "--dim", str(args.dim),
+           "--hidden", str(args.hidden),
+           "--warmup", str(args.warmup), "--iters", str(args.iters),
+           "--rounds", str(args.rounds), "--devices", str(args.devices),
+           "--sample-every", str(args.sample_every)]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    return run_child_with_retries(
+        cmd, os.path.dirname(here), args.timeouts, METRIC, UNIT,
+        use_cache=args.platform is None,
+        cache_match={"batch": args.batch, "dim": args.dim,
+                     "hidden": args.hidden, "iters": args.iters},
+        # an off/on overhead ratio: 1.0 is free, higher is overhead
+        check=args.check, check_direction="lower")
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--child", action="store_true")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--dim", type=int, default=512)
+    p.add_argument("--hidden", type=int, default=2048)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--iters", type=int, default=60,
+                   help="timed updates per arm per round (sized so a "
+                        "1%% bar is resolvable against host noise)")
+    p.add_argument("--rounds", type=int, default=6,
+                   help="order-alternating back-to-back arm pairs; "
+                        "the reported value is the MEDIAN of the "
+                        "per-round off/on ratios (more rounds = more "
+                        "pairs for the median to discard the "
+                        "burst-straddled ones)")
+    p.add_argument("--sample-every", type=int, default=16,
+                   help="memory-accountant sampling cadence in steps "
+                        "on the ON arm (the statusz-scrape cadence)")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual device count for the cpu platform")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--check", action="store_true",
+                   help="perf-regression sentinel: score the fresh "
+                        "record against BENCH_MEASURED.json history "
+                        "(exit 1 on a regression verdict)")
+    p.add_argument("--timeouts", type=int, nargs="+", default=[480])
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    args = _parse_args(sys.argv[1:])
+    if args.child:
+        _child_main(args)
+    else:
+        sys.exit(_parent_main(args))

@@ -81,6 +81,12 @@ from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.parallel._compat import pcast, typeof
 from chainermn_tpu.utils.metrics import get_registry
+from chainermn_tpu.utils.programs import (
+    get_accountant,
+    get_ledger,
+    ledger_jit,
+    weakref_root,
+)
 from chainermn_tpu.utils.telemetry import RequestTraceStore, get_recorder
 
 from . import kv_blocks as kvb
@@ -578,9 +584,9 @@ class ServingEngine:
             buf = _vary(jnp.zeros((S, H), jnp.int32), *bax)
             return caches, buf
 
-        self._init_fn = jax.jit(jax.shard_map(
+        self._init_fn = ledger_jit(jax.shard_map(
             init_body, mesh=mesh, in_specs=(),
-            out_specs=(cspecs, row_spec)))
+            out_specs=(cspecs, row_spec)), label="serve/init")
 
         def pool_body():
             comps = ad.make_cache(1, pq, batch_varying=False)
@@ -589,8 +595,9 @@ class ServingEngine:
                           + c.shape[3:], c.dtype)
                 for c in comps)
 
-        self._pool_init_fn = jax.jit(jax.shard_map(
-            pool_body, mesh=mesh, in_specs=(), out_specs=pool_specs))
+        self._pool_init_fn = ledger_jit(jax.shard_map(
+            pool_body, mesh=mesh, in_specs=(), out_specs=pool_specs),
+            label="serve/pool_init")
 
         def round_body(params, caches, buf, offsets, done, end_t, t0):
             def one(carry, r):
@@ -617,13 +624,13 @@ class ServingEngine:
                 one, (caches, buf, done), jnp.arange(R))
             return caches, buf, done
 
-        self._round_fn = jax.jit(
+        self._round_fn = ledger_jit(
             jax.shard_map(
                 round_body, mesh=mesh,
                 in_specs=(pspecs, cspecs, row_spec, row_spec, row_spec,
                           row_spec, P()),
                 out_specs=(cspecs, row_spec, row_spec)),
-            donate_argnums=(1, 2))
+            label="serve/round", donate_argnums=(1, 2))
 
         def prefill_body(params, pools, prompt, p_off, ids, valid):
             caches = ad.make_cache(1, pq, batch_varying=False)
@@ -634,12 +641,12 @@ class ServingEngine:
                                   ids, valid)
                 for pc, c in zip(pools, caches))
 
-        self._prefill_fn = jax.jit(
+        self._prefill_fn = ledger_jit(
             jax.shard_map(
                 prefill_body, mesh=mesh,
                 in_specs=(pspecs, pool_specs, P(), P(), P(), P()),
                 out_specs=pool_specs),
-            donate_argnums=(1,))
+            label="serve/prefill", donate_argnums=(1,))
 
         def admit_body(caches, buf, pools, flat, prompt, slot, dst0):
             # position-level gather: a LEFT-aligned staged prompt
@@ -657,13 +664,13 @@ class ServingEngine:
             buf = lax.dynamic_update_slice(buf, row, (lsc, dst0))
             return caches, buf
 
-        self._admit_fn = jax.jit(
+        self._admit_fn = ledger_jit(
             jax.shard_map(
                 admit_body, mesh=mesh,
                 in_specs=(cspecs, row_spec, pool_specs, P(), P(), P(),
                           P()),
                 out_specs=(cspecs, row_spec)),
-            donate_argnums=(0, 1))
+            label="serve/admit", donate_argnums=(0, 1))
 
         def suffix_prefill_body(params, pools, prefix_flat, toks, ids,
                                 valid):
@@ -697,12 +704,12 @@ class ServingEngine:
         if self._can_suffix:
             # shapes vary per (prefix, suffix) block split — jit
             # retraces per split, the specs are split-invariant
-            self._suffix_prefill_fn = jax.jit(
+            self._suffix_prefill_fn = ledger_jit(
                 jax.shard_map(
                     suffix_prefill_body, mesh=mesh,
                     in_specs=(pspecs, pool_specs, P(), P(), P(), P()),
                     out_specs=pool_specs),
-                donate_argnums=(1,))
+                label="serve/suffix_prefill", donate_argnums=(1,))
 
         def fork_body(pools, src, dst):
             # copy-on-write: duplicate one physical block so a row can
@@ -710,11 +717,11 @@ class ServingEngine:
             return tuple(kvb.copy_block(pc, src, dst, jnp.asarray(True))
                          for pc in pools)
 
-        self._fork_fn = jax.jit(
+        self._fork_fn = ledger_jit(
             jax.shard_map(
                 fork_body, mesh=mesh,
                 in_specs=(pool_specs, P(), P()), out_specs=pool_specs),
-            donate_argnums=(0,))
+            label="serve/fork", donate_argnums=(0,))
 
         def round_sampled_body(params, caches, buf, offsets, done,
                                end_t, t0, temp, topk, topp, keys):
@@ -748,26 +755,26 @@ class ServingEngine:
                 one, (caches, buf, done), jnp.arange(R))
             return caches, buf, done
 
-        self._round_sampled_fn = jax.jit(
+        self._round_sampled_fn = ledger_jit(
             jax.shard_map(
                 round_sampled_body, mesh=mesh,
                 in_specs=(pspecs, cspecs, row_spec, row_spec, row_spec,
                           row_spec, P(), row_spec, row_spec, row_spec,
                           row_spec),
                 out_specs=(cspecs, row_spec, row_spec)),
-            donate_argnums=(1, 2))
+            label="serve/round_sampled", donate_argnums=(1, 2))
 
         def rebase_body(caches, buf, delta):
             caches = tuple(kvb.shift_positions(c, delta) for c in caches)
             idx = jnp.clip(jnp.arange(H) + delta, 0, H - 1)
             return caches, jnp.take(buf, idx, axis=1)
 
-        self._rebase_fn = jax.jit(
+        self._rebase_fn = ledger_jit(
             jax.shard_map(
                 rebase_body, mesh=mesh,
                 in_specs=(cspecs, row_spec, P()),
                 out_specs=(cspecs, row_spec)),
-            donate_argnums=(0, 1))
+            label="serve/rebase", donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -835,6 +842,40 @@ class ServingEngine:
         benches and latency-bound deployments call this once."""
         self._caches, self._buf = self._rebase_fn(
             self._caches, self._buf, np.int32(0))
+
+    def mark_steady(self) -> None:
+        """Declare this engine's programs steady-state in the program
+        ledger: the caller asserts warmup traffic has compiled every
+        program it intends to serve with, so any further ``serve/*``
+        compile is a retrace-storm signal (``compile/
+        steady_retraces``, the ``retrace_storm_rule`` feed).  Call
+        after the warmup pass; a deliberate rebuild (resize, engine
+        swap) should ``get_ledger().forget("serve/")`` — the rebuilt
+        programs are new executables, so their compiles must be
+        re-recorded even at previously-seen signatures — then
+        re-warm and re-mark.  (Not automatic on construction:
+        coexisting engines legitimately share these labels, and a
+        second engine's construction must not invalidate the first's
+        recorded programs.)  A colocated
+        :class:`~chainermn_tpu.serving.SpeculativeDecoder` has its
+        own ``mark_steady`` for its ``spec/`` scope — this one covers
+        ``serve/`` only."""
+        get_ledger().mark_steady("serve/")
+
+    def register_memory(self, accountant=None,
+                        prefix: str = "serving") -> None:
+        """Register this engine's device-buffer roots with the memory
+        accountant: ``<prefix>_params``, ``<prefix>_caches`` (the
+        per-slot KV lanes + token buffer), ``<prefix>_pool`` (the
+        block-paged staging pool — the prefix cache lives inside it).
+        Roots are held via weakref (``programs.weakref_root``), so
+        registration never pins a retired engine; a dead root samples
+        as 0 bytes."""
+        acc = accountant if accountant is not None else get_accountant()
+        acc.register(f"{prefix}_params", weakref_root(self, "_params"))
+        acc.register(f"{prefix}_caches",
+                     weakref_root(self, "_caches", "_buf"))
+        acc.register(f"{prefix}_pool", weakref_root(self, "_pools"))
 
     def set_policy(self, policy: Union[str, Callable]) -> None:
         """Swap the admission policy (host-side only — no recompile)."""
@@ -1660,7 +1701,7 @@ class ServingEngine:
                 if req.rid in self._staged:
                     continue
                 try:
-                    if not self._stage(req, rec, steal=False):
+                    if not self._stage_traced(req, rec, steal=False):
                         break
                 except Exception as err:    # noqa: BLE001 — harden
                     self._check_state_alive(err)
@@ -1787,9 +1828,24 @@ class ServingEngine:
                     shared=plan.n_shared)
         return True
 
+    def _stage_traced(self, req: Request, rec, steal: bool) -> bool:
+        """:meth:`_stage` with the request's trace id as the program
+        ledger's exemplar: a compile caused by THIS request's shapes
+        (the per-(prefix,suffix)-split ``serve/suffix_prefill``
+        retrace) links its ``compile/seconds`` exemplar straight to
+        the request's retained timeline — the same trace-id hop the
+        latency exemplars ride."""
+        led = get_ledger()
+        prev = led.exemplar
+        led.exemplar = req.trace_id
+        try:
+            return self._stage(req, rec, steal=steal)
+        finally:
+            led.exemplar = prev
+
     def _ensure_staged(self, req: Request, rec) -> bool:
-        return req.rid in self._staged or self._stage(req, rec,
-                                                      steal=True)
+        return req.rid in self._staged or self._stage_traced(
+            req, rec, steal=True)
 
     def fork_block(self, row_id, idx: int) -> int:
         """Copy-on-write fork of a STAGED row's ``idx``-th block: if

@@ -133,8 +133,27 @@ class SpeculativeDecoder:
 
     def _jit(self, name, fn):
         if name not in self._jits:
-            self._jits[name] = jax.jit(fn)
+            from chainermn_tpu.utils.programs import ledger_jit
+
+            # ledger label: the program kind only — the adapter id in
+            # a ("prefill", id) key is cache identity, not a label
+            kind = name[0] if isinstance(name, tuple) else name
+            self._jits[name] = ledger_jit(fn, label=f"spec/{kind}")
         return self._jits[name]
+
+    def mark_steady(self) -> None:
+        """Declare this decoder's ``spec/*`` programs steady-state in
+        the program ledger (the ``ServingEngine.mark_steady``
+        twin — the engine's ``serve/`` scope does NOT cover these):
+        call after warmup generations have compiled the draft/verify
+        programs for the splits you serve, and any further ``spec/``
+        compile counts as ``compile/steady_retraces`` — the
+        speculative half of the retrace-storm coverage.  A rebuild
+        (new adapters) should ``get_ledger().forget("spec/")``,
+        re-warm, re-mark."""
+        from chainermn_tpu.utils.programs import get_ledger
+
+        get_ledger().mark_steady("spec/")
 
     def _prefill(self, ad, params, kv_len, row, offs):
         def body(params, row, offs):

@@ -17,6 +17,7 @@ from typing import Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from chainermn_tpu.utils.programs import ledger_jit
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .updater import default_converter
@@ -66,11 +67,12 @@ class Evaluator:
             m = metrics_fn(params, *batch)
             return {k: jax.lax.pmean(v, ax) for k, v in m.items()}
 
-        fn = jax.jit(
+        fn = ledger_jit(
             jax.shard_map(
                 shard_metrics, mesh=self.comm.mesh,
                 in_specs=(P(),) + (P(ax),) * n_batch_args, out_specs=P(),
-            )
+            ),
+            label="eval/metrics",
         )
         self._step_cache[n_batch_args] = fn
         return fn
@@ -112,7 +114,7 @@ class Evaluator:
             return {k: (total * m_pad[k] - n_fill * m_row0[k]) / n_real
                     for k in m_pad}
 
-        fn = jax.jit(padded_metrics)
+        fn = ledger_jit(padded_metrics, label="eval/remainder")
         self._step_cache[key] = fn
         return fn
 

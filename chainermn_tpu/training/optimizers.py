@@ -271,6 +271,7 @@ def _ceil_div(a: int, b: int) -> int:
 from chainermn_tpu.parallel._compat import (
     all_gather_invariant as _all_gather_invariant,
 )
+from chainermn_tpu.utils.programs import ledger_jit
 
 
 def _ensure_varying(x, axis_name):
@@ -431,7 +432,8 @@ def shard_opt_state(optimizer, params):
         mesh = mesh if mesh is not None else sh.mesh
         by_path[tuple(str(k) for k in path)] = (p.shape, sh)
     if mesh is None:
-        return jax.jit(optimizer.init)(params)
+        return ledger_jit(optimizer.init,
+                          label="train/opt_init")(params)
     replicated = NamedSharding(mesh, P())
     shapes = jax.eval_shape(optimizer.init, params)
     s_paths, treedef = tree_flatten_with_path(shapes)
@@ -448,7 +450,8 @@ def shard_opt_state(optimizer, params):
 
     out_shardings = treedef.unflatten(
         [pick(path, sd) for path, sd in s_paths])
-    return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
+    return ledger_jit(optimizer.init, label="train/opt_init",
+                      out_shardings=out_shardings)(params)
 
 
 def zero1_init(tx, params, mesh, axis_name: str):
@@ -479,8 +482,9 @@ def zero1_init(tx, params, mesh, axis_name: str):
             lambda x: _ensure_varying(jnp.asarray(x), axis_name)[None],
             state)
 
-    f = jax.jit(jax.shard_map(
-        body, mesh=mesh, in_specs=P(), out_specs=P(axis_name)))
+    f = ledger_jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(), out_specs=P(axis_name)),
+        label="train/opt_init")
     return f(params)
 
 

@@ -36,6 +36,12 @@ from chainermn_tpu.iterators.prefetch import (
 )
 from chainermn_tpu.utils.metrics import get_registry
 from chainermn_tpu.utils.profiling import get_profiler
+from chainermn_tpu.utils.programs import (
+    get_accountant,
+    get_ledger,
+    ledger_jit,
+    weakref_root,
+)
 from chainermn_tpu.utils.telemetry import get_recorder
 
 __all__ = ["StandardUpdater", "default_converter", "fuse_steps"]
@@ -470,7 +476,12 @@ class StandardUpdater:
         # ZeRO-1 state is world-stacked: its leading member axis shards
         # over the data axis (each member holds its own 1/N slice).
         opt_spec = P(ax) if self.zero1 else P()
-        fn = jax.jit(
+        # the program ledger's cache-miss hook rides every step
+        # program: the steady window, the accum-group/single-step tail
+        # programs, and each distinct ragged tail shape record their
+        # compiles (and signature diffs) under ONE label — exactly the
+        # per-shape attribution the epoch-tail recompile story needs
+        fn = ledger_jit(
             jax.shard_map(
                 fused,
                 mesh=self.comm.mesh,
@@ -478,6 +489,7 @@ class StandardUpdater:
                     (None, ax) if window > 1 else (ax,))),) * n_batch_args,
                 out_specs=((P(), P(), opt_spec), P()),
             ),
+            label="train/step",
             donate_argnums=(0,),
         )
         self._step_cache[key] = fn
@@ -501,6 +513,33 @@ class StandardUpdater:
             "inflight_windows": len(self._inflight),
             "zero1": bool(self.zero1),
         }
+
+    def mark_steady(self) -> None:
+        """Declare the training step programs steady-state in the
+        program ledger (call after step 1 has compiled the steady
+        window): any further ``train/`` compile — a shape leak in the
+        feed, a plan-change recompile outside a declared retune —
+        counts as ``compile/steady_retraces`` and feeds the
+        retrace-storm alert.  Epoch tails are part of steady training
+        only if their shapes repeat; the first epoch's tail compiles
+        BEFORE marking if tails are expected (run one full epoch
+        first, or accept the one attributed event)."""
+        get_ledger().mark_steady("train/")
+
+    def register_memory(self, accountant=None,
+                        prefix: str = "train") -> None:
+        """Register the training state's device-buffer roots with the
+        memory accountant: ``<prefix>_params``, ``<prefix>_opt_state``
+        (the full or ZeRO-sharded optimizer state), ``<prefix>_state``
+        (model state, when carried).  Weakref-held
+        (``programs.weakref_root``) — registration never pins a
+        retired updater; dead roots sample as 0."""
+        acc = accountant if accountant is not None else get_accountant()
+        acc.register(f"{prefix}_params", weakref_root(self, "params"))
+        acc.register(f"{prefix}_opt_state",
+                     weakref_root(self, "opt_state"))
+        if self.state is not None:
+            acc.register(f"{prefix}_state", weakref_root(self, "state"))
 
     def rebind_world(self, comm, optimizer) -> None:
         """Re-bind this updater to a NEW communicator/mesh mid-run — the
@@ -557,6 +596,11 @@ class StandardUpdater:
         self._plan_generation = None if cell is None else cell.generation
         self._exchange_probe = None
         self._step_cache = {}
+        # the rebuilt step programs are NEW executables: drop the
+        # program ledger's train/ signature memory (and any steady
+        # declaration) so the post-resize recompile is re-recorded —
+        # even when the new world returns to a previously-seen shape
+        get_ledger().forget("train/")
         self._inflight.clear()
         self._batch_sharding = NamedSharding(comm.mesh, P(comm.axis_name))
         self._stacked_sharding = NamedSharding(

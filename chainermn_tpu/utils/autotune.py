@@ -616,8 +616,14 @@ def build_exchange_fn(mesh, axis_name: str, plan_like,
                                     inter_axis_name=inter_axis_name)
         return jax.tree.map(lambda a: a[None], red)
 
-    return jax.jit(jax.shard_map(
-        body, mesh=mesh, in_specs=spec, out_specs=spec))
+    from chainermn_tpu.utils.programs import ledger_jit
+
+    # every probe candidate's compile lands in the program ledger
+    # under one label — an autotune sweep that compiles N candidates
+    # is N attributed ledger entries, not silent wall time
+    return ledger_jit(jax.shard_map(
+        body, mesh=mesh, in_specs=spec, out_specs=spec),
+        label="autotune/exchange")
 
 
 def build_plan_probe(comm, plan, params, zeros: bool = True):

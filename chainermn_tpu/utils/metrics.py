@@ -902,6 +902,18 @@ class GoodputReport:
       the overlapped disk write is not badput).
     - ``exchange_probe_s`` — ``step/exchange_probe``: the isolated
       drift-guard re-times.
+    - ``compile_s`` — XLA compiles, fed from the PROGRAM LEDGER
+      (:mod:`chainermn_tpu.utils.programs`): the window's delta of
+      ``ledger.compile_seconds(COMPILE_SCOPES)`` — the ``train/``
+      labels, whose compiles happen INSIDE the dispatch spans (the
+      first call of a new program shape traces+compiles under
+      ``step/dispatch``), so the delta is subtracted out of
+      ``productive_s`` (clamped at 0) — a post-resize recompile or an
+      epoch-tail shape shows up as compile badput instead of hiding
+      inside productive time.  Autotune-probe compiles stay in
+      ``exchange_probe_s``, eval compiles in ``stall_s``, and a
+      colocated serving engine's compiles bill nothing here.  Zero
+      whenever the ledger is disabled.
     - ``stall_s`` — the unaccounted remainder (extensions, evaluators,
       GC pauses, genuine stalls).
 
@@ -935,6 +947,7 @@ class GoodputReport:
         self.registry = registry
         self.last_report: Optional[dict] = None
         self._t_last: Optional[float] = None
+        self._compile_mark: Optional[float] = None
 
     def _recorder(self):
         rec = self.recorder
@@ -953,9 +966,37 @@ class GoodputReport:
                    + self.CHECKPOINT + self.EXCHANGE_PROBE))
         return rec
 
+    #: Ledger label scopes whose compiles bill into THIS report's
+    #: compile badput.  ``train/`` ONLY: those are the compiles that
+    #: happen inside the dispatch spans (so subtracting them out of
+    #: ``productive_s`` is exact).  ``autotune/`` compiles bill inside
+    #: the ``step/exchange_probe`` span (already ``exchange_probe_s``
+    #: — counting them here would double-bill), ``eval/`` compiles
+    #: inside evaluator extension time (``stall_s``), and a colocated
+    #: serving engine's ``serve/``/``spec/`` compiles must never
+    #: depress a training window at all.
+    COMPILE_SCOPES = ("train/",)
+
+    def _compile_delta(self) -> float:
+        """Seconds of XLA compile the program ledger recorded since
+        the last window, training-side labels only (0.0 with the
+        ledger disabled or absent)."""
+        from chainermn_tpu.utils.programs import get_ledger
+
+        total = get_ledger().compile_seconds(self.COMPILE_SCOPES)
+        if self._compile_mark is None or total < self._compile_mark:
+            # first window, or the ledger was cleared/swapped mid-run:
+            # no baseline to difference against
+            self._compile_mark = total
+            return 0.0
+        delta = total - self._compile_mark
+        self._compile_mark = total
+        return delta
+
     def initialize(self, trainer=None) -> None:
         self._recorder()        # open the channel before the first window
         self._t_last = time.perf_counter()
+        self._compile_delta()   # anchor the ledger baseline
 
     def __call__(self, trainer=None) -> None:
         rec = self._recorder()
@@ -976,7 +1017,14 @@ class GoodputReport:
         host_blocked = total(self.HOST_BLOCKED)
         checkpoint = total(self.CHECKPOINT)
         probe = total(self.EXCHANGE_PROBE)
-        accounted = productive + host_blocked + checkpoint + probe
+        compile_s = self._compile_delta()
+        # compiles bill inside the dispatch spans (see class
+        # docstring): move them out of productive, clamped — a compile
+        # landing outside any span (engine warmup between windows)
+        # would otherwise drive productive negative
+        productive = builtins_max(0.0, productive - compile_s)
+        accounted = (productive + host_blocked + checkpoint + probe
+                     + compile_s)
         stall = builtins_max(0.0, window - accounted)
         goodput = (productive / window
                    if window > 0 and rec.enabled else None)
@@ -989,6 +1037,7 @@ class GoodputReport:
                 "host_blocked_s": host_blocked,
                 "checkpoint_s": checkpoint,
                 "exchange_probe_s": probe,
+                "compile_s": compile_s,
                 "stall_s": stall,
             },
             "goodput": goodput,
@@ -1004,6 +1053,7 @@ class GoodputReport:
             reg.inc("goodput/host_blocked_s", host_blocked)
             reg.inc("goodput/checkpoint_s", checkpoint)
             reg.inc("goodput/exchange_probe_s", probe)
+            reg.inc("goodput/compile_s", compile_s)
             reg.inc("goodput/stall_s", stall)
         if (self.write and trainer is not None
                 and (self.comm is None

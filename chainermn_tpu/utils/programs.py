@@ -1,0 +1,795 @@
+"""Compile-and-memory plane — the XLA program ledger and the
+device-memory accountant.
+
+Every measured win in this stack rides an XLA program, and until now
+the programs themselves were dark: a retrace storm (the serving
+engine's per-(prefix,suffix)-split ``verify`` compiles, the epoch-tail
+shapes, a post-resize recompile) or a device-memory creep (staging
+pool vs params vs ZeRO shards) was only ever discovered AFTER it ate a
+bench run.  GC3 (PAPERS.md) treats communication programs as
+inspectable compile-time artifacts rather than opaque lowered blobs;
+this module applies the same stance to every jit program the stack
+builds.  Two instruments:
+
+- :class:`ProgramLedger` — a process-global bounded ring of compile
+  events.  Call sites wrap their jitted programs through
+  :func:`ledger_jit` (or :func:`instrument` for an already-jitted fn);
+  the wrapper computes the abstract argument signature per call (leaf
+  shapes/dtypes + tree structure — exactly what decides a jit retrace)
+  and, on a signature never seen for that label, times the call and
+  records a ledger entry: label, signature digest, compile wall time
+  (the first-call wall time — tracing + XLA compile + the first
+  execution, the cost an operator actually pays), the donation map,
+  and a **signature diff vs the previous entry for that label** — the
+  "why did this retrace" attribution (dtype flip vs shape change vs
+  sharding change vs structure change vs donation change).  Signature-identical calls pay
+  one set lookup and dispatch straight through; a disabled ledger is
+  one attribute read (the PR 6/9 singleton discipline — nothing is
+  allocated or retained, pinned by test).
+
+  Each compile event also fans out through the existing plane: a
+  ``compile/<label>`` span in the flight recorder, a
+  ``compile/seconds`` histogram observation (exemplar → the current
+  request trace id when the engine staged one), ``compile/retraces``
+  + per-label ``compile/retraces_<label>`` counters, and — after
+  :meth:`~ProgramLedger.mark_steady` declares a label prefix
+  steady-state — ``compile/steady_retraces``, the feed of the
+  retrace-storm alert (:func:`retrace_storm_rule`).  Zero
+  steady-state recompiles is a pinned invariant: the serving decode
+  loop post-warm and the accum training loop post-step-1 each carry a
+  ledger-backed test proving no compiles after warmup.
+
+- :class:`MemoryAccountant` — per-subsystem live-buffer byte gauges.
+  Subsystems register their buffer roots (``params``, optimizer
+  state, the serving staging pool, prefix-cache pools, prefetch
+  slots) as pytrees or zero-arg callables; :meth:`~MemoryAccountant
+  .sample` walks the leaves into ``memory/<subsystem>_bytes`` gauges
+  (per-addressable-shard bytes when the leaf is a sharded jax array —
+  replication counts, the device question is "how much HBM is held",
+  not "how large is the logical array") plus ``memory/total_bytes``.
+  The gauge's max IS the high-watermark, and gauge cross-rank merge
+  (max-of-max) is order-independent, so merged fleet watermarks are
+  deterministic whatever order ranks fold in (pinned by test).
+
+``/programz`` (:mod:`chainermn_tpu.utils.statusz`) serves both live:
+the newest-first ledger with signature diffs and the per-subsystem
+memory table.  ``GoodputReport`` reads the ledger's cumulative compile
+seconds per window (``train/`` labels only) into a ``compile`` badput
+category, so a post-resize recompile shows up in the goodput
+decomposition instead of hiding inside "host-blocked"
+(``rebind_world`` calls :meth:`ProgramLedger.forget`, so the
+recompile is recorded even at a previously-seen signature).  ``bench_programs.py`` pins the
+ledger+accountant overhead < 1%; ``CHAINERMN_TPU_PROGRAMS=1`` enables
+the global ledger at import.
+
+Importable without jax (only the stdlib and the equally jax-free
+metrics/telemetry siblings load at import; jax resolves lazily inside
+the wrappers), so the module stays usable from the iterator layer and
+from tooling that never touches an accelerator.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from chainermn_tpu.utils.metrics import get_registry
+from chainermn_tpu.utils.telemetry import get_recorder
+
+__all__ = [
+    "MemoryAccountant",
+    "ProgramLedger",
+    "abstract_signature",
+    "get_accountant",
+    "get_ledger",
+    "instrument",
+    "ledger_jit",
+    "retrace_storm_rule",
+    "set_accountant",
+    "set_ledger",
+    "signature_diff",
+    "weakref_root",
+]
+
+
+def _slug(name: str) -> str:
+    """A label as a metric-name suffix: lowercase, ``[a-z0-9_]`` only
+    (``serve/suffix_prefill`` → ``serve_suffix_prefill``) — the
+    dynamic-family convention ``serve/shed_<reason>`` established."""
+    return re.sub(r"[^a-z0-9_]", "_", str(name).lower())
+
+
+# ---------------------------------------------------------------------- #
+# abstract signatures
+# ---------------------------------------------------------------------- #
+
+def _leaf_key(x):
+    """One leaf's abstract signature as a cheap hashable key —
+    ``(shape, dtype, sharding)`` for anything array-like, the bare
+    type for a python scalar (scalars trace by type, not value —
+    value changes do not retrace).  SHARDING is part of the key
+    because it is part of jit's: a feed suddenly arriving committed
+    to a different layout (a stale-mesh ``device_put`` after a
+    resize) recompiles every call, and a ledger blind to it would
+    read that storm as healthy.  A host array (numpy) carries no
+    sharding and keys as ``None`` there.  No string work on the hot
+    path; :func:`format_leaf` renders the human form only when a
+    compile is recorded."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return type(x)
+    sharding = getattr(x, "sharding", None)
+    if sharding is not None:
+        try:
+            hash(sharding)
+        except TypeError:       # exotic array-like: drop, never crash
+            sharding = None
+    return (tuple(shape), dtype, sharding)
+
+
+def format_leaf(key) -> str:
+    """The readable form of a :func:`_leaf_key`:
+    ``dtype[d0,d1,...]`` (``@sharding`` appended when the leaf
+    carried one) or ``py:<type>`` — what ledger entries, diffs and
+    /programz render."""
+    if isinstance(key, type):
+        return f"py:{key.__name__}"
+    shape, dtype, sharding = key
+    base = f"{dtype}[{','.join(str(int(d)) for d in shape)}]"
+    return base if sharding is None else f"{base}@{sharding}"
+
+
+def abstract_signature(args: tuple) -> Tuple[Any, Tuple[str, ...]]:
+    """``(treedef, per-leaf signatures)`` for a call's positional
+    args, human-readable form — the pair that decides whether jit
+    retraces (modulo weak-type promotion, which only ever COALESCES
+    signatures; a signature the ledger has seen can never recompile).
+    The introspection entry point; the record hot path uses the raw
+    :func:`_leaf_key` form and formats lazily."""
+    from jax import tree_util
+
+    leaves, treedef = tree_util.tree_flatten(args)
+    return treedef, tuple(format_leaf(_leaf_key(x)) for x in leaves)
+
+
+def signature_diff(old: Optional[Sequence[str]], new: Sequence[str],
+                   old_donate: Sequence[int] = (),
+                   new_donate: Sequence[int] = (),
+                   max_changed: int = 8) -> Optional[dict]:
+    """The "why did this retrace" attribution: a JSON-safe diff of two
+    leaf-signature tuples (plus the donation maps), ``None`` for a
+    first compile.  ``kinds`` names what moved — ``"dtype"``,
+    ``"shape"``, ``"sharding"``, ``"type"`` (array ↔ scalar),
+    ``"structure"`` (leaf count or treedef changed), ``"donation"``
+    — and ``changed`` lists the first
+    ``max_changed`` per-leaf transitions so a /programz reader sees
+    the offending leaf, not just a count."""
+    if old is None:
+        return None
+    kinds = set()
+    changed: List[dict] = []
+    n_changed = 0
+    if len(old) != len(new):
+        kinds.add("structure")
+    for i, (a, b) in enumerate(zip(old, new)):
+        if a == b:
+            continue
+        n_changed += 1
+        da, db = a.split("[", 1)[0], b.split("[", 1)[0]
+        if a.startswith("py:") or b.startswith("py:"):
+            # a python-scalar leaf changed type (py:int → py:float),
+            # or an array swapped with a scalar — either way the
+            # attribution is "type", never an array-dtype hunt
+            kind = "type"
+        elif da != db:
+            kind = "dtype"
+        elif a.split("]", 1)[0] != b.split("]", 1)[0]:
+            kind = "shape"
+        else:
+            # same dtype, same dims: only the @sharding suffix moved
+            kind = "sharding"
+        kinds.add(kind)
+        if len(changed) < max_changed:
+            changed.append({"leaf": i, "from": a, "to": b,
+                            "kind": kind})
+    if tuple(old_donate) != tuple(new_donate):
+        kinds.add("donation")
+    return {
+        "n_old": len(old),
+        "n_new": len(new),
+        "n_changed": n_changed,
+        "kinds": sorted(kinds),
+        "changed": changed,
+        **({} if tuple(old_donate) == tuple(new_donate)
+           else {"donate_from": list(old_donate),
+                 "donate_to": list(new_donate)}),
+    }
+
+
+# ---------------------------------------------------------------------- #
+# the ledger
+# ---------------------------------------------------------------------- #
+
+class ProgramLedger:
+    """Bounded ring of compile/retrace events (see module docstring).
+
+    Args:
+      capacity: ring length — oldest entries drop when full (the
+        per-label seen-sets and counters are NOT ring-bounded; they
+        are what keeps a long-running job's hit path a set lookup).
+      enabled: start recording immediately (default False — the
+        instrumented call sites pay one attribute read and dispatch
+        straight through until :meth:`enable`).
+
+    Labels are PROCESS-GLOBAL: every wrapper built with the same
+    label shares one signature set.  A REBUILT program (a fresh
+    engine after a resize, a second adapter under one ``spec/*``
+    label) recompiling an already-seen signature is therefore not
+    re-recorded — the ledger answers "did a NEW program shape
+    appear", which is the retrace question.  A deliberate rebuild
+    that wants its compiles re-attributed calls the SCOPED
+    :meth:`forget` (``forget("serve/")`` around an engine rebuild —
+    what ``rebind_world`` does for ``train/``): counters stay
+    monotonic and other subsystems' label state is untouched, unlike
+    the wholesale :meth:`clear`.
+    """
+
+    def __init__(self, capacity: int = 1024, enabled: bool = False):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        # label -> {"seen": {(treedef, leafsigs)}, "compiles": n,
+        #           "calls": n, "steady_compiles": n,
+        #           "last_sig": leafsigs, "last_donate": tuple}
+        self._labels: Dict[str, dict] = {}
+        self._steady: Tuple[str, ...] = ()
+        self.total_compile_s = 0.0
+        self.dropped = 0
+        # the current causal exemplar: a serving engine staging request
+        # R sets this to R's trace id, so a compile event caused by R's
+        # shapes (the per-(prefix,suffix)-split verify retrace) links
+        # its compile/seconds exemplar to R's retained timeline.
+        # THREAD-LOCAL: in a colocated train+serve process a training
+        # thread's epoch-tail compile must never pick up the serving
+        # thread's in-flight request id as its cause
+        self._exemplar_local = threading.local()
+
+    @property
+    def exemplar(self) -> Optional[str]:
+        return getattr(self._exemplar_local, "value", None)
+
+    @exemplar.setter
+    def exemplar(self, value: Optional[str]) -> None:
+        self._exemplar_local.value = value
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._labels.clear()
+            self._steady = ()
+            self.total_compile_s = 0.0
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # -- steady-state declaration -------------------------------------- #
+
+    def mark_steady(self, scope: str) -> None:
+        """Declare every label under ``scope`` (a label prefix —
+        ``"serve/"``, ``"train/"``) steady-state: the caller asserts
+        warmup is over, so any further compile under the scope is a
+        RETRACE STORM signal (``compile/steady_retraces``, the
+        :func:`retrace_storm_rule` bad feed).  Idempotent."""
+        with self._lock:
+            if scope not in self._steady:
+                self._steady = self._steady + (str(scope),)
+
+    def clear_steady(self, scope: Optional[str] = None) -> None:
+        """Withdraw a steady declaration (``None`` withdraws all) —
+        the legitimate-recompile escape hatch: a live resize or an
+        engine rebuild re-warms, re-marks."""
+        with self._lock:
+            if scope is None:
+                self._steady = ()
+            else:
+                self._steady = tuple(s for s in self._steady
+                                     if s != scope)
+
+    def forget(self, scope: Optional[str] = None) -> None:
+        """Drop the SIGNATURE MEMORY for labels under ``scope`` (all
+        labels when ``None``) and withdraw the matching steady
+        declarations — the REBUILD hook: a re-formed mesh's programs
+        (``rebind_world``, a fresh engine after a resize) are new
+        executables, so their first calls really re-trace and
+        re-compile even at previously-seen signatures, and the ledger
+        must re-record them (the post-resize compile lands in the
+        ring, the metrics, and the goodput ``compile_s`` badput).
+        Counters and ring history are KEPT — only the seen-sets
+        clear, so ``compiles()``/``compile_seconds()`` stay
+        monotonic; the first post-rebuild entry's signature diff
+        reads against the pre-rebuild signature (often "no change" —
+        which is itself the attribution: a rebuild, not a shape
+        leak)."""
+        with self._lock:
+            for label, st in self._labels.items():
+                if scope is None or label.startswith(scope):
+                    st["seen"].clear()
+            self._steady = tuple(
+                s for s in self._steady
+                if not (scope is None or s.startswith(scope)
+                        or scope.startswith(s)))
+
+    def is_steady(self, label: str) -> bool:
+        return any(label.startswith(s) for s in self._steady)
+
+    # -- recording ----------------------------------------------------- #
+
+    def record_call(self, fn: Callable, label: str,
+                    donate: Tuple[int, ...], args: tuple,
+                    kwargs: Optional[dict] = None):
+        """The instrumented-call hot path: signature lookup, dispatch,
+        and — on a first-seen signature — the timed compile record.
+        Only :class:`_InstrumentedJit` calls this, and only while
+        enabled.  The signature key is raw hashable leaf keys (no
+        string work — the <1% bar is won here); the readable form is
+        rendered only when a compile is recorded.  Keyword args ride
+        the signature through the treedef (a dict pytree keys by
+        sorted names, so a kwarg rename is a structure change)."""
+        from jax import tree_util
+
+        if kwargs:
+            leaves, treedef = tree_util.tree_flatten((args, kwargs))
+        else:
+            leaves, treedef = tree_util.tree_flatten(args)
+            kwargs = {}
+        key = (treedef, tuple(_leaf_key(x) for x in leaves))
+        with self._lock:
+            st = self._labels.get(label)
+            if st is None:
+                st = self._labels[label] = {
+                    "seen": set(), "compiles": 0, "calls": 0,
+                    "steady_compiles": 0, "compile_s": 0.0,
+                    "last_sig": None, "last_donate": (),
+                    "last_treedef": None,
+                }
+            st["calls"] += 1
+            miss = key not in st["seen"]
+            if miss:
+                # claimed at DETECTION time, under the lock: two
+                # threads first-calling the same shape concurrently
+                # must record ONE compile, not two (a double-counted
+                # steady retrace would feed the storm rule)
+                st["seen"].add(key)
+        reg = get_registry()
+        reg.inc("compile/calls")
+        if not miss:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            out = fn(*args, **kwargs)
+        except BaseException:
+            # the program never materialized — release the claim so
+            # a retry's compile is still recorded
+            with self._lock:
+                self._labels[label]["seen"].discard(key)
+            raise
+        dt = time.perf_counter() - t0
+        self._record_compile(
+            label, key, tuple(format_leaf(k) for k in key[1]),
+            donate, dt, reg)
+        return out
+
+    def _record_compile(self, label, key, leaf_sigs, donate, dt, reg):
+        steady = self.is_steady(label)
+        treedef = key[0]
+        with self._lock:
+            st = self._labels[label]
+            st["compiles"] += 1
+            st["compile_s"] += dt
+            if steady:
+                st["steady_compiles"] += 1
+            diff = signature_diff(st["last_sig"], leaf_sigs,
+                                  st["last_donate"], donate)
+            # a treedef-only change (dict key rename, container swap —
+            # same leaves, different structure) must not render as an
+            # empty diff an operator would read as "a rebuild": the
+            # structure change IS the retrace cause
+            if diff is not None and st.get("last_treedef") is not None \
+                    and st["last_treedef"] != treedef \
+                    and "structure" not in diff["kinds"]:
+                diff["kinds"] = sorted(diff["kinds"] + ["structure"])
+            st["last_sig"] = leaf_sigs
+            st["last_donate"] = tuple(donate)
+            st["last_treedef"] = treedef
+            n = st["compiles"]
+            self.total_compile_s += dt
+            exemplar = self.exemplar
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append({
+                "label": label,
+                "n": n,
+                "compile_s": dt,
+                "n_leaves": len(leaf_sigs),
+                "signature": list(leaf_sigs[:64]),
+                "donate_argnums": list(donate),
+                "steady": steady,
+                "diff": diff,
+                "exemplar": exemplar,
+                "ts": time.time(),
+            })
+        reg.observe("compile/seconds", dt,
+                    exemplar=exemplar if exemplar is not None else label)
+        reg.inc("compile/retraces")
+        reg.inc("compile/retraces_" + _slug(label))
+        if steady:
+            reg.inc("compile/steady_retraces")
+        get_recorder().record(
+            f"compile/{label}", dt, cat="compile",
+            retrace=n > 1, steady=steady,
+            **({} if diff is None else {"diff_kinds": diff["kinds"]}))
+
+    # -- read surface -------------------------------------------------- #
+
+    def entries(self, n: Optional[int] = None,
+                scope: Optional[str] = None) -> List[dict]:
+        """The newest ``n`` ledger entries (all by default), NEWEST
+        FIRST — the incident-reading order — optionally restricted to
+        labels under ``scope``."""
+        with self._lock:
+            rows = list(self._ring)
+        if scope is not None:
+            rows = [r for r in rows if r["label"].startswith(scope)]
+        rows.reverse()
+        return rows if n is None or n < 0 else rows[:int(n)]
+
+    def compiles(self, scope: Optional[str] = None) -> int:
+        """Total compiles recorded (survives ring wrap), optionally
+        restricted to labels under ``scope`` — the number the
+        zero-steady-state-recompile tests snapshot and re-read."""
+        with self._lock:
+            return sum(st["compiles"]
+                       for label, st in self._labels.items()
+                       if scope is None or label.startswith(scope))
+
+    def steady_retraces(self, scope: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(st["steady_compiles"]
+                       for label, st in self._labels.items()
+                       if scope is None or label.startswith(scope))
+
+    def compile_seconds(self, scopes=None) -> float:
+        """Cumulative recorded compile wall seconds, optionally
+        restricted to labels under any of ``scopes`` (one prefix or a
+        tuple of prefixes) — what lets a TRAINING goodput window bill
+        only training-side compiles while a colocated serving engine
+        compiles its own programs in the same process."""
+        if scopes is None:
+            return self.total_compile_s
+        if isinstance(scopes, str):
+            scopes = (scopes,)
+        with self._lock:
+            return sum(st["compile_s"]
+                       for label, st in self._labels.items()
+                       if any(label.startswith(s) for s in scopes))
+
+    def label_stats(self) -> Dict[str, dict]:
+        """Per-label ``{compiles, calls, steady_compiles, compile_s,
+        programs}`` (``programs`` = distinct signatures = live
+        executables)."""
+        with self._lock:
+            return {label: {"compiles": st["compiles"],
+                            "calls": st["calls"],
+                            "steady_compiles": st["steady_compiles"],
+                            "compile_s": st["compile_s"],
+                            "programs": len(st["seen"])}
+                    for label, st in self._labels.items()}
+
+    def status(self) -> dict:
+        """The ``/programz`` summary block (JSON-safe)."""
+        stats = self.label_stats()
+        return {
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "recorded": len(self._ring),
+            "dropped": self.dropped,
+            "total_compile_s": self.total_compile_s,
+            "steady_scopes": list(self._steady),
+            "labels": stats,
+            "compiles": sum(s["compiles"] for s in stats.values()),
+            "steady_retraces": sum(s["steady_compiles"]
+                                   for s in stats.values()),
+        }
+
+
+# ---------------------------------------------------------------------- #
+# instrumentation wrappers
+# ---------------------------------------------------------------------- #
+
+class _InstrumentedJit:
+    """The cache-miss hook around one jitted callable.  Disabled
+    ledger: one attribute read, then straight dispatch.  Attribute
+    access (``.lower``, ``._cache_size`` — the HLO-proof surfaces the
+    optimizer tests drive) delegates to the wrapped jit function."""
+
+    __slots__ = ("_fn", "label", "donate")
+
+    def __init__(self, fn: Callable, label: str,
+                 donate: Sequence[int] = ()):
+        self._fn = fn
+        self.label = str(label)
+        self.donate = tuple(int(i) for i in donate)
+
+    def __call__(self, *args, **kwargs):
+        led = _GLOBAL
+        if not led.enabled:
+            return self._fn(*args, **kwargs)
+        return led.record_call(self._fn, self.label, self.donate,
+                               args, kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __repr__(self):
+        return f"<instrumented jit {self.label!r}>"
+
+
+def instrument(fn: Callable, label: str,
+               donate_argnums: Sequence[int] = ()) -> _InstrumentedJit:
+    """Wrap an already-jitted callable with the ledger's cache-miss
+    hook.  The wrapper resolves the GLOBAL ledger per call, so
+    :func:`set_ledger` swaps (tests, scoped benches) are honored."""
+    return _InstrumentedJit(fn, label, donate_argnums)
+
+
+def ledger_jit(fun: Callable, *, label: str, **jit_kwargs):
+    """``jax.jit`` + :func:`instrument` in one call — the drop-in form
+    for the stack's jit call sites (``ledger_jit(body, label=
+    "serve/round", donate_argnums=(1, 2))``).  All keyword arguments
+    besides ``label`` pass through to ``jax.jit``; the donation map
+    rides the ledger entries."""
+    import jax
+
+    donate = jit_kwargs.get("donate_argnums", ())
+    if isinstance(donate, int):
+        donate = (donate,)
+    return instrument(jax.jit(fun, **jit_kwargs), label, donate)
+
+
+# ---------------------------------------------------------------------- #
+# the device-memory accountant
+# ---------------------------------------------------------------------- #
+
+def _leaf_bytes(x) -> int:
+    """Device bytes held by one leaf.  A sharded jax array counts its
+    ADDRESSABLE SHARDS (replication is real memory — an 8-device
+    replicated array holds 8 copies); anything else with ``nbytes``
+    counts that; the rest count zero."""
+    shards = getattr(x, "addressable_shards", None)
+    if shards is not None:
+        try:
+            return int(sum(s.data.nbytes for s in shards))
+        except Exception:       # noqa: BLE001 — a deleted/donated array
+            return 0
+    nb = getattr(x, "nbytes", None)
+    try:
+        return int(nb) if nb is not None else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+def weakref_root(obj, *attrs) -> Callable[[], Optional[list]]:
+    """A zero-arg accountant root reading ``[obj.a for a in attrs]``
+    through a WEAK reference — the one place the dead-root contract
+    lives: registration never pins a retired owner, and once the
+    owner is collected the root resolves to ``None`` (samples as 0
+    bytes).  ``ServingEngine.register_memory`` and
+    ``StandardUpdater.register_memory`` both register through this."""
+    import weakref
+
+    ref = weakref.ref(obj)
+
+    def read():
+        owner = ref()
+        return None if owner is None else [getattr(owner, a)
+                                           for a in attrs]
+
+    return read
+
+
+def tree_bytes(root) -> int:
+    """Total device bytes across a pytree of arrays (jax resolves
+    lazily; a non-tree leaf counts via its own ``nbytes``)."""
+    try:
+        from jax import tree_util
+
+        leaves = tree_util.tree_leaves(root)
+    except Exception:           # noqa: BLE001 — jax-free tooling
+        leaves = root if isinstance(root, (list, tuple)) else [root]
+    return sum(_leaf_bytes(x) for x in leaves)
+
+
+class MemoryAccountant:
+    """Per-subsystem live-buffer byte gauges with high-watermarks.
+
+    Subsystems register the ROOTS of what they keep alive on device —
+    a pytree, or (the usual form) a zero-arg callable re-resolved per
+    sample, so a root that is reassigned (a donated carry, a reset
+    engine) is never sampled stale.  :meth:`sample` walks every root
+    into ``memory/<subsystem>_bytes`` gauges plus ``memory/
+    total_bytes``; the gauge's ``max`` is the high-watermark, and the
+    accountant keeps its own watermark table too so ``/programz``
+    renders one with the registry disabled."""
+
+    def __init__(self):
+        self._roots: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._last: Dict[str, int] = {}
+        self._watermark: Dict[str, int] = {}
+        self._errors: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, root) -> "MemoryAccountant":
+        """Register (or replace) subsystem ``name``'s buffer root —
+        a pytree or a zero-arg callable returning one."""
+        with self._lock:
+            self._roots[str(name)] = root
+        return self
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._roots.pop(str(name), None)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._roots)
+
+    def sample(self, registry=None) -> Dict[str, int]:
+        """Walk every registered root into per-subsystem byte totals;
+        set the gauges; return ``{subsystem: bytes}``.  A root whose
+        callable raises samples as 0 (accounting must never kill the
+        loop) — the error string lands in the /programz table."""
+        with self._lock:
+            roots = list(self._roots.items())
+        out: Dict[str, int] = {}
+        errors: Dict[str, str] = {}
+        for name, root in roots:
+            try:
+                tree = root() if callable(root) else root
+                out[name] = tree_bytes(tree)
+            except Exception as err:    # noqa: BLE001
+                out[name] = 0
+                errors[name] = f"{type(err).__name__}: {err}"
+        total = sum(out.values())
+        with self._lock:
+            self._last = dict(out)
+            self._errors = errors
+            for name, b in out.items():
+                if b > self._watermark.get(name, -1):
+                    self._watermark[name] = b
+            if total > self._watermark.get("total", -1):
+                self._watermark["total"] = total
+        if registry is None:
+            registry = get_registry()
+        for name, b in out.items():
+            registry.set(f"memory/{_slug(name)}_bytes", b)
+        registry.set("memory/total_bytes", total)
+        return out
+
+    def table(self) -> List[dict]:
+        """The ``/programz`` memory rows: one per subsystem —
+        last-sampled bytes and the high-watermark."""
+        with self._lock:
+            errors = self._errors
+            rows = [{"subsystem": name,
+                     "bytes": self._last.get(name),
+                     "high_watermark": self._watermark.get(name),
+                     **({"error": errors[name]} if name in errors
+                        else {})}
+                    for name in self._roots]
+            rows.append({"subsystem": "total",
+                         "bytes": (sum(self._last.values())
+                                   if self._last else None),
+                         "high_watermark": self._watermark.get("total")})
+        return rows
+
+    def clear(self) -> None:
+        with self._lock:
+            self._roots.clear()
+            self._last.clear()
+            self._watermark.clear()
+            self._errors.clear()
+
+
+# ---------------------------------------------------------------------- #
+# the retrace-storm alert rule
+# ---------------------------------------------------------------------- #
+
+def retrace_storm_rule(name: str = "retrace-storm", *,
+                       budget: float = 0.001,
+                       windows=None, protect: bool = False):
+    """A burn-rate rule over the ledger's counters: bad =
+    ``compile/steady_retraces`` (compiles after a phase was declared
+    steady), total = ``compile/calls``.  A healthy steady phase
+    compiles NOTHING, so the sustainable bad fraction is ~0 and any
+    sustained recompile churn (a shape leak in the serving round, an
+    un-cached tail shape every epoch) burns the budget within one
+    window pair.  ``protect`` defaults False — a retrace storm wants a
+    page and a /programz read, not admission shedding."""
+    from chainermn_tpu.utils.alerts import DEFAULT_WINDOWS, RatioRule
+
+    return RatioRule(
+        name,
+        bad="compile/steady_retraces",
+        total="compile/calls",
+        budget=budget,
+        windows=DEFAULT_WINDOWS if windows is None else windows,
+        protect=protect,
+    )
+
+
+# ---------------------------------------------------------------------- #
+# process-global instances
+# ---------------------------------------------------------------------- #
+
+def _from_env() -> ProgramLedger:
+    enabled = os.environ.get("CHAINERMN_TPU_PROGRAMS", "") \
+        not in ("", "0")
+    try:
+        capacity = int(os.environ.get(
+            "CHAINERMN_TPU_PROGRAMS_CAPACITY", 1024))
+        if capacity < 1:
+            raise ValueError(capacity)
+    except ValueError:
+        capacity = 1024     # typo'd env degrades, never crashes import
+    return ProgramLedger(capacity=capacity, enabled=enabled)
+
+
+_GLOBAL = _from_env()
+_ACCOUNTANT = MemoryAccountant()
+
+
+def get_ledger() -> ProgramLedger:
+    """The process-global program ledger every instrumented jit call
+    site records into (disabled by default — see module docstring)."""
+    return _GLOBAL
+
+
+def set_ledger(ledger: ProgramLedger) -> ProgramLedger:
+    """Swap the global ledger (tests, scoped benches); returns the
+    previous one so callers can restore it."""
+    global _GLOBAL
+    prev = _GLOBAL
+    _GLOBAL = ledger
+    return prev
+
+
+def get_accountant() -> MemoryAccountant:
+    """The process-global memory accountant (always constructed; a
+    sample with nothing registered is an empty table)."""
+    return _ACCOUNTANT
+
+
+def set_accountant(acc: MemoryAccountant) -> MemoryAccountant:
+    global _ACCOUNTANT
+    prev = _ACCOUNTANT
+    _ACCOUNTANT = acc
+    return prev

@@ -6,7 +6,7 @@ production serving fleet also needs the live question answered NOW:
 is this process healthy, what is its queue depth, which epoch is it
 serving, which alerts are firing, and show me the trace of that slow
 request.  This module is that surface — a stdlib-only
-(:mod:`http.server`) daemon thread serving four endpoints:
+(:mod:`http.server`) daemon thread serving five endpoints:
 
 - ``/healthz`` — liveness + registered health checks; HTTP 200 while
   every check passes, 503 otherwise (the load-balancer probe).
@@ -26,6 +26,13 @@ request.  This module is that surface — a stdlib-only
   newest-first summaries, ``?trace_id=`` resolves one full timeline
   (the last hop of the exemplar link), ``?chrome=1`` renders the
   Perfetto document.
+- ``/programz`` — the compile-and-memory plane
+  (:mod:`chainermn_tpu.utils.programs`): the XLA program ledger
+  newest-first (each compile with its signature diff — the "why did
+  this retrace" attribution), per-label compile/call stats, and the
+  memory accountant's per-subsystem byte table with high-watermarks
+  (``?n=`` bounds the entry list, ``?scope=serve/`` restricts to one
+  subsystem's labels).
 
 Discipline matches the rest of the stack: OFF by default, explicitly
 constructed (or env-gated — ``CHAINERMN_TPU_STATUSZ=1`` serves on an
@@ -91,11 +98,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, owner.statusz())
             elif path == "/tracez":
                 self._tracez(owner, params)
+            elif path == "/programz":
+                self._programz(owner, params)
             else:
                 self._send_json(404, {
                     "error": f"no route {path!r}",
-                    "routes": ["/healthz", "/metricsz", "/statusz",
-                               "/tracez"]})
+                    "routes": ["/healthz", "/metricsz", "/programz",
+                               "/statusz", "/tracez"]})
         except Exception as err:        # noqa: BLE001 — introspection
             try:                        # must never kill the server
                 self._send_json(500, {"error": f"{type(err).__name__}: "
@@ -188,6 +197,14 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, {"stores": stores,
                               "traces": _json_safe(traces)})
 
+    def _programz(self, owner: "StatuszServer", params) -> None:
+        try:
+            n = int((params.get("n") or ["64"])[0])
+        except ValueError:
+            n = 64          # typo'd knob degrades, never a 500
+        scope = (params.get("scope") or [None])[0]
+        self._send_json(200, owner.programz(n=n, scope=scope))
+
 
 class _Server(ThreadingHTTPServer):
     daemon_threads = True
@@ -219,12 +236,15 @@ class StatuszServer:
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1", *,
-                 registry=None, alerts=None,
+                 registry=None, alerts=None, ledger=None,
+                 accountant=None,
                  labels: Optional[Dict[str, str]] = None):
         self.requested_port = int(port)
         self.host = host
         self.registry = registry
         self.alerts = alerts
+        self.ledger = ledger
+        self.accountant = accountant
         self.labels = labels
         self._sections: Dict[str, Callable[[], dict]] = {}
         self._health: Dict[str, Callable[[], bool]] = {}
@@ -314,6 +334,52 @@ class StatuszServer:
         from chainermn_tpu.utils.alerts import get_installed
 
         return get_installed()
+
+    def _ledger(self):
+        if self.ledger is not None:
+            return self.ledger
+        from chainermn_tpu.utils.programs import get_ledger
+
+        return get_ledger()
+
+    def _accountant(self):
+        if self.accountant is not None:
+            return self.accountant
+        from chainermn_tpu.utils.programs import get_accountant
+
+        return get_accountant()
+
+    def programz(self, n: int = 64,
+                 scope: Optional[str] = None) -> dict:
+        """The ``/programz`` document: the program ledger's summary +
+        newest-first compile entries (each with its signature diff —
+        the "why did this retrace" read), and the memory accountant's
+        per-subsystem byte table with high-watermarks.  ``scope``
+        restricts the entry list to a label prefix (``?scope=serve/``
+        — the incident view of one subsystem's programs)."""
+        led = self._ledger()
+        acc = self._accountant()
+        doc = {"ts": time.time()}
+        # each block renders (or errors) independently — one broken
+        # producer must not blank the others (the section discipline)
+        try:
+            doc["ledger"] = _json_safe(led.status())
+        except Exception as err:        # noqa: BLE001 — introspection
+            doc["ledger"] = {"error": f"{type(err).__name__}: {err}"}
+        try:
+            doc["programs"] = _json_safe(led.entries(n, scope=scope))
+        except Exception as err:        # noqa: BLE001
+            doc["programs"] = {"error": f"{type(err).__name__}: {err}"}
+        try:
+            # sample() refreshes the gauges — on THIS server's
+            # configured registry, the one /metricsz renders — so a
+            # scrape never shows a stale (or absent) memory table;
+            # with nothing registered it is an empty walk
+            acc.sample(registry=self._registry())
+            doc["memory"] = _json_safe(acc.table())
+        except Exception as err:        # noqa: BLE001
+            doc["memory"] = {"error": f"{type(err).__name__}: {err}"}
+        return doc
 
     def health(self):
         checks = {}

@@ -1,0 +1,147 @@
+"""Ledger-backed training invariants (ISSUE 15): the accum training
+loop compiles NOTHING after step 1 (the zero-steady-state-recompile
+pin for the training half), epoch-tail shapes are ATTRIBUTED ledger
+events rather than silent wall time, and GoodputReport's compile
+badput decomposes a real updater window."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models import init_mlp, mlp_apply, softmax_cross_entropy
+from chainermn_tpu.utils.metrics import (
+    GoodputReport,
+    MetricsRegistry,
+    set_registry,
+)
+from chainermn_tpu.utils.programs import ProgramLedger, set_ledger
+from chainermn_tpu.utils.telemetry import TraceRecorder, set_recorder
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla")
+
+
+@pytest.fixture()
+def ledger():
+    led = ProgramLedger(enabled=True)
+    prev = set_ledger(led)
+    try:
+        yield led
+    finally:
+        set_ledger(prev)
+
+
+def _dataset(n=256, dim=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32), np.int32(i % classes))
+            for i in range(n)]
+
+
+def _loss_fn(p, x, y):
+    return softmax_cross_entropy(mlp_apply(p, x), y)
+
+
+def _make(comm, batch_size, **kw):
+    it = cmn.SerialIterator(_dataset(n=kw.pop("n", 256)), batch_size,
+                            repeat=kw.pop("repeat", True),
+                            shuffle=True, seed=7)
+    optimizer = cmn.create_multi_node_optimizer(
+        optax.sgd(0.05), comm, zero1=kw.pop("zero1", False))
+    params = init_mlp(jax.random.PRNGKey(0), [6, 12, 3])
+    return cmn.StandardUpdater(it, optimizer, _loss_fn, params, comm,
+                               **kw)
+
+
+class TestZeroSteadyStateRecompile:
+    def test_accum_loop_post_step_1(self, comm, ledger):
+        """The acceptance invariant: step 1 compiles the one fused
+        accumulating window program; every later steady window runs
+        it signature-identically — zero compiles post-step-1, proven
+        by the ledger."""
+        upd = _make(comm, 16, accum_steps=4, steps_per_execution=2)
+        upd.update()                     # step 1: the compile
+        assert ledger.compiles("train/") >= 1
+        stats = ledger.label_stats()["train/step"]
+        assert stats["compiles"] == 1 and stats["programs"] == 1
+        upd.mark_steady()
+        for _ in range(6):
+            upd.update()
+        assert ledger.steady_retraces("train/") == 0, \
+            ledger.entries(scope="train/")
+        assert ledger.label_stats()["train/step"]["compiles"] == 1
+
+    def test_rebind_world_re_records_the_recompile(self, comm,
+                                                   ledger):
+        """rebind_world drops the ledger's train/ signature memory:
+        the rebuilt step program's compile is re-recorded even though
+        the world (and so the signature) is unchanged — the
+        post-resize recompile can never hide behind a seen
+        signature."""
+        upd = _make(comm, 16)
+        upd.update()
+        upd.mark_steady()
+        assert ledger.compiles("train/") >= 1
+        before = ledger.compiles("train/")
+        for pending in list(upd._inflight):
+            jax.block_until_ready(pending)
+        upd.rebind_world(comm, upd.optimizer)
+        assert not ledger.is_steady("train/step")
+        upd.update()
+        assert ledger.compiles("train/") > before
+        assert ledger.steady_retraces("train/") == 0
+
+    def test_epoch_tail_shapes_are_attributed(self, comm, ledger):
+        """A non-dividing epoch tail flushes through the n_steps=1
+        programs — EXTRA compiles under the same train/step label,
+        each a ledger entry whose signature diff names the batch-shape
+        change (the PR 4 epoch-tail story, now attributed)."""
+        # 250 examples / batch 16 -> 15 full batches + a 10-row tail
+        upd = _make(comm, 16, n=250, repeat=False,
+                    steps_per_execution=2)
+        with pytest.raises(StopIteration):
+            for _ in range(100):
+                upd.update()
+        stats = ledger.label_stats()["train/step"]
+        assert stats["compiles"] >= 2    # steady window + tail program
+        entries = ledger.entries(scope="train/step")
+        diffs = [e["diff"] for e in entries if e["diff"] is not None]
+        assert diffs, entries
+        assert any("shape" in d["kinds"] or "structure" in d["kinds"]
+                   for d in diffs)
+
+
+class TestGoodputDecomposition:
+    def test_compile_badput_on_a_real_window(self, comm, ledger):
+        """Window 1 (the step-1 compile) bills compile_s > 0 and the
+        compile seconds leave productive; window 2 (steady) bills
+        zero."""
+        reg = MetricsRegistry(enabled=True)
+        prev_reg = set_registry(reg)
+        rec = TraceRecorder(enabled=True)
+        prev_rec = set_recorder(rec)
+        try:
+            report = GoodputReport(recorder=rec, write=False,
+                                   registry=reg)
+            report.initialize()
+            upd = _make(comm, 16, accum_steps=2)
+            upd.update()
+            jax.block_until_ready(upd.params)
+            report()
+            first = report.last_report
+            assert first["badput"]["compile_s"] > 0.0
+            assert first["badput"]["compile_s"] == pytest.approx(
+                ledger.total_compile_s)
+            for _ in range(3):
+                upd.update()
+            jax.block_until_ready(upd.params)
+            report()
+            second = report.last_report
+            assert second["badput"]["compile_s"] == 0.0
+            assert second["productive_s"] > 0.0
+        finally:
+            set_registry(prev_reg)
+            set_recorder(prev_rec)

@@ -1,0 +1,161 @@
+"""Ledger-backed serving invariants (ISSUE 15): zero steady-state
+recompiles in the decode loop post-warm — pinned through the program
+ledger, which records exactly the signature set that decides a jit
+retrace — and the per-(prefix,suffix)-split verify-retrace budget
+(docs/SERVING.md "The verify-retrace budget")."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import ServingEngine
+from chainermn_tpu.serving.sampling import SamplingParams
+from chainermn_tpu.utils.programs import ProgramLedger, set_ledger
+
+
+@pytest.fixture()
+def ledger():
+    led = ProgramLedger(enabled=True)
+    prev = set_ledger(led)
+    try:
+        yield led
+    finally:
+        set_ledger(prev)
+
+
+def _serve(eng, rng, n, max_new=(4, 12), sampled_every=0):
+    for i in range(n):
+        sp = None
+        if sampled_every and i % sampled_every == 0:
+            sp = SamplingParams(temperature=0.8, top_k=8, seed=i)
+        eng.submit(rng.randint(1, 60, size=rng.randint(3, 14)),
+                   max_new=rng.randint(*max_new), sampling=sp)
+    out = []
+    while not eng.idle:
+        out.extend(eng.step())
+    return out
+
+
+class TestZeroSteadyStateRecompile:
+    def test_decode_loop_post_warm(self, mini_adapter, mini_params,
+                                   ledger):
+        """The acceptance invariant: after a warmup pass has exercised
+        every program the engine serves with (greedy + sampled rounds,
+        prefill, admit, rebase via warm()), steady ragged traffic —
+        different prompt lengths, budgets, sampling mixes, admissions
+        mid-stream — compiles NOTHING.  The ledger proves it: its
+        signature sets are exactly what decides a jit retrace, so
+        steady_retraces == 0 IS the no-recompile property."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4)
+        eng.warm()
+        rng = np.random.RandomState(0)
+        # warmup covers BOTH round programs: a pure-greedy pass (the
+        # all-greedy rounds run serve/round) then a sampled mix
+        warm = _serve(eng, rng, 8)
+        warm += _serve(eng, rng, 6, sampled_every=2)
+        assert len(warm) == 14
+        warm_compiles = ledger.compiles("serve/")
+        assert warm_compiles >= 7     # init, pool, rebase, prefill,
+        #                               admit, round, round_sampled
+        stats = ledger.label_stats()
+        assert "serve/round" in stats
+        assert "serve/round_sampled" in stats
+
+        eng.mark_steady()
+        steady = _serve(eng, rng, 20, sampled_every=4)
+        assert len(steady) == 20
+        assert ledger.steady_retraces("serve/") == 0, \
+            ledger.entries(scope="serve/")
+        assert ledger.compiles("serve/") == warm_compiles
+
+    def test_shape_leak_is_caught(self, mini_adapter, mini_params,
+                                  ledger):
+        """The invariant's teeth: a genuinely new program shape after
+        mark_steady IS counted — the zero above is not vacuous."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4)
+        eng.warm()
+        rng = np.random.RandomState(1)
+        _serve(eng, rng, 6)
+        eng.mark_steady()
+        # the first SAMPLED request after warmup that never saw a
+        # sampled round: serve/round_sampled must compile now
+        _serve(eng, rng, 3, sampled_every=1)
+        assert ledger.steady_retraces("serve/") >= 1
+        entry = ledger.entries(scope="serve/round_sampled")[0]
+        assert entry["steady"] is True and entry["diff"] is None
+
+
+class TestVerifyRetraceBudget:
+    def test_one_compile_per_prefix_suffix_split(self, mini_adapter,
+                                                 mini_params, ledger):
+        """The suffix-prefill program's shapes vary per (prefix,
+        suffix) BLOCK split, so it retraces per distinct split — and
+        only per distinct split: the ledger bounds the compile count
+        by the split set, and a repeated split costs nothing (the
+        SERVING.md verify-retrace budget)."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=4,
+                            round_tokens=4, prefix_sharing=True)
+        eng.warm()
+        system = np.arange(1, 9, dtype=np.int32)       # 2 full blocks
+        splits = set()
+
+        def submit_with_suffix(suffix_tokens):
+            prompt = np.concatenate(
+                [system, np.asarray(suffix_tokens, np.int32)])
+            n_shared = min(len(system) // eng.block,
+                           len(prompt) // eng.block)
+            n_blocks = -(-len(prompt) // eng.block)
+            if n_blocks > n_shared:
+                splits.add((n_shared, n_blocks - n_shared))
+            eng.submit(prompt, max_new=4)
+            while not eng.idle:
+                eng.step()
+
+        submit_with_suffix([20, 21])            # split (2, 1)
+        submit_with_suffix([22, 23, 24])        # split (2, 1) again
+        before = ledger.compiles("serve/suffix_prefill")
+        submit_with_suffix([25])                # (2, 1) third time
+        assert ledger.compiles("serve/suffix_prefill") == before
+        submit_with_suffix([26] * 6)            # split (2, 2): fresh
+        stats = ledger.label_stats().get("serve/suffix_prefill")
+        assert stats is not None, ledger.label_stats()
+        assert stats["compiles"] <= len(splits)
+        # the retrace attribution names the changing leaves as shapes
+        entries = ledger.entries(scope="serve/suffix_prefill")
+        diffs = [e["diff"] for e in entries if e["diff"] is not None]
+        assert diffs and all(d["kinds"] == ["shape"] for d in diffs)
+
+    def test_suffix_compile_exemplar_links_to_request(
+            self, mini_adapter, mini_params, ledger):
+        """The compile→trace link: a suffix-prefill compile caused by
+        a traced request carries that request's trace id as its
+        ledger exemplar (the /programz row points at the causal
+        request, the compile/seconds exemplar resolves in its
+        timeline)."""
+        from chainermn_tpu.utils.telemetry import RequestTraceStore
+
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=4,
+                            round_tokens=4, prefix_sharing=True,
+                            traces=RequestTraceStore(sample_rate=1.0))
+        eng.warm()
+        system = np.arange(1, 9, dtype=np.int32)       # 2 full blocks
+        eng.submit(np.concatenate([system,
+                                   np.asarray([30, 31], np.int32)]),
+                   max_new=4, trace_id="cold-req")
+        while not eng.idle:
+            eng.step()
+        eng.submit(np.concatenate([system,
+                                   np.asarray([40, 41], np.int32)]),
+                   max_new=4, trace_id="hit-req")
+        while not eng.idle:
+            eng.step()
+        entries = ledger.entries(scope="serve/suffix_prefill")
+        assert entries, ledger.label_stats()
+        assert entries[-1]["exemplar"] in ("cold-req", "hit-req")
+        # and the staging exemplar never leaks past the stage
+        assert ledger.exemplar is None

@@ -199,10 +199,14 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
       "--max-prompt", "16", "--block", "8", "--min-new", "4",
       "--max-new", "12", "--round-tokens", "2", "--rounds", "1",
       "--reps", "1"], "x"),
+    ("bench_programs.py",
+     ["--batch", "8", "--dim", "64", "--hidden", "128", "--warmup",
+      "1", "--iters", "4", "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
         "autotune", "telemetry", "metrics_registry", "overlap",
-        "overload", "elastic", "live_elastic", "obs_plane"])
+        "overload", "elastic", "live_elastic", "obs_plane",
+        "programs"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
         _run(script, ["--platform", "cpu", *args, "--timeouts", "420"]),
