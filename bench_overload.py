@@ -124,7 +124,7 @@ def _replay(engine, trace, deadlines=None):
 
 def _calibrate(engine, trace):
     """Two unloaded waves: the first eats every compile (prefill /
-    admit / round / rebase via ``warm()``) and is DISCARDED; the
+    admit / round via ``warm()``) and is DISCARDED; the
     second measures the warmed, no-queue TTFT/TPOT that the SLO
     targets (and the predictor prior) are derived from — a target
     calibrated against compile time would be generous enough to make
@@ -237,6 +237,11 @@ def run(args):
         pred = ServiceTimePredictor(quantile=args.quantile)
         for t, p in cal_records:
             pred.observe_ttft(t)
+            # calibration ran unloaded (no queue), so its TTFT IS the
+            # queue-free service time: prime the split predictor's
+            # service stream too, and the deadline check models the
+            # LIVE queue instead of inheriting calibration-era waits
+            pred.observe_service_ttft(t)
             pred.observe_tpot(p)
         return AdmissionController(
             max_queue=args.max_queue or None, predictor=pred)

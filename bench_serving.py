@@ -48,6 +48,20 @@ the same record:
   story).  Reported: tokens/s both ways, their ratio, and the
   acceptance rate for a cheap random draft and the self-draft
   ceiling.
+
+**Ragged-round arms** (``--ragged-tier 0`` skips them):
+
+- *ragged-ttft*: the TTFT-independence claim, measured: short prompts
+  admitted mid-stream next to chunk-staged LONG prompts vs the same
+  shorts with no longs at all — the short-prompt TTFT p50 must not
+  move beyond the noise bar (asserted in-run; ``--ttft-noise-bar``).
+  A lockstep arm (one chunk = the whole prompt, the old monolithic
+  staging shape) runs the same co-admit trace for the
+  ragged-vs-lockstep ratio.
+- *engine-spec*: per-row speculative ROUNDS (``draft_adapter=`` on
+  the engine) vs plain ragged rounds over the same trace — tokens/s
+  ratio, per-row acceptance rate, and per-request token identity
+  (which must hold at ANY acceptance).
 """
 
 import argparse
@@ -321,6 +335,174 @@ def _spec_arm(args, rng):
     return out
 
 
+def _ragged_arm(args, rng):
+    """TTFT independence under chunked co-admission, plus the
+    ragged-vs-lockstep staging comparison.
+
+    Scenario per pass: half the slots decode long-running background
+    rows; then LONG prompts arrive (staged one chunk per round) and
+    short prompts arrive right behind them.  Measured: the shorts'
+    TTFT p50 with the longs present vs the same shorts with no longs
+    at all (same engine, same background).  The lockstep engine stages
+    a whole prompt as ONE chunk — the monolithic shape chunking
+    replaced — over the identical co-admit trace."""
+    import jax
+    import numpy as np
+
+    from chainermn_tpu.parallel import MeshConfig
+    from chainermn_tpu.serving import (
+        MiniLMAdapter, MiniLMConfig, ServingEngine, init_minilm,
+    )
+
+    blk = args.block
+    long_p = (max(args.long_prompt, 2 * blk) // blk) * blk
+    bg_new = 48
+    horizon = long_p + bg_new + blk
+    cfg = MiniLMConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.heads, d_head=args.d_model // args.heads,
+        d_ff=2 * args.d_model, n_layers=args.n_layers,
+        max_pos=horizon)
+    n_dev = min(args.slots, jax.device_count())
+    mc = MeshConfig(data=n_dev, devices=jax.devices()[:n_dev])
+    params = init_minilm(jax.random.PRNGKey(0), cfg)
+    adapter = MiniLMAdapter(mc, cfg)
+
+    n_bg = args.slots // 2
+    n_long = args.slots - n_bg
+    bg = [rng.randint(0, args.vocab, blk) for _ in range(n_bg)]
+    longs = [rng.randint(0, args.vocab, long_p)
+             for _ in range(n_long)]
+    shorts = [rng.randint(0, args.vocab,
+                          rng.randint(args.min_prompt, blk + 1))
+              for _ in range(args.ragged_requests)]
+
+    def one_pass(eng, with_longs):
+        eng.reset()
+        for p in bg:
+            eng.submit(p, max_new=bg_new)
+        for _ in range(2):
+            eng.step()              # background rows are mid-decode
+        if with_longs:
+            for p in longs:
+                eng.submit(p, max_new=8)
+        rids = {eng.submit(p, max_new=8) for p in shorts}
+        comps = eng.run(max_steps=20000)
+        ttfts = [c.ttft for c in comps if c.rid in rids]
+        assert len(ttfts) == len(shorts)
+        return float(np.percentile(np.asarray(ttfts), 50)), eng.stats()
+
+    out = {}
+    engines = {
+        "ragged": ServingEngine(
+            adapter, params, n_slots=args.slots, horizon=horizon,
+            max_prompt=long_p, block=blk,
+            round_tokens=args.round_tokens, prefill_chunk=1),
+        "lockstep": ServingEngine(
+            adapter, params, n_slots=args.slots, horizon=horizon,
+            max_prompt=long_p, block=blk,
+            round_tokens=args.round_tokens,
+            prefill_chunk=long_p // blk),
+    }
+    for eng in engines.values():
+        eng.warm()
+    solo = coadmit = lockstep = float("inf")
+    for _ in range(max(args.rounds, 1)):
+        p50, _ = one_pass(engines["ragged"], with_longs=False)
+        solo = min(solo, p50)
+        p50, st = one_pass(engines["ragged"], with_longs=True)
+        coadmit = min(coadmit, p50)
+        out["ragged_chunk_prefills"] = st["chunk_prefills"]
+        p50, _ = one_pass(engines["lockstep"], with_longs=True)
+        lockstep = min(lockstep, p50)
+    out["ragged_short_ttft_solo_p50_ms"] = round(solo * 1e3, 2)
+    out["ragged_short_ttft_coadmit_p50_ms"] = round(coadmit * 1e3, 2)
+    out["lockstep_short_ttft_coadmit_p50_ms"] = round(
+        lockstep * 1e3, 2)
+    ratio = coadmit / max(solo, 1e-9)
+    out["ragged_ttft_coadmit_ratio"] = round(ratio, 3)
+    out["ragged_vs_lockstep_short_ttft"] = round(
+        lockstep / max(coadmit, 1e-9), 3)
+    # the independence ASSERT: long-prompt co-admission must not move
+    # the short-prompt TTFT p50 beyond the noise bar
+    assert ratio <= args.ttft_noise_bar, (
+        f"short-prompt TTFT p50 moved {ratio:.2f}x under long-prompt "
+        f"co-admission (bar {args.ttft_noise_bar}x) — chunked "
+        "admission is not isolating TTFT")
+    return out
+
+
+def _engine_spec_arm(args, rng):
+    """Per-row speculative rounds (the engine's draft_adapter= mode)
+    vs plain ragged rounds over one trace: tokens/s ratio, per-row
+    acceptance, token identity at any acceptance."""
+    import jax
+    import numpy as np
+
+    from chainermn_tpu.parallel import MeshConfig
+    from chainermn_tpu.serving import (
+        MiniLMAdapter, MiniLMConfig, ServingEngine, init_minilm,
+    )
+
+    horizon = args.max_prompt + args.max_new + args.spec_k + 2
+    t_cfg = MiniLMConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.heads, d_head=args.d_model // args.heads,
+        d_ff=2 * args.d_model, n_layers=args.n_layers,
+        max_pos=horizon)
+    d_cfg = MiniLMConfig(
+        vocab_size=args.vocab, d_model=max(args.d_model // 4, 8),
+        n_heads=2, d_head=max(args.d_model // 8, 4),
+        d_ff=args.d_model // 2, n_layers=1, max_pos=horizon)
+    n_dev = min(args.slots, jax.device_count())
+    mc = MeshConfig(data=n_dev, devices=jax.devices()[:n_dev])
+    t_params = init_minilm(jax.random.PRNGKey(0), t_cfg)
+    d_params = init_minilm(jax.random.PRNGKey(1), d_cfg)
+    target = MiniLMAdapter(mc, t_cfg)
+    trace = [(rng.randint(0, args.vocab,
+                          rng.randint(args.min_prompt,
+                                      args.max_prompt + 1)),
+              int(rng.randint(args.min_new, args.max_new // 2 + 1)))
+             for _ in range(args.prefix_requests)]
+    out = {}
+    tokens_by_mode = {}
+    for mode, kwargs in (
+            ("plain", {}),
+            ("spec", {"draft_adapter": MiniLMAdapter(mc, d_cfg),
+                      "draft_params": d_params,
+                      "spec_k": args.spec_k})):
+        eng = ServingEngine(
+            target, t_params, n_slots=args.slots,
+            horizon=horizon, max_prompt=args.max_prompt,
+            block=args.block, round_tokens=args.round_tokens,
+            **kwargs)
+        eng.warm()
+        best = float("inf")
+        for _ in range(max(args.rounds, 1)):
+            eng.reset()
+            for p, n in trace:
+                eng.submit(p, max_new=n)
+            t0 = time.perf_counter()
+            comps = eng.run(max_steps=20000)
+            best = min(best, time.perf_counter() - t0)
+        tokens = sum(c.n_generated for c in comps)
+        tokens_by_mode[mode] = {
+            c.rid: np.asarray(c.tokens) for c in comps}
+        out[f"engine_{mode}_tokens_per_sec"] = round(tokens / best, 1)
+        if mode == "spec":
+            st = eng.stats()
+            out["engine_spec_acceptance_rate"] = round(
+                st["spec_accepted"] / max(st["spec_drafted"], 1), 4)
+    out["engine_spec_vs_plain"] = round(
+        out["engine_spec_tokens_per_sec"]
+        / max(out["engine_plain_tokens_per_sec"], 1e-9), 3)
+    out["engine_spec_identity_mismatches"] = sum(
+        not np.array_equal(tokens_by_mode["plain"][r],
+                           tokens_by_mode["spec"][r])
+        for r in tokens_by_mode["plain"])
+    return out
+
+
 def run(args):
     import jax
     import numpy as np
@@ -347,11 +529,9 @@ def run(args):
     rng = np.random.RandomState(args.seed)
     trace = _make_trace(rng, args)
 
-    # warmup: a mini trace compiles round/prefill/admit; warm() the
-    # rebase program too — it fires only when the horizon binds, which
-    # happens mid-measurement in the CONTINUOUS arm only (gang drains
-    # between waves and resets the clock for free), so an unwarmed
-    # compile would bias exactly the arm under test
+    # warmup: a mini trace compiles round/admit; warm() covers the
+    # chunked-prefill program across its splits so no compile lands
+    # mid-measurement in either arm
     for p, n in [(trace[0][1], 4), (trace[1][1], 4)]:
         engine.submit(p, max_new=n)
     engine.run(max_steps=200)
@@ -402,6 +582,11 @@ def run(args):
                                   np.random.RandomState(args.seed + 2)))
         extra.update(_spec_arm(args,
                                np.random.RandomState(args.seed + 3)))
+    if args.ragged_tier:
+        extra.update(_ragged_arm(args,
+                                 np.random.RandomState(args.seed + 4)))
+        extra.update(_engine_spec_arm(
+            args, np.random.RandomState(args.seed + 5)))
 
     ratio = arms["continuous"]["tokens_per_sec"] \
         / arms["static"]["tokens_per_sec"]
@@ -501,6 +686,18 @@ def main(argv):
     p.add_argument("--spec-prompts", type=int, default=6)
     p.add_argument("--spec-new", type=int, default=48,
                    help="tokens per prompt in the speculative arm")
+    p.add_argument("--ragged-tier", type=int, default=1,
+                   help="run the ragged-round arms (TTFT independence "
+                        "+ in-engine speculation); 0 skips them")
+    p.add_argument("--ragged-requests", type=int, default=12,
+                   help="short prompts per TTFT-independence pass")
+    p.add_argument("--long-prompt", type=int, default=96,
+                   help="long co-admitted prompt length (block-"
+                        "rounded) in the ragged-ttft arm")
+    p.add_argument("--ttft-noise-bar", type=float, default=1.75,
+                   help="max allowed short-prompt TTFT p50 ratio "
+                        "(co-admit / solo) before the independence "
+                        "assert trips")
     p.add_argument("--rounds", type=int, default=3,
                    help="interleaved replay rounds per arm (best round "
                         "counts — scheduler-noise rejection)")
@@ -521,10 +718,12 @@ def main(argv):
                  "vocab", "d_model", "heads", "n_layers", "seed",
                  "rounds", "devices", "decode_tier", "prefix_requests",
                  "shared_prefix", "spec_k", "spec_prompts",
-                 "spec_new"):
+                 "spec_new", "ragged_tier", "ragged_requests",
+                 "long_prompt"):
         cmd += [f"--{name.replace('_', '-')}",
                 str(getattr(args, name))]
-    cmd += ["--arrival-ms", str(args.arrival_ms)]
+    cmd += ["--arrival-ms", str(args.arrival_ms),
+            "--ttft-noise-bar", str(args.ttft_noise_bar)]
     if args.platform:
         cmd += ["--platform", args.platform]
     return run_child_with_retries(

@@ -4,24 +4,30 @@ production decode tier (prefix sharing, keyed sampling, speculative
 decoding).
 
 ``engine`` schedules requests onto decode slots (queue, admission into
-freed slots mid-stream, per-row EOS eviction, FCFS/shortest-prompt/
-deadline/WFQ policies, per-request deadlines + ``cancel()``,
-decode-round quarantine); ``admission`` supplies the overload layer
-(service-time prediction from the live TTFT/TPOT lattice histograms,
-bounded queue with priority displacement, per-tenant token quotas with
-deficit-round-robin WFQ scheduling, reason-coded ``ShedCompletion``
-fast rejects); ``kv_blocks`` supplies the paging layer (free-list
-block allocator, prefill-to-pool scatter, copy-on-admit gather,
-horizon rebase) that keeps the decode step one compiled program over
-the dense static cache; ``prefix_cache`` adds copy-on-write prefix
-sharing over it (refcounted blocks, a prefix trie keyed by token-id
-chunks — N requests sharing a system prompt hold ONE physical copy
-and stage only their divergent suffix); ``sampling`` threads
-per-request keyed temperature/top-k/top-p streams through the decode
-round (greedy stays the byte-identical exactness oracle, sampled runs
-pin by keyed replay); ``speculative`` drafts k tokens with a cheap
-adapter and verifies them in one target pass (greedy output exactly
-the target-only decode); ``slo`` scores request records (percentiles
+freed slots mid-stream, RAGGED per-row position clocks — every row
+advances on its own origin-0 lane, chunked prefill interleaves into
+decode rounds, speculation is a round mode — per-row EOS eviction,
+FCFS/shortest-prompt/deadline/WFQ policies, per-request deadlines +
+``cancel()``, decode-round quarantine); ``admission`` supplies the
+overload layer (split wait/service-time prediction from the live
+TTFT/TPOT lattice histograms, bounded queue with priority
+displacement, per-tenant token quotas with deficit-round-robin WFQ
+scheduling, reason-coded ``ShedCompletion`` fast rejects);
+``kv_blocks`` supplies the paging layer (free-list block allocator,
+chunked prefill-to-pool scatter, copy-on-admit gather) that keeps the
+decode step one compiled program over the dense static cache;
+``prefix_cache`` adds copy-on-write prefix sharing over it (refcounted
+blocks, a prefix trie keyed by token-id chunks — N requests sharing a
+system prompt hold ONE physical copy and stage only their divergent
+suffix, with mid-block divergence forking the matched sub-block
+prefix by device copy); ``sampling`` threads per-request keyed
+temperature/top-k/top-p streams through the decode round (greedy
+stays the byte-identical exactness oracle, sampled runs pin by keyed
+replay); ``speculative`` drafts k tokens with a cheap adapter and
+verifies them in one target pass (greedy output exactly the
+target-only decode) as a standalone/offline tier — in-engine, pass
+``draft_adapter=`` and the engine runs per-row speculative ROUNDS;
+``slo`` scores request records (percentiles
 + SLO attainment/goodput + extra columns like acceptance/hit rates);
 ``minilm`` is the portable reference decode backend (and
 adapter-protocol example) — the flagship transformer rides the same

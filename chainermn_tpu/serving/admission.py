@@ -15,7 +15,13 @@ ROADMAP names, using the measurements PR 9 already collects:
   configurable percentile of observed TTFT plus ``max_new - 1`` times
   the TPOT percentile.  Cold (no observations, no defaults) it
   predicts nothing and admission is optimistic — shedding needs
-  evidence.
+  evidence.  The engine additionally feeds an admit→first-token
+  stream (``observe_service_ttft``) so deadline decisions can SPLIT
+  the prediction: live-queue drain for the wait term, queue-free
+  service time for the rest — observed submit→first-token TTFT folds
+  each sample's own queue wait in, and a prediction built on it
+  over-sheds exactly when the queue is emptier than the history it
+  was measured under.
 - :class:`AdmissionController` — the submit/admit-time decisions:
   a bounded queue with priority displacement (a more important
   arrival may displace the least important queued request instead of
@@ -160,6 +166,9 @@ class ServiceTimePredictor:
         self.min_count = int(min_count)
         self.ttft_hist = Histogram()
         self.tpot_hist = Histogram()
+        # admit→first-token (queue-wait EXCLUDED): the service-side
+        # half of the split prediction — see :meth:`service_ttft`
+        self.service_hist = Histogram()
         # percentile over up to 512 exact samples is a sort; the
         # scheduler asks per queued request per tick, so memoize until
         # the next observation
@@ -170,6 +179,15 @@ class ServiceTimePredictor:
     def observe_ttft(self, seconds: float) -> None:
         self.ttft_hist.observe(seconds)
         self._cache.pop("ttft", None)
+
+    def observe_service_ttft(self, seconds: float) -> None:
+        """Feed an ADMIT→first-token measurement — the queue-free
+        service time.  ``serve/ttft`` (submit→first-token) folds the
+        request's own queue wait into the sample, so a predictor fed
+        only that double-counts waiting when it also models the queue;
+        this stream is the clean service half."""
+        self.service_hist.observe(seconds)
+        self._cache.pop("service", None)
 
     def observe_tpot(self, seconds: float) -> None:
         self.tpot_hist.observe(seconds)
@@ -189,17 +207,43 @@ class ServiceTimePredictor:
         """Predicted submit→first-token time under current load."""
         return self._estimate("ttft", self.ttft_hist, self.default_ttft)
 
+    def service_ttft(self) -> Optional[float]:
+        """Predicted ADMIT→first-token time — service only, no queue
+        wait.  ``None`` until :meth:`observe_service_ttft` has fed at
+        least ``min_count`` samples (no default: the split model needs
+        real service evidence, else callers fall back to the blended
+        :meth:`predict_e2e`)."""
+        return self._estimate("service", self.service_hist, None)
+
     def tpot(self) -> Optional[float]:
         """Predicted steady-state seconds per generated token."""
         return self._estimate("tpot", self.tpot_hist, self.default_tpot)
 
     def predict_e2e(self, max_new: int) -> Optional[float]:
         """Predicted submit→done seconds for a fresh ``max_new``-token
-        request (TTFT + (max_new−1)·TPOT); ``None`` while cold."""
+        request (TTFT + (max_new−1)·TPOT); ``None`` while cold.
+
+        Caveat the split model exists to fix: the observed TTFT folds
+        each SAMPLE's queue wait in, so this estimate is conditioned
+        on the historical queue, not the live one — with an empty
+        queue it over-predicts (and over-sheds).  Deadline decisions
+        prefer :meth:`predict_service` plus an explicit
+        :meth:`predict_queue_drain` wait term when service evidence
+        exists."""
         t, p = self.ttft(), self.tpot()
         if t is None or p is None:
             return None
         return t + p * max(int(max_new) - 1, 0)
+
+    def predict_service(self, max_new: int) -> Optional[float]:
+        """Predicted ADMIT→done seconds for a ``max_new``-token
+        request — pure service time (``service_ttft`` + (max_new−1)·
+        TPOT), no queue-wait term; ``None`` without live service
+        evidence."""
+        s, p = self.service_ttft(), self.tpot()
+        if s is None or p is None:
+            return None
+        return s + p * max(int(max_new) - 1, 0)
 
     def predict_remaining(self, tokens_left: int) -> Optional[float]:
         """Predicted seconds to generate ``tokens_left`` more tokens
@@ -232,8 +276,10 @@ class ServiceTimePredictor:
         return {
             "quantile": self.quantile,
             "ttft": self.ttft(),
+            "service_ttft": self.service_ttft(),
             "tpot": self.tpot(),
             "ttft_count": self.ttft_hist.count,
+            "service_count": self.service_hist.count,
             "tpot_count": self.tpot_hist.count,
         }
 
@@ -445,7 +491,8 @@ class AdmissionController:
             return False
 
     def check_submit(self, req, queue: Sequence,
-                     inflight: Dict[Optional[str], int]
+                     inflight: Dict[Optional[str], int],
+                     n_slots: Optional[int] = None
                      ) -> Tuple[bool, Optional[str], Optional[object]]:
         """The submit-time verdict: ``(admit, reason, victim)``.
 
@@ -460,6 +507,16 @@ class AdmissionController:
         any one request), quota (per-tenant fairness), predicted
         deadline (no point queueing the hopeless), then the queue
         bound.
+
+        ``n_slots`` (the engine passes its lane count) enables the
+        SPLIT deadline prediction: the wait term is the LIVE queue's
+        drain estimate conditioned on this request's actual queue
+        position, the service term is the queue-free
+        :meth:`ServiceTimePredictor.predict_service`.  Without it (or
+        without service evidence) the blended :meth:`predict_e2e`
+        estimate is used — which folds HISTORICAL queue waits into a
+        prediction for THIS queue, the over-shedding flaw the split
+        fixes (an empty queue inherits the congested past's wait).
         """
         if req.priority > self.protect_priority and self.protective():
             return False, "overload", None
@@ -468,7 +525,8 @@ class AdmissionController:
                 inflight.get(req.tenant, 0) + req.max_new > quota:
             return False, "over_quota", None
         if self.shed_on_deadline and req.deadline is not None:
-            pred = self.predictor.predict_e2e(req.max_new)
+            pred = self._predict_wait_and_service(req.max_new, queue,
+                                                  n_slots)
             if pred is not None and req.t_submit + pred > req.deadline:
                 return False, "deadline", None
         if self.max_queue is not None and len(queue) >= self.max_queue:
@@ -477,6 +535,25 @@ class AdmissionController:
                 return True, "queue_full", victim
             return False, "queue_full", None
         return True, None, None
+
+    def _predict_wait_and_service(self, max_new: int, queue: Sequence,
+                                  n_slots: Optional[int]
+                                  ) -> Optional[float]:
+        """Queue-position-conditioned e2e prediction: the LIVE queued
+        backlog's drain time (zero for an empty queue) plus the pure
+        service time.  Falls back to the blended :meth:`predict_e2e`
+        when the split inputs are missing."""
+        service = self.predictor.predict_service(max_new)
+        if service is None or n_slots is None:
+            return self.predictor.predict_e2e(max_new)
+        wait = 0.0
+        if queue:
+            backlog = sum(int(r.max_new) for r in queue)
+            drain = self.predictor.predict_queue_drain(backlog,
+                                                       n_slots)
+            if drain is not None:
+                wait = drain
+        return wait + service
 
     @staticmethod
     def _displacement_victim(req, queue: Sequence):

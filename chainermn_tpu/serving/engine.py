@@ -16,13 +16,25 @@ replaces the batch with SLOTS:
   gathered into the slot's contiguous cache lane;
 - **per-row eviction**: a slot leaves the moment ITS row is done
   (EOS or token budget), not when the last row is;
-- a **decode round** program advancing every live slot
-  ``round_tokens`` positions — the ONE compiled program property of
-  the static cache is preserved: the cache stays the dense
-  ``_make_cache`` layout, per-row raggedness rides the
-  ``pos_offset`` origin mechanism the padded decode paths already
-  use, and a global position clock (plus a block-aligned **rebase**
-  shift when it nears the horizon) keeps the buffer static forever.
+- a **ragged decode round** program advancing every live slot up to
+  ``round_tokens`` positions off its OWN position clock — the ONE
+  compiled program property of the static cache is preserved (the
+  cache stays the dense ``_make_cache`` layout and every program
+  shape is fixed), but rows are origin-0 (token ``i`` lives at lane
+  position ``i``) and carry per-row ``position`` / ``length`` /
+  ``end`` vectors instead of sharing a global clock.  No shared
+  horizon ever binds (``prompt_len - 1 + max_new <= horizon - 1`` by
+  submit validation), so the old block-aligned rebase shift — and its
+  prewarm and mid-serve stalls — is gone entirely;
+- **chunked prefill inside the round**: admission stages a prompt one
+  fixed-shape chunk per scheduler step through the adapter's
+  chunk-attends-cache ``verify`` surface while other rows keep
+  decoding, so a long co-scheduled prompt no longer moves a short
+  prompt's TTFT; and **per-row speculation as a round mode**: with a
+  ``draft_adapter`` attached, all-greedy rounds draft ``spec_k``
+  tokens per row and verify them in one target pass, committing a
+  DIFFERENT number of tokens per row (accepted prefix + one) — the
+  ragged clocks are what let acceptance raggedness ride at all.
 
 The engine is MODEL-AGNOSTIC: a decode adapter supplies
 ``make_cache`` / ``prefill`` / ``step`` (plus ``verify`` for the
@@ -286,6 +298,29 @@ class TransformerAdapter:
             chunk_attends_cache=True, pos_offset=pos_offset)
         return (logits if with_logits else None), caches
 
+    def step_ragged(self, params, caches, tok, t):
+        """Per-row-position decode step (the ragged-round engine
+        contract; see ``MiniLMAdapter.step_ragged``).  The flagship
+        ``_decode_step`` advances every row at one scalar position, so
+        the ragged form needs per-row position support in
+        ``models.decoding``'s vma path — not landed yet."""
+        raise NotImplementedError(
+            "TransformerAdapter does not implement the ragged decode "
+            "step: models.decoding._decode_step takes one scalar "
+            "position for the whole batch.  Ragged serving needs the "
+            "per-row-position decode path (future models.decoding "
+            "work); MiniLMAdapter is the runnable ragged reference.")
+
+    def verify_ragged(self, params, caches, tok_chunk, t,
+                      with_logits=True):
+        """Per-row-start chunk verify (ragged speculation); same gap
+        as :meth:`step_ragged`."""
+        raise NotImplementedError(
+            "TransformerAdapter does not implement the ragged chunk "
+            "verify: models.decoding's chunk path takes one scalar "
+            "start position.  MiniLMAdapter is the runnable ragged "
+            "reference.")
+
 
 def _fcfs(queue: Sequence[Request], engine) -> Request:
     return queue[0]
@@ -376,15 +411,17 @@ class ServingEngine:
         per ``adapter.param_specs()`` once at construction.
       n_slots: concurrent decode rows; must divide evenly over the
         mesh's batch shards.
-      horizon: the dense cache's position capacity.  The global clock
-        lives in ``[0, horizon)``; a block-aligned rebase shift
-        reclaims retired positions when admissions near the edge.
+      horizon: the dense cache's position capacity.  Rows are
+        origin-0 and carry their own position clocks in
+        ``[0, horizon)``; submit validation guarantees
+        ``prompt_len - 1 + max_new <= horizon - 1``, so no rebase
+        machinery exists — a freed slot simply restarts at 0.
       max_prompt: longest admissible prompt; rounded up to a block
-        multiple internally (``Pq``) — every prompt prefills as one
-        right-aligned ``Pq`` chunk so admission is ONE compiled
-        program, not one per length.
-      block: position-block size of the staging pool (and the rebase
-        granularity).
+        multiple internally (``Pq``) — every prompt stages into
+        ``ceil(P/block)`` pool blocks and admission gathers ONE
+        fixed-shape ``Pq`` chunk into lane positions ``[0, Pq)``, so
+        admission is ONE compiled program, not one per length.
+      block: position-block size of the staging pool.
       pool_blocks: staging-pool capacity in blocks (default: one full
         ``Pq`` chunk per slot).  A staged request holds only
         ``ceil(P/block)`` blocks — its real footprint — so a deep
@@ -394,6 +431,25 @@ class ServingEngine:
       round_tokens: decode-round length — positions advanced per
         dispatch; the host observes the per-row done bitmap between
         rounds (larger = less dispatch overhead, more post-EOS waste).
+      prefill_chunk: chunked-admission budget in BLOCKS — while other
+        rows are decoding, a staging prompt advances at most this many
+        prompt blocks per scheduler step through the adapter's
+        ``verify`` chunk-attends-cache surface (one fixed-shape
+        program for every chunk of every split, so chunked admission
+        never retraces).  With NO live rows the whole prompt stages in
+        one step regardless (nothing to interleave with).  Default 1
+        block; adapters without ``verify`` fall back to the monolithic
+        prefill program.
+      draft_adapter / draft_params: attach a DRAFT model and turn
+        per-row speculative draft/verify into a round MODE: all-greedy
+        rounds draft ``spec_k`` tokens per row with the draft model,
+        verify them in one target ``verify_ragged`` pass, and commit a
+        per-row accepted-prefix-plus-one token count — token-identical
+        to greedy decode whatever the draft proposes.  Rounds with a
+        SAMPLED row live fall back to per-token rounds (keyed-replay
+        sampling and speculative commits do not compose).  The draft
+        adapter must share the target's mesh/batch axes.
+      spec_k: draft tokens per speculative round (>= 1).
       policy: ``"fcfs"``, ``"spf"``, or ``callable(queue, engine) ->
         Request`` choosing the next admission from the queue.
       gang: static-batching mode — admit only when EVERY slot is free
@@ -429,8 +485,9 @@ class ServingEngine:
         :class:`~chainermn_tpu.utils.telemetry.RequestTraceStore` —
         turns ON per-request causal tracing: every request gets a
         ``trace_id`` (caller-propagated or generated), its lifecycle
-        spans (``queue_wait``/``admit``/``prefill``/sampled
-        ``decode_round``/``rebase``/terminal) are assembled into a
+        spans (``queue_wait``/``admit``/``prefill`` or
+        ``chunk_prefill``/sampled ``decode_round``/terminal) are
+        assembled into a
         timeline offered to the store at eviction/shed (tail-based
         retention there), and every ``serve/*`` histogram observation
         carries the trace id as its EXEMPLAR — a p99 on the dashboard
@@ -467,8 +524,36 @@ class ServingEngine:
                  epoch: int = 0,
                  traces: Optional[RequestTraceStore] = None,
                  trace_decode_every: int = 4,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 prefill_chunk: int = 1,
+                 draft_adapter=None, draft_params=None,
+                 spec_k: int = 4):
         mesh = adapter.mesh_cfg.mesh
+        if not callable(getattr(adapter, "step_ragged", None)):
+            raise ValueError(
+                f"{type(adapter).__name__} has no step_ragged: the "
+                "ragged decode round advances every row at its own "
+                "position, which the adapter must implement (see "
+                "MiniLMAdapter.step_ragged for the contract)")
+        if (draft_adapter is None) != (draft_params is None):
+            raise ValueError(
+                "draft_adapter and draft_params come together — give "
+                "both (speculative round mode) or neither")
+        if draft_adapter is not None:
+            if spec_k < 1:
+                raise ValueError(f"spec_k={spec_k} must be >= 1")
+            if draft_adapter.mesh_cfg.mesh is not mesh \
+                    or tuple(draft_adapter.batch_axes) \
+                    != tuple(adapter.batch_axes):
+                raise ValueError(
+                    "draft_adapter must share the target adapter's "
+                    "mesh and batch axes (its cache rides the same "
+                    "slot sharding)")
+            if not callable(getattr(adapter, "verify_ragged", None)):
+                raise ValueError(
+                    f"{type(adapter).__name__} has no verify_ragged: "
+                    "per-row speculation verifies each row's draft "
+                    "chunk at its own start position")
         shards = 1
         for a in adapter.batch_axes:
             shards *= mesh.shape.get(a, 1)
@@ -496,6 +581,9 @@ class ServingEngine:
             raise ValueError(f"pad_id={pad_id} must be >= 0 with eos")
         if round_tokens < 1:
             raise ValueError(f"round_tokens={round_tokens} must be >= 1")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be >= 1 (blocks)")
         self.set_policy(policy)
         self.adapter = adapter
         self.n_slots = n_slots
@@ -530,10 +618,21 @@ class ServingEngine:
                 lambda s: NamedSharding(mesh, s), adapter.param_specs(),
                 is_leaf=lambda x: isinstance(x, P)))
         self.prefix_sharing = bool(prefix_sharing)
-        # suffix-only prefill on a partial prefix hit needs the
-        # adapter's chunk-attends-cache verify surface; without it a
-        # hit still shares blocks, it just re-prefills the whole chunk
+        # chunked (and suffix-resumed) prefill needs the adapter's
+        # chunk-attends-cache verify surface; without it staging falls
+        # back to one monolithic prefill per prompt (prefix hits still
+        # share blocks, they just re-prefill the whole chunk)
         self._can_suffix = hasattr(adapter, "verify")
+        self.prefill_chunk = min(int(prefill_chunk), self._w)
+        self._chunk_tokens = self.prefill_chunk * block
+        self.draft_adapter = draft_adapter
+        self.spec_k = int(spec_k)
+        if draft_adapter is not None:
+            self._draft_params = jax.device_put(
+                draft_params, jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    draft_adapter.param_specs(),
+                    is_leaf=lambda x: isinstance(x, P)))
         self._alloc = RefcountedBlockPool(pool_blocks, block,
                                           share=self.prefix_sharing)
         self._build_programs()
@@ -544,7 +643,6 @@ class ServingEngine:
         # not force the copy (the iterators.prefetch.put_window
         # hazard), so the transfer could still be reading the buffer
         # when the next admission rewrites it.
-        self._prompt_staging = np.zeros((self._pq,), np.int32)
         self._lprompt_staging = np.zeros((self._pq,), np.int32)
         self._ids_staging = np.zeros((self._w,), np.int32)
         self.reset()
@@ -599,121 +697,170 @@ class ServingEngine:
             pool_body, mesh=mesh, in_specs=(), out_specs=pool_specs),
             label="serve/pool_init")
 
-        def round_body(params, caches, buf, offsets, done, end_t, t0):
-            def one(carry, r):
-                caches, buf, done = carry
-                t = t0 + r
-                tok = lax.dynamic_slice(
-                    buf, (0, jnp.minimum(t, H - 1)), (S, 1))[:, 0]
-                logits, caches = ad.step(
-                    params, caches, tok, jnp.minimum(t, H - 1), offsets)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                nxt = jnp.where(done, pad if pad >= 0 else 0, nxt)
-                if eos >= 0:
-                    done = done | (nxt == eos)
-                done = done | ((t + 1) >= end_t)
-                # steps past every row's end still run inside a round;
-                # their writes must not clamp onto live position H-1
-                wpos = jnp.minimum(t + 1, H - 1)
-                cur = lax.dynamic_slice(buf, (0, wpos), (S, 1))
-                val = jnp.where(t + 1 < H, nxt[:, None], cur)
-                buf = lax.dynamic_update_slice(buf, val, (0, wpos))
-                return (caches, buf, done), None
+        rows = jnp.arange(S)
 
-            (caches, buf, done), _ = lax.scan(
-                one, (caches, buf, done), jnp.arange(R))
-            return caches, buf, done
+        def ragged_step(params, caches, buf, pos, done, end, sample):
+            """One ragged position per LIVE row: read each row's token
+            at its OWN position, step, write the next token at
+            ``pos + 1``, advance.  Done (and empty) rows re-step their
+            frozen position — the rewrite is value-identical (same
+            token, same attended prefix), which is what makes the
+            frozen rows free instead of needing a gather/compact."""
+            pc = jnp.clip(pos, 0, H - 1)
+            tok = jnp.take_along_axis(buf, pc[:, None], axis=1)[:, 0]
+            logits, caches = ad.step_ragged(params, caches, tok, pc)
+            nxt = sample(logits, pos)
+            new_done = done
+            if eos >= 0:
+                new_done = new_done | (nxt == eos)
+            new_done = new_done | ((pos + 1) >= end)
+            # live rows never clip (pos + 1 <= end <= H - 1); done
+            # rows route their write OUT of bounds instead of onto a
+            # clamped live position
+            wpos = jnp.where(done, H, jnp.clip(pos + 1, 0, H - 1))
+            buf = buf.at[rows, wpos].set(nxt, mode="drop")
+            pos = jnp.where(done, pos, pos + 1)
+            return caches, buf, pos, new_done
+
+        def round_body(params, caches, buf, pos, done, end):
+            def greedy(logits, _pos):
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def one(carry, _):
+                carry = ragged_step(params, *carry, end, greedy)
+                return carry, None
+
+            (caches, buf, pos, done), _ = lax.scan(
+                one, (caches, buf, pos, done), None, length=R)
+            return caches, buf, pos, done
 
         self._round_fn = ledger_jit(
             jax.shard_map(
                 round_body, mesh=mesh,
                 in_specs=(pspecs, cspecs, row_spec, row_spec, row_spec,
-                          row_spec, P()),
-                out_specs=(cspecs, row_spec, row_spec)),
+                          row_spec),
+                out_specs=(cspecs, row_spec, row_spec, row_spec)),
             label="serve/round", donate_argnums=(1, 2))
 
-        def prefill_body(params, pools, prompt, p_off, ids, valid):
-            caches = ad.make_cache(1, pq, batch_varying=False)
-            caches = ad.prefill(params, caches, prompt[None, :pq - 1],
-                                p_off[None])
-            return tuple(
-                kvb.scatter_chunk(pc, kvb.chunk_to_blocks(c, self.block),
-                                  ids, valid)
-                for pc, c in zip(pools, caches))
+        def round_sampled_body(params, caches, buf, pos, done, end,
+                               temp, topk, topp, keys):
+            # the greedy round plus per-request keyed sampling: rows
+            # with temperature 0 take the argmax values the greedy
+            # program computes; sampled rows draw with the key folded
+            # by their OWN token index — under origin-0 lanes that IS
+            # ``pos + 1`` (the new token's row-local index), the same
+            # stream the lockstep engine folded as ``t + 1 - offset``,
+            # so keyed replay stays bit-identical across the redesign
+            def sample(logits, pos):
+                step_keys = fold_keys(keys, pos + 1)
+                return sample_tokens(logits, step_keys, temp, topk,
+                                     topp)
 
-        self._prefill_fn = ledger_jit(
+            def one(carry, _):
+                carry = ragged_step(params, *carry, end, sample)
+                return carry, None
+
+            (caches, buf, pos, done), _ = lax.scan(
+                one, (caches, buf, pos, done), None, length=R)
+            return caches, buf, pos, done
+
+        self._round_sampled_fn = ledger_jit(
             jax.shard_map(
-                prefill_body, mesh=mesh,
-                in_specs=(pspecs, pool_specs, P(), P(), P(), P()),
-                out_specs=pool_specs),
-            label="serve/prefill", donate_argnums=(1,))
+                round_sampled_body, mesh=mesh,
+                in_specs=(pspecs, cspecs, row_spec, row_spec, row_spec,
+                          row_spec, row_spec, row_spec, row_spec,
+                          row_spec),
+                out_specs=(cspecs, row_spec, row_spec, row_spec)),
+            label="serve/round_sampled", donate_argnums=(1, 2))
 
-        def admit_body(caches, buf, pools, flat, prompt, slot, dst0):
-            # position-level gather: a LEFT-aligned staged prompt
-            # (shareable block identity) lands RIGHT-aligned in its
-            # lane; the sub-block shift rides the flat index
+        def admit_body(caches, buf, pools, flat, prompt, slot):
+            # position-level gather: the staged prompt is LEFT-aligned
+            # in the pool (shareable block identity) and lands
+            # LEFT-aligned in its lane too — origin-0 rows, token i at
+            # position i, so admission is a straight gather at dst 0
             ls = slot - self._shard_base()
             ok = (ls >= 0) & (ls < S)
             lsc = jnp.clip(ls, 0, S - 1)
             caches = tuple(
                 kvb.insert_chunk(c, kvb.gather_positions(pc, flat),
-                                 lsc, dst0, ok)
+                                 lsc, 0, ok)
                 for c, pc in zip(caches, pools))
-            cur = lax.dynamic_slice(buf, (lsc, dst0), (1, pq))
+            cur = lax.dynamic_slice(buf, (lsc, 0), (1, pq))
             row = jnp.where(ok, prompt[None], cur)
-            buf = lax.dynamic_update_slice(buf, row, (lsc, dst0))
+            buf = lax.dynamic_update_slice(buf, row, (lsc, 0))
             return caches, buf
 
         self._admit_fn = ledger_jit(
             jax.shard_map(
                 admit_body, mesh=mesh,
-                in_specs=(cspecs, row_spec, pool_specs, P(), P(), P(),
-                          P()),
+                in_specs=(cspecs, row_spec, pool_specs, P(), P(), P()),
                 out_specs=(cspecs, row_spec)),
             label="serve/admit", donate_argnums=(0, 1))
 
-        def suffix_prefill_body(params, pools, prefix_flat, toks, ids,
-                                valid):
-            # prefill ONLY the divergent suffix of a prefix-cache hit:
-            # gather the shared prefix K/V ([0, start) positions, one
-            # physical copy in the pool), chunk-step the suffix tokens
-            # against it, scatter just the fresh suffix blocks
-            start = prefix_flat.shape[0]
-            width = toks.shape[0]
-            comps = ad.make_cache(1, start + width,
-                                  batch_varying=False)
-            caches = tuple(
-                lax.dynamic_update_slice(
-                    c, kvb.gather_positions(pc, prefix_flat)
-                    .astype(c.dtype),
-                    (0,) * c.ndim)
-                for c, pc in zip(comps, pools))
-            _, caches = ad.verify(
-                params, caches, toks[None], jnp.int32(start),
-                jnp.zeros((1,), jnp.int32), with_logits=False)
+        C = self._chunk_tokens
+        M = pq + C                  # materialized staging-row width
+
+        def chunk_prefill_body(params, pools, flat, toks, t, ids,
+                               valid):
+            # ONE fixed-shape program for EVERY prefill chunk: the
+            # chunk start ``t`` is a traced scalar, so every chunk of
+            # every (prefix, suffix) split — block-aligned or resumed
+            # mid-block after a sub-block copy — reuses one compile
+            # (the per-split suffix-prefill retrace family this
+            # replaces is dead).  Gather the row's staged content
+            # ([0, t) real: shared prefix + earlier chunks + any
+            # copied partial block), chunk-step ``toks`` at positions
+            # [t, t+C) through the verify surface, and scatter back
+            # the block-aligned window covering the chunk.
+            caches = tuple(kvb.gather_positions(pc, flat)
+                           for pc in pools)
+            _, caches = ad.verify(params, caches, toks[None], t,
+                                  jnp.zeros((1,), jnp.int32),
+                                  with_logits=False)
+            t0 = (t // self.block) * self.block
+            # t <= pq - 1 so t0 + C + block <= pq + C = M: the window
+            # slice never clamps (which would misalign it with ids)
+            window = tuple(
+                lax.dynamic_slice_in_dim(c, t0, C + self.block,
+                                         axis=kvb.POS_AXIS)
+                for c in caches)
             return tuple(
-                kvb.scatter_chunk(
-                    pc,
-                    kvb.chunk_to_blocks(
-                        lax.dynamic_slice_in_dim(
-                            c, start, width, axis=kvb.POS_AXIS),
-                        self.block),
-                    ids, valid)
-                for pc, c in zip(pools, caches))
+                kvb.scatter_chunk(pc, kvb.chunk_to_blocks(w, self.block),
+                                  ids, valid)
+                for pc, w in zip(pools, window))
 
         if self._can_suffix:
-            # shapes vary per (prefix, suffix) block split — jit
-            # retraces per split, the specs are split-invariant
-            self._suffix_prefill_fn = ledger_jit(
+            self._chunk_prefill_fn = ledger_jit(
                 jax.shard_map(
-                    suffix_prefill_body, mesh=mesh,
-                    in_specs=(pspecs, pool_specs, P(), P(), P(), P()),
+                    chunk_prefill_body, mesh=mesh,
+                    in_specs=(pspecs, pool_specs, P(), P(), P(), P(),
+                              P()),
                     out_specs=pool_specs),
-                label="serve/suffix_prefill", donate_argnums=(1,))
+                label="serve/chunk_prefill", donate_argnums=(1,))
+        else:
+            # no chunk-attends-cache surface: monolithic left-aligned
+            # prefill per prompt (the pre-chunking fallback)
+            def prefill_body(params, pools, prompt, ids, valid):
+                caches = ad.make_cache(1, pq, batch_varying=False)
+                caches = ad.prefill(params, caches, prompt[None],
+                                    jnp.zeros((1,), jnp.int32))
+                return tuple(
+                    kvb.scatter_chunk(
+                        pc, kvb.chunk_to_blocks(c, self.block), ids,
+                        valid)
+                    for pc, c in zip(pools, caches))
+
+            self._prefill_fn = ledger_jit(
+                jax.shard_map(
+                    prefill_body, mesh=mesh,
+                    in_specs=(pspecs, pool_specs, P(), P(), P()),
+                    out_specs=pool_specs),
+                label="serve/prefill", donate_argnums=(1,))
 
         def fork_body(pools, src, dst):
             # copy-on-write: duplicate one physical block so a row can
             # write privately while other holders keep the original
+            # (also the sub-block fork's device copy)
             return tuple(kvb.copy_block(pc, src, dst, jnp.asarray(True))
                          for pc in pools)
 
@@ -723,58 +870,120 @@ class ServingEngine:
                 in_specs=(pool_specs, P(), P()), out_specs=pool_specs),
             label="serve/fork", donate_argnums=(0,))
 
-        def round_sampled_body(params, caches, buf, offsets, done,
-                               end_t, t0, temp, topk, topp, keys):
-            # the greedy round plus per-request keyed sampling: rows
-            # with temperature 0 take the argmax values the greedy
-            # program computes; sampled rows draw with the key folded
-            # by their OWN token index (t + 1 - offset) — schedule-
-            # independent, so a (key, params) replay pins the tokens
-            def one(carry, r):
-                caches, buf, done = carry
-                t = t0 + r
-                tok = lax.dynamic_slice(
-                    buf, (0, jnp.minimum(t, H - 1)), (S, 1))[:, 0]
-                logits, caches = ad.step(
-                    params, caches, tok, jnp.minimum(t, H - 1),
-                    offsets)
-                step_keys = fold_keys(keys, t + 1 - offsets)
-                nxt = sample_tokens(logits, step_keys, temp, topk,
-                                    topp)
-                nxt = jnp.where(done, pad if pad >= 0 else 0, nxt)
-                if eos >= 0:
-                    done = done | (nxt == eos)
-                done = done | ((t + 1) >= end_t)
-                wpos = jnp.minimum(t + 1, H - 1)
-                cur = lax.dynamic_slice(buf, (0, wpos), (S, 1))
-                val = jnp.where(t + 1 < H, nxt[:, None], cur)
-                buf = lax.dynamic_update_slice(buf, val, (0, wpos))
-                return (caches, buf, done), None
+        if self.draft_adapter is not None:
+            self._build_spec_programs(mesh, bax, row_spec, pspecs,
+                                      cspecs)
 
-            (caches, buf, done), _ = lax.scan(
-                one, (caches, buf, done), jnp.arange(R))
-            return caches, buf, done
+    def _build_spec_programs(self, mesh, bax, row_spec, pspecs,
+                             cspecs):
+        """The speculative round MODE's programs: draft-lane init and
+        prefill, plus the draft/verify round itself."""
+        ad, d_ad = self.adapter, self.draft_adapter
+        S, H, K = self._n_local, self.horizon, self.spec_k
+        eos, pq = self.eos_id, self._pq
+        d_pspecs = d_ad.param_specs()
+        d_cspecs = tuple(d_ad.cache_specs())
+        rows = jnp.arange(S)
 
-        self._round_sampled_fn = ledger_jit(
+        def draft_init_body():
+            return tuple(_vary(c, *bax) for c in d_ad.make_cache(S, H))
+
+        self._draft_init_fn = ledger_jit(jax.shard_map(
+            draft_init_body, mesh=mesh, in_specs=(),
+            out_specs=d_cspecs), label="serve/draft_init")
+
+        def draft_prefill_body(d_params, d_caches, prompt, slot):
+            # the draft model has no staging pool: its cache is
+            # per-slot only, rebuilt by one monolithic prefill of the
+            # LEFT-aligned prompt row at each admit
+            ls = slot - self._shard_base()
+            ok = (ls >= 0) & (ls < S)
+            lsc = jnp.clip(ls, 0, S - 1)
+            comps = d_ad.make_cache(1, pq, batch_varying=False)
+            comps = d_ad.prefill(d_params, comps, prompt[None],
+                                 jnp.zeros((1,), jnp.int32))
+            return tuple(
+                kvb.insert_chunk(c, nc.astype(c.dtype), lsc, 0, ok)
+                for c, nc in zip(d_caches, comps))
+
+        self._draft_prefill_fn = ledger_jit(
             jax.shard_map(
-                round_sampled_body, mesh=mesh,
-                in_specs=(pspecs, cspecs, row_spec, row_spec, row_spec,
-                          row_spec, P(), row_spec, row_spec, row_spec,
-                          row_spec),
-                out_specs=(cspecs, row_spec, row_spec)),
-            label="serve/round_sampled", donate_argnums=(1, 2))
+                draft_prefill_body, mesh=mesh,
+                in_specs=(d_pspecs, d_cspecs, P(), P()),
+                out_specs=d_cspecs),
+            label="serve/draft_prefill", donate_argnums=(1,))
 
-        def rebase_body(caches, buf, delta):
-            caches = tuple(kvb.shift_positions(c, delta) for c in caches)
-            idx = jnp.clip(jnp.arange(H) + delta, 0, H - 1)
-            return caches, jnp.take(buf, idx, axis=1)
+        def round_spec_body(params, d_params, caches, d_caches, buf,
+                            pos, done, end):
+            # one speculative round: K ragged draft steps, ONE target
+            # verify pass over each row's (K+1)-token chunk at its own
+            # start, per-row accepted-prefix commit.  Committed tokens
+            # come ONLY from the target's logits, so greedy token
+            # identity holds whatever the draft proposes; stale
+            # draft/target K/V beyond a row's commit point is
+            # rewritten by that position's next step before anything
+            # attends it (the same written-before-attended argument
+            # the ragged round rests on).
+            def draft_one(carry, _):
+                d_caches, buf, dpos = carry
+                pc = jnp.clip(dpos, 0, H - 1)
+                tok = jnp.take_along_axis(buf, pc[:, None],
+                                          axis=1)[:, 0]
+                logits, d_caches = d_ad.step_ragged(
+                    d_params, d_caches, tok, pc)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                wpos = jnp.where(done, H,
+                                 jnp.clip(dpos + 1, 0, H - 1))
+                buf = buf.at[rows, wpos].set(nxt, mode="drop")
+                dpos = jnp.where(done, dpos, dpos + 1)
+                return (d_caches, buf, dpos), None
 
-        self._rebase_fn = ledger_jit(
+            (d_caches, buf, _), _ = lax.scan(
+                draft_one, (d_caches, buf, pos), None, length=K)
+
+            j1 = jnp.arange(K + 1)
+            cpos = jnp.clip(pos[:, None] + j1[None, :], 0, H - 1)
+            chunk = jnp.take_along_axis(buf, cpos, axis=1)
+            logits, caches = ad.verify_ragged(
+                params, caches, chunk, jnp.clip(pos, 0, H - 1))
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # accepted = longest drafted prefix the target agrees
+            # with; commit that prefix plus the target's one bonus
+            # token, clipped to the row's remaining budget
+            match = jnp.cumprod(
+                (chunk[:, 1:] == g[:, :K]).astype(jnp.int32), axis=1)
+            a = jnp.sum(match, axis=1)
+            c = jnp.minimum(a + 1, jnp.maximum(end - pos, 1))
+            if eos >= 0:
+                iseos = g == eos
+                first = jnp.where(iseos.any(axis=1),
+                                  jnp.argmax(iseos, axis=1), K + 1)
+                c = jnp.minimum(c, first + 1)
+            # commit: scatter the c target tokens at pos+1..pos+c;
+            # uncommitted lanes route out of bounds (a clamped write
+            # could collide with a committed one nondeterministically)
+            wmask = (~done[:, None]) & (j1[None, :] < c[:, None])
+            wpos = jnp.where(wmask, pos[:, None] + 1 + j1[None, :], H)
+            buf = buf.at[rows[:, None], wpos].set(g, mode="drop")
+            pos2 = jnp.where(done, pos, pos + c)
+            new_done = done | (pos2 >= end)
+            if eos >= 0:
+                hit = jnp.take_along_axis(
+                    g, jnp.clip(c - 1, 0, K)[:, None], axis=1)[:, 0] \
+                    == eos
+                new_done = new_done | ((~done) & hit)
+            acc = jnp.where(done, 0, a).astype(jnp.int32)
+            com = jnp.where(done, 0, c).astype(jnp.int32)
+            return caches, d_caches, buf, pos2, new_done, acc, com
+
+        self._round_spec_fn = ledger_jit(
             jax.shard_map(
-                rebase_body, mesh=mesh,
-                in_specs=(cspecs, row_spec, P()),
-                out_specs=(cspecs, row_spec)),
-            label="serve/rebase", donate_argnums=(0, 1))
+                round_spec_body, mesh=mesh,
+                in_specs=(pspecs, d_pspecs, cspecs, d_cspecs, row_spec,
+                          row_spec, row_spec, row_spec),
+                out_specs=(cspecs, d_cspecs, row_spec, row_spec,
+                           row_spec, row_spec, row_spec)),
+            label="serve/round_spec", donate_argnums=(2, 3, 4))
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -795,10 +1004,17 @@ class ServingEngine:
                                           share=self.prefix_sharing)
         self._queue: collections.deque = collections.deque()
         self._staged = {}           # rid -> (flat (Pq,), prompt_row (Pq,))
+        self._chunking = {}         # rid -> in-flight chunk-prefill job
         self._slot_req: List[Optional[Request]] = [None] * self.n_slots
-        self._offsets = np.full((self.n_slots,), self.horizon, np.int32)
+        # per-row ragged clocks, origin-0 lanes: token i at position i.
+        # _pos = the row's CURRENT position (its token there is the
+        # next step's input), _plen = prompt length, _end = the last
+        # position the row may reach (_plen - 1 + max_new <= H - 1 by
+        # submit validation).  Empty slots: pos 0, done.
+        self._pos = np.zeros((self.n_slots,), np.int32)
+        self._plen = np.zeros((self.n_slots,), np.int32)
+        self._end = np.zeros((self.n_slots,), np.int32)
         self._done = np.ones((self.n_slots,), bool)
-        self._end_t = np.zeros((self.n_slots,), np.int32)
         # per-slot sampling state (zeros = greedy row); the sampled
         # round program runs only while a sampled row is live
         self._s_temp = np.zeros((self.n_slots,), np.float32)
@@ -808,7 +1024,8 @@ class ServingEngine:
         self._n_sampled_active = 0
         self._slot_status: List[str] = ["ok"] * self.n_slots
         self._slot_detail: List[str] = [""] * self.n_slots
-        self._clock = self._pq - 1
+        if self.draft_adapter is not None:
+            self._draft_caches = self._draft_init_fn()
         self._pending_first: set = set()
         self._pending_shed: List[ShedCompletion] = []
         self._tenant_tokens: collections.Counter = collections.Counter()
@@ -817,8 +1034,11 @@ class ServingEngine:
         self.admit_log: List[str] = []
         self._records: collections.deque = collections.deque(
             maxlen=self.record_history)
-        self.n_rebases = 0
         self.n_rounds = 0
+        self._round_capacity = 0        # token-slots offered by rounds
+        self.spec_drafted = 0           # draft tokens proposed (spec mode)
+        self.spec_accepted = 0          # draft tokens the target accepted
+        self.n_chunk_prefills = 0       # prompt chunks staged into rounds
         self.useful_tokens = 0
         self.wasted_tokens = 0          # partial tokens of non-ok rows
         self.prefill_seconds = 0.0      # staging wall time (bench lever)
@@ -835,13 +1055,34 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
 
     def warm(self) -> None:
-        """Compile the rebase program ahead of serving (a zero shift is
-        the identity).  The other programs compile on their first
-        natural use; rebase fires only when the horizon binds, which
-        can land its compile inside a latency-sensitive window —
-        benches and latency-bound deployments call this once."""
-        self._caches, self._buf = self._rebase_fn(
-            self._caches, self._buf, np.int32(0))
+        """Compile the staging programs ahead of serving: dispatch the
+        chunk-prefill program (or the monolithic fallback) once with
+        an all-invalid scatter — every block write is dropped, so the
+        pool content round-trips unchanged — and, when a draft model
+        is attached, the draft-prefill program at an out-of-range
+        slot.  The round programs compile on their first natural use;
+        staging is the one program whose first compile would otherwise
+        land inside a latency-sensitive admit window.  (The rebase
+        prewarm this replaces is gone with the rebase program itself:
+        ragged rows never share a horizon, so nothing ever shifts.)"""
+        row = np.zeros((self._pq,), np.int32)
+        if self._can_suffix:
+            nw = self._chunk_tokens // self.block + 1
+            self._pools = self._chunk_prefill_fn(
+                self._params, self._pools,
+                np.zeros((self._pq + self._chunk_tokens,), np.int32),
+                np.zeros((self._chunk_tokens,), np.int32),
+                np.int32(0), np.full((nw,), -1, np.int32),
+                np.zeros((nw,), bool))
+        else:
+            self._pools = self._prefill_fn(
+                self._params, self._pools, row,
+                np.full((self._w,), -1, np.int32),
+                np.zeros((self._w,), bool))
+        if self.draft_adapter is not None:
+            self._draft_caches = self._draft_prefill_fn(
+                self._draft_params, self._draft_caches, row,
+                np.int32(-1))
 
     def mark_steady(self) -> None:
         """Declare this engine's programs steady-state in the program
@@ -1003,7 +1244,8 @@ class ServingEngine:
                        f"{int(epoch)}")
         if self.admission is not None:
             admit, reason, victim = self.admission.check_submit(
-                req, list(self._queue), self._tenant_tokens)
+                req, list(self._queue), self._tenant_tokens,
+                n_slots=self.n_slots)
             if victim is not None:
                 # a lower-priority queued request makes room; its shed
                 # record flows out of the next step()
@@ -1098,46 +1340,74 @@ class ServingEngine:
         if self._pending_shed:          # queue sheds from this tick
             out.extend(self._pending_shed)
             self._pending_shed.clear()
-        live = any(self._slot_req[s] is not None and not self._done[s]
-                   for s in range(self.n_slots))
-        if live:
+        n_live = sum(1 for s in range(self.n_slots)
+                     if self._slot_req[s] is not None
+                     and not self._done[s])
+        if n_live:
             rt0 = time.perf_counter()
+            spec = (self.draft_adapter is not None
+                    and not self._n_sampled_active)
+            cap = (self.spec_k + 1) if spec else self.round_tokens
             try:
                 with rec.span("serve/decode_round", cat="serve",
-                              step=int(self._clock),
-                              tokens=self.round_tokens,
-                              active=self.n_active):
+                              step=int(self.n_rounds),
+                              tokens=cap, active=self.n_active):
                     if self._n_sampled_active:
                         # keyed-sampling round; greedy rows inside it
                         # still take the argmax values.  The sampling
                         # arrays are rewritten per admission, so the
                         # jitted call gets copies (the staging-buffer
                         # aliasing discipline)
-                        self._caches, self._buf, done_dev = \
+                        self._caches, self._buf, pos_dev, done_dev = \
                             self._round_sampled_fn(
                                 self._params, self._caches, self._buf,
-                                self._offsets, self._done,
-                                self._end_t, np.int32(self._clock),
+                                self._staging_copy(self._pos),
+                                self._staging_copy(self._done),
+                                self._staging_copy(self._end),
                                 self._staging_copy(self._s_temp),
                                 self._staging_copy(self._s_topk),
                                 self._staging_copy(self._s_topp),
                                 self._staging_copy(self._s_keys))
+                    elif spec:
+                        # speculative round MODE: per-row draft/verify
+                        # with ragged accepted-token counts.  Sampled
+                        # rows force the per-token fallback above —
+                        # spec acceptance is defined against the
+                        # target's argmax
+                        (self._caches, self._draft_caches, self._buf,
+                         pos_dev, done_dev, acc_dev, com_dev) = \
+                            self._round_spec_fn(
+                                self._params, self._draft_params,
+                                self._caches, self._draft_caches,
+                                self._buf,
+                                self._staging_copy(self._pos),
+                                self._staging_copy(self._done),
+                                self._staging_copy(self._end))
+                        drafted = self.spec_k * n_live
+                        accepted = int(np.sum(np.array(acc_dev)))
+                        self.spec_drafted += drafted
+                        self.spec_accepted += accepted
+                        reg0 = get_registry()
+                        reg0.inc("serve/spec_drafted", drafted)
+                        reg0.inc("serve/spec_accepted", accepted)
                     else:
-                        # all-greedy: the ORIGINAL compiled program,
-                        # byte-identical to the pre-sampling engine
-                        self._caches, self._buf, done_dev = \
+                        # all-greedy per-token rounds
+                        self._caches, self._buf, pos_dev, done_dev = \
                             self._round_fn(
                                 self._params, self._caches, self._buf,
-                                self._offsets, self._done,
-                                self._end_t, np.int32(self._clock))
-                    # np.array, not asarray: the host mirror is mutated
-                    # by admissions, and jax arrays view out read-only
+                                self._staging_copy(self._pos),
+                                self._staging_copy(self._done),
+                                self._staging_copy(self._end))
+                    # np.array, not asarray: the host mirrors are
+                    # mutated by admissions, and jax arrays view out
+                    # read-only
+                    self._pos = np.array(pos_dev)
                     self._done = np.array(done_dev)  # the round's sync
             except Exception as err:        # noqa: BLE001 — harden
                 self._on_round_failure(err, rec)
             else:
-                self._clock += self.round_tokens
                 self.n_rounds += 1
+                self._round_capacity += cap * self.n_slots
                 now = time.perf_counter()
                 if self.traces is not None:
                     # per-round spans are SAMPLED into request
@@ -1154,7 +1424,7 @@ class ServingEngine:
                             self._rspan(r, "decode_round", rt0,
                                         now - rt0,
                                         round=self.n_rounds,
-                                        tokens=self.round_tokens)
+                                        tokens=cap)
                 reg = get_registry()
                 for s in self._pending_first:
                     req = self._slot_req[s]
@@ -1166,6 +1436,12 @@ class ServingEngine:
                     if self.admission is not None:
                         self.admission.predictor.observe_ttft(
                             now - req.t_submit)
+                        if req.t_admit is not None:
+                            # queue-free service TTFT: admit -> first
+                            # token, the predictor's service-side
+                            # evidence (wait is predicted separately)
+                            self.admission.predictor \
+                                .observe_service_ttft(now - req.t_admit)
                 self._pending_first.clear()
         rec.counter("serve/active_slots", self.n_active, cat="serve")
         return out
@@ -1179,7 +1455,10 @@ class ServingEngine:
         drains the batch one quarantine per step — degraded, never
         hung.  If the failure consumed the round's donated buffers the
         device state is unrecoverable and the error propagates."""
-        for leaf in jax.tree.leaves((self._caches, self._buf)):
+        state = (self._caches, self._buf)
+        if self.draft_adapter is not None:
+            state = state + (self._draft_caches,)
+        for leaf in jax.tree.leaves(state):
             if getattr(leaf, "is_deleted", lambda: False)():
                 raise RuntimeError(
                     "decode round failed after its donated buffers "
@@ -1282,6 +1561,7 @@ class ServingEngine:
         reqs = list(self._queue)
         for r in reqs:
             self._staged.pop(r.rid, None)
+            self._chunking.pop(r.rid, None)
             self._alloc.free_row(r.rid)
             self._release_tokens(r)
         self._queue.clear()
@@ -1315,10 +1595,12 @@ class ServingEngine:
         get_registry().set("serve/queue_depth", len(self._queue))
 
     def stats(self) -> dict:
-        issued = self.n_rounds * self.round_tokens * self.n_slots
+        issued = self._round_capacity
         out = {
             "rounds": self.n_rounds,
-            "rebases": self.n_rebases,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "chunk_prefills": self.n_chunk_prefills,
             "useful_tokens": self.useful_tokens,
             "wasted_tokens": self.wasted_tokens,
             "slot_utilization": (self.useful_tokens / issued
@@ -1350,7 +1632,7 @@ class ServingEngine:
     def metrics_snapshot(self) -> dict:
         """The ``serve/*`` slice of the global metrics registry —
         per-request queue-wait/TTFT/TPOT/e2e histograms plus
-        submit/admit/evict/rebase counters recorded at the points that
+        submit/admit/evict counters recorded at the points that
         hold the timestamps.  Empty when the registry is disabled
         (``CHAINERMN_TPU_METRICS=1`` or
         ``utils.metrics.get_registry().enable()`` turn it on);
@@ -1418,18 +1700,19 @@ class ServingEngine:
             with rec.span("serve/evict", cat="serve", rid=req.rid,
                           slot=s, status=status):
                 row = np.asarray(self._buf[s])
-                first = int(self._offsets[s] + req.prompt.shape[0] - 1)
-                # a mid-stream eviction (timeout/cancel/quarantine)
-                # has only decoded up to the clock, not to its budget
-                end = min(int(self._end_t[s]), self._clock)
-                gen = row[first + 1: end + 1]
+                # origin-0 lane: generated tokens live at positions
+                # [plen, pos]; a mid-stream eviction (timeout/cancel/
+                # quarantine) has only decoded up to the row's OWN
+                # position, which is all the clock there is
+                gen = row[int(self._plen[s]): int(self._pos[s]) + 1]
                 if self.eos_id >= 0:
                     hits = np.nonzero(gen == self.eos_id)[0]
                     if hits.size:
                         gen = gen[:int(hits[0]) + 1]
                 self._slot_req[s] = None
-                self._offsets[s] = self.horizon     # mask-all sentinel
-                self._end_t[s] = 0
+                self._pos[s] = 0
+                self._plen[s] = 0
+                self._end[s] = 0
                 if req.sampling is not None:
                     self._s_temp[s] = 0.0
                     self._s_topk[s] = 0
@@ -1500,7 +1783,8 @@ class ServingEngine:
         backlog = sum(r.max_new for r in self._queue)
         for s in range(self.n_slots):
             if self._slot_req[s] is not None and not self._done[s]:
-                backlog += max(int(self._end_t[s]) - self._clock, 0)
+                backlog += max(int(self._end[s]) - int(self._pos[s]),
+                               0)
         return backlog
 
     def _retry_after(self) -> Optional[float]:
@@ -1571,6 +1855,7 @@ class ServingEngine:
                          detail: str = "") -> ShedCompletion:
         self._queue.remove(req)
         self._staged.pop(req.rid, None)
+        self._chunking.pop(req.rid, None)
         self._alloc.free_row(req.rid)
         shed = self._finish_shed(
             req, reason, detail,
@@ -1613,19 +1898,34 @@ class ServingEngine:
             # queue holds (deadlines above still enforced) until
             # complete_drain() re-opens under the new epoch
             return
+        # idle is judged ONCE, at phase start: rows admitted later in
+        # this same phase have not decoded yet, so synchronous staging
+        # while idle delays nothing — and keeps gang batches forming
+        # whole and cold-start admission in strict policy order
+        idle = not any(self._slot_req[s] is not None
+                       and not self._done[s]
+                       for s in range(self.n_slots))
+        # advance in-flight chunked stagings FIRST, in queue order:
+        # one chunk each per round while decode rows are live (the
+        # long prompt pays its own staging across rounds), straight
+        # to completion when the device would otherwise sit idle
+        self._advance_chunks(rec, all_chunks=idle)
         free = [s for s in range(self.n_slots)
                 if self._slot_req[s] is None]
         if self.gang and len(free) < self.n_slots:
             free = []                   # static batching: whole gang only
+        skip: set = set()
         while free and self._queue:
-            req = self._pick()
-            a = self._clock
-            if a + req.max_new > self.horizon - 1:
-                if not self._maybe_rebase(req.max_new, rec):
-                    break               # horizon full until rows retire
-                a = self._clock
+            cands = [r for r in self._queue if r.rid not in skip]
+            if not cands:
+                break
+            req = self._policy(cands, self)
+            if req not in self._queue:
+                raise ValueError(
+                    "policy returned a request not in the queue: "
+                    f"{req!r}")
             try:
-                staged = self._ensure_staged(req, rec)
+                staged = self._ensure_staged(req, rec, idle=idle)
             except Exception as err:    # noqa: BLE001 — harden
                 # prefill failed for THIS request: quarantine it and
                 # keep admitting others — one poison prompt must not
@@ -1635,20 +1935,31 @@ class ServingEngine:
                     req, "quarantined",
                     detail=f"stage: {type(err).__name__}: {err}")
                 continue
-            if not staged:
+            if staged == "pool_full":
                 break                   # pool full until slots drain
+            if staged == "chunking":
+                # mid-chunking: later-queued requests must not wait
+                # behind its remaining chunks (TTFT independence) —
+                # skip it and keep admitting
+                skip.add(req.rid)
+                continue
             slot = free.pop(0)
             self._queue.remove(req)
-            dst0 = a + 1 - self._pq
-            assert dst0 >= 0, (a, self._pq)   # clock >= Pq-1 invariant
             at0 = time.perf_counter()
             try:
                 with rec.span("serve/admit", cat="serve", rid=req.rid,
-                              slot=slot, step=int(a)):
+                              slot=slot):
                     flat, prompt_row = self._staged.pop(req.rid)
                     self._caches, self._buf = self._admit_fn(
                         self._caches, self._buf, self._pools, flat,
-                        prompt_row, np.int32(slot), np.int32(dst0))
+                        prompt_row, np.int32(slot))
+                    if self.draft_adapter is not None:
+                        # rebuild the slot's draft lane from the
+                        # left-aligned prompt row (the draft model has
+                        # no staging pool)
+                        self._draft_caches = self._draft_prefill_fn(
+                            self._draft_params, self._draft_caches,
+                            prompt_row, np.int32(slot))
                     # refcount-aware: the row lets go, but blocks the
                     # trie (or other rows) hold stay resident — that
                     # retention IS the prefix cache
@@ -1661,9 +1972,12 @@ class ServingEngine:
                     detail=f"admit: {type(err).__name__}: {err}"))
                 free.insert(0, slot)    # the slot was never filled
                 continue
-            p = req.prompt.shape[0]
-            self._offsets[slot] = a + 1 - p
-            self._end_t[slot] = a + req.max_new
+            p = int(req.prompt.shape[0])
+            self._pos[slot] = p - 1
+            self._plen[slot] = p
+            # p - 1 + max_new <= Pq - 1 + max_new <= H - 1 by submit
+            # validation: a row's end never needs a shared horizon
+            self._end[slot] = p - 1 + req.max_new
             self._done[slot] = False
             self._slot_req[slot] = req
             if req.sampling is not None:
@@ -1698,10 +2012,12 @@ class ServingEngine:
             for req in list(self._queue):
                 if budget <= 0:
                     break
-                if req.rid in self._staged:
+                if req.rid in self._staged \
+                        or req.rid in self._chunking:
                     continue
                 try:
-                    if not self._stage_traced(req, rec, steal=False):
+                    if self._stage_traced(req, rec, steal=False,
+                                          idle=idle) == "pool_full":
                         break
                 except Exception as err:    # noqa: BLE001 — harden
                     self._check_state_alive(err)
@@ -1734,118 +2050,244 @@ class ServingEngine:
         copy (see ``iterators.prefetch.put_window``)."""
         return np.array(buf)
 
-    def _stage(self, req: Request, rec, steal: bool) -> bool:
-        """Prefill ``req``'s prompt into pool blocks — or, with prefix
-        sharing, REFERENCE the cached leading full blocks and prefill
-        only the divergent suffix (the first divergent write forks
-        onto fresh blocks; the shared prefix is never written).
-        ``steal`` frees queue-tail stagings to make room (used on the
-        admission path, where the request must land NOW; prefill-ahead
-        never steals).  Staging is LEFT-aligned — token ``i`` in block
-        ``i // block`` — which is what makes block content addressable
-        by token prefix; the admit gather restores the lane's
-        right-aligned layout."""
+    def _stage(self, req: Request, rec, steal: bool,
+               idle: bool = True) -> str:
+        """Begin (and possibly finish) staging ``req``'s prompt into
+        pool blocks.  With prefix sharing the cached leading full
+        blocks are REFERENCED, a mid-block divergence forks the
+        matching sub-block prefix onto a fresh block with a device
+        copy (``copy_block`` — no recompute), and only tokens from the
+        divergence point on are prefilled.  Prefill runs in
+        fixed-shape CHUNKS of ``prefill_chunk`` blocks through the
+        adapter's verify surface: with live decode rows the remaining
+        chunks interleave one per round (``_advance_chunks``) so a
+        long prompt never stalls co-scheduled requests; with the
+        device otherwise idle every chunk runs now.  ``steal`` frees
+        queue-tail stagings to make room (admission path only;
+        prefill-ahead never steals).  Staging is LEFT-aligned — token
+        ``i`` in block ``i // block`` — which is both what makes block
+        content addressable by token prefix AND the lane layout
+        origin-0 rows decode from: admission is a straight gather.
+
+        Returns ``"ready"`` (staged, admission can gather),
+        ``"chunking"`` (chunks still in flight), or ``"pool_full"``."""
         P_len = int(req.prompt.shape[0])
         n_real = kvb.blocks_needed(P_len, self.block)
         plan = self._alloc.stage(req.rid, req.prompt)
         while plan is None and steal:
             victims = [r for r in reversed(list(self._queue))
-                       if r.rid in self._staged and r is not req]
+                       if (r.rid in self._staged
+                           or r.rid in self._chunking)
+                       and r is not req]
             if not victims:
-                return False
+                return "pool_full"
             victim = victims[0]
             self._alloc.free_row(victim.rid)
-            del self._staged[victim.rid]
+            self._staged.pop(victim.rid, None)
+            self._chunking.pop(victim.rid, None)
             plan = self._alloc.stage(req.rid, req.prompt)
         if plan is None:
-            return False
+            return "pool_full"
         reg = get_registry()
         pt0 = time.perf_counter()
         with rec.span("serve/prefill", cat="serve", rid=req.rid,
                       blocks=plan.n_new, shared=plan.n_shared):
-            st = self._prompt_staging
+            st = self._lprompt_staging
             st[:] = max(self.pad_id, 0)
-            st[self._pq - P_len:] = req.prompt
+            st[:P_len] = req.prompt
             prompt_row = self._staging_copy(st)
-            if plan.n_new and (plan.n_shared == 0
-                               or not self._can_suffix):
-                # cold path (or no chunk-verify surface): prefill the
-                # whole left-aligned chunk, scatter only this row's
-                # fresh blocks (never a shared one — the refcount
-                # contract the fork primitive enforces elsewhere)
-                lst = self._lprompt_staging
-                lst[:] = max(self.pad_id, 0)
-                lst[:P_len] = req.prompt
-                lrow = self._staging_copy(lst)
+            if plan.copy_src is not None:
+                # sub-block fork-with-copy: the row diverges MID-block
+                # from a cached child — device-copy the whole cached
+                # block onto this row's first fresh block and resume
+                # prefill at the divergence point, instead of
+                # recomputing the matched sub-block prefix
+                ft0 = time.perf_counter()
+                with rec.span("serve/fork", cat="serve", rid=req.rid,
+                              src=int(plan.copy_src),
+                              copied=plan.n_copied):
+                    self._pools = self._fork_fn(
+                        self._pools, np.int32(plan.copy_src),
+                        np.int32(plan.table[plan.n_shared]))
+                # the transient ref stage() took on the source block
+                # (so the steal loop above could not reclaim it before
+                # the copy) is released only now
+                self._alloc.copy_done(plan.copy_src)
+                reg.inc("serve/prefix_forks")
+                self._rspan(req, "fork", ft0,
+                            time.perf_counter() - ft0,
+                            copied=plan.n_copied)
+            if plan.n_new and not self._can_suffix:
+                # no chunk-attends-cache surface: monolithic prefill
+                # of the whole left-aligned row, scatter only this
+                # row's fresh blocks (never a shared one)
                 ids_np = self._ids_staging
                 ids_np[:] = -1
                 ids_np[plan.n_shared:n_real] = \
                     plan.table[plan.n_shared:]
                 ids_row = self._staging_copy(ids_np)
                 self._pools = self._prefill_fn(
-                    self._params, self._pools, lrow, np.int32(0),
+                    self._params, self._pools, prompt_row,
                     ids_row, ids_row >= 0)
             elif plan.n_new:
-                # copy-on-write fork: the row leaves the shared chain
-                # at token n_shared*block; only the suffix computes
-                start = plan.n_shared * self.block
-                width = n_real * self.block - start
-                ft0 = time.perf_counter()
-                with rec.span("serve/fork", cat="serve", rid=req.rid,
-                              shared=plan.n_shared, new=plan.n_new):
-                    pf = np.empty((start,), np.int32)
-                    intra = np.arange(self.block, dtype=np.int32)
-                    for j in range(plan.n_shared):
-                        pf[j * self.block:(j + 1) * self.block] = \
-                            plan.table[j] * self.block + intra
-                    toks = np.full((width,), max(self.pad_id, 0),
-                                   np.int32)
-                    toks[:P_len - start] = req.prompt[start:]
-                    sids = np.asarray(plan.table[plan.n_shared:],
-                                      np.int32)
-                    self._pools = self._suffix_prefill_fn(
-                        self._params, self._pools,
-                        self._staging_copy(pf),
-                        self._staging_copy(toks), sids, sids >= 0)
-                self._rspan(req, "fork", ft0,
-                            time.perf_counter() - ft0,
-                            shared=plan.n_shared, new=plan.n_new)
+                start = plan.n_shared * self.block + plan.n_copied
+                if start < P_len:
+                    job = self._build_chunk_job(req, plan, P_len,
+                                                n_real, start,
+                                                prompt_row)
+                    self._chunking[req.rid] = job
             # plan.n_new == 0: the whole prompt is cached full blocks —
             # no prefill compute at all, admission is just the gather
-            if self.prefix_sharing:
-                self._alloc.insert_cached(req.rid, req.prompt)
-            flat = self._alloc.flat_gather_index(req.rid, self._pq,
-                                                P_len)
-            self._staged[req.rid] = (flat, prompt_row)
         dur = time.perf_counter() - pt0
         self.prefill_seconds += dur
-        self.peak_staged = max(self.peak_staged, len(self._staged))
-        if plan.n_shared:
+        if plan.n_shared or plan.n_copied:
             reg.inc("serve/prefix_hits", plan.n_shared)
             reg.set("serve/prefix_blocks_shared",
                     self._alloc.n_shared_blocks)
         self._rspan(req, "prefill", pt0, dur, blocks=plan.n_new,
                     shared=plan.n_shared)
-        return True
+        if req.rid in self._chunking:
+            # a fresh job runs its first chunk NOW (it owes this
+            # round's chunk budget), and every remaining chunk too
+            # when the device was idle at phase start — a solo submit
+            # still stages fully, and therefore admits and decodes,
+            # in its first step
+            self._run_job(self._chunking[req.rid], rec,
+                          all_chunks=idle)
+            if req.rid in self._chunking:
+                return "chunking"
+            return "ready"
+        self._finalize_stage(req, P_len, prompt_row)
+        return "ready"
 
-    def _stage_traced(self, req: Request, rec, steal: bool) -> bool:
+    def _build_chunk_job(self, req: Request, plan, P_len: int,
+                         n_real: int, start: int,
+                         prompt_row: np.ndarray) -> dict:
+        """Precompute one prompt's chunk-prefill schedule: the (M,)
+        flat gather index over its staged blocks, and per chunk the
+        start position, padded token slice, and scatter ids for the
+        ``C + block``-wide window the fixed-shape program writes back.
+        Because the chunk width is a block multiple, every chunk of a
+        job keeps the same sub-block offset — one compile serves every
+        chunk of every (prefix, suffix) split."""
+        C, blk = self._chunk_tokens, self.block
+        fm = np.full((self._pq + C,), -1, np.int32)
+        intra = np.arange(blk, dtype=np.int32)
+        for j in range(n_real):
+            w = min(blk, P_len - j * blk)
+            fm[j * blk:j * blk + w] = plan.table[j] * blk + intra[:w]
+        nw = C // blk + 1
+        starts, toks, ids = [], [], []
+        t = start
+        while t < P_len:
+            starts.append(t)
+            tk = np.full((C,), max(self.pad_id, 0), np.int32)
+            w = min(C, P_len - t)
+            tk[:w] = req.prompt[t:t + w]
+            toks.append(tk)
+            idr = np.full((nw,), -1, np.int32)
+            wb0 = t // blk
+            for j in range(nw):
+                wb = wb0 + j
+                if wb < plan.n_shared or wb >= n_real:
+                    continue            # shared or beyond the prompt
+                if wb * blk >= t + C:
+                    continue            # unwritten trailing window
+                idr[j] = plan.table[wb]
+            ids.append(idr)
+            t += C
+        return {"req": req, "fm": fm, "starts": starts, "toks": toks,
+                "ids": ids, "next": 0, "p_len": P_len,
+                "prompt_row": prompt_row}
+
+    def _run_job(self, job: dict, rec, all_chunks: bool) -> None:
+        """Dispatch the job's next chunk (or every remaining chunk)
+        through the fixed-shape chunk-prefill program; finalize the
+        staging when the last chunk lands.  Compiles caused by this
+        request carry its trace id as the ledger exemplar."""
+        req = job["req"]
+        n = len(job["starts"]) - job["next"] if all_chunks else 1
+        led = get_ledger()
+        prev = led.exemplar
+        led.exemplar = req.trace_id
+        pt0 = time.perf_counter()
+        try:
+            for _ in range(n):
+                k = job["next"]
+                t = job["starts"][k]
+                idr = job["ids"][k]
+                with rec.span("serve/chunk_prefill", cat="serve",
+                              rid=req.rid, start=int(t), chunk=k,
+                              of=len(job["starts"])):
+                    self._pools = self._chunk_prefill_fn(
+                        self._params, self._pools,
+                        self._staging_copy(job["fm"]),
+                        self._staging_copy(job["toks"][k]),
+                        np.int32(t), self._staging_copy(idr),
+                        idr >= 0)
+                job["next"] += 1
+        finally:
+            led.exemplar = prev
+        dur = time.perf_counter() - pt0
+        self.prefill_seconds += dur
+        self._rspan(req, "chunk_prefill", pt0, dur, chunks=n)
+        self.n_chunk_prefills += n
+        get_registry().inc("serve/chunk_prefills", n)
+        if job["next"] == len(job["starts"]):
+            self._chunking.pop(req.rid, None)
+            self._finalize_stage(req, job["p_len"],
+                                 job["prompt_row"])
+
+    def _advance_chunks(self, rec, all_chunks: bool) -> None:
+        """Advance every in-flight chunk job (queue order).  A failed
+        chunk quarantines ITS request only; the others keep going."""
+        if not self._chunking:
+            return
+        for rid in [r.rid for r in self._queue
+                    if r.rid in self._chunking]:
+            job = self._chunking[rid]
+            try:
+                self._run_job(job, rec, all_chunks)
+            except Exception as err:    # noqa: BLE001 — harden
+                self._check_state_alive(err)
+                self._shed_from_queue(
+                    job["req"], "quarantined",
+                    detail=f"stage: {type(err).__name__}: {err}")
+
+    def _finalize_stage(self, req: Request, P_len: int,
+                        prompt_row: np.ndarray) -> None:
+        """The staged row is complete: publish it to the prefix cache
+        and record the admission gather index."""
+        if self.prefix_sharing:
+            self._alloc.insert_cached(req.rid, req.prompt)
+        flat = self._alloc.flat_gather_index(req.rid, self._pq, P_len,
+                                             align="left")
+        self._staged[req.rid] = (flat, prompt_row)
+        self.peak_staged = max(self.peak_staged, len(self._staged))
+
+    def _stage_traced(self, req: Request, rec, steal: bool,
+                      idle: bool = True) -> str:
         """:meth:`_stage` with the request's trace id as the program
-        ledger's exemplar: a compile caused by THIS request's shapes
-        (the per-(prefix,suffix)-split ``serve/suffix_prefill``
-        retrace) links its ``compile/seconds`` exemplar straight to
-        the request's retained timeline — the same trace-id hop the
-        latency exemplars ride."""
+        ledger's exemplar: a compile caused by THIS request (the
+        ``serve/chunk_prefill`` program's one compile, on whichever
+        request reaches it first cold) links its ``compile/seconds``
+        exemplar straight to the request's retained timeline — the
+        same trace-id hop the latency exemplars ride."""
         led = get_ledger()
         prev = led.exemplar
         led.exemplar = req.trace_id
         try:
-            return self._stage(req, rec, steal=steal)
+            return self._stage(req, rec, steal=steal, idle=idle)
         finally:
             led.exemplar = prev
 
-    def _ensure_staged(self, req: Request, rec) -> bool:
-        return req.rid in self._staged or self._stage_traced(
-            req, rec, steal=True)
+    def _ensure_staged(self, req: Request, rec,
+                       idle: bool = True) -> str:
+        if req.rid in self._staged:
+            return "ready"
+        if req.rid in self._chunking:
+            return "chunking"
+        return self._stage_traced(req, rec, steal=True, idle=idle)
 
     def fork_block(self, row_id, idx: int) -> int:
         """Copy-on-write fork of a STAGED row's ``idx``-th block: if
@@ -1869,43 +2311,19 @@ class ServingEngine:
                        None)
             if req is not None:
                 flat = self._alloc.flat_gather_index(
-                    row_id, self._pq, req.prompt.shape[0])
+                    row_id, self._pq, req.prompt.shape[0],
+                    align="left")
                 self._staged[row_id] = (flat, self._staged[row_id][1])
+        if row_id in self._chunking:
+            # an in-flight chunk job gathers through its own flat map:
+            # repoint the forked block's positions there too
+            job = self._chunking[row_id]
+            blk = self.block
+            w = min(blk, job["p_len"] - idx * blk)
+            job["fm"][idx * blk:idx * blk + w] = \
+                new * blk + np.arange(w, dtype=np.int32)
+            for k in range(len(job["ids"])):
+                m = job["ids"][k] == src
+                job["ids"][k][m] = new
         get_registry().inc("serve/prefix_forks")
         return new
-
-    def _maybe_rebase(self, needed_new: int, rec) -> bool:
-        """Shift every lane down by a block-aligned delta so an
-        admission at the current clock can fit ``needed_new`` more
-        positions; True if it now fits."""
-        active = [s for s in range(self.n_slots)
-                  if self._slot_req[s] is not None]
-        if not active:
-            # nothing live: the device content is all retired garbage —
-            # reset the clock outright, no shift needed
-            self._clock = self._pq - 1
-            return self._clock + needed_new <= self.horizon - 1
-        # the shift may neither strand a live position (<= min offset)
-        # nor pull the clock under Pq-1 (admissions insert a full Pq
-        # chunk at clock+1-Pq, which must stay >= 0)
-        delta = (min(int(min(self._offsets[s] for s in active)),
-                     self._clock - (self._pq - 1))
-                 // self.block) * self.block
-        if delta > 0:
-            bt0 = time.perf_counter()
-            with rec.span("serve/rebase", cat="serve", delta=delta,
-                          step=int(self._clock)):
-                self._caches, self._buf = self._rebase_fn(
-                    self._caches, self._buf, np.int32(delta))
-            if self.traces is not None:
-                bdur = time.perf_counter() - bt0
-                for s in active:
-                    self._rspan(self._slot_req[s], "rebase", bt0, bdur,
-                                delta=delta)
-            for s in active:
-                self._offsets[s] -= delta
-                self._end_t[s] -= delta
-            self._clock -= delta
-            self.n_rebases += 1
-            get_registry().inc("serve/rebases")
-        return self._clock + needed_new <= self.horizon - 1

@@ -18,12 +18,15 @@ supplies the paging layer that reconciles the two:
   :func:`scatter_chunk`, :func:`gather_blocks`, :func:`insert_chunk`,
   :func:`shift_positions`) — pure ``jnp`` functions over cache
   COMPONENT arrays, composable inside any ``shard_map`` body.  The
-  engine strings them into three jitted programs: prefill→pool
-  (scatter), pool→slot copy-on-admit (gather + contiguous insert —
-  the defrag step that lets the decode program keep reading a dense
-  per-slot layout), and the horizon rebase (shift every lane down by
-  a block-aligned delta so the global position clock never exhausts
-  the static buffer).
+  engine strings them into its jitted programs: chunked prefill→pool
+  (gather + scatter per chunk), pool→slot copy-on-admit (gather +
+  contiguous insert — the defrag step that lets the decode program
+  keep reading a dense per-slot layout), and the copy-on-write block
+  fork (:func:`copy_block`).  Rows decode origin-0 against their own
+  per-row position clocks, so a lane never shifts; a row's positions
+  simply end at ``prompt + max_new - 1 <= horizon - 1``
+  (:func:`shift_positions` remains for callers that relocate lane
+  content wholesale).
 
 Layout convention (shared with ``_make_cache``): every cache component
 carries its ROWS on axis 1 and its POSITIONS on axis 2; leading axis 0
@@ -36,9 +39,8 @@ the gather/scatter pair as the portable collective-free lowering.
 
 Trade-off, stated plainly: true paged ATTENTION (vLLM-style) indexes
 the block table inside the kernel and never copies; this layer instead
-pays one O(prompt) copy per admission (and one O(cache) shift per
-rebase) so the hot per-token step stays byte-for-byte the program
-``_make_cache`` already compiles.  On a step that reads the whole
+pays one O(prompt) copy per admission so the hot per-token step stays
+byte-for-byte the program ``_make_cache`` already compiles.  On a step that reads the whole
 cache every token anyway, the admission copy is noise; what paging
 buys here is the ragged-length pool accounting and the static-shape
 guarantee.
@@ -260,13 +262,13 @@ def insert_chunk(cache_comp, chunk_comp, row, dst, ok):
 
 
 def shift_positions(comp, delta):
-    """Rebase: shift a component's position axis down by ``delta``
+    """Shift a component's position axis down by ``delta``
     (``new[..., p, ...] = old[..., p + delta, ...]``, tail clamped to
-    the last position).  The engine only calls this with block-aligned
-    deltas no larger than the smallest live offset, so every live
-    position survives and the clamped tail holds only positions the
-    advancing clock has yet to rewrite (never inside any row's
-    attention window)."""
+    the last position).  Historically the horizon-rebase primitive;
+    the ragged engine's origin-0 per-row clocks never shift a lane,
+    but the op stays exported for callers that relocate lane content
+    wholesale (a caller must keep the clamped tail outside every
+    attention window until rewritten)."""
     import jax.numpy as jnp
 
     h = comp.shape[POS_AXIS]

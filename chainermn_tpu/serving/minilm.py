@@ -171,6 +171,90 @@ class MiniLMAdapter:
         logits = _rms(h, params["ln_f"]) @ params["embed"].T
         return logits.astype(jnp.float32), (ck, cv)
 
+    def step_ragged(self, params, caches, tok, t):
+        """One token for every row at PER-ROW positions: ``tok`` (B,)
+        int32, ``t`` (B,) int32 — row ``b``'s token sits at cache
+        position ``t[b]``.  Rows are origin-0 (ragged-round engine
+        contract: token ``i`` lives at lane position ``i``), so the
+        attention window is simply ``kpos <= t[b]`` and the learned
+        position IS ``t[b]``.  Returns ``(logits (B, V) fp32, caches)``.
+
+        The K/V write is a per-row scatter (rows advance raggedly, so
+        no single ``dynamic_update_slice`` start exists); out-of-range
+        positions drop, and a re-step of an already-written position
+        overwrites it with identical values — the property the engine's
+        frozen/done rows rely on."""
+        cfg = self.cfg
+        ck, cv = caches
+        B = tok.shape[0]
+        T = ck.shape[POS_AXIS]
+        rows = jnp.arange(B)
+        h = jnp.take(params["embed"], tok, axis=0) \
+            + self._positions(params, t)
+        blk = params["blocks"]
+        kpos = jnp.arange(T)
+        allow = kpos[None, :] <= t[:, None]                  # (B, T)
+        for layer in range(cfg.n_layers):
+            x = _rms(h, blk["ln1"][layer])
+            q = (x @ blk["wq"][layer]).reshape(B, cfg.n_heads, cfg.d_head)
+            k = x @ blk["wk"][layer]                         # (B, dh)
+            v = x @ blk["wv"][layer]
+            ck = ck.at[layer, rows, t].set(k, mode="drop")
+            cv = cv.at[layer, rows, t].set(v, mode="drop")
+            s = jnp.einsum("bhd,btd->bht", q, ck[layer]) \
+                * (cfg.d_head ** -0.5)
+            s = jnp.where(allow[:, None, :], s, _NEG)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bht,btd->bhd", p, cv[layer])
+            h = h + o.reshape(B, -1) @ blk["wo"][layer]
+            x2 = _rms(h, blk["ln2"][layer])
+            h = h + jax.nn.relu(x2 @ blk["w1"][layer]) @ blk["w2"][layer]
+        logits = _rms(h, params["ln_f"]) @ params["embed"].T
+        return logits.astype(jnp.float32), (ck, cv)
+
+    def verify_ragged(self, params, caches, tok_chunk, t,
+                      with_logits=True):
+        """Chunk step at PER-ROW start positions: ``tok_chunk`` (B, C)
+        with row ``b``'s chunk occupying positions ``[t[b], t[b]+C)``
+        (origin-0 rows — the ragged-round contract).  Same semantics
+        as :meth:`verify` otherwise: each chunk token writes its K/V
+        and attends the full cache through its own position, so one
+        weights read verifies C draft positions per row even when the
+        rows' clocks disagree.  Returns ``(logits (B, C, V) | None,
+        caches)``."""
+        cfg = self.cfg
+        ck, cv = caches
+        B, C = tok_chunk.shape
+        T = ck.shape[POS_AXIS]
+        rows = jnp.arange(B)
+        j = jnp.arange(C)
+        pos = t[:, None] + j[None, :]                        # (B, C)
+        h = jnp.take(params["embed"], tok_chunk, axis=0) \
+            + self._positions(params, pos)
+        blk = params["blocks"]
+        kpos = jnp.arange(T)
+        allow = kpos[None, None, :] <= pos[:, :, None]       # (B, C, T)
+        for layer in range(cfg.n_layers):
+            x = _rms(h, blk["ln1"][layer])
+            q = (x @ blk["wq"][layer]).reshape(
+                B, C, cfg.n_heads, cfg.d_head)
+            k = x @ blk["wk"][layer]                     # (B, C, dh)
+            v = x @ blk["wv"][layer]
+            ck = ck.at[layer, rows[:, None], pos].set(k, mode="drop")
+            cv = cv.at[layer, rows[:, None], pos].set(v, mode="drop")
+            s = jnp.einsum("bchd,btd->bhct", q, ck[layer]) \
+                * (cfg.d_head ** -0.5)
+            s = jnp.where(allow[:, None], s, _NEG)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhct,btd->bchd", p, cv[layer])
+            h = h + o.reshape(B, C, -1) @ blk["wo"][layer]
+            x2 = _rms(h, blk["ln2"][layer])
+            h = h + jax.nn.relu(x2 @ blk["w1"][layer]) @ blk["w2"][layer]
+        if not with_logits:
+            return None, (ck, cv)
+        logits = _rms(h, params["ln_f"]) @ params["embed"].T
+        return logits.astype(jnp.float32), (ck, cv)
+
     def verify(self, params, caches, tok_chunk, t, pos_offset,
                with_logits=True):
         """Chunk step — the speculative VERIFY pass (and, without

@@ -70,6 +70,15 @@ class PrefixTrie:
         self._map: "collections.OrderedDict[bytes, int]" = \
             collections.OrderedDict()
         self._key_of: Dict[int, bytes] = {}
+        # sub-block divergence support: each cached block remembers its
+        # own token slice, and each parent prefix remembers ONE cached
+        # child block (first writer wins, like insert) so stage() can
+        # measure how far into the next block a new prompt agrees with
+        # cached content before diverging
+        self._tokens_of: Dict[int, np.ndarray] = {}
+        self._child_of: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._parent_of: Dict[int, bytes] = {}
 
     def __len__(self) -> int:
         return len(self._map)
@@ -99,13 +108,36 @@ class PrefixTrie:
             return False
         self._map[key] = block_id
         self._key_of[block_id] = key
+        self._tokens_of[block_id] = np.ascontiguousarray(
+            tokens[j * self.block:(j + 1) * self.block], np.int32).copy()
+        parent = _prefix_key(tokens, j * self.block)
+        if parent not in self._child_of:
+            self._child_of[parent] = block_id
+            self._parent_of[block_id] = parent
         return True
+
+    def peek_child(self, tokens: np.ndarray, n_matched: int):
+        """A cached FULL block extending ``tokens``' first
+        ``n_matched`` blocks, as ``(block_id, its token slice)`` —
+        ``None`` when no child is cached.  The sub-block fork probe:
+        the caller diffs the slice against its own next block to find
+        how many leading K/V positions a device copy can reuse."""
+        parent = _prefix_key(tokens, n_matched * self.block)
+        bid = self._child_of.get(parent)
+        if bid is None:
+            return None
+        self._map.move_to_end(self._key_of[bid])
+        return bid, self._tokens_of[bid]
 
     def drop_block(self, block_id: int) -> bool:
         key = self._key_of.pop(block_id, None)
         if key is None:
             return False
         del self._map[key]
+        self._tokens_of.pop(block_id, None)
+        parent = self._parent_of.pop(block_id, None)
+        if parent is not None:
+            del self._child_of[parent]
         return True
 
     def lru_blocks(self):
@@ -120,14 +152,26 @@ class StagePlan:
     prefill is skipped), the last ``n_new`` were freshly allocated and
     must be prefilled + scattered.  ``n_shared > 0 and n_new > 0`` is
     the copy-on-write FORK: the row's chain leaves the shared prefix
-    for private blocks at token ``n_shared * block``."""
+    for private blocks at token ``n_shared * block``.
+
+    ``copy_src``/``n_copied`` refine the fork to SUB-block
+    granularity: when a cached child block agrees with the prompt on
+    its first ``n_copied`` tokens, the first new block is
+    device-copied from ``copy_src`` (the caller owes the
+    ``copy_block`` dispatch, then :meth:`RefcountedBlockPool.
+    copy_done` to drop the transient reference ``stage`` holds on the
+    source) and prefill resumes at token ``n_shared * block +
+    n_copied`` instead of re-deriving the whole block."""
 
     table: List[int]
     n_shared: int
     n_new: int
+    copy_src: Optional[int] = None
+    n_copied: int = 0
 
     def __post_init__(self):
         assert self.n_shared + self.n_new == len(self.table)
+        assert (self.copy_src is None) == (self.n_copied == 0)
 
 
 class RefcountedBlockPool:
@@ -163,6 +207,7 @@ class RefcountedBlockPool:
         self.n_hits = 0             # blocks served from the trie
         self.n_prefilled = 0        # blocks that needed prefill
         self.n_forks = 0            # fork_for_write invocations that forked
+        self.n_partial_copies = 0   # sub-block forks (copy_src plans)
         self.n_reclaimed = 0        # cache blocks dropped under pressure
         self.peak_blocks_used = 0   # physical residency (rows + cache)
         self.peak_row_blocks = 0    # unreclaimable pressure (row-held)
@@ -253,17 +298,46 @@ class RefcountedBlockPool:
         # exactly that
         for b in run:
             self._refs[b] += 1
+        # sub-block fork probe: a cached child block whose leading
+        # tokens agree with ours lets the first divergent block start
+        # as a device copy.  The source holds a TRANSIENT reference
+        # (same reclaim hazard as the run hits) until copy_done().
+        copy_src, n_copied = None, 0
+        if self.share and n_real > len(run):
+            ours = tokens[len(run) * self.block:
+                          (len(run) + 1) * self.block]
+            child = self._trie.peek_child(tokens, len(run))
+            if child is not None:
+                bid, cached = child
+                n = min(len(ours), len(cached))
+                eq = np.flatnonzero(ours[:n] != cached[:n])
+                d = int(eq[0]) if eq.size else n
+                if d > 0:
+                    copy_src, n_copied = bid, d
+                    self._refs[bid] += 1
         new = self._take(n_real - len(run))
         if new is None:
             for b in run:
                 self._refs[b] -= 1
+            if copy_src is not None:
+                self._refs[copy_src] -= 1
             return None
         self._tables[row_id] = list(run) + new
         self.n_hits += len(run)
         self.n_prefilled += len(new)
+        if copy_src is not None:
+            self.n_partial_copies += 1
         self._note_peak()
         return StagePlan(table=list(run) + new, n_shared=len(run),
-                         n_new=len(new))
+                         n_new=len(new), copy_src=copy_src,
+                         n_copied=n_copied)
+
+    def copy_done(self, block_id: int) -> None:
+        """Drop the transient reference :meth:`stage` holds on a
+        ``copy_src`` block once the device copy has been dispatched.
+        Skipping this leaks the reference — :meth:`leak_report`
+        catches it."""
+        self._decref(block_id)
 
     def insert_cached(self, row_id, tokens) -> int:
         """Publish the row's FULL blocks into the trie (the trie holds
@@ -386,19 +460,26 @@ class RefcountedBlockPool:
                 raise ValueError(f"align={align!r} not in left/right")
         return out
 
-    def flat_gather_index(self, row_id, pq: int,
-                          prompt_len: int) -> np.ndarray:
-        """The admit gather's position-level index (``Pq``,): chunk
-        position ``p`` (right-aligned lane layout) reads pool position
-        ``table[i // block] * block + i % block`` for token
-        ``i = p - (pq - prompt_len)``; out-of-prompt positions are -1
+    def flat_gather_index(self, row_id, pq: int, prompt_len: int, *,
+                          align: str = "right") -> np.ndarray:
+        """The admit gather's position-level index (``Pq``,): token
+        ``i`` reads pool position ``table[i // block] * block +
+        i % block``.  ``align="right"`` puts token ``i`` at chunk
+        position ``pq - prompt_len + i`` (the legacy padded-lane
+        layout); ``align="left"`` at position ``i`` (the ragged
+        engine's origin-0 lanes).  Out-of-prompt positions are -1
         (clamped garbage the attention window never reads)."""
         table = self._tables[row_id]
         out = np.full((pq,), -1, np.int32)
-        align = pq - prompt_len
         i = np.arange(prompt_len)
-        out[align:] = (np.asarray(table, np.int32)[i // self.block]
-                       * self.block + i % self.block)
+        flat = (np.asarray(table, np.int32)[i // self.block]
+                * self.block + i % self.block)
+        if align == "left":
+            out[:prompt_len] = flat
+        elif align == "right":
+            out[pq - prompt_len:] = flat
+        else:
+            raise ValueError(f"align={align!r} not in left/right")
         return out
 
     # -- auditing ------------------------------------------------------ #
@@ -410,6 +491,7 @@ class RefcountedBlockPool:
             "prefix_prefilled": self.n_prefilled,
             "prefix_hit_rate": self.n_hits / total if total else 0.0,
             "prefix_forks": self.n_forks,
+            "prefix_partial_copies": self.n_partial_copies,
             "prefix_reclaimed": self.n_reclaimed,
             "cached_blocks": self.n_cached,
             "shared_blocks": self.n_shared_blocks,

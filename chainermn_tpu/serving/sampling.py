@@ -15,7 +15,8 @@ like it breaks the oracle.  It doesn't — it moves it:
   request carries its own ``jax.random`` key stream; the key for its
   ``i``-th generated token is ``fold_in(request_key, i)`` — a pure
   function of the REQUEST (seed and token index), never of the slot,
-  the global position clock, rebases, or what else is in the batch.
+  round timing, or what else is in the batch (under ragged rounds
+  the token index IS the row's own position clock).
   Two runs of the same request under any scheduling produce the same
   tokens, and the test oracle replays them solo from ``(key,
   params)`` alone.

@@ -33,10 +33,12 @@ decode-adapter protocol instead of ``TransformerConfig`` internals:
   spans.
 
 Host-driven rounds over jitted draft/verify programs, single request
-per call (the serving shape; the engine's continuous rounds advance
-all slots in lockstep, which per-row ragged acceptance cannot ride —
-the fused batch form lives in ``models.decoding``).  See
-docs/SERVING.md "Speculative serving".
+per call — the standalone/offline tier.  For continuous serving, pass
+``draft_adapter=`` to :class:`~chainermn_tpu.serving.engine.ServingEngine`
+and the engine runs speculation as a ROUND MODE over its ragged
+per-row position clocks (per-row acceptance, same counters); the
+fused batch form lives in ``models.decoding``.  See docs/SERVING.md
+"Speculative serving" and "Ragged rounds".
 """
 
 from __future__ import annotations

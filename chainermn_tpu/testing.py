@@ -424,7 +424,7 @@ class FaultInjector:
             maybe_release()
             return out
 
-        def stage_wrapper(req, rec, steal):
+        def stage_wrapper(req, rec, steal, idle=True):
             n = state["stages"]
             state["stages"] += 1
             state["ticks"] += 1
@@ -440,7 +440,7 @@ class FaultInjector:
                 state["hoard_until"] = (
                     state["ticks"] + plan.serve_exhaust_pool_rounds)
                 self.fired.append(("serve_pool_exhaust", n))
-            out = real_stage(req, rec, steal)
+            out = real_stage(req, rec, steal, idle=idle)
             maybe_release()
             return out
 

@@ -98,7 +98,7 @@ __all__ = [
 
 def _slug(name: str) -> str:
     """A label as a metric-name suffix: lowercase, ``[a-z0-9_]`` only
-    (``serve/suffix_prefill`` → ``serve_suffix_prefill``) — the
+    (``serve/chunk_prefill`` → ``serve_chunk_prefill``) — the
     dynamic-family convention ``serve/shed_<reason>`` established."""
     return re.sub(r"[^a-z0-9_]", "_", str(name).lower())
 

@@ -593,7 +593,7 @@ class RequestTraceStore:
     The flight recorder's ring answers *"what was this process doing"*;
     a serving operator's question is *"what happened to THIS request"*.
     The engine assembles one span timeline per request (``queue_wait``,
-    ``admit``, ``prefill``, sampled ``decode_round``\\ s, ``rebase``,
+    ``admit``, ``prefill``/``chunk_prefill``, sampled ``decode_round``\\ s,
     the terminal ``evict``/``shed``) and OFFERS the finished trace
     here.  Retention is tail-based — the retention the exemplar link
     needs, because exemplars point at tails:

@@ -2,8 +2,8 @@
 
 The load-bearing property is TOKEN IDENTITY — every admitted request's
 greedy tokens equal its solo static decode, whatever shared its rounds
-(ragged prompts, staggered admissions, EOS freezes, rebases, gang
-mode).  The oracle is conftest's plain-loop decode over the same
+(ragged prompts, staggered admissions, EOS freezes, tight horizons,
+gang mode).  The oracle is conftest's plain-loop decode over the same
 adapter functions, independent of all engine code."""
 
 import time
@@ -93,16 +93,20 @@ class TestParity:
             comps = eng.run(max_steps=2000)
             _check_parity(comps, rids, oracle, eos=eos)
 
-    def test_rebase_preserves_tokens(self, mini_adapter, mini_params,
-                                     oracle, ragged_trace):
+    def test_tight_horizon_serves_forever(self, mini_adapter,
+                                          mini_params, oracle,
+                                          ragged_trace):
+        # the horizon that forced the old rebase shift: origin-0 rows
+        # only need prompt + max_new <= horizon per REQUEST, so a
+        # 24-request trace over horizon=40 drains with zero shifts
         eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
-                            horizon=56, max_prompt=16, block=8,
+                            horizon=40, max_prompt=16, block=8,
                             round_tokens=4)
         trace = ragged_trace(np.random.RandomState(3), 24, min_new=12,
                              max_new=20)
         rids = _submit_all(eng, trace)
         comps = eng.run(max_steps=4000)
-        assert eng.n_rebases >= 1   # the tight horizon forced a shift
+        assert "rebases" not in eng.stats()   # the program is gone
         _check_parity(comps, rids, oracle)
 
     def test_gang_mode_matches_solo_and_waves(self, engine, oracle,
@@ -258,7 +262,7 @@ class TestMachinery:
         iterators.prefetch hazard): everything handed to a jitted call
         from the reused staging buffers must be a fresh copy."""
         engine.reset()
-        st = engine._prompt_staging
+        st = engine._lprompt_staging
         c = engine._staging_copy(st)
         assert c is not st and not np.shares_memory(c, st)
         # behavioural: the staged entry survives the staging buffer
@@ -270,10 +274,11 @@ class TestMachinery:
         req1 = engine._queue[0]
         assert engine._stage(req1, rec, steal=False)
         staged_prompt = engine._staged[rid1][1]
-        engine._prompt_staging[:] = -7      # simulate the next rewrite
+        engine._lprompt_staging[:] = -7     # simulate the next rewrite
         assert not np.shares_memory(staged_prompt,
-                                    engine._prompt_staging)
-        assert staged_prompt[-1] == p1[-1]
+                                    engine._lprompt_staging)
+        # left-aligned staging: token i at row position i
+        assert staged_prompt[len(p1) - 1] == p1[-1]
         engine.reset()
 
     def test_back_to_back_admits_share_staging_safely(self, engine,

@@ -1,15 +1,23 @@
-"""Ledger-backed serving invariants (ISSUE 15): zero steady-state
-recompiles in the decode loop post-warm — pinned through the program
-ledger, which records exactly the signature set that decides a jit
-retrace — and the per-(prefix,suffix)-split verify-retrace budget
-(docs/SERVING.md "The verify-retrace budget")."""
+"""Ledger-backed serving invariants (ISSUE 15, re-pinned for ragged
+rounds): zero steady-state recompiles in the decode loop post-warm —
+pinned through the program ledger, which records exactly the
+signature set that decides a jit retrace — and the chunk-prefill
+ONE-compile budget across every chunk position and (prefix, suffix)
+block split (docs/SERVING.md "The verify-retrace budget")."""
 
 import numpy as np
 import pytest
 
-from chainermn_tpu.serving import ServingEngine
+from chainermn_tpu.serving import (
+    MiniLMAdapter,
+    MiniLMConfig,
+    ServingEngine,
+    init_minilm,
+)
 from chainermn_tpu.serving.sampling import SamplingParams
 from chainermn_tpu.utils.programs import ProgramLedger, set_ledger
+
+import jax
 
 
 @pytest.fixture()
@@ -20,6 +28,16 @@ def ledger():
         yield led
     finally:
         set_ledger(prev)
+
+
+@pytest.fixture(scope="module")
+def draft_pair(mini_adapter):
+    """A cheap draft sharing the target's MeshConfig INSTANCE (the
+    engine validates mesh identity, not equality)."""
+    cfg = MiniLMConfig(vocab_size=64, d_model=16, n_heads=2, d_head=8,
+                       d_ff=32, n_layers=1, max_pos=256)
+    params = init_minilm(jax.random.PRNGKey(7), cfg)
+    return MiniLMAdapter(mini_adapter.mesh_cfg, cfg), params
 
 
 def _serve(eng, rng, n, max_new=(4, 12), sampled_every=0):
@@ -40,11 +58,12 @@ class TestZeroSteadyStateRecompile:
                                    ledger):
         """The acceptance invariant: after a warmup pass has exercised
         every program the engine serves with (greedy + sampled rounds,
-        prefill, admit, rebase via warm()), steady ragged traffic —
-        different prompt lengths, budgets, sampling mixes, admissions
-        mid-stream — compiles NOTHING.  The ledger proves it: its
-        signature sets are exactly what decides a jit retrace, so
-        steady_retraces == 0 IS the no-recompile property."""
+        chunked prefill via warm(), admit), steady ragged traffic —
+        different prompt lengths, budgets, chunk positions, sampling
+        mixes, admissions mid-stream — compiles NOTHING.  The ledger
+        proves it: its signature sets are exactly what decides a jit
+        retrace, so steady_retraces == 0 IS the no-recompile
+        property."""
         eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
                             horizon=160, max_prompt=16, block=8,
                             round_tokens=4)
@@ -56,11 +75,12 @@ class TestZeroSteadyStateRecompile:
         warm += _serve(eng, rng, 6, sampled_every=2)
         assert len(warm) == 14
         warm_compiles = ledger.compiles("serve/")
-        assert warm_compiles >= 7     # init, pool, rebase, prefill,
+        assert warm_compiles >= 6     # init, pool, chunk_prefill,
         #                               admit, round, round_sampled
         stats = ledger.label_stats()
         assert "serve/round" in stats
         assert "serve/round_sampled" in stats
+        assert "serve/chunk_prefill" in stats
 
         eng.mark_steady()
         steady = _serve(eng, rng, 20, sampled_every=4)
@@ -68,6 +88,34 @@ class TestZeroSteadyStateRecompile:
         assert ledger.steady_retraces("serve/") == 0, \
             ledger.entries(scope="serve/")
         assert ledger.compiles("serve/") == warm_compiles
+
+    def test_spec_rounds_post_warm(self, mini_adapter, mini_params,
+                                   draft_pair, ledger):
+        """Speculation as a round mode obeys the same invariant: with
+        a draft attached, warm() + one greedy pass compile the spec
+        round and draft programs, and steady ragged greedy traffic
+        compiles nothing further."""
+        d_ad, d_params = draft_pair
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4, draft_adapter=d_ad,
+                            draft_params=d_params, spec_k=3)
+        eng.warm()
+        rng = np.random.RandomState(2)
+        warm = _serve(eng, rng, 8)
+        assert len(warm) == 8
+        stats = ledger.label_stats()
+        assert "serve/round_spec" in stats
+        assert "serve/draft_prefill" in stats
+        warm_compiles = ledger.compiles("serve/")
+
+        eng.mark_steady()
+        steady = _serve(eng, rng, 16)
+        assert len(steady) == 16
+        assert ledger.steady_retraces("serve/") == 0, \
+            ledger.entries(scope="serve/")
+        assert ledger.compiles("serve/") == warm_compiles
+        assert eng.spec_drafted > 0    # spec rounds actually ran
 
     def test_shape_leak_is_caught(self, mini_adapter, mini_params,
                                   ledger):
@@ -88,74 +136,60 @@ class TestZeroSteadyStateRecompile:
         assert entry["steady"] is True and entry["diff"] is None
 
 
-class TestVerifyRetraceBudget:
-    def test_one_compile_per_prefix_suffix_split(self, mini_adapter,
-                                                 mini_params, ledger):
-        """The suffix-prefill program's shapes vary per (prefix,
-        suffix) BLOCK split, so it retraces per distinct split — and
-        only per distinct split: the ledger bounds the compile count
-        by the split set, and a repeated split costs nothing (the
-        SERVING.md verify-retrace budget)."""
+class TestChunkPrefillBudget:
+    def test_one_compile_for_all_splits(self, mini_adapter,
+                                        mini_params, ledger):
+        """The chunk-prefill program takes FIXED operand shapes (the
+        start position is a traced scalar), so ONE compile — paid at
+        warm() — covers every chunk of every prompt at every (prefix,
+        suffix) block split.  The per-split retrace budget the old
+        suffix-prefill program paid is gone."""
         eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
                             horizon=160, max_prompt=16, block=4,
                             round_tokens=4, prefix_sharing=True)
         eng.warm()
+        after_warm = ledger.compiles("serve/chunk_prefill")
+        assert after_warm == 1, ledger.label_stats()
         system = np.arange(1, 9, dtype=np.int32)       # 2 full blocks
-        splits = set()
 
         def submit_with_suffix(suffix_tokens):
             prompt = np.concatenate(
                 [system, np.asarray(suffix_tokens, np.int32)])
-            n_shared = min(len(system) // eng.block,
-                           len(prompt) // eng.block)
-            n_blocks = -(-len(prompt) // eng.block)
-            if n_blocks > n_shared:
-                splits.add((n_shared, n_blocks - n_shared))
             eng.submit(prompt, max_new=4)
             while not eng.idle:
                 eng.step()
 
         submit_with_suffix([20, 21])            # split (2, 1)
         submit_with_suffix([22, 23, 24])        # split (2, 1) again
-        before = ledger.compiles("serve/suffix_prefill")
         submit_with_suffix([25])                # (2, 1) third time
-        assert ledger.compiles("serve/suffix_prefill") == before
         submit_with_suffix([26] * 6)            # split (2, 2): fresh
-        stats = ledger.label_stats().get("serve/suffix_prefill")
-        assert stats is not None, ledger.label_stats()
-        assert stats["compiles"] <= len(splits)
-        # the retrace attribution names the changing leaves as shapes
-        entries = ledger.entries(scope="serve/suffix_prefill")
-        diffs = [e["diff"] for e in entries if e["diff"] is not None]
-        assert diffs and all(d["kinds"] == ["shape"] for d in diffs)
+        submit_with_suffix(np.arange(30, 38))   # no shared prefix
+        assert ledger.compiles("serve/chunk_prefill") == after_warm, \
+            ledger.entries(scope="serve/chunk_prefill")
+        assert eng.stats()["prefix_hit_rate"] > 0
 
-    def test_suffix_compile_exemplar_links_to_request(
+    def test_chunk_compile_exemplar_links_to_request(
             self, mini_adapter, mini_params, ledger):
-        """The compile→trace link: a suffix-prefill compile caused by
-        a traced request carries that request's trace id as its
-        ledger exemplar (the /programz row points at the causal
-        request, the compile/seconds exemplar resolves in its
-        timeline)."""
+        """The compile→trace link: a chunk-prefill compile caused by a
+        traced request (no warm() here, so the FIRST staging pays it)
+        carries that request's trace id as its ledger exemplar (the
+        /programz row points at the causal request)."""
         from chainermn_tpu.utils.telemetry import RequestTraceStore
 
         eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
                             horizon=160, max_prompt=16, block=4,
                             round_tokens=4, prefix_sharing=True,
                             traces=RequestTraceStore(sample_rate=1.0))
-        eng.warm()
-        system = np.arange(1, 9, dtype=np.int32)       # 2 full blocks
-        eng.submit(np.concatenate([system,
-                                   np.asarray([30, 31], np.int32)]),
-                   max_new=4, trace_id="cold-req")
+        eng.submit(np.arange(1, 11, dtype=np.int32), max_new=4,
+                   trace_id="cold-req")
         while not eng.idle:
             eng.step()
-        eng.submit(np.concatenate([system,
-                                   np.asarray([40, 41], np.int32)]),
-                   max_new=4, trace_id="hit-req")
+        eng.submit(np.arange(40, 46, dtype=np.int32), max_new=4,
+                   trace_id="hit-req")
         while not eng.idle:
             eng.step()
-        entries = ledger.entries(scope="serve/suffix_prefill")
+        entries = ledger.entries(scope="serve/chunk_prefill")
         assert entries, ledger.label_stats()
-        assert entries[-1]["exemplar"] in ("cold-req", "hit-req")
+        assert entries[-1]["exemplar"] == "cold-req"
         # and the staging exemplar never leaks past the stage
         assert ledger.exemplar is None

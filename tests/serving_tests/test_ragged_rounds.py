@@ -1,0 +1,196 @@
+"""Ragged-round token identity (the tentpole's safety rail): per-row
+position clocks advance every slot on its own origin-0 lane, chunked
+prefill interleaves prompt staging into live decode rounds, and
+speculation runs as a per-row round mode — and NONE of it may move a
+single token.  Every case here pins engine output against conftest's
+engine-independent solo oracle (greedy) or the keyed replay oracle
+(sampled), across staggered long/short admits, chunk budgets, draft
+qualities, and mixed sampling."""
+
+import jax
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import (
+    MiniLMAdapter,
+    MiniLMConfig,
+    ServingEngine,
+    init_minilm,
+)
+from chainermn_tpu.serving.sampling import SamplingParams
+
+VOCAB = 64
+
+
+def _check_parity(comps, rids, oracle, eos=-1):
+    by_rid = {c.rid: c for c in comps}
+    assert sorted(by_rid) == sorted(r for r, _, _ in rids)
+    for rid, prompt, max_new in rids:
+        np.testing.assert_array_equal(
+            by_rid[rid].tokens, oracle(prompt, max_new, eos=eos),
+            err_msg=f"request {rid} diverged from its solo decode")
+
+
+@pytest.fixture(scope="module")
+def draft_pair(mini_adapter):
+    """An UNTRAINED draft (acceptance near zero) sharing the target's
+    MeshConfig instance: token identity must hold regardless of draft
+    quality, so the worst draft is the strongest witness."""
+    cfg = MiniLMConfig(vocab_size=VOCAB, d_model=16, n_heads=2,
+                       d_head=8, d_ff=32, n_layers=1, max_pos=256)
+    params = init_minilm(jax.random.PRNGKey(99), cfg)
+    return MiniLMAdapter(mini_adapter.mesh_cfg, cfg), params
+
+
+@pytest.fixture(scope="module")
+def self_draft(mini_adapter, mini_params):
+    """The target drafting for itself: acceptance exactly 1.0 — the
+    other extreme of the acceptance range."""
+    return mini_adapter, mini_params
+
+
+class TestChunkedPrefill:
+    def test_staggered_long_short_admits(self, mini_adapter,
+                                         mini_params, oracle):
+        """The TTFT-independence scenario as a correctness case: long
+        prompts admitted mid-stream stage one chunk per round while
+        short requests decode — tokens of BOTH populations must equal
+        their solo decodes."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=64, block=8,
+                            round_tokens=4, prefill_chunk=1)
+        rng = np.random.RandomState(0)
+        short = [(rng.randint(0, VOCAB, rng.randint(2, 9)),
+                  int(rng.randint(6, 14))) for _ in range(6)]
+        long = [(rng.randint(0, VOCAB, rng.randint(40, 65)),
+                 int(rng.randint(6, 14))) for _ in range(4)]
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in short[:4]]
+        comps = []
+        # interleave long-prompt submits while shorts decode: the
+        # long prompts MUST take the chunk-per-round path
+        for p, n in long + short[4:]:
+            comps.extend(eng.step())
+            rids.append((eng.submit(p, max_new=n), p, n))
+        comps.extend(eng.run(max_steps=4000))
+        assert eng.stats()["chunk_prefills"] >= len(long)
+        _check_parity(comps, rids, oracle)
+
+    @pytest.mark.parametrize("prefill_chunk", [1, 2, 4])
+    def test_chunk_budget_sweep(self, mini_adapter, mini_params,
+                                oracle, prefill_chunk):
+        """Every per-round chunk budget stages the same tokens."""
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=32, block=8,
+                            round_tokens=4,
+                            prefill_chunk=prefill_chunk)
+        rng = np.random.RandomState(prefill_chunk)
+        trace = [(rng.randint(0, VOCAB, rng.randint(2, 33)),
+                  int(rng.randint(4, 16))) for _ in range(12)]
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+        comps = eng.run(max_steps=4000)
+        _check_parity(comps, rids, oracle)
+
+    def test_chunked_with_prefix_sharing_and_eos(self, mini_adapter,
+                                                 mini_params, oracle):
+        """Chunked admission over trie-shared prefixes with EOS
+        freezing mid-round: the full cross product the seed suite
+        pinned, now under ragged clocks."""
+        rng = np.random.RandomState(3)
+        system = rng.randint(0, VOCAB, 12)
+        trace = [(np.concatenate([system,
+                                  rng.randint(0, VOCAB,
+                                              rng.randint(2, 20))]),
+                  int(rng.randint(6, 14))) for _ in range(10)]
+        eos = int(oracle(trace[0][0], trace[0][1])[2])
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=32, block=8,
+                            round_tokens=4, eos_id=eos,
+                            prefix_sharing=True, prefill_chunk=1)
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+        comps = eng.run(max_steps=4000)
+        _check_parity(comps, rids, oracle, eos=eos)
+        assert eng.stats()["prefix_hit_rate"] > 0
+
+
+class TestSpeculativeRounds:
+    @pytest.mark.parametrize("which", ["untrained", "self"])
+    def test_greedy_identity_any_draft(self, mini_adapter,
+                                       mini_params, oracle,
+                                       draft_pair, self_draft, which):
+        """Per-row speculative rounds commit the target's own argmax
+        stream whatever the draft proposes: identical tokens at
+        acceptance ~0 (untrained draft) and exactly 1 (self-draft)."""
+        d_ad, d_params = draft_pair if which == "untrained" \
+            else self_draft
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4, draft_adapter=d_ad,
+                            draft_params=d_params, spec_k=3)
+        rng = np.random.RandomState(5)
+        trace = [(rng.randint(0, VOCAB, rng.randint(2, 17)),
+                  int(rng.randint(4, 20))) for _ in range(12)]
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace]
+        comps = eng.run(max_steps=4000)
+        _check_parity(comps, rids, oracle)
+        st = eng.stats()
+        assert st["spec_drafted"] > 0
+        if which == "self":
+            # self-draft: every drafted token verifies, except drafts
+            # clipped by a row's remaining budget at its last round
+            assert st["spec_accepted"] >= 0.9 * st["spec_drafted"]
+
+    def test_spec_with_eos_and_staggered_admits(self, mini_adapter,
+                                                mini_params, oracle,
+                                                draft_pair):
+        d_ad, d_params = draft_pair
+        rng = np.random.RandomState(6)
+        trace = [(rng.randint(0, VOCAB, rng.randint(2, 17)),
+                  int(rng.randint(8, 20))) for _ in range(12)]
+        eos = int(oracle(trace[0][0], trace[0][1])[2])
+        eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                            horizon=160, max_prompt=16, block=8,
+                            round_tokens=4, eos_id=eos,
+                            draft_adapter=d_ad, draft_params=d_params,
+                            spec_k=4)
+        rids = [(eng.submit(p, max_new=n), p, n) for p, n in trace[:6]]
+        comps = []
+        for p, n in trace[6:]:
+            comps.extend(eng.step())
+            rids.append((eng.submit(p, max_new=n), p, n))
+        comps.extend(eng.run(max_steps=4000))
+        _check_parity(comps, rids, oracle, eos=eos)
+
+    def test_sampled_requests_fall_back_and_replay(self, mini_adapter,
+                                                   mini_params,
+                                                   oracle, draft_pair):
+        """Spec rounds are defined against the target argmax, so
+        rounds with sampled rows take the keyed sampled program — and
+        the sampled tokens still replay schedule-independently while
+        greedy rows keep oracle identity."""
+        d_ad, d_params = draft_pair
+        rng = np.random.RandomState(7)
+        greedy = [(rng.randint(0, VOCAB, rng.randint(2, 17)),
+                   int(rng.randint(4, 12))) for _ in range(6)]
+        sampled = [(rng.randint(0, VOCAB, rng.randint(2, 17)),
+                    int(rng.randint(4, 12)),
+                    SamplingParams(temperature=0.8, top_k=10,
+                                   seed=40 + i)) for i in range(4)]
+
+        def run_once():
+            eng = ServingEngine(mini_adapter, mini_params, n_slots=8,
+                                horizon=160, max_prompt=16, block=8,
+                                round_tokens=4, draft_adapter=d_ad,
+                                draft_params=d_params, spec_k=3)
+            g = [(eng.submit(p, max_new=n), p, n) for p, n in greedy]
+            s = [eng.submit(p, max_new=n, sampling=sp)
+                 for p, n, sp in sampled]
+            comps = {c.rid: c for c in eng.run(max_steps=4000)}
+            return eng, g, s, comps
+
+        eng1, g1, s1, comps1 = run_once()
+        _check_parity([comps1[r] for r, _, _ in g1], g1, oracle)
+        eng2, _, s2, comps2 = run_once()
+        for r1, r2 in zip(s1, s2):
+            np.testing.assert_array_equal(
+                comps1[r1].tokens, comps2[r2].tokens,
+                err_msg="sampled tokens changed across runs")

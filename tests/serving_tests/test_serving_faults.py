@@ -190,10 +190,10 @@ class TestStageFailure:
 
         real_stage = eng._stage
 
-        def stage_wrapper(req, rec, steal):
+        def stage_wrapper(req, rec, steal, idle=True):
             if req.rid == poison_rid.get("rid"):
                 raise RuntimeError("injected prefill failure")
-            return real_stage(req, rec, steal)
+            return real_stage(req, rec, steal, idle=idle)
 
         eng._stage = stage_wrapper
         trace = [(rng.randint(0, 64, rng.randint(2, 16)), 6)

@@ -216,10 +216,13 @@ def test_other_benches_contract(script, args, unit):
 
 def test_serving_decode_tier_arms_contract():
     """The serving bench's contract row (ONE child covers the generic
-    one-JSON-line contract AND the ISSUE 14 decode-tier arms —
-    prefix-share, sampled, speculative): exactness witnesses all
-    zero, rates within range, self-draft acceptance exactly 1 (the
-    machinery sanity anchor)."""
+    one-JSON-line contract, the ISSUE 14 decode-tier arms —
+    prefix-share, sampled, speculative — AND the ragged-round arms:
+    short-prompt TTFT independence under long-prompt co-admission,
+    in-engine per-row speculation): exactness witnesses all zero,
+    rates within range, self-draft acceptance exactly 1 (the
+    machinery sanity anchor), and the in-run TTFT-independence assert
+    must have held for the child to emit its line at all."""
     rec = _assert_contract(
         _run("bench_serving.py",
              ["--platform", "cpu", "--requests", "8", "--slots", "8",
@@ -229,6 +232,8 @@ def test_serving_decode_tier_arms_contract():
               "2", "--vocab", "64", "--rounds", "1", "--decode-tier",
               "1", "--prefix-requests", "8", "--shared-prefix", "8",
               "--spec-prompts", "2", "--spec-new", "16",
+              "--ragged-tier", "1", "--ragged-requests", "6",
+              "--long-prompt", "48", "--ttft-noise-bar", "3.0",
               "--timeouts", "420"]),
         expect_value=True)
     for field in ("prefix_prefill_speedup", "prefix_hit_rate",
@@ -236,7 +241,15 @@ def test_serving_decode_tier_arms_contract():
                   "prefix_share_peak_row_blocks",
                   "sampled_tokens_per_sec", "spec_tokens_per_sec",
                   "spec_acceptance_rate", "spec_vs_target_only",
-                  "spec_selfdraft_acceptance_rate"):
+                  "spec_selfdraft_acceptance_rate",
+                  "ragged_short_ttft_solo_p50_ms",
+                  "ragged_short_ttft_coadmit_p50_ms",
+                  "lockstep_short_ttft_coadmit_p50_ms",
+                  "ragged_ttft_coadmit_ratio",
+                  "ragged_vs_lockstep_short_ttft",
+                  "engine_spec_tokens_per_sec",
+                  "engine_spec_vs_plain",
+                  "engine_spec_acceptance_rate"):
         assert field in rec, field
     # the exactness ladder's bench-side witnesses
     assert rec["prefix_token_identity_mismatches"] == 0
@@ -245,6 +258,11 @@ def test_serving_decode_tier_arms_contract():
     assert rec["spec_selfdraft_identity_mismatches"] == 0
     assert rec["spec_selfdraft_acceptance_rate"] == 1.0
     assert 0.0 <= rec["prefix_hit_rate"] <= 1.0
+    # ragged arms: per-row speculation may not move a token, long
+    # co-admits staged through the chunk path
+    assert rec["engine_spec_identity_mismatches"] == 0
+    assert 0.0 <= rec["engine_spec_acceptance_rate"] <= 1.0
+    assert rec["ragged_chunk_prefills"] >= 1
 
 
 def test_breakdown_analyze_only_roofline():
