@@ -31,10 +31,14 @@ target-only decode) as a standalone/offline tier — in-engine, pass
 + SLO attainment/goodput + extra columns like acceptance/hit rates);
 ``minilm`` is the portable reference decode backend (and
 adapter-protocol example) — the flagship transformer rides the same
-engine through :class:`TransformerAdapter`.  See docs/SERVING.md
-("Serving at scale", "Overload and admission", "Prefix sharing",
-"Sampling", "Speculative serving"), ``bench_serving.py`` and
-``bench_overload.py``.
+engine through :class:`TransformerAdapter`; ``fleet`` fronts N engine
+replicas with prefix-cache-aware routing, replica health/failover
+(queue migration + committed-prefix re-dispatch, exactly-once
+delivery), budgeted retries with hedged dispatch, and brown-out
+degradation.  See docs/SERVING.md ("Serving at scale", "Overload and
+admission", "Prefix sharing", "Sampling", "Speculative serving",
+"Fleet"), ``bench_serving.py``, ``bench_overload.py`` and
+``bench_fleet.py``.
 """
 
 from .admission import (
@@ -44,9 +48,16 @@ from .admission import (
     ShedCompletion,
 )
 from .engine import Completion, Request, ServingEngine, TransformerAdapter
+from .fleet import FleetRouter, ReplicaHandle, RetryBudget
 from .kv_blocks import BlockAllocator, blocks_needed
 from .minilm import MiniLMAdapter, MiniLMConfig, init_minilm
-from .prefix_cache import PrefixTrie, RefcountedBlockPool, StagePlan
+from .prefix_cache import (
+    PrefixTrie,
+    RefcountedBlockPool,
+    StagePlan,
+    load_prefix_snapshot,
+    prefix_snapshot,
+)
 from .sampling import SamplingParams
 from .slo import SLOReport
 from .speculative import SpecResult, SpeculativeDecoder
@@ -55,11 +66,14 @@ __all__ = [
     "AdmissionController",
     "BlockAllocator",
     "Completion",
+    "FleetRouter",
     "MiniLMAdapter",
     "MiniLMConfig",
     "PrefixTrie",
     "RefcountedBlockPool",
+    "ReplicaHandle",
     "Request",
+    "RetryBudget",
     "SHED_REASONS",
     "SLOReport",
     "SamplingParams",
@@ -72,4 +86,6 @@ __all__ = [
     "TransformerAdapter",
     "blocks_needed",
     "init_minilm",
+    "load_prefix_snapshot",
+    "prefix_snapshot",
 ]

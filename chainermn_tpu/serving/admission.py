@@ -492,7 +492,8 @@ class AdmissionController:
 
     def check_submit(self, req, queue: Sequence,
                      inflight: Dict[Optional[str], int],
-                     n_slots: Optional[int] = None
+                     n_slots: Optional[int] = None,
+                     ahead_tokens: Optional[int] = None
                      ) -> Tuple[bool, Optional[str], Optional[object]]:
         """The submit-time verdict: ``(admit, reason, victim)``.
 
@@ -517,6 +518,13 @@ class AdmissionController:
         estimate is used — which folds HISTORICAL queue waits into a
         prediction for THIS queue, the over-shedding flaw the split
         fixes (an empty queue inherits the congested past's wait).
+
+        ``ahead_tokens`` (the engine passes its scheduling policy's
+        verdict) narrows the wait term further, to only the queued
+        budget the policy would serve BEFORE this request — without
+        it the whole queue is charged, which over-sheds under any
+        policy that can serve the new arrival early (deadline slack,
+        short prompt, priority).
         """
         if req.priority > self.protect_priority and self.protective():
             return False, "overload", None
@@ -526,7 +534,8 @@ class AdmissionController:
             return False, "over_quota", None
         if self.shed_on_deadline and req.deadline is not None:
             pred = self._predict_wait_and_service(req.max_new, queue,
-                                                  n_slots)
+                                                  n_slots,
+                                                  ahead_tokens)
             if pred is not None and req.t_submit + pred > req.deadline:
                 return False, "deadline", None
         if self.max_queue is not None and len(queue) >= self.max_queue:
@@ -537,18 +546,23 @@ class AdmissionController:
         return True, None, None
 
     def _predict_wait_and_service(self, max_new: int, queue: Sequence,
-                                  n_slots: Optional[int]
+                                  n_slots: Optional[int],
+                                  ahead_tokens: Optional[int] = None
                                   ) -> Optional[float]:
         """Queue-position-conditioned e2e prediction: the LIVE queued
         backlog's drain time (zero for an empty queue) plus the pure
-        service time.  Falls back to the blended :meth:`predict_e2e`
-        when the split inputs are missing."""
+        service time.  The backlog is ``ahead_tokens`` when the caller
+        supplies the policy-conditioned queue position (only requests
+        served BEFORE this one count), else the whole queue — the
+        conservative charge.  Falls back to the blended
+        :meth:`predict_e2e` when the split inputs are missing."""
         service = self.predictor.predict_service(max_new)
         if service is None or n_slots is None:
             return self.predictor.predict_e2e(max_new)
         wait = 0.0
-        if queue:
-            backlog = sum(int(r.max_new) for r in queue)
+        backlog = ahead_tokens if ahead_tokens is not None \
+            else (sum(int(r.max_new) for r in queue) if queue else 0)
+        if backlog:
             drain = self.predictor.predict_queue_drain(backlog,
                                                        n_slots)
             if drain is not None:

@@ -1245,7 +1245,8 @@ class ServingEngine:
         if self.admission is not None:
             admit, reason, victim = self.admission.check_submit(
                 req, list(self._queue), self._tenant_tokens,
-                n_slots=self.n_slots)
+                n_slots=self.n_slots,
+                ahead_tokens=self._ahead_tokens(req))
             if victim is not None:
                 # a lower-priority queued request makes room; its shed
                 # record flows out of the next step()
@@ -1594,6 +1595,43 @@ class ServingEngine:
                                cat="serve")
         get_registry().set("serve/queue_depth", len(self._queue))
 
+    def import_prefixes(self, prefixes: Sequence[np.ndarray]) -> int:
+        """Warm the prefix cache with token prefixes exported from
+        another engine (see
+        :func:`~chainermn_tpu.serving.prefix_cache.prefix_snapshot`) —
+        the rejoin half of a fleet failover: a restarted replica
+        re-prefills the snapshot's prefixes ONCE (as ordinary 1-token
+        requests, paying compute but no retrace) so subsequent traffic
+        hits its cache and the router's prefix-placement signal
+        survives the restart.  Must be called idle; returns the number
+        of newly cached blocks.
+
+        Prefixes that don't fit (shorter than one full block after
+        clipping to ``max_prompt``) or are already cached are
+        skipped — importing is best-effort by design."""
+        if not self.idle:
+            raise ValueError("import_prefixes needs an idle engine")
+        before = self._alloc.n_cached
+        warmed = 0
+        for i, p in enumerate(prefixes):
+            p = np.asarray(p, np.int32).reshape(-1)
+            end = min(int(p.shape[0]), self.max_prompt - 1)
+            end = (end // self.block) * self.block
+            if end < self.block:
+                continue
+            p = p[:end]
+            if len(self._alloc._trie.lookup_run(p)) * self.block \
+                    >= end:
+                continue
+            res = self.submit(p, max_new=1,
+                              request_id=f"__warm{i}__")
+            if isinstance(res, ShedCompletion):
+                continue
+            warmed += 1
+        if warmed:
+            self.run()
+        return self._alloc.n_cached - before
+
     def stats(self) -> dict:
         issued = self._round_capacity
         out = {
@@ -1786,6 +1824,50 @@ class ServingEngine:
                 backlog += max(int(self._end[s]) - int(self._pos[s]),
                                0)
         return backlog
+
+    def _ahead_tokens(self, req: Request) -> Optional[int]:
+        """Queued token budget the ADMISSION POLICY would serve before
+        ``req`` — the deadline feasibility check's honest wait basis.
+
+        The controller's predictor used to charge every arrival the
+        WHOLE queue's drain; under any policy that can serve the new
+        request early (deadline slack, short prompt, priority) that
+        over-states its wait and sheds feasible requests — observed as
+        ``--max-queue 0`` traffic shedding "deadline" off a backlog it
+        would never stand behind.  This conditions the wait on the
+        request's predicted queue POSITION: sum only requests the
+        policy ranks ahead of it.  FCFS keeps the whole queue
+        (position = tail); a custom callable policy returns ``None``
+        (unknown ordering — fall back to the conservative whole-queue
+        charge)."""
+        if self._policy is _fcfs:
+            return sum(int(r.max_new) for r in self._queue)
+        if self._policy is _spf:
+            plen = int(req.prompt.shape[0])
+            return sum(int(r.max_new) for r in self._queue
+                       if int(r.prompt.shape[0]) <= plen)
+        if self._policy is _deadline:
+            now = time.perf_counter()
+            ctrl = self.admission
+            pred = ctrl.predictor if ctrl is not None else None
+
+            def key(i, r):
+                if r.deadline is None:
+                    return (r.priority, 1, 0.0, i)
+                rem = pred.predict_remaining(r.max_new) \
+                    if pred is not None else None
+                slack = (r.deadline - now) \
+                    - (rem if rem is not None else 0.0)
+                return (r.priority, 0, slack, i)
+
+            mine = key(len(self._queue), req)
+            return sum(int(r.max_new)
+                       for i, r in enumerate(self._queue)
+                       if key(i, r) < mine)
+        if self._policy is _wfq:
+            return sum(int(r.max_new) for r in self._queue
+                       if int(r.priority) <= int(req.priority))
+        return None
 
     def _retry_after(self) -> Optional[float]:
         """Predicted seconds until the current backlog drains (the

@@ -1,0 +1,1111 @@
+"""Fault-tolerant serving fleet: prefix-aware routing over N replicas.
+
+One :class:`~chainermn_tpu.serving.engine.ServingEngine` is a single
+point of failure — the exact all-or-nothing fault model the training
+side spent three PRs burying (fallback resume, elastic membership,
+live resize).  This module is the serving tier's counterpart: a
+:class:`FleetRouter` fronting N in-process engine replicas, built
+FAILURE-FIRST — a replica dying, flapping, or browning out is an
+absorbed event, not an outage.
+
+**Routing.**  Placement is prefix-cache-aware: a request is scored
+against each replica's :class:`~chainermn_tpu.serving.prefix_cache.
+PrefixTrie` (how many leading full blocks of its prompt are already
+cached there) and routed to the replica that can skip the most
+prefill.  COLD prefixes (no trie evidence anywhere yet — the first
+wave of a new system prompt lands before any prefill completes) are
+anchored by a deterministic hash of the prompt's leading block, so
+the wave converges on one replica instead of scattering by load-race;
+ties beyond that fall to per-replica
+:class:`~chainermn_tpu.serving.admission.
+ServiceTimePredictor`-fed least-loaded fallback and session affinity
+for multi-turn traffic (``submit(session=...)`` sticks to the replica
+whose cache holds the conversation).  ``placement="round_robin"`` and
+``"oblivious"`` (least-loaded only, cache-blind) exist as bench
+baselines.
+
+**Health.**  Each replica runs a watchdog-style state machine —
+``healthy → suspect → dead → rejoining`` — driven by its step
+heartbeat: a step that raises (or overruns ``dead_after``) kills the
+replica; one that overruns ``suspect_after`` marks it suspect, and
+``suspect_strikes`` consecutive slow steps escalate to dead.  A
+revived replica REJOINS under flap damping: the hold before it takes
+traffic again grows exponentially with its death count, so a flapping
+replica converges to out-of-rotation instead of whipsawing the
+placement signal.
+
+**Failover.**  Replica death is a first-class path, not an exception:
+queued requests migrate to a survivor through the PR 12
+``export_queue``/``import_queue`` primitives (timestamps intact);
+ACTIVE rows are salvaged from the dead engine's host token mirror —
+their committed greedy prefix becomes part of the re-dispatch prompt,
+so the survivor re-prefills cheaply (prefix cache) and continues the
+EXACT solo decode (committed prefix + re-dispatched suffix is
+token-bitwise the oracle, pinned by drill).  Completion delivery is
+idempotent: the fleet delivers each request id exactly once, whatever
+hedges, retries, or failovers raced.
+
+**Retries and hedging.**  Failure-driven re-dispatches take bounded
+exponential backoff AND a fleet-wide :class:`RetryBudget` (the gRPC
+token-bucket shape: capacity spent per retry, refilled per success) —
+a persistent failure burns the budget and degrades to shedding
+instead of amplifying into a retry storm.  Optional HEDGED dispatch
+covers the tail: a request outstanding past ``hedge_after`` seconds
+is duplicated onto a second replica, first completion wins, and the
+loser is cancelled through ``cancel(rid)`` (greedy decode makes the
+copies token-identical, so hedging never changes output).
+
+**Degradation.**  Fleet admission folds the per-replica predictors
+into one global decision: when the predicted fleet-wide queue wait
+exceeds ``brown_out_after``, below-tier priority classes are shed
+``"overload"`` at the door — a brown-out shorts low-priority traffic
+instead of timing everyone out.
+
+Observability rides the existing planes: ``fleet/route``,
+``fleet/failover``, ``fleet/hedge_won`` / ``fleet/hedge_lost``,
+``fleet/retries``, ``fleet/sheds`` counters and the
+``fleet/replica_state`` gauge in the metrics registry, a
+``fleet/failover`` span and ``fleet/replica_state`` transition
+markers in the flight recorder, and :meth:`FleetRouter.status` as a
+statusz section (``server.add_section("fleet", router)``).  Chaos
+drills script replica kill/slow/flap through
+:meth:`~chainermn_tpu.testing.FaultInjector.attach_fleet`.  See
+docs/SERVING.md "Fleet" and docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from chainermn_tpu.utils.metrics import get_registry
+from chainermn_tpu.utils.telemetry import get_recorder
+
+from .admission import ShedCompletion
+from .engine import Completion, Request, ServingEngine
+from .prefix_cache import load_prefix_snapshot, prefix_snapshot
+
+__all__ = ["FleetRouter", "ReplicaHandle", "RetryBudget",
+           "REPLICA_STATES"]
+
+#: The replica health state machine's states, in escalation order.
+REPLICA_STATES = ("healthy", "suspect", "dead", "rejoining")
+
+#: Placement modes (``"prefix"`` is the production one; the others are
+#: bench baselines).
+PLACEMENTS = ("prefix", "round_robin", "oblivious")
+
+
+class RetryBudget:
+    """Fleet-wide retry token bucket (the gRPC retry-throttling
+    shape): every failure-driven re-dispatch or hedge SPENDS one
+    token, every successfully served request REFILLS ``refill``
+    tokens (capped at ``capacity``).  Under a persistent failure the
+    bucket drains and further retries are denied — the router then
+    sheds instead of amplifying the failure into a retry storm.
+    Successes keep a trickle flowing, so isolated failures always
+    retry."""
+
+    def __init__(self, capacity: float = 10.0, refill: float = 0.1):
+        if capacity < 1:
+            raise ValueError(f"capacity={capacity} must be >= 1")
+        if refill < 0:
+            raise ValueError(f"refill={refill} must be >= 0")
+        self.capacity = float(capacity)
+        self.refill = float(refill)
+        self.tokens = float(capacity)
+        self.spent = 0
+        self.denied = 0
+
+    def on_success(self) -> None:
+        self.tokens = min(self.capacity, self.tokens + self.refill)
+
+    def try_spend(self) -> bool:
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            self.spent += 1
+            return True
+        self.denied += 1
+        return False
+
+    def snapshot(self) -> dict:
+        return {"capacity": self.capacity, "refill": self.refill,
+                "tokens": self.tokens, "spent": self.spent,
+                "denied": self.denied}
+
+
+class ReplicaHandle:
+    """One replica's router-side identity: the engine, its health
+    state, and the flap-damping history.  The router owns every
+    transition; the handle is bookkeeping."""
+
+    def __init__(self, name: str, engine: ServingEngine):
+        self.name = str(name)
+        self.engine = engine
+        self.state = "healthy"
+        self.slow_strikes = 0       # consecutive suspect-slow steps
+        self.deaths = 0             # lifetime kill count (flap signal)
+        self.rejoin_at: Optional[int] = None   # fleet step gate
+        self.rejoin_hold = 0        # the damped hold last applied
+        self.steps = 0
+        self.step_seconds = 0.0
+        self.last_error = ""
+
+    @property
+    def alive(self) -> bool:
+        return self.state != "dead"
+
+    def taking_traffic(self, fleet_step: int) -> bool:
+        """Whether placement may target this replica now: healthy or
+        suspect (degraded but serving); a rejoining replica holds
+        until its damped gate expires."""
+        if self.state in ("healthy", "suspect"):
+            return True
+        if self.state == "rejoining":
+            return self.rejoin_at is not None \
+                and fleet_step >= self.rejoin_at
+        return False
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state,
+            "deaths": self.deaths,
+            "steps": self.steps,
+            "step_seconds": self.step_seconds,
+            "rejoin_at": self.rejoin_at,
+            "rejoin_hold": self.rejoin_hold,
+            "queue_depth": len(self.engine._queue),
+            "active": self.engine.n_active,
+            "last_error": self.last_error,
+        }
+
+
+@dataclasses.dataclass(eq=False)
+class _Flight:
+    """Router-side state of one in-flight fleet request.
+
+    ``committed`` is the salvaged greedy prefix (tokens the request
+    had generated on a replica that later died); ``dispatches`` maps
+    replica name -> ``{"kind": "primary"|"hedge"|"migrated",
+    "base": n}`` where ``base`` is how many committed tokens were
+    folded into THAT dispatch's prompt (delivery re-prepends
+    ``committed[:base]`` so merged output is the full stream)."""
+
+    fid: str
+    prompt: np.ndarray
+    max_new: int
+    priority: int = 0
+    tenant: Optional[str] = None
+    deadline: Optional[float] = None
+    session: Optional[str] = None
+    sampling: Optional[object] = None
+    t_submit: float = 0.0
+    t_dispatch: float = 0.0
+    committed: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int32))
+    dispatches: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    hedged: bool = False
+    retries: int = 0
+    not_before: float = 0.0
+    cancel_requested: bool = False
+
+
+class FleetRouter:
+    """Prefix-aware, failure-absorbing router over N in-process
+    :class:`~chainermn_tpu.serving.engine.ServingEngine` replicas.
+
+    Args:
+      engines: the replica engines (>= 1; homogeneous configs are
+        assumed for placement math but not enforced).
+      names: replica names (default ``replica0..N-1``).
+      placement: ``"prefix"`` (cache-aware, the default),
+        ``"round_robin"``, or ``"oblivious"`` (least-loaded only).
+      hedge_after: seconds an un-completed request waits before a
+        duplicate dispatch to a second replica (``None`` disables
+        hedging).  The loser is cancelled; delivery stays
+        exactly-once.
+      retry_budget: the fleet-wide :class:`RetryBudget` (one is
+        created by default).  Hedges and failure-driven retries spend
+        it; successes refill it.
+      max_retries: per-request cap on failure-driven re-dispatches.
+      backoff_base / backoff_cap: bounded exponential backoff between
+        a request's retries (``base * 2**(retries-1)``, capped).
+      suspect_after: a replica step slower than this (seconds) marks
+        it suspect; ``suspect_strikes`` consecutive slow steps
+        escalate to dead.  ``None`` disables slowness detection.
+      dead_after: a step slower than this is an immediate death
+        (hard watchdog deadline; ``None`` disables).
+      rejoin_hold: base fleet-step hold before a revived replica
+        takes traffic again.
+      flap_damping: hold multiplier per prior death — the k-th rejoin
+        holds ``rejoin_hold * flap_damping**(k-1)`` steps (capped at
+        ``max_hold``), so a flapping replica converges out of
+        rotation.
+      brown_out_after: predicted fleet-wide queue wait (seconds,
+        folded from the per-replica predictors) beyond which arriving
+        requests with ``priority > protect_priority`` are shed
+        ``"overload"`` at the door.  ``None`` disables.
+      protect_priority: the most-important class still sheltered from
+        brown-out shedding (default 0, matching
+        ``AdmissionController``).
+      warm_on_rejoin: import the dead replica's CRC-guarded prefix
+        snapshot when reviving it, so it rejoins warm and the
+        placement signal survives the failover.
+      clock: time source (``time.perf_counter``); injectable for
+        deterministic drills.
+    """
+
+    def __init__(self, engines: Sequence[ServingEngine], *,
+                 names: Optional[Sequence[str]] = None,
+                 placement: str = "prefix",
+                 hedge_after: Optional[float] = None,
+                 retry_budget: Optional[RetryBudget] = None,
+                 max_retries: int = 3,
+                 backoff_base: float = 0.0,
+                 backoff_cap: float = 1.0,
+                 suspect_after: Optional[float] = None,
+                 dead_after: Optional[float] = None,
+                 suspect_strikes: int = 2,
+                 rejoin_hold: int = 2,
+                 flap_damping: float = 2.0,
+                 max_hold: int = 64,
+                 brown_out_after: Optional[float] = None,
+                 protect_priority: int = 0,
+                 warm_on_rejoin: bool = True,
+                 clock=time.perf_counter):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        if placement not in PLACEMENTS:
+            raise ValueError(
+                f"placement={placement!r} not in {PLACEMENTS}")
+        if names is None:
+            names = [f"replica{i}" for i in range(len(engines))]
+        if len(names) != len(engines) or len(set(names)) != len(names):
+            raise ValueError("names must be unique, one per engine")
+        if hedge_after is not None and hedge_after < 0:
+            raise ValueError(f"hedge_after={hedge_after} must be >= 0")
+        if max_retries < 0:
+            raise ValueError(f"max_retries={max_retries} must be >= 0")
+        if suspect_strikes < 1:
+            raise ValueError(
+                f"suspect_strikes={suspect_strikes} must be >= 1")
+        if rejoin_hold < 0 or max_hold < rejoin_hold:
+            raise ValueError(
+                f"need 0 <= rejoin_hold ({rejoin_hold}) <= max_hold "
+                f"({max_hold})")
+        if flap_damping < 1.0:
+            raise ValueError(
+                f"flap_damping={flap_damping} must be >= 1 (damping "
+                "never shortens the hold)")
+        self.replicas = [ReplicaHandle(n, e)
+                         for n, e in zip(names, engines)]
+        self._by_name = {h.name: h for h in self.replicas}
+        self.placement = placement
+        self.hedge_after = hedge_after
+        self.retry_budget = retry_budget or RetryBudget()
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.suspect_after = suspect_after
+        self.dead_after = dead_after
+        self.suspect_strikes = int(suspect_strikes)
+        self.rejoin_hold = int(rejoin_hold)
+        self.flap_damping = float(flap_damping)
+        self.max_hold = int(max_hold)
+        self.brown_out_after = brown_out_after
+        self.protect_priority = int(protect_priority)
+        self.warm_on_rejoin = bool(warm_on_rejoin)
+        self._clock = clock
+        self.step_count = 0
+        self._rr = 0
+        self._next_fid = 0
+        self._flights: Dict[str, _Flight] = {}
+        self._pending: List[str] = []
+        # terminal records produced OUTSIDE a step() heartbeat
+        # (dispatch-time sheds, pending cancels) park here until the
+        # next step() drains them — every asynchronous terminal flows
+        # through the step() stream exactly once
+        self._outbox: List[Union[Completion, ShedCompletion]] = []
+        self._delivered: set = set()
+        self._records: List[Union[Completion, ShedCompletion]] = []
+        self._sessions: Dict[str, str] = {}
+        self._snapshots: Dict[str, dict] = {}
+        self.n_failovers = 0
+        self.n_hedges = 0
+        self.n_hedge_won = 0
+        self.n_hedge_lost = 0
+        self.n_retries = 0
+        self.n_sheds = 0
+        self.n_migrated = 0
+
+    # ------------------------------------------------------------------ #
+    # submission / cancellation
+    # ------------------------------------------------------------------ #
+
+    @property
+    def n_healthy(self) -> int:
+        return sum(h.state in ("healthy", "suspect")
+                   for h in self.replicas)
+
+    @property
+    def idle(self) -> bool:
+        return not self._flights and not self._outbox
+
+    def submit(self, prompt, max_new: Optional[int] = None, *,
+               priority: int = 0, tenant: Optional[str] = None,
+               deadline: Optional[float] = None,
+               timeout: Optional[float] = None,
+               session: Optional[str] = None,
+               sampling=None) -> Union[str, ShedCompletion]:
+        """Queue one request with the fleet; returns its fleet id
+        (``f<n>``) — or a reason-coded
+        :class:`~chainermn_tpu.serving.admission.ShedCompletion` when
+        fleet admission turns it away (brown-out).  The id doubles as
+        the per-replica engine request id, so it is the ONE identity a
+        request carries across failovers, hedges and migrations.
+
+        ``session`` names a multi-turn conversation: later submits
+        with the same session stick to the replica whose prefix cache
+        holds the earlier turns (re-learned on failover)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        now = self._clock()
+        if timeout is not None:
+            if deadline is not None:
+                raise ValueError("give deadline= OR timeout=, not both")
+            if timeout <= 0:
+                raise ValueError(f"timeout={timeout} must be > 0")
+            deadline = now + timeout
+        if max_new is None:
+            max_new = self.replicas[0].engine.default_max_new
+        fid = f"f{self._next_fid}"
+        self._next_fid += 1
+        fl = _Flight(fid=fid, prompt=prompt, max_new=int(max_new),
+                     priority=int(priority), tenant=tenant,
+                     deadline=deadline, session=session,
+                     sampling=sampling, t_submit=now)
+        reason = self._fleet_admission(fl)
+        if reason is not None:
+            return self._shed_flight(fl, reason,
+                                     detail="fleet brown-out: predicted "
+                                            "queue wait over threshold")
+        self._flights[fid] = fl
+        self._pending.append(fid)
+        self._dispatch_pending()
+        return fid
+
+    def cancel(self, fid: str) -> bool:
+        """Cancel a live fleet request on every replica carrying a
+        copy; a pending (undispatched) request sheds ``"cancelled"``
+        immediately.  False when the id is not live."""
+        fl = self._flights.get(fid)
+        if fl is None:
+            return False
+        fl.cancel_requested = True
+        if not fl.dispatches:
+            self._pending = [f for f in self._pending if f != fid]
+            rec = self._shed_flight(fl, "cancelled")
+            del self._flights[fid]
+            self._outbox.append(rec)
+            return True
+        for name in list(fl.dispatches):
+            h = self._by_name[name]
+            if h.alive:
+                try:
+                    h.engine.cancel(fid)
+                except Exception:   # noqa: BLE001 — dying replica
+                    pass
+        return True
+
+    # ------------------------------------------------------------------ #
+    # fleet admission (graceful degradation)
+    # ------------------------------------------------------------------ #
+
+    def predicted_queue_wait(self) -> Optional[float]:
+        """The global queue-wait estimate fleet admission keys on:
+        total live backlog tokens (every serving replica's queue +
+        active remainders, plus the router's own pending requests)
+        drained at the fleet's aggregate decode rate, with the TPOT
+        folded from the per-replica predictors.  ``None`` while no
+        replica has evidence — shedding needs evidence, fleet-wide
+        exactly like per-engine."""
+        serving = [h for h in self.replicas
+                   if h.state in ("healthy", "suspect")]
+        if not serving:
+            return None
+        backlog = 0
+        slots = 0
+        tpots = []
+        for h in serving:
+            backlog += h.engine._backlog_tokens()
+            slots += h.engine.n_slots
+            ctrl = h.engine.admission
+            if ctrl is not None:
+                t = ctrl.predictor.tpot()
+                if t is not None:
+                    tpots.append(t)
+        for fid in self._pending:
+            backlog += self._flights[fid].max_new
+        if not tpots:
+            return None
+        return (sum(tpots) / len(tpots)) * backlog / max(slots, 1)
+
+    def _fleet_admission(self, fl: _Flight) -> Optional[str]:
+        if self.brown_out_after is None:
+            return None
+        if fl.priority <= self.protect_priority:
+            return None
+        wait = self.predicted_queue_wait()
+        if wait is not None and wait > self.brown_out_after:
+            return "overload"
+        return None
+
+    # ------------------------------------------------------------------ #
+    # placement
+    # ------------------------------------------------------------------ #
+
+    def _prefix_score(self, h: ReplicaHandle,
+                      prompt: np.ndarray) -> int:
+        """Cached leading full blocks of ``prompt`` in the replica's
+        trie — the prefill this placement would skip."""
+        try:
+            return len(h.engine._alloc._trie.lookup_run(prompt))
+        except Exception:       # noqa: BLE001 — scoring must not kill
+            return 0
+
+    def _load_score(self, h: ReplicaHandle) -> float:
+        """Predicted seconds of queue wait on this replica (its own
+        predictor's TPOT over its live backlog); falls back to raw
+        backlog tokens per slot while the predictor is cold."""
+        eng = h.engine
+        backlog = eng._backlog_tokens()
+        tpot = None
+        if eng.admission is not None:
+            tpot = eng.admission.predictor.tpot()
+        if tpot is None:
+            return backlog / max(eng.n_slots, 1)
+        return tpot * backlog / max(eng.n_slots, 1)
+
+    def _placement_order(self, fl: _Flight,
+                         exclude: Sequence[str] = ()
+                         ) -> List[ReplicaHandle]:
+        cands = [h for h in self.replicas
+                 if h.taking_traffic(self.step_count)
+                 and h.name not in exclude]
+        if not cands:
+            return []
+        if self.placement == "round_robin":
+            k = self._rr % len(cands)
+            self._rr += 1
+            return cands[k:] + cands[:k]
+        order = {h.name: i for i, h in enumerate(self.replicas)}
+        if self.placement == "oblivious":
+            ranked = sorted(
+                cands, key=lambda h: (self._load_score(h),
+                                      order[h.name]))
+        else:                           # "prefix"
+            block = max(self.replicas[0].engine.block, 1)
+            full = fl.prompt.shape[0] // block
+            # deterministic hash affinity anchors COLD prefixes: the
+            # first wave of a new system prompt lands before any
+            # prefill has populated a trie, so trie evidence alone
+            # would scatter it by load (whoever wins the race keeps
+            # the prefix) — hashing the leading block gives every
+            # replica-set member the same verdict from request #1,
+            # and live trie evidence still dominates once it exists
+            if full >= 1:
+                lead = np.ascontiguousarray(
+                    fl.prompt[:block], np.int32).tobytes()
+                anchor = zlib.crc32(lead) % len(self.replicas)
+            else:
+                anchor = None
+            anchor_name = (self.replicas[anchor].name
+                           if anchor is not None else None)
+            ranked = sorted(
+                cands,
+                key=lambda h: (-min(self._prefix_score(h, fl.prompt),
+                                    full),
+                               h.name != anchor_name,
+                               self._load_score(h), order[h.name]))
+            sticky = self._sessions.get(fl.session)
+            if sticky is not None:
+                home = self._by_name.get(sticky)
+                if home is not None and home in ranked:
+                    ranked.remove(home)
+                    ranked.insert(0, home)
+        return ranked
+
+    # ------------------------------------------------------------------ #
+    # dispatch
+    # ------------------------------------------------------------------ #
+
+    def _dispatch(self, fl: _Flight, h: ReplicaHandle,
+                  kind: str) -> Optional[ShedCompletion]:
+        """Submit the flight to one replica.  Returns ``None`` on
+        success or the engine's ShedCompletion on rejection (the
+        caller tries the next candidate)."""
+        base = int(fl.committed.shape[0])
+        prompt = fl.prompt
+        remaining = fl.max_new - base
+        if base:
+            prompt = np.concatenate([fl.prompt, fl.committed])
+            if prompt.shape[0] > h.engine.max_prompt:
+                # the committed prefix no longer fits as prompt —
+                # re-decode from scratch (greedy: same tokens)
+                prompt, base, remaining = fl.prompt, 0, fl.max_new
+        res = h.engine.submit(prompt, max_new=max(remaining, 1),
+                              request_id=fl.fid,
+                              priority=fl.priority, tenant=fl.tenant,
+                              deadline=fl.deadline,
+                              sampling=fl.sampling)
+        if isinstance(res, ShedCompletion):
+            return res
+        fl.dispatches[h.name] = {"kind": kind, "base": base}
+        fl.t_dispatch = self._clock()
+        if fl.session is not None:
+            self._sessions[fl.session] = h.name
+        get_registry().inc("fleet/route")
+        return None
+
+    def _dispatch_pending(self) -> None:
+        if not self._pending:
+            return
+        now = self._clock()
+        if not any(h.alive for h in self.replicas):
+            # total outage: fail fast rather than queue into the void
+            for fid in list(self._pending):
+                fl = self._flights.pop(fid)
+                self._outbox.append(self._shed_flight(
+                    fl, "overload", detail="no live replicas"))
+            self._pending.clear()
+            return
+        still: List[str] = []
+        for fid in self._pending:
+            fl = self._flights[fid]
+            if fl.not_before > now:
+                still.append(fid)
+                continue
+            order = self._placement_order(fl)
+            if not order:
+                still.append(fid)       # all holds; retry next step
+                continue
+            last_shed = None
+            placed = False
+            for h in order:
+                shed = self._dispatch(fl, h, kind="primary")
+                if shed is None:
+                    placed = True
+                    break
+                last_shed = shed
+            if placed:
+                continue
+            # every candidate replica refused — the fleet verdict is
+            # the last engine's reason-coded shed
+            del self._flights[fid]
+            last_shed.t_submit = fl.t_submit
+            self._deliver_record(fl, last_shed)
+            self._outbox.append(last_shed)
+        self._pending = still
+
+    # ------------------------------------------------------------------ #
+    # stepping, health, delivery
+    # ------------------------------------------------------------------ #
+
+    def _step_replica(self, h: ReplicaHandle):
+        """One replica heartbeat — separated out so
+        ``FaultInjector.attach_fleet`` can wrap it (kill/slow/flap
+        drills) without the router knowing it is under test."""
+        return h.engine.step()
+
+    def step(self) -> List[Union[Completion, ShedCompletion]]:
+        """One fleet iteration: promote rejoiners whose hold expired,
+        dispatch pending requests, heartbeat every live replica
+        (collecting and delivering its terminal records), fail over
+        any replica that died this tick, then hedge the stragglers.
+        Returns this iteration's fleet-level terminal records —
+        each fleet id appears EXACTLY ONCE across all steps."""
+        self.step_count += 1
+        out: List[Union[Completion, ShedCompletion]] = []
+        if self._outbox:
+            out.extend(self._outbox)
+            self._outbox.clear()
+        self._promote_rejoining()
+        self._dispatch_pending()
+        died: List[ReplicaHandle] = []
+        for h in self.replicas:
+            if not h.alive:
+                continue
+            t0 = self._clock()
+            try:
+                recs = self._step_replica(h)
+            except Exception as err:    # noqa: BLE001 — that IS death
+                h.last_error = f"{type(err).__name__}: {err}"
+                self._set_state(h, "dead")
+                h.deaths += 1
+                died.append(h)
+                continue
+            dt = self._clock() - t0
+            h.steps += 1
+            h.step_seconds += dt
+            if self._note_step_health(h, dt):
+                died.append(h)
+            for r in recs:
+                self._deliver(h, r, out)
+        for h in died:
+            self._failover(h, out)
+        self._hedge_scan(out)
+        if self._outbox:                # sheds parked mid-step
+            out.extend(self._outbox)
+            self._outbox.clear()
+        return out
+
+    def run(self, max_steps: Optional[int] = None
+            ) -> List[Union[Completion, ShedCompletion]]:
+        """Drive :meth:`step` until every submitted request has been
+        delivered (or ``max_steps`` elapsed)."""
+        out: List[Union[Completion, ShedCompletion]] = []
+        steps = 0
+        while not self.idle:
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    def _note_step_health(self, h: ReplicaHandle, dt: float) -> bool:
+        """Heartbeat verdict for one completed step; True when the
+        replica just died (deadline overrun / strike-out)."""
+        if self.dead_after is not None and dt > self.dead_after:
+            h.last_error = (f"step overran the {self.dead_after}s "
+                            "death deadline")
+            self._set_state(h, "dead")
+            h.deaths += 1
+            return True
+        if self.suspect_after is not None and dt > self.suspect_after:
+            h.slow_strikes += 1
+            if h.state == "healthy":
+                self._set_state(h, "suspect")
+            if h.slow_strikes >= self.suspect_strikes:
+                h.last_error = (f"{h.slow_strikes} consecutive steps "
+                                f"over the {self.suspect_after}s "
+                                "suspect threshold")
+                self._set_state(h, "dead")
+                h.deaths += 1
+                return True
+            return False
+        h.slow_strikes = 0
+        if h.state == "suspect":
+            self._set_state(h, "healthy")
+        return False
+
+    def _set_state(self, h: ReplicaHandle, state: str) -> None:
+        if h.state == state:
+            return
+        h.state = state
+        reg = get_registry()
+        reg.set("fleet/replica_state", float(self.n_healthy))
+        get_recorder().instant("fleet/replica_state", cat="fleet",
+                               replica=h.name, state=state,
+                               deaths=h.deaths)
+
+    def _promote_rejoining(self) -> None:
+        for h in self.replicas:
+            if h.state == "rejoining" and h.rejoin_at is not None \
+                    and self.step_count >= h.rejoin_at:
+                h.slow_strikes = 0
+                self._set_state(h, "healthy")
+
+    # ------------------------------------------------------------------ #
+    # failover
+    # ------------------------------------------------------------------ #
+
+    def _salvage_active(self, h: ReplicaHandle) -> Dict[str, dict]:
+        """Read the dead engine's host mirrors: per live row, the
+        committed greedy tokens (positions ``[plen, pos]`` of its
+        origin-0 lane) and terminal status — the committed log a
+        re-dispatch continues from.  Best-effort: an unreadable
+        engine salvages nothing (those rows retry from scratch)."""
+        eng = h.engine
+        salvaged: Dict[str, dict] = {}
+        try:
+            for s in range(eng.n_slots):
+                req = eng._slot_req[s]
+                if req is None:
+                    continue
+                try:
+                    row = np.asarray(eng._buf[s])
+                    gen = np.array(
+                        row[int(eng._plen[s]): int(eng._pos[s]) + 1],
+                        np.int32)
+                except Exception:   # noqa: BLE001 — device state gone
+                    gen = np.zeros((0,), np.int32)
+                salvaged[req.rid] = {
+                    "tokens": gen,
+                    "done": bool(eng._done[s]),
+                    "status": eng._slot_status[s],
+                }
+        except Exception:           # noqa: BLE001 — salvage is bonus
+            pass
+        return salvaged
+
+    def _failover(self, h: ReplicaHandle,
+                  out: List[Union[Completion, ShedCompletion]]) -> None:
+        """Absorb one replica death: snapshot its prefix cache (for a
+        warm rejoin), migrate its queued requests to a survivor via
+        ``export_queue``/``import_queue``, re-dispatch its active
+        rows from their committed prefixes, then reset the engine so
+        a later revive starts clean."""
+        now = self._clock()
+        rec = get_recorder()
+        reg = get_registry()
+        with rec.span("fleet/failover", cat="fleet", replica=h.name,
+                      step=self.step_count):
+            self.n_failovers += 1
+            reg.inc("fleet/failover")
+            try:
+                self._snapshots[h.name] = prefix_snapshot(
+                    h.engine._alloc)
+            except Exception:       # noqa: BLE001 — snapshot is bonus
+                pass
+            salvaged = self._salvage_active(h)
+            try:
+                exported = h.engine.export_queue()
+            except Exception:       # noqa: BLE001
+                exported = []
+            # forget the dead replica's session homes — the next turn
+            # re-learns placement from the survivors' caches
+            for sess, name in list(self._sessions.items()):
+                if name == h.name:
+                    del self._sessions[sess]
+            # --- queued requests migrate wholesale ------------------- #
+            exported = [r for r in exported if self._forget_dispatch(
+                r.rid, h.name)]
+            if exported:
+                target = self._migration_target()
+                migrated = False
+                if target is not None:
+                    try:
+                        target.engine.import_queue(exported)
+                        for r in exported:
+                            fl = self._flights.get(r.rid)
+                            if fl is not None:
+                                fl.dispatches[target.name] = {
+                                    "kind": "migrated",
+                                    "base": self._dispatch_base(fl, r)}
+                        self.n_migrated += len(exported)
+                        migrated = True
+                    except Exception:   # noqa: BLE001 — fall back
+                        pass
+                if not migrated:
+                    # no survivor to adopt the queue: each re-dispatch
+                    # is a failure-driven RETRY, so it pays backoff and
+                    # budget like one — a replica crash-looping alone
+                    # must drain the budget and shed, not spin free
+                    for r in exported:
+                        fl = self._flights.get(r.rid)
+                        if fl is None or r.rid in self._delivered:
+                            continue
+                        self._retry_or_shed(fl, now, out)
+            # --- active rows re-dispatch from their committed log ---- #
+            for rid, info in salvaged.items():
+                fl = self._flights.get(rid)
+                if fl is None or rid in self._delivered:
+                    continue
+                disp = fl.dispatches.pop(h.name, None)
+                if disp is None:
+                    continue
+                candidate = np.concatenate(
+                    [fl.committed[:disp["base"]], info["tokens"]])
+                if candidate.shape[0] > fl.committed.shape[0]:
+                    fl.committed = candidate
+                if fl.dispatches:
+                    continue        # a hedge copy is still running
+                if self._finalize_if_complete(fl, h, out, now):
+                    continue
+                self._retry_or_shed(fl, now, out)
+            try:
+                h.engine.reset()
+            except Exception:       # noqa: BLE001 — engine truly gone
+                pass
+
+    def _forget_dispatch(self, fid: str, replica: str) -> bool:
+        """Drop the dead replica from a flight's dispatch map; True
+        when the flight is still live (needs migration)."""
+        fl = self._flights.get(fid)
+        if fl is None:
+            return False
+        fl.dispatches.pop(replica, None)
+        return fid not in self._delivered
+
+    def _dispatch_base(self, fl: _Flight, req: Request) -> int:
+        """How many committed tokens a migrated queued Request's
+        prompt already folds in (its prompt may be the original or a
+        committed-prefix re-dispatch)."""
+        return max(int(req.prompt.shape[0])
+                   - int(fl.prompt.shape[0]), 0)
+
+    def _migration_target(self) -> Optional[ReplicaHandle]:
+        cands = [h for h in self.replicas
+                 if h.taking_traffic(self.step_count)]
+        if not cands:
+            return None
+        order = {h.name: i for i, h in enumerate(self.replicas)}
+        return min(cands, key=lambda h: (self._load_score(h),
+                                         order[h.name]))
+
+    def _finalize_if_complete(self, fl: _Flight, h: ReplicaHandle,
+                              out: list, now: float) -> bool:
+        """A salvaged committed prefix that already reached EOS or the
+        token budget IS the completion — deliver it instead of
+        re-dispatching a zero-token remainder."""
+        eos = h.engine.eos_id
+        tokens = fl.committed
+        hit_eos = False
+        if eos >= 0:
+            hits = np.nonzero(tokens == eos)[0]
+            if hits.size:
+                tokens = tokens[:int(hits[0]) + 1]
+                hit_eos = True
+        if not hit_eos and tokens.shape[0] < fl.max_new:
+            return False
+        comp = Completion(
+            rid=fl.fid, prompt=fl.prompt, tokens=np.array(tokens),
+            t_submit=fl.t_submit, t_admit=None, t_first=None,
+            t_done=now, slot=-1, status="ok",
+            detail=f"salvaged complete from {h.name}")
+        del self._flights[fl.fid]
+        self.retry_budget.on_success()
+        self._deliver_record(fl, comp)
+        out.append(comp)
+        return True
+
+    def _retry_or_shed(self, fl: _Flight, now: float,
+                       out: list) -> None:
+        """The bounded-backoff, budget-governed retry decision for a
+        flight whose every dispatch just failed."""
+        if fl.cancel_requested:
+            shed = self._shed_flight(fl, "cancelled")
+            del self._flights[fl.fid]
+            out.append(shed)
+            return
+        if fl.retries >= self.max_retries \
+                or not self.retry_budget.try_spend():
+            shed = self._shed_flight(
+                fl, "overload",
+                detail=f"retry budget exhausted after {fl.retries} "
+                       "retries")
+            del self._flights[fl.fid]
+            out.append(shed)
+            return
+        fl.retries += 1
+        self.n_retries += 1
+        get_registry().inc("fleet/retries")
+        backoff = min(self.backoff_cap,
+                      self.backoff_base * (2.0 ** (fl.retries - 1)))
+        fl.not_before = now + backoff
+        if fl.fid not in self._pending:
+            self._pending.append(fl.fid)
+
+    # ------------------------------------------------------------------ #
+    # hedging
+    # ------------------------------------------------------------------ #
+
+    def _hedge_scan(self, out: list) -> None:
+        if self.hedge_after is None:
+            return
+        now = self._clock()
+        for fid, fl in list(self._flights.items()):
+            if fl.hedged or not fl.dispatches \
+                    or len(fl.dispatches) != 1:
+                continue
+            if now - fl.t_dispatch < self.hedge_after:
+                continue
+            order = self._placement_order(
+                fl, exclude=list(fl.dispatches))
+            if not order:
+                continue
+            if not self.retry_budget.try_spend():
+                continue        # budget empty: the tail stays unhedged
+            shed = self._dispatch(fl, order[0], kind="hedge")
+            if shed is None:
+                fl.hedged = True
+                self.n_hedges += 1
+
+    # ------------------------------------------------------------------ #
+    # delivery (exactly-once)
+    # ------------------------------------------------------------------ #
+
+    def _deliver_record(self, fl: _Flight, record) -> None:
+        self._delivered.add(fl.fid)
+        self._records.append(record)
+
+    def _shed_flight(self, fl: _Flight, reason: str,
+                     detail: str = "") -> ShedCompletion:
+        shed = ShedCompletion(
+            rid=fl.fid, prompt=fl.prompt, reason=reason,
+            t_submit=fl.t_submit, t_shed=self._clock(),
+            max_new=fl.max_new, priority=fl.priority,
+            tenant=fl.tenant, detail=detail)
+        self.n_sheds += 1
+        get_registry().inc("fleet/sheds")
+        self._deliver_record(fl, shed)
+        return shed
+
+    def _deliver(self, h: ReplicaHandle, record, out: list) -> None:
+        """Translate one replica terminal record into the fleet's
+        exactly-once stream.  Loser copies (hedge/cancel races) and
+        records for already-delivered ids are absorbed silently."""
+        fid = getattr(record, "rid", None)
+        fl = self._flights.get(fid)
+        if fl is None or fid in self._delivered:
+            return                      # stray: already settled
+        disp = fl.dispatches.pop(h.name, None)
+        if isinstance(record, ShedCompletion):
+            # a queue-side termination on ONE replica.  If another
+            # copy is still live the request is not over; if the shed
+            # was the only copy, it is the fleet verdict.
+            if fl.dispatches:
+                return
+            if record.reason == "cancelled" \
+                    and not fl.cancel_requested:
+                # cancelled as a hedge loser, but no live copy left —
+                # re-dispatch rather than losing the request
+                self._retry_or_shed(fl, self._clock(), out)
+                return
+            del self._flights[fid]
+            record.t_submit = fl.t_submit
+            self.n_sheds += 1
+            get_registry().inc("fleet/sheds")
+            self._deliver_record(fl, record)
+            out.append(record)
+            return
+        status = record.status
+        if status == "cancelled" and not fl.cancel_requested:
+            # hedge loser evicted after losing the race
+            if not fl.dispatches:
+                self._retry_or_shed(fl, self._clock(), out)
+            return
+        if status == "quarantined":
+            # replica-side failure of THIS request; other slots kept
+            # serving, so the replica is fine — retry elsewhere unless
+            # a copy is still live
+            base = disp["base"] if disp else 0
+            candidate = np.concatenate(
+                [fl.committed[:base], record.tokens])
+            if candidate.shape[0] > fl.committed.shape[0]:
+                fl.committed = candidate
+            if not fl.dispatches:
+                self._retry_or_shed(fl, self._clock(), out)
+            return
+        # "ok" / "timeout" / caller-asked "cancelled": the verdict.
+        base = disp["base"] if disp else 0
+        if base:
+            record.tokens = np.concatenate(
+                [fl.committed[:base], record.tokens])
+            eos = h.engine.eos_id
+            if eos >= 0:
+                hits = np.nonzero(record.tokens == eos)[0]
+                if hits.size:
+                    record.tokens = record.tokens[:int(hits[0]) + 1]
+        record.t_submit = fl.t_submit
+        losers = list(fl.dispatches)
+        del self._flights[fid]
+        self._deliver_record(fl, record)
+        out.append(record)
+        if status == "ok":
+            self.retry_budget.on_success()
+        if fl.hedged and disp is not None:
+            reg = get_registry()
+            if disp["kind"] == "hedge":
+                self.n_hedge_won += 1
+                reg.inc("fleet/hedge_won")
+            else:
+                self.n_hedge_lost += 1
+                reg.inc("fleet/hedge_lost")
+        for name in losers:
+            loser = self._by_name.get(name)
+            if loser is not None and loser.alive:
+                try:
+                    loser.engine.cancel(fid)
+                except Exception:   # noqa: BLE001 — racing a death
+                    pass
+
+    # ------------------------------------------------------------------ #
+    # revive / rejoin
+    # ------------------------------------------------------------------ #
+
+    def revive(self, name: str, *, engine: Optional[ServingEngine]
+               = None, warm: Optional[dict] = None) -> ReplicaHandle:
+        """Bring a dead replica back as REJOINING: it heartbeats
+        immediately but takes no traffic until its flap-damped hold
+        expires (``rejoin_hold * flap_damping**(deaths-1)`` fleet
+        steps, capped at ``max_hold`` — a flapping replica waits
+        exponentially longer each time).  ``engine`` swaps in a
+        replacement engine (a real restart); by default the reset
+        original is reused.  ``warm`` imports a prefix snapshot
+        (default: the one taken at death, when ``warm_on_rejoin``) so
+        the replica rejoins with its placement signal intact."""
+        h = self._by_name[name]
+        if h.state != "dead":
+            raise ValueError(f"replica {name!r} is {h.state}, not dead")
+        if engine is not None:
+            h.engine = engine
+        hold = self.rejoin_hold * (
+            self.flap_damping ** max(h.deaths - 1, 0))
+        h.rejoin_hold = min(self.max_hold, int(math.ceil(hold)))
+        h.rejoin_at = self.step_count + h.rejoin_hold
+        h.slow_strikes = 0
+        h.last_error = ""
+        self._set_state(h, "rejoining")
+        payload = warm if warm is not None else (
+            self._snapshots.get(name) if self.warm_on_rejoin else None)
+        if payload:
+            try:
+                prefixes = load_prefix_snapshot(payload)
+                if prefixes:
+                    h.engine.import_prefixes(prefixes)
+            except ValueError:
+                pass        # corrupt snapshot: rejoin cold, not crash
+        return h
+
+    # ------------------------------------------------------------------ #
+    # reporting
+    # ------------------------------------------------------------------ #
+
+    def request_records(self) -> List[Union[Completion,
+                                            ShedCompletion]]:
+        """Every delivered fleet-level terminal record, in delivery
+        order — each fleet id exactly once (the idempotent-delivery
+        contract), with fleet-honest ``t_submit`` whatever replica
+        served it."""
+        return list(self._records)
+
+    def stats(self) -> dict:
+        return {
+            "placement": self.placement,
+            "steps": self.step_count,
+            "replicas": {h.name: h.snapshot() for h in self.replicas},
+            "n_healthy": self.n_healthy,
+            "inflight": len(self._flights),
+            "pending": len(self._pending),
+            "delivered": len(self._delivered),
+            "failovers": self.n_failovers,
+            "migrated": self.n_migrated,
+            "hedges": self.n_hedges,
+            "hedge_won": self.n_hedge_won,
+            "hedge_lost": self.n_hedge_lost,
+            "retries": self.n_retries,
+            "sheds": self.n_sheds,
+            "retry_budget": self.retry_budget.snapshot(),
+            "predicted_queue_wait": self.predicted_queue_wait(),
+        }
+
+    def status(self) -> dict:
+        """The statusz section form (``server.add_section("fleet",
+        router)`` binds this)."""
+        return self.stats()

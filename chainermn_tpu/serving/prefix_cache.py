@@ -45,11 +45,15 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import json
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["PrefixTrie", "RefcountedBlockPool", "StagePlan"]
+__all__ = ["PrefixTrie", "RefcountedBlockPool", "StagePlan",
+           "prefix_snapshot", "load_prefix_snapshot",
+           "PREFIX_SNAPSHOT_VERSION"]
 
 
 def _prefix_key(tokens: np.ndarray, end: int) -> bytes:
@@ -525,3 +529,70 @@ class RefcountedBlockPool:
                 f"pool imbalance: {len(self._free)} free + "
                 f"{len(self._refs)} held != {self.n_blocks}")
         return problems
+
+
+# --------------------------------------------------------------------- #
+# cache snapshot (export / import, CRC-guarded)
+# --------------------------------------------------------------------- #
+#
+# A restarted or rejoining replica starting COLD is a double loss: it
+# pays re-prefill for every request the dead replica had cached, and
+# the fleet router's prefix-placement signal goes dark exactly when
+# traffic is being re-balanced.  The snapshot is the fix: the trie's
+# cached prefixes travel as plain token lists (the trie key IS the
+# whole token prefix, so the map reconstructs from tokens alone — no
+# block ids, which are meaningless across a reset pool).  Only MAXIMAL
+# prefixes ship; re-inserting a maximal prefix re-creates every
+# ancestor block.  Like the autotune plan, the payload carries a
+# format version (unknown -> empty, never crash) and a CRC32 over the
+# canonical content (corruption -> ValueError, never silent garbage).
+
+PREFIX_SNAPSHOT_VERSION = 1
+
+
+def _snapshot_crc(block: int, prefixes: List[List[int]]) -> int:
+    body = json.dumps({"block": block, "prefixes": prefixes},
+                      sort_keys=True, separators=(",", ":"))
+    return zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+
+
+def prefix_snapshot(pool_or_trie) -> dict:
+    """Export a :class:`PrefixTrie`'s cached content as a JSON-safe,
+    CRC-guarded payload.  Accepts the trie or the owning
+    :class:`RefcountedBlockPool`."""
+    trie = getattr(pool_or_trie, "_trie", pool_or_trie)
+    keys = sorted(trie._map.keys())
+    maximal: List[bytes] = []
+    for i, key in enumerate(keys):
+        # sorted bytes put any extension right after its prefix; a key
+        # is maximal iff its successor does not extend it
+        if i + 1 < len(keys) and keys[i + 1][:len(key)] == key:
+            continue
+        maximal.append(key)
+    prefixes = [np.frombuffer(k, np.int32).tolist() for k in maximal]
+    return {
+        "format_version": PREFIX_SNAPSHOT_VERSION,
+        "block": trie.block,
+        "prefixes": prefixes,
+        "crc32": _snapshot_crc(trie.block, prefixes),
+    }
+
+
+def load_prefix_snapshot(payload: dict) -> List[np.ndarray]:
+    """Decode a :func:`prefix_snapshot` payload back into token-prefix
+    arrays (for ``ServingEngine.import_prefixes``).  An unknown format
+    version returns ``[]`` (forward-compatible, like the autotune
+    plan); a CRC mismatch raises ``ValueError`` (corruption must be
+    loud)."""
+    if int(payload.get("format_version", -1)) \
+            != PREFIX_SNAPSHOT_VERSION:
+        return []
+    block = int(payload["block"])
+    prefixes = [[int(t) for t in p] for p in payload["prefixes"]]
+    got = _snapshot_crc(block, prefixes)
+    want = int(payload["crc32"])
+    if got != want:
+        raise ValueError(
+            f"prefix snapshot CRC mismatch: computed {got:#010x}, "
+            f"recorded {want:#010x}")
+    return [np.asarray(p, np.int32) for p in prefixes]

@@ -251,6 +251,26 @@ class FaultPlan:
       admission backpressures while active slots keep decoding.  The
       hoard is released after ``serve_exhaust_pool_rounds`` further
       decode rounds (recovery half of the drill).
+
+    Fleet faults (applied by :meth:`FaultInjector.attach_fleet` to a
+    ``serving.fleet.FleetRouter``, keyed by FLEET STEP count; replicas
+    are named by index):
+
+    - ``fleet_kill_at_step`` + ``fleet_kill_replica`` — that replica's
+      next heartbeat at/after the step raises (host crash): the router
+      must fail over — migrate its queue, re-dispatch its active rows
+      from their committed prefixes — and the drill's requests must
+      all still complete exactly once, token-identical to the oracle.
+    - ``fleet_slow_at_step`` + ``fleet_slow_replica`` +
+      ``fleet_slow_seconds`` + ``fleet_slow_steps`` — stall that
+      replica's heartbeat for N consecutive steps (a degraded host):
+      drives the suspect path and, with hedging enabled, the
+      hedge-wins path.
+    - ``fleet_flap_at_step`` + ``fleet_flap_replica`` +
+      ``fleet_flap_count`` — kill/revive the replica
+      ``fleet_flap_count`` times (crash-looping host): each rejoin's
+      hold must grow under the router's flap damping until the
+      replica is effectively out of rotation.
     """
 
     kill_at_iteration: Optional[int] = None
@@ -274,6 +294,15 @@ class FaultPlan:
     serve_raise_at_round: Optional[int] = None
     serve_exhaust_pool_at_admit: Optional[int] = None
     serve_exhaust_pool_rounds: int = 4
+    fleet_kill_at_step: Optional[int] = None
+    fleet_kill_replica: int = 0
+    fleet_slow_at_step: Optional[int] = None
+    fleet_slow_replica: int = 0
+    fleet_slow_seconds: float = 0.0
+    fleet_slow_steps: int = 1
+    fleet_flap_at_step: Optional[int] = None
+    fleet_flap_replica: int = 0
+    fleet_flap_count: int = 2
     seed: int = 0
 
     def to_json(self) -> str:
@@ -447,6 +476,77 @@ class FaultInjector:
         engine._round_fn = round_wrapper
         engine._stage = stage_wrapper
         return engine
+
+    def attach_fleet(self, router):
+        """Apply the plan's FLEET faults to a
+        ``serving.fleet.FleetRouter`` by wrapping its per-replica
+        heartbeat (``_step_replica``) and its ``step`` (host-side
+        wrappers, same discipline as :meth:`attach_engine` — the
+        router never knows it is under test).  Faults key on the
+        router's OWN step counter, replicas on their index.  Firings
+        append to :attr:`fired` as ``("fleet_<kind>", step)``.
+        Returns the router."""
+        plan = self.plan
+        names = [h.name for h in router.replicas]
+
+        def target(idx):
+            return names[idx] if 0 <= idx < len(names) else None
+
+        kill_name = target(plan.fleet_kill_replica)
+        slow_name = target(plan.fleet_slow_replica)
+        flap_name = target(plan.fleet_flap_replica)
+        state = {"killed": False, "slowed": 0,
+                 "flap_kills": 0, "flap_revives": 0}
+        real_step_replica = router._step_replica
+        real_step = router.step
+
+        def step_replica_wrapper(h):
+            step = router.step_count
+            if (plan.fleet_kill_at_step is not None
+                    and h.name == kill_name and not state["killed"]
+                    and step >= plan.fleet_kill_at_step):
+                state["killed"] = True
+                self.fired.append(("fleet_kill", step))
+                raise RuntimeError(
+                    "injected replica crash "
+                    "(FaultPlan.fleet_kill_at_step)")
+            if (plan.fleet_flap_at_step is not None
+                    and h.name == flap_name
+                    and state["flap_kills"] < plan.fleet_flap_count
+                    and step >= plan.fleet_flap_at_step):
+                state["flap_kills"] += 1
+                self.fired.append(("fleet_flap_kill", step))
+                raise RuntimeError(
+                    "injected replica flap "
+                    "(FaultPlan.fleet_flap_at_step)")
+            if (plan.fleet_slow_at_step is not None
+                    and h.name == slow_name
+                    and step >= plan.fleet_slow_at_step
+                    and state["slowed"] < plan.fleet_slow_steps):
+                state["slowed"] += 1
+                self.fired.append(("fleet_slow", step))
+                time.sleep(plan.fleet_slow_seconds)
+            return real_step_replica(h)
+
+        def step_wrapper():
+            out = real_step()
+            # the flap's revive half: the crash-looping host comes
+            # straight back, so the ROUTER's damping (not the host's
+            # absence) is what must contain it
+            if (plan.fleet_flap_at_step is not None
+                    and flap_name is not None
+                    and state["flap_revives"] < state["flap_kills"]):
+                h = router._by_name[flap_name]
+                if h.state == "dead":
+                    router.revive(flap_name)
+                    state["flap_revives"] += 1
+                    self.fired.append(
+                        ("fleet_flap_revive", router.step_count))
+            return out
+
+        router._step_replica = step_replica_wrapper
+        router.step = step_wrapper
+        return router
 
 
 def requires_vma(reason: str = "requires vma-typed shard_map"):

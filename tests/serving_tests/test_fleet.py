@@ -1,0 +1,453 @@
+"""Fleet chaos drills: every failure mode the ``FleetRouter``
+promises to absorb — replica kill mid-decode, slow replica (hedge
+wins), flapping replica (damped out of rotation), brown-out (priority
+sheds) — scripted through ``FaultPlan``'s fleet actions and pinned to
+the two fleet invariants: ZERO lost non-shed requests (each fleet id
+delivered exactly once) and token output bitwise-identical to the
+engine-independent solo oracle, whatever hedges, retries, and
+failovers raced underneath (docs/RESILIENCE.md, fleet rows).
+
+Plus the satellite units riding the same PR: the CRC-guarded prefix
+snapshot (cache export/import for warm rejoin) and the
+queue-POSITION-conditioned admission wait (the ``--max-queue 0``
+over-shed fix)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import (
+    AdmissionController,
+    FleetRouter,
+    Request,
+    RetryBudget,
+    ServingEngine,
+    ShedCompletion,
+    load_prefix_snapshot,
+    prefix_snapshot,
+)
+from chainermn_tpu.testing import FaultInjector, FaultPlan
+from chainermn_tpu.utils.metrics import MetricsRegistry, set_registry
+
+VOCAB = 64
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _engine(mini_adapter, mini_params, **kw):
+    kw.setdefault("n_slots", 8)
+    kw.setdefault("horizon", 96)
+    kw.setdefault("max_prompt", 48)
+    kw.setdefault("block", 4)
+    kw.setdefault("pool_blocks", 256)
+    return ServingEngine(mini_adapter, mini_params, **kw)
+
+
+def _trace(rng, n, lo_new=4, hi_new=16, max_prompt=16):
+    return [(rng.randint(0, VOCAB, rng.randint(2, max_prompt)),
+             int(rng.randint(lo_new, hi_new)))
+            for _ in range(n)]
+
+
+def _assert_exactly_once_ok(router, reqs, oracle):
+    """The two fleet invariants, asserted together: every submitted
+    fleet id delivered exactly once with status ok, tokens bitwise
+    the solo oracle's."""
+    by = {}
+    for r in router.request_records():
+        assert r.rid not in by, f"duplicate delivery for {r.rid}"
+        by[r.rid] = r
+    for fid, prompt, max_new in reqs:
+        r = by[fid]
+        assert r.status == "ok", \
+            (fid, r.status, getattr(r, "detail", ""))
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens), oracle(prompt, max_new),
+            err_msg=f"{fid} diverged from the solo oracle")
+
+
+class TestFleetRouting:
+    def test_routes_completes_and_reports(self, mini_adapter,
+                                          mini_params, oracle,
+                                          registry):
+        router = FleetRouter([_engine(mini_adapter, mini_params),
+                              _engine(mini_adapter, mini_params)])
+        rng = np.random.RandomState(0)
+        reqs = [(router.submit(p, n), p, n)
+                for p, n in _trace(rng, 10)]
+        router.run(max_steps=300)
+        assert router.idle
+        _assert_exactly_once_ok(router, reqs, oracle)
+        assert registry.snapshot()["fleet/route"]["value"] >= 10
+        # the statusz section contract: status() is JSON-safe
+        json.dumps(router.status())
+
+    def test_prefix_placement_follows_the_cache(
+            self, mini_adapter, mini_params):
+        router = FleetRouter([_engine(mini_adapter, mini_params),
+                              _engine(mini_adapter, mini_params)])
+        shared = np.arange(16, dtype=np.int32) % VOCAB
+        fid = router.submit(shared, 4, session="conv")
+        router.run(max_steps=100)
+        home = router._sessions["conv"]
+        # a cache-sharing follow-up routes to the SAME replica both
+        # by prefix score and by session affinity
+        follow = np.concatenate(
+            [shared, np.array([3, 1], np.int32)])
+        fid2 = router.submit(follow, 4, session="conv")
+        assert router._sessions["conv"] == home
+        router.run(max_steps=100)
+        eng = router._by_name[home].engine
+        assert {fid, fid2} <= {c.rid for c in eng.request_records()}
+        assert eng._alloc.stats()["prefix_hits"] > 0
+
+    def test_cancel_pending_and_dispatched(self, mini_adapter,
+                                           mini_params):
+        router = FleetRouter([_engine(mini_adapter, mini_params)])
+        fid = router.submit(np.arange(8, dtype=np.int32), 24)
+        assert router.cancel(fid)
+        router.run(max_steps=100)
+        recs = router.request_records()
+        assert [r.rid for r in recs].count(fid) == 1
+        assert recs[-1].status in ("shed", "cancelled")
+        assert not router.cancel("f999")
+
+
+@pytest.mark.drill
+class TestKillDrill:
+    def test_kill_mid_decode_exactly_once_token_identical(
+            self, mini_adapter, mini_params, oracle, registry):
+        """THE acceptance drill: one of two replicas crashes
+        mid-trace.  Queued requests migrate via export/import, active
+        rows re-dispatch from their committed prefixes, and every
+        request still completes exactly once, token-bitwise the solo
+        oracle."""
+        router = FleetRouter([_engine(mini_adapter, mini_params),
+                              _engine(mini_adapter, mini_params)])
+        rng = np.random.RandomState(1)
+        # oversubscribe 2x8 slots so the kill catches BOTH queued and
+        # active requests on the dying replica
+        reqs = [(router.submit(p, n), p, n)
+                for p, n in _trace(rng, 28)]
+        inj = FaultInjector(FaultPlan(fleet_kill_at_step=2,
+                                      fleet_kill_replica=0))
+        inj.attach_fleet(router)
+        router.run(max_steps=500)
+        assert router.idle
+        assert ("fleet_kill", 2) in inj.fired
+        assert router.n_failovers == 1
+        assert router.n_migrated > 0, \
+            "the kill must catch queued requests (queue migration arm)"
+        assert router.n_retries > 0, \
+            "the kill must catch active rows (committed re-dispatch)"
+        _assert_exactly_once_ok(router, reqs, oracle)
+        snap = registry.snapshot()
+        assert snap["fleet/failover"]["value"] == 1
+        assert router._by_name["replica0"].state == "dead"
+        # the dead engine was reset: clean pool, ready to revive
+        assert not router._by_name["replica0"].engine._alloc \
+            .leak_report()
+
+    def test_revive_rejoins_warm(self, mini_adapter, mini_params,
+                                 oracle, registry):
+        """A killed replica revived with its death-time prefix
+        snapshot rejoins WARM: the prefixes its cache held are cached
+        again before it takes traffic."""
+        router = FleetRouter([_engine(mini_adapter, mini_params),
+                              _engine(mini_adapter, mini_params)],
+                             rejoin_hold=1)
+        shared = (np.arange(24, dtype=np.int32) * 3) % VOCAB
+        fid = router.submit(shared, 4)
+        router.run(max_steps=100)
+        served = [h for h in router.replicas
+                  if fid in {c.rid for c in
+                             h.engine.request_records()}][0]
+        idx = router.replicas.index(served)
+        inj = FaultInjector(FaultPlan(fleet_kill_at_step=0,
+                                      fleet_kill_replica=idx))
+        inj.attach_fleet(router)
+        router.step()
+        assert served.state == "dead"
+        router.revive(served.name)
+        assert served.state == "rejoining"
+        run = served.engine._alloc._trie.lookup_run(shared)
+        assert len(run) * served.engine.block >= \
+            (shared.shape[0] // served.engine.block) \
+            * served.engine.block - served.engine.block, \
+            "rejoined replica must hold the snapshot prefixes again"
+
+
+@pytest.mark.drill
+class TestHedgeDrill:
+    def test_slow_replica_hedge_wins_no_duplicates(
+            self, mini_adapter, mini_params, oracle, registry):
+        """A stalling replica's request is hedged onto the healthy
+        one; the hedge wins, the loser is cancelled, delivery stays
+        exactly-once and token-identical."""
+        router = FleetRouter([_engine(mini_adapter, mini_params),
+                              _engine(mini_adapter, mini_params)],
+                             hedge_after=0.01)
+        prompt = np.arange(10, dtype=np.int32)
+        # replica0 is the empty-fleet placement winner; stall it
+        inj = FaultInjector(FaultPlan(fleet_slow_at_step=0,
+                                      fleet_slow_replica=0,
+                                      fleet_slow_seconds=0.15,
+                                      fleet_slow_steps=30))
+        inj.attach_fleet(router)
+        fid = router.submit(prompt, 8)
+        router.run(max_steps=200)
+        assert router.idle
+        assert any(k == "fleet_slow" for k, _ in inj.fired)
+        assert router.n_hedges == 1
+        assert router.n_hedge_won + router.n_hedge_lost == 1
+        recs = [r for r in router.request_records() if r.rid == fid]
+        assert len(recs) == 1 and recs[0].status == "ok"
+        np.testing.assert_array_equal(np.asarray(recs[0].tokens),
+                                      oracle(prompt, 8))
+        snap = registry.snapshot()
+        won = snap.get("fleet/hedge_won", {"value": 0})["value"]
+        lost = snap.get("fleet/hedge_lost", {"value": 0})["value"]
+        assert won + lost == 1
+
+    def test_hedge_denied_when_budget_empty(self, mini_adapter,
+                                            mini_params, registry):
+        router = FleetRouter(
+            [_engine(mini_adapter, mini_params),
+             _engine(mini_adapter, mini_params)],
+            hedge_after=0.0,
+            retry_budget=RetryBudget(capacity=1, refill=0.0))
+        router.retry_budget.tokens = 0.0
+        fid = router.submit(np.arange(6, dtype=np.int32), 4)
+        router.run(max_steps=200)
+        assert router.n_hedges == 0
+        assert router.retry_budget.denied >= 1
+        assert router.request_records()[-1].rid == fid
+
+
+@pytest.mark.drill
+class TestFlapDrill:
+    def test_flapping_replica_is_damped(self, mini_adapter,
+                                        mini_params, oracle,
+                                        registry):
+        """A crash-looping replica's rejoin hold must GROW
+        exponentially (flap damping) while the stable replica serves
+        every request to oracle-identical completion."""
+        router = FleetRouter([_engine(mini_adapter, mini_params),
+                              _engine(mini_adapter, mini_params)],
+                             rejoin_hold=1, flap_damping=2.0,
+                             warm_on_rejoin=False)
+        inj = FaultInjector(FaultPlan(fleet_flap_at_step=1,
+                                      fleet_flap_replica=0,
+                                      fleet_flap_count=3))
+        inj.attach_fleet(router)
+        rng = np.random.RandomState(2)
+        reqs = [(router.submit(p, n), p, n)
+                for p, n in _trace(rng, 12, lo_new=16, hi_new=24)]
+        router.run(max_steps=500)
+        assert router.idle
+        h = router._by_name["replica0"]
+        kills = [f for f in inj.fired if f[0] == "fleet_flap_kill"]
+        revives = [f for f in inj.fired
+                   if f[0] == "fleet_flap_revive"]
+        assert len(kills) >= 2 and len(revives) >= 2
+        assert h.deaths == len(kills)
+        # damping: the LAST applied hold is rejoin_hold * 2**(k-1)
+        assert h.rejoin_hold == min(router.max_hold,
+                                    2 ** (h.deaths - 1))
+        _assert_exactly_once_ok(router, reqs, oracle)
+
+
+@pytest.mark.drill
+class TestBrownOutDrill:
+    def test_brown_out_sheds_low_priority_only(
+            self, mini_adapter, mini_params, oracle, registry):
+        """With the fleet saturated past the brown-out threshold,
+        arriving LOW-priority traffic sheds ``"overload"`` at the
+        door while the protected class completes untouched."""
+        engines = [_engine(mini_adapter, mini_params,
+                           admission=AdmissionController())
+                   for _ in range(2)]
+        router = FleetRouter(engines, brown_out_after=1e-4,
+                             protect_priority=0)
+        rng = np.random.RandomState(3)
+        # evidence first: the predictors must SEE service before any
+        # brown-out verdict (shedding needs evidence, fleet-wide);
+        # top the histograms up past min_count deterministically
+        warm = [(router.submit(p, n), p, n)
+                for p, n in _trace(rng, 10)]
+        router.run(max_steps=300)
+        _assert_exactly_once_ok(router, warm, oracle)
+        for eng in engines:
+            for _ in range(eng.admission.predictor.min_count):
+                eng.admission.predictor.observe_tpot(0.01)
+        # saturate with protected traffic, then arrive low-priority
+        load = [(router.submit(p, n, priority=0), p, n)
+                for p, n in _trace(rng, 20, lo_new=12, hi_new=16)]
+        assert router.predicted_queue_wait() > router.brown_out_after
+        lowly = router.submit(np.arange(8, dtype=np.int32), 8,
+                              priority=1)
+        assert isinstance(lowly, ShedCompletion)
+        assert lowly.reason == "overload"
+        assert "brown-out" in lowly.detail
+        protected = router.submit(np.arange(8, dtype=np.int32), 8,
+                                  priority=0)
+        assert not isinstance(protected, ShedCompletion)
+        router.run(max_steps=500)
+        assert router.idle
+        _assert_exactly_once_ok(router, load, oracle)
+        assert registry.snapshot()["fleet/sheds"]["value"] >= 1
+
+
+@pytest.mark.drill
+class TestRetryBudgetDrill:
+    def test_persistent_failure_stays_inside_budget(
+            self, mini_adapter, mini_params, registry):
+        """A replica that dies EVERY time it serves (persistent
+        failure) must burn retries only up to the fleet budget, then
+        degrade to a shed — never a retry storm, never a hang."""
+        router = FleetRouter(
+            [_engine(mini_adapter, mini_params)],
+            rejoin_hold=0, warm_on_rejoin=False,
+            retry_budget=RetryBudget(capacity=2, refill=0.0),
+            max_retries=10)
+        inj = FaultInjector(FaultPlan(fleet_flap_at_step=0,
+                                      fleet_flap_replica=0,
+                                      fleet_flap_count=50))
+        inj.attach_fleet(router)
+        fid = router.submit(np.arange(8, dtype=np.int32), 8)
+        router.run(max_steps=100)
+        assert router.idle
+        recs = [r for r in router.request_records() if r.rid == fid]
+        assert len(recs) == 1
+        assert recs[0].status == "shed"
+        assert router.retry_budget.spent <= 2
+        assert router.retry_budget.denied >= 1
+        assert router.n_retries <= 2
+        assert registry.snapshot()["fleet/retries"]["value"] <= 2
+
+
+class TestPrefixSnapshot:
+    def test_roundtrip_maximal_prefixes(self):
+        from chainermn_tpu.serving import PrefixTrie
+
+        t = PrefixTrie(4)
+        toks = np.arange(12, dtype=np.int32)
+        for j, bid in enumerate((10, 11, 12)):
+            t.insert(toks, j, bid)
+        t.insert(np.full((4,), 9, np.int32), 0, 13)
+        snap = prefix_snapshot(t)
+        assert snap["format_version"] == 1
+        # only MAXIMAL prefixes ship (ancestors reconstruct on insert)
+        assert sorted(map(len, snap["prefixes"])) == [4, 12]
+        back = load_prefix_snapshot(snap)
+        assert any(np.array_equal(p, toks) for p in back)
+        json.dumps(snap)        # snapshot-rideable: JSON-safe
+
+    def test_crc_guard_and_version_gate(self):
+        from chainermn_tpu.serving import PrefixTrie
+
+        t = PrefixTrie(4)
+        t.insert(np.arange(8, dtype=np.int32), 0, 1)
+        snap = prefix_snapshot(t)
+        corrupt = dict(snap)
+        corrupt["prefixes"] = [[7, 7, 7, 7]]
+        with pytest.raises(ValueError, match="CRC"):
+            load_prefix_snapshot(corrupt)
+        future = dict(snap, format_version=99)
+        assert load_prefix_snapshot(future) == []
+
+    def test_engine_import_warms_cache(self, mini_adapter,
+                                       mini_params):
+        a = _engine(mini_adapter, mini_params)
+        prompt = (np.arange(20, dtype=np.int32) * 5) % VOCAB
+        a.submit(prompt, 4)
+        a.run(max_steps=100)
+        snap = prefix_snapshot(a._alloc)
+        assert snap["prefixes"]
+        b = _engine(mini_adapter, mini_params)
+        n = b.import_prefixes(load_prefix_snapshot(snap))
+        assert n > 0
+        assert b.idle
+        assert len(b._alloc._trie.lookup_run(prompt)) > 0
+        # idempotent: importing again warms nothing new
+        assert b.import_prefixes(load_prefix_snapshot(snap)) == 0
+
+
+class TestQueuePositionAdmission:
+    """The ``ServiceTimePredictor`` over-shed fix: predicted queue
+    wait conditions on the POSITION the scheduling policy would give
+    the arrival, not the whole queue."""
+
+    @staticmethod
+    def _hot_controller():
+        ctrl = AdmissionController()
+        for _ in range(10):
+            ctrl.predictor.observe_service_ttft(0.01)
+            ctrl.predictor.observe_tpot(0.01)
+        return ctrl
+
+    def _queue(self, n, max_new=100):
+        return [Request(f"q{i}", np.arange(4, dtype=np.int32),
+                        max_new, t_submit=0.0) for i in range(n)]
+
+    def test_ahead_tokens_narrows_the_wait(self):
+        ctrl = self._hot_controller()
+        req = Request("new", np.arange(4, dtype=np.int32), 8,
+                      t_submit=time.perf_counter(),
+                      deadline=time.perf_counter() + 0.5)
+        deep = self._queue(20)
+        # whole-queue charge: 2000 backlog tokens at 10ms/tok over 8
+        # slots ~ 2.5s wait -> shed
+        admit, reason, _ = ctrl.check_submit(req, deep, {}, n_slots=8)
+        assert not admit and reason == "deadline"
+        # position-conditioned: the policy serves it FIRST -> feasible
+        admit, reason, _ = ctrl.check_submit(req, deep, {}, n_slots=8,
+                                             ahead_tokens=0)
+        assert admit and reason is None
+
+    def test_engine_policy_positions(self, mini_adapter, mini_params):
+        eng = _engine(mini_adapter, mini_params, policy="deadline",
+                      admission=self._hot_controller())
+        eng._queue = self._queue(6)       # deadline-less backlog
+        urgent = Request("u", np.arange(4, dtype=np.int32), 8,
+                         t_submit=time.perf_counter(),
+                         deadline=time.perf_counter() + 0.5)
+        # deadline policy ranks the urgent arrival ahead of every
+        # deadline-less queued request: nothing ahead of it
+        assert eng._ahead_tokens(urgent) == 0
+        eng.set_policy("fcfs")
+        assert eng._ahead_tokens(urgent) == 600
+        eng.set_policy("spf")
+        short = Request("s", np.arange(2, dtype=np.int32), 8,
+                        t_submit=0.0)
+        assert eng._ahead_tokens(short) == 0
+        eng.set_policy(lambda q, e: q[0])     # custom: unknowable
+        assert eng._ahead_tokens(urgent) is None
+        eng._queue = []
+
+    def test_unbounded_queue_urgent_submit_admits(
+            self, mini_adapter, mini_params):
+        """The observed ``--max-queue 0`` (unbounded) symptom, end to
+        end: under the deadline policy, an URGENT feasible-deadline
+        arrival behind a deep deadline-less backlog must admit — the
+        old whole-queue wait charge shed it "deadline" off a backlog
+        it would never stand behind."""
+        eng = _engine(mini_adapter, mini_params, policy="deadline",
+                      admission=self._hot_controller())
+        fillers = [eng.submit(np.arange(4, dtype=np.int32), 16)
+                   for _ in range(24)]
+        assert all(not isinstance(r, ShedCompletion)
+                   for r in fillers)
+        res = eng.submit(np.arange(8, dtype=np.int32), 8,
+                         timeout=2.0)
+        assert not isinstance(res, ShedCompletion), \
+            f"admissible urgent request shed: {res.reason}"
+        eng.run(max_steps=300)

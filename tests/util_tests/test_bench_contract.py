@@ -188,6 +188,14 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
       "--max-new", "24", "--round-tokens", "2", "--d-model", "32",
       "--n-layers", "1", "--heads", "2", "--vocab", "64",
       "--rounds", "1"], "x"),
+    ("bench_fleet.py",
+     ["--replicas", "2", "--requests", "12", "--slots", "8",
+      "--horizon", "128", "--max-prompt", "40", "--block", "8",
+      "--shared-prefixes", "2", "--shared-prefix", "16",
+      "--max-suffix", "4", "--min-new", "4", "--max-new", "16",
+      "--round-tokens", "2", "--arrival-ms", "2.0",
+      "--kill-at-step", "2", "--d-model", "32", "--n-layers", "1",
+      "--heads", "2", "--vocab", "64", "--rounds", "1"], "x"),
     ("bench_elastic.py",
      ["--dim", "64", "--hidden", "64", "--batch", "16",
       "--rounds", "1"], "x"),
@@ -205,7 +213,7 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
         "autotune", "telemetry", "metrics_registry", "overlap",
-        "overload", "elastic", "live_elastic", "obs_plane",
+        "overload", "fleet", "elastic", "live_elastic", "obs_plane",
         "programs"])
 def test_other_benches_contract(script, args, unit):
     rec = _assert_contract(
