@@ -1574,12 +1574,20 @@ class ServingEngine:
         """Adopt requests exported from another engine (see
         :meth:`export_queue`); submit order and ``t_submit`` are
         preserved so queue-wait metrics stay honest across the
-        handover."""
+        handover.
+
+        All-or-nothing: every rid is validated against this engine's
+        live set BEFORE anything is adopted, so a collision raises
+        with the queue untouched — a failover caller can fall back to
+        per-request re-dispatch without first unwinding a partial
+        import."""
+        live = {q.rid for q in self._queue}
+        live.update(a.rid for a in self._slot_req if a is not None)
         for r in reqs:
-            if any(q.rid == r.rid for q in self._queue) \
-                    or any(a is not None and a.rid == r.rid
-                           for a in self._slot_req):
+            if r.rid in live:
                 raise ValueError(f"request id {r.rid!r} already live")
+            live.add(r.rid)
+        for r in reqs:
             self._queue.append(r)
             self._tenant_tokens[r.tenant] += r.max_new
             self._charged.add(r.rid)

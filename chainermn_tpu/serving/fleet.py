@@ -133,6 +133,14 @@ class RetryBudget:
         self.denied += 1
         return False
 
+    def refund(self) -> None:
+        """Return a token whose retry/hedge was never actually
+        placed (the chosen replica refused the dispatch) — the
+        budget meters placed re-dispatches, not attempts, or a
+        refusing replica would drain it with zero retries flowing."""
+        self.tokens = min(self.capacity, self.tokens + 1.0)
+        self.spent = max(self.spent - 1, 0)
+
     def snapshot(self) -> dict:
         return {"capacity": self.capacity, "refill": self.refill,
                 "tokens": self.tokens, "spent": self.spent,
@@ -256,6 +264,16 @@ class FleetRouter:
       warm_on_rejoin: import the dead replica's CRC-guarded prefix
         snapshot when reviving it, so it rejoins warm and the
         placement signal survives the failover.
+      max_sessions: LRU cap on remembered session -> replica homes
+        (affinity is a routing hint; evicting an old session only
+        costs a re-learned placement, never correctness).
+      max_records: cap on retained terminal records — the oldest are
+        dropped past it so :meth:`request_records` stays bounded on a
+        long-running fleet.  ``None`` (the default) retains every
+        record, which grows without bound by design: offline drills
+        and benches audit the full stream.  (The delivered-id set
+        backing idempotent delivery is always retained — it is the
+        exactly-once contract, a few bytes per request.)
       clock: time source (``time.perf_counter``); injectable for
         deterministic drills.
     """
@@ -277,6 +295,8 @@ class FleetRouter:
                  brown_out_after: Optional[float] = None,
                  protect_priority: int = 0,
                  warm_on_rejoin: bool = True,
+                 max_sessions: int = 4096,
+                 max_records: Optional[int] = None,
                  clock=time.perf_counter):
         engines = list(engines)
         if not engines:
@@ -303,6 +323,13 @@ class FleetRouter:
             raise ValueError(
                 f"flap_damping={flap_damping} must be >= 1 (damping "
                 "never shortens the hold)")
+        if max_sessions < 1:
+            raise ValueError(
+                f"max_sessions={max_sessions} must be >= 1")
+        if max_records is not None and max_records < 1:
+            raise ValueError(
+                f"max_records={max_records} must be >= 1 (or None "
+                "for unbounded retention)")
         self.replicas = [ReplicaHandle(n, e)
                          for n, e in zip(names, engines)]
         self._by_name = {h.name: h for h in self.replicas}
@@ -321,6 +348,9 @@ class FleetRouter:
         self.brown_out_after = brown_out_after
         self.protect_priority = int(protect_priority)
         self.warm_on_rejoin = bool(warm_on_rejoin)
+        self.max_sessions = int(max_sessions)
+        self.max_records = (None if max_records is None
+                            else int(max_records))
         self._clock = clock
         self.step_count = 0
         self._rr = 0
@@ -552,23 +582,47 @@ class FleetRouter:
         base = int(fl.committed.shape[0])
         prompt = fl.prompt
         remaining = fl.max_new - base
+        if remaining <= 0:
+            # the committed prefix already fills the token budget —
+            # the flight IS complete; submitting would force at least
+            # one extra generated token past max_new.  Deliver it.
+            if self._finalize_if_complete(fl, h, self._outbox,
+                                          self._clock()):
+                return None
         if base:
             prompt = np.concatenate([fl.prompt, fl.committed])
             if prompt.shape[0] > h.engine.max_prompt:
                 # the committed prefix no longer fits as prompt —
                 # re-decode from scratch (greedy: same tokens)
                 prompt, base, remaining = fl.prompt, 0, fl.max_new
-        res = h.engine.submit(prompt, max_new=max(remaining, 1),
-                              request_id=fl.fid,
-                              priority=fl.priority, tenant=fl.tenant,
-                              deadline=fl.deadline,
-                              sampling=fl.sampling)
+        try:
+            res = h.engine.submit(prompt, max_new=max(remaining, 1),
+                                  request_id=fl.fid,
+                                  priority=fl.priority,
+                                  tenant=fl.tenant,
+                                  deadline=fl.deadline,
+                                  sampling=fl.sampling)
+        except ValueError as err:
+            # the engine refused to even queue it (rid already live
+            # there — e.g. a surviving hedge copy — or the request
+            # violates its limits); a refusal, not a router crash
+            return ShedCompletion(
+                rid=fl.fid, prompt=fl.prompt, reason="overload",
+                t_submit=fl.t_submit, t_shed=self._clock(),
+                max_new=fl.max_new, priority=fl.priority,
+                tenant=fl.tenant,
+                detail=f"submit refused by {h.name}: {err}")
         if isinstance(res, ShedCompletion):
             return res
         fl.dispatches[h.name] = {"kind": kind, "base": base}
         fl.t_dispatch = self._clock()
         if fl.session is not None:
+            # LRU: re-insertion moves the session to the young end;
+            # overflow evicts the stalest home (a routing hint only)
+            self._sessions.pop(fl.session, None)
             self._sessions[fl.session] = h.name
+            while len(self._sessions) > self.max_sessions:
+                del self._sessions[next(iter(self._sessions))]
         get_registry().inc("fleet/route")
         return None
 
@@ -586,11 +640,17 @@ class FleetRouter:
             return
         still: List[str] = []
         for fid in self._pending:
-            fl = self._flights[fid]
+            fl = self._flights.get(fid)
+            if fl is None or fid in self._delivered:
+                continue            # settled while parked (cancel race)
             if fl.not_before > now:
                 still.append(fid)
                 continue
-            order = self._placement_order(fl)
+            # a replica already carrying a copy (surviving hedge /
+            # migrated twin) must not receive a second one — its
+            # engine would refuse the duplicate rid
+            order = self._placement_order(fl,
+                                          exclude=list(fl.dispatches))
             if not order:
                 still.append(fid)       # all holds; retry next step
                 continue
@@ -604,10 +664,16 @@ class FleetRouter:
                 last_shed = shed
             if placed:
                 continue
+            if fl.dispatches:
+                # every candidate refused, but a live copy still
+                # carries the request — its verdict will arrive
+                continue
             # every candidate replica refused — the fleet verdict is
             # the last engine's reason-coded shed
             del self._flights[fid]
             last_shed.t_submit = fl.t_submit
+            self.n_sheds += 1
+            get_registry().inc("fleet/sheds")
             self._deliver_record(fl, last_shed)
             self._outbox.append(last_shed)
         self._pending = still
@@ -785,6 +851,12 @@ class FleetRouter:
             # --- queued requests migrate wholesale ------------------- #
             exported = [r for r in exported if self._forget_dispatch(
                 r.rid, h.name)]
+            # a hedge copy whose OTHER copy is still live rides that
+            # copy — migrating it would plant a duplicate rid on a
+            # replica the twin may already occupy (import_queue would
+            # refuse the whole batch)
+            exported = [r for r in exported
+                        if not self._flights[r.rid].dispatches]
             if exported:
                 target = self._migration_target()
                 migrated = False
@@ -935,6 +1007,11 @@ class FleetRouter:
             if shed is None:
                 fl.hedged = True
                 self.n_hedges += 1
+            else:
+                # no hedge was placed: hand the token back, or this
+                # flight re-spends one every step while the candidate
+                # keeps refusing — draining the budget for nothing
+                self.retry_budget.refund()
 
     # ------------------------------------------------------------------ #
     # delivery (exactly-once)
@@ -943,6 +1020,9 @@ class FleetRouter:
     def _deliver_record(self, fl: _Flight, record) -> None:
         self._delivered.add(fl.fid)
         self._records.append(record)
+        if self.max_records is not None \
+                and len(self._records) > self.max_records:
+            del self._records[:len(self._records) - self.max_records]
 
     def _shed_flight(self, fl: _Flight, reason: str,
                      detail: str = "") -> ShedCompletion:
@@ -974,8 +1054,11 @@ class FleetRouter:
             if record.reason == "cancelled" \
                     and not fl.cancel_requested:
                 # cancelled as a hedge loser, but no live copy left —
-                # re-dispatch rather than losing the request
-                self._retry_or_shed(fl, self._clock(), out)
+                # re-dispatch rather than losing the request (unless
+                # its committed prefix already completes it)
+                now = self._clock()
+                if not self._finalize_if_complete(fl, h, out, now):
+                    self._retry_or_shed(fl, now, out)
                 return
             del self._flights[fid]
             record.t_submit = fl.t_submit
@@ -986,21 +1069,33 @@ class FleetRouter:
             return
         status = record.status
         if status == "cancelled" and not fl.cancel_requested:
-            # hedge loser evicted after losing the race
+            # hedge loser evicted after losing the race; bank its
+            # tokens (greedy: identical to any other copy's) so a
+            # rare both-copies-gone re-dispatch resumes, not restarts
+            base = disp["base"] if disp else 0
+            candidate = np.concatenate(
+                [fl.committed[:base],
+                 np.asarray(record.tokens, np.int32).reshape(-1)])
+            if candidate.shape[0] > fl.committed.shape[0]:
+                fl.committed = candidate
             if not fl.dispatches:
-                self._retry_or_shed(fl, self._clock(), out)
+                now = self._clock()
+                if not self._finalize_if_complete(fl, h, out, now):
+                    self._retry_or_shed(fl, now, out)
             return
         if status == "quarantined":
             # replica-side failure of THIS request; other slots kept
             # serving, so the replica is fine — retry elsewhere unless
-            # a copy is still live
+            # a copy is still live (or the prefix already completes)
             base = disp["base"] if disp else 0
             candidate = np.concatenate(
                 [fl.committed[:base], record.tokens])
             if candidate.shape[0] > fl.committed.shape[0]:
                 fl.committed = candidate
             if not fl.dispatches:
-                self._retry_or_shed(fl, self._clock(), out)
+                now = self._clock()
+                if not self._finalize_if_complete(fl, h, out, now):
+                    self._retry_or_shed(fl, now, out)
             return
         # "ok" / "timeout" / caller-asked "cancelled": the verdict.
         base = disp["base"] if disp else 0

@@ -14,6 +14,7 @@ over-shed fix)."""
 
 import json
 import time
+import zlib
 
 import numpy as np
 import pytest
@@ -379,6 +380,237 @@ class TestPrefixSnapshot:
         assert len(b._alloc._trie.lookup_run(prompt)) > 0
         # idempotent: importing again warms nothing new
         assert b.import_prefixes(load_prefix_snapshot(snap)) == 0
+
+
+class _FrozenClock:
+    """Manually advanced time source — hedge/backoff deadlines fire
+    exactly when the test says so."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _anchored_prompt(anchor, n_replicas=2, block=4, length=8, salt=0):
+    """A distinct prompt whose leading-block hash anchors placement
+    on ``replicas[anchor]`` (mirrors the router's cold-prefix
+    affinity), so multi-replica drills place deterministically."""
+    i = salt
+    while True:
+        p = (np.arange(length, dtype=np.int32) * 7 + i) % VOCAB
+        lead = np.ascontiguousarray(p[:block], np.int32).tobytes()
+        if zlib.crc32(lead) % n_replicas == anchor:
+            return p
+        i += 1
+
+
+@pytest.mark.drill
+class TestHedgeFailoverRaces:
+    """The hedge x failover interaction drills: a dead replica's
+    exported queue can hold hedge copies whose twin is alive
+    elsewhere — those must ride the surviving copy, never migrate
+    into a duplicate-rid crash or deliver with a dropped prefix."""
+
+    def test_hedge_copy_queued_on_dead_replica(
+            self, mini_adapter, mini_params, oracle, registry):
+        """Primary active on the survivor, hedge copy QUEUED on the
+        replica that dies: failover must not migrate the orphan
+        hedge onto the replica its twin already occupies
+        (previously: import_queue 'already live' -> blind re-dispatch
+        -> uncaught ValueError out of step()).
+
+        Both replicas' slots are SATURATED before the clock jump so
+        the hedge copies actually queue (a free slot admits a submit
+        eagerly, which would dodge the export_queue path)."""
+        clk = _FrozenClock()
+        router = FleetRouter(
+            [_engine(mini_adapter, mini_params),
+             _engine(mini_adapter, mini_params)],
+            hedge_after=5.0, clock=clk,
+            retry_budget=RetryBudget(capacity=32))
+        n = router.replicas[0].engine.n_slots
+        reqs = []
+        for anchor in (1, 0):           # replica1's primaries first
+            for i in range(n):
+                p = _anchored_prompt(anchor, salt=1000 * anchor + i)
+                reqs.append((router.submit(p, 16), p, 16))
+        for _ in range(3 * n):
+            if all(h.engine.n_active == n for h in router.replicas):
+                break
+            router.step()
+        assert all(h.engine.n_active == n for h in router.replicas)
+        clk.t = 10.0                    # past hedge_after: hedge all
+        router.step()
+        r1 = router._by_name["replica1"]
+        queued_hedges = [q.rid for q in r1.engine._queue]
+        assert queued_hedges, "drill needs hedge copies QUEUED on " \
+                              "the dying replica"
+        for rid in queued_hedges:       # ...whose twin is live on r0
+            assert "replica0" in router._flights[rid].dispatches
+        real = router._step_replica
+        state = {"killed": False}
+
+        def crashing(h):
+            if h.name == "replica1" and not state["killed"]:
+                state["killed"] = True
+                raise RuntimeError("injected crash under hedge")
+            return real(h)
+
+        router._step_replica = crashing
+        router.step()                   # the death + failover tick
+        assert r1.state == "dead"
+        # the orphan hedge copies were NOT planted on the survivor's
+        # engine as duplicates; the flights ride their live copies
+        for rid in queued_hedges:
+            fl = router._flights.get(rid)
+            if fl is not None:
+                assert list(fl.dispatches) == ["replica0"]
+        router.run(max_steps=800)
+        assert router.idle
+        _assert_exactly_once_ok(router, reqs, oracle)
+
+    def test_completed_prefix_retry_never_overruns_max_new(
+            self, mini_adapter, mini_params, oracle):
+        """A retry of a flight whose committed prefix already fills
+        ``max_new`` must DELIVER the prefix, not submit with
+        ``max(remaining, 1)`` and grow a max_new+1 token stream."""
+        from chainermn_tpu.serving.fleet import _Flight
+
+        router = FleetRouter([_engine(mini_adapter, mini_params)])
+        prompt = np.arange(8, dtype=np.int32)
+        full = np.asarray(oracle(prompt, 4), np.int32)
+        fl = _Flight(fid="fq", prompt=prompt, max_new=4, t_submit=0.0)
+        fl.committed = full
+        router._flights["fq"] = fl
+        router._retry_or_shed(fl, 0.0, [])
+        router.run(max_steps=50)
+        recs = [r for r in router.request_records() if r.rid == "fq"]
+        assert len(recs) == 1 and recs[0].status == "ok"
+        toks = np.asarray(recs[0].tokens)
+        assert toks.shape[0] <= 4, \
+            f"token budget overrun: {toks.shape[0]} > max_new=4"
+        np.testing.assert_array_equal(toks, full)
+
+    def test_refused_hedge_refunds_the_retry_budget(
+            self, mini_adapter, mini_params, registry):
+        """A hedge candidate that sheds the submit must hand the
+        budget token back — previously the same flight re-spent one
+        every step while the replica kept refusing, draining the
+        budget with zero hedges placed."""
+        clk = _FrozenClock()
+        e0 = _engine(mini_adapter, mini_params)
+        e1 = _engine(mini_adapter, mini_params,
+                     admission=AdmissionController(max_queue=1))
+        router = FleetRouter([e0, e1], hedge_after=5.0, clock=clk)
+        # fill replica1 out-of-band: every slot active + a full
+        # queue, so every hedge submit there sheds "queue_full"
+        # (admit one per step — the queue bound is 1, and prefill
+        # admits one request per tick)
+        for i in range(e1.n_slots):
+            r = e1.submit(np.full((4,), 5 + i, np.int32), 48)
+            assert not isinstance(r, ShedCompletion)
+            while e1.n_active <= i:
+                e1.step()
+        assert e1.n_active == e1.n_slots
+        r = e1.submit(np.full((4,), 40, np.int32), 48)
+        assert not isinstance(r, ShedCompletion)
+        fid = router.submit(_anchored_prompt(0, salt=0), 8)
+        assert list(router._flights[fid].dispatches) == ["replica0"]
+        cap = router.retry_budget.capacity
+        clk.t = 10.0
+        for _ in range(3):              # three refused hedge scans
+            router.step()
+        assert router.retry_budget.tokens == cap, \
+            "refused hedges must not drain the retry budget"
+        assert router.retry_budget.spent == 0
+        assert router.n_hedges == 0
+        router.run(max_steps=300)
+        recs = [r for r in router.request_records() if r.rid == fid]
+        assert len(recs) == 1 and recs[0].status == "ok"
+
+
+class TestFleetAccountingAndBounds:
+    def test_all_candidates_refused_counts_as_fleet_shed(
+            self, mini_adapter, mini_params, registry):
+        """The dispatch-time every-replica-refused verdict must hit
+        ``n_sheds`` / ``fleet/sheds`` like every other shed path."""
+        router = FleetRouter(
+            [_engine(mini_adapter, mini_params,
+                     admission=AdmissionController(max_queue=1))])
+        keep = router.submit(np.arange(6, dtype=np.int32), 8)
+        refused = router.submit(np.arange(6, dtype=np.int32) + 1, 8)
+        assert isinstance(refused, str)     # shed delivers via step()
+        assert router.n_sheds == 1
+        assert registry.snapshot()["fleet/sheds"]["value"] == 1
+        router.run(max_steps=200)
+        by = {r.rid: r for r in router.request_records()}
+        assert by[refused].status == "shed"
+        assert by[refused].reason == "queue_full"
+        assert by[keep].status == "ok"
+        assert [r.rid for r in router.request_records()].count(
+            refused) == 1
+
+    def test_session_homes_are_lru_bounded(self, mini_adapter,
+                                           mini_params):
+        router = FleetRouter([_engine(mini_adapter, mini_params)],
+                             max_sessions=2)
+        for i, sess in enumerate(("s0", "s1", "s2")):
+            router.submit(np.arange(6, dtype=np.int32) + i, 4,
+                          session=sess)
+        assert set(router._sessions) == {"s1", "s2"}
+        router.submit(np.arange(6, dtype=np.int32) + 9, 4,
+                      session="s1")       # touch: s1 is young again
+        router.submit(np.arange(6, dtype=np.int32) + 10, 4,
+                      session="s3")
+        assert set(router._sessions) == {"s1", "s3"}
+        router.run(max_steps=300)
+
+    def test_max_records_bounds_retention(self, mini_adapter,
+                                          mini_params):
+        router = FleetRouter([_engine(mini_adapter, mini_params)],
+                             max_records=2)
+        fids = [router.submit(np.arange(6, dtype=np.int32) + i, 4)
+                for i in range(3)]
+        router.run(max_steps=300)
+        assert router.idle
+        recs = router.request_records()
+        assert len(recs) == 2           # oldest aged out
+        assert {r.rid for r in recs} <= set(fids)
+        # idempotent-delivery memory is retained regardless
+        assert len(router._delivered) == 3
+
+    def test_engine_import_queue_is_all_or_nothing(
+            self, mini_adapter, mini_params):
+        eng = _engine(mini_adapter, mini_params)
+        eng.submit(np.arange(4, dtype=np.int32), 4,
+                   request_id="dup")
+        batch = [Request("fresh", np.arange(4, dtype=np.int32), 4,
+                         t_submit=0.0),
+                 Request("dup", np.arange(4, dtype=np.int32) + 1, 4,
+                         t_submit=0.0)]
+        with pytest.raises(ValueError, match="already live"):
+            eng.import_queue(batch)
+        assert [r.rid for r in eng._queue] == ["dup"], \
+            "a refused import must leave the queue untouched"
+        with pytest.raises(ValueError, match="already live"):
+            eng.import_queue([
+                Request("twin", np.arange(4, dtype=np.int32), 4,
+                        t_submit=0.0),
+                Request("twin", np.arange(4, dtype=np.int32), 4,
+                        t_submit=0.0)])
+        assert [r.rid for r in eng._queue] == ["dup"]
+
+    def test_retry_budget_refund(self):
+        b = RetryBudget(capacity=2, refill=0.0)
+        assert b.try_spend() and b.try_spend()
+        assert b.tokens == 0.0 and b.spent == 2
+        b.refund()
+        assert b.tokens == 1.0 and b.spent == 1
+        b.refund()
+        b.refund()                      # never past capacity / below 0
+        assert b.tokens == 2.0 and b.spent == 0
 
 
 class TestQueuePositionAdmission:
