@@ -40,6 +40,21 @@ from .point_to_point import (
     shift_down,
     shift_up,
 )
+from .plan_ir import (
+    PATTERNS,
+    PRIMITIVES,
+    LeafDesc,
+    PlanProgram,
+    PlanStep,
+    describe_payload,
+    ensure_program,
+    enumerate_pattern_programs,
+    lower_fsdp_gather,
+    lower_moe_all_to_all,
+    lower_pipeline_edge,
+    lower_ring_permute,
+    step,
+)
 
 __all__ = [
     "flash_attention", "flash_attention_supported",
@@ -51,4 +66,8 @@ __all__ = [
     "psum", "reduce_scatter", "scatter",
     "ppermute", "pseudo_connect", "recv", "send", "send_recv",
     "shift_down", "shift_up",
+    "PATTERNS", "PRIMITIVES", "LeafDesc", "PlanProgram", "PlanStep",
+    "describe_payload", "ensure_program", "enumerate_pattern_programs",
+    "lower_fsdp_gather", "lower_moe_all_to_all", "lower_pipeline_edge",
+    "lower_ring_permute", "step",
 ]

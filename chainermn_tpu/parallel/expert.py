@@ -31,6 +31,18 @@ from jax import lax
 __all__ = ["expert_parallel_moe"]
 
 
+def _a2a(v, axis_name: str, split_axis: int, concat_axis: int, plan):
+    if plan is None:
+        return lax.all_to_all(v, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    from chainermn_tpu.ops import plan_ir
+
+    return plan_ir.lower_moe_all_to_all(
+        plan_ir.ensure_program(plan, "moe_all_to_all"), v,
+        axis_name=axis_name, split_axis=split_axis,
+        concat_axis=concat_axis)
+
+
 def expert_parallel_moe(
     x,
     router_w,
@@ -40,6 +52,7 @@ def expert_parallel_moe(
     axis_name: str = "expert",
     capacity_factor: float = 1.25,
     top_k: int = 1,
+    a2a_plan=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k mixture-of-experts over the ``expert`` mesh axis.
     Call INSIDE ``shard_map``.
@@ -49,6 +62,15 @@ def expert_parallel_moe(
     and the k gates are renormalised to sum to one.  Later choices
     queue behind earlier ones for capacity slots (rank-0 assignments
     are never dropped in favour of someone's rank-1).
+
+    ``a2a_plan`` (a tuned Plan from
+    ``autotune_pattern_plan(pattern="moe_all_to_all")``, its
+    ``.program`` dict, or an ``ops.plan_ir.PlanProgram``) lowers BOTH
+    all-to-alls through the collective-plan IR — single-shot vs
+    axis-split chunked candidates, optional wire dtype with the
+    non-float exemption.  The dispatch/combine directions reuse one
+    program; the call site supplies each direction's split/concat
+    axes.
 
     Args:
       x: ``(N, D)`` local tokens (flatten batch×seq first).
@@ -112,16 +134,14 @@ def expert_parallel_moe(
     if S > 1:
         # (E, C, D) → (E_local, S·C, D): chunk e-dim to peers, stack their
         # slot blocks — every expert now holds its global token queue
-        slots = lax.all_to_all(slots, axis_name, split_axis=0,
-                               concat_axis=1, tiled=True)
+        slots = _a2a(slots, axis_name, 0, 1, a2a_plan)
 
     # --- expert compute (batched over local experts) ------------------ #
     hidden = jax.vmap(expert_fn)(expert_params, slots)  # (E_local, S·C, D)
 
     # --- combine all-to-all (inverse) --------------------------------- #
     if S > 1:
-        hidden = lax.all_to_all(hidden, axis_name, split_axis=1,
-                                concat_axis=0, tiled=True)
+        hidden = _a2a(hidden, axis_name, 1, 0, a2a_plan)
     out = jnp.einsum("ecd,nec->nd", hidden, combine)
 
     # --- Switch load-balancing loss (global) -------------------------- #

@@ -37,6 +37,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from chainermn_tpu.ops.fused import _wire_dtype_for
+from chainermn_tpu.ops.plan_ir import _pin
+
 __all__ = ["fsdp_dims", "fsdp_specs", "fsdp_gather"]
 
 
@@ -114,7 +117,8 @@ def fsdp_specs(params, dims, axis: str = "data", base_specs=None):
     return jax.tree.map(build, params, dims, base_specs)
 
 
-def fsdp_gather(params, dims, axis_name: str = "data", wire_dtype=None):
+def fsdp_gather(params, dims, axis_name: str = "data", wire_dtype=None,
+                *, plan=None, inter_axis_name: Optional[str] = None):
     """All-gather the FSDP-sharded leaves back to full width — call
     INSIDE shard_map, just before the params are consumed.  Grads
     reduce-scatter through the gather's transpose automatically.
@@ -124,17 +128,40 @@ def fsdp_gather(params, dims, axis_name: str = "data", wire_dtype=None):
     (the cast's transpose converts the cotangent to ``wire_dtype``
     before the scatter, back to the param dtype after) move half the
     bytes while forward/backward compute still sees the params' own
-    dtype.  The only numerics change vs ``None`` is the wire-dtype
-    rounding of the moved values — the ``allreduce_grad_dtype``
-    analogue, exactly as documented.
+    dtype.  Non-float leaves (int/bool step counters, embedding ids)
+    are exempt — rounding them through bf16 is silent corruption, the
+    same hazard ``flatten_buckets`` guards against.  The only numerics
+    change vs ``None`` is the wire-dtype rounding of the moved FLOAT
+    values — the ``allreduce_grad_dtype`` analogue.
+
+    ``plan`` (a tuned :class:`~chainermn_tpu.utils.autotune.Plan` from
+    ``autotune_pattern_plan(pattern="fsdp_gather")``, its ``.program``
+    dict, or an ``ops.plan_ir.PlanProgram``) switches the lowering to
+    the collective-plan IR: fused/hierarchical candidates instead of
+    the one-gather-per-leaf default.  Hierarchical programs need
+    ``inter_axis_name`` bound to the mesh's outer axis.
     """
+    if plan is not None:
+        from chainermn_tpu.ops import plan_ir
+
+        return plan_ir.lower_fsdp_gather(
+            plan_ir.ensure_program(plan, "fsdp_gather"), params, dims,
+            axis_name=axis_name, inter_axis_name=inter_axis_name)
+
     wd = None if wire_dtype is None else jnp.dtype(wire_dtype)
 
     def gather(leaf, dim):
         if dim is None:
             return leaf
+        if leaf.size == 0:
+            # XLA rejects an all_gather over an empty dim; the gathered
+            # value is fully determined by the (still empty) shape
+            shape = list(leaf.shape)
+            shape[dim] *= lax.axis_size(axis_name)
+            return jnp.zeros(tuple(shape), leaf.dtype)
         orig = leaf.dtype
-        narrowed = wd is not None and orig != wd
+        eff = orig if wd is None else _wire_dtype_for(orig, wd)
+        narrowed = eff != orig
         if narrowed:
             # barriers pin BOTH casts against the collective: without
             # them XLA commutes the elementwise converts across the
@@ -142,11 +169,14 @@ def fsdp_gather(params, dims, axis_name: str = "data", wire_dtype=None):
             # cast-back) and the wire silently widens to the param
             # dtype — verified in HLO: f32-wide gathers barrier-less.
             # optimization_barrier transposes to itself, so the
-            # gradient reduce-scatter stays at wire_dtype too.
-            leaf = lax.optimization_barrier(leaf.astype(wd))
+            # gradient reduce-scatter stays at wire_dtype too.  (On
+            # pre-vma jax the pin degrades to identity — shard_map's
+            # check_rep has no rule for the primitive; see
+            # ops.plan_ir._pin.)
+            leaf = _pin(leaf.astype(eff))
         out = lax.all_gather(leaf, axis_name, axis=dim, tiled=True)
         if narrowed:
-            out = lax.optimization_barrier(out).astype(orig)
+            out = _pin(out).astype(orig)
         return out
 
     return jax.tree.map(gather, params, dims)

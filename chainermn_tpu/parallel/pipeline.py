@@ -64,6 +64,20 @@ def _replicate_bwd(axis_name, src, _, ct):
 _replicate_from.defvjp(_replicate_fwd, _replicate_bwd)
 
 
+def _edge_send(act, axis_name, perm, shift, wrap, plan):
+    """One stage-edge hand-off — a raw ``lax.ppermute``, or the
+    collective-plan IR lowering when a tuned ``pipeline_edge`` plan is
+    supplied.  ``perm`` is the prebuilt legacy permutation for exactly
+    the same (shift, wrap) edge, so both paths move identical data."""
+    if plan is None:
+        return lax.ppermute(act, axis_name, perm=perm)
+    from chainermn_tpu.ops import plan_ir
+
+    return plan_ir.lower_pipeline_edge(
+        plan_ir.ensure_program(plan, "pipeline_edge"), act,
+        axis_name=axis_name, shift=shift, wrap=wrap)
+
+
 def _with_dummy_aux(stage_fn, with_aux):
     """Normalise ``stage_fn`` to the ``(mb, aux)`` shape.  The dummy aux
     must DERIVE from mb so its vma matches the varying cotangent seeded
@@ -99,6 +113,7 @@ def pipeline_apply(
     remat: bool = True,
     with_aux: bool = False,
     checkpoint_fn: Callable = None,
+    edge_plan=None,
 ):
     """Run the GPipe schedule.  Call INSIDE ``shard_map`` over ``axis_name``.
 
@@ -122,6 +137,11 @@ def pipeline_apply(
         stages and averaged over micro-batches, and the call returns
         ``(out, aux)`` — how the Switch-MoE balancing loss survives
         pipelining instead of being dropped.
+      edge_plan: a tuned Plan from
+        ``autotune_pattern_plan(pattern="pipeline_edge")``, its
+        ``.program`` dict, or an ``ops.plan_ir.PlanProgram`` — lowers
+        every stage-edge hand-off through the collective-plan IR
+        instead of the raw ``lax.ppermute``.
 
     Returns the full batch output ``(B, ...)``, replicated over the pipe
     axis (masked psum from the last stage — so downstream loss code is
@@ -152,7 +172,8 @@ def pipeline_apply(
     def tick(carry, t):
         act, outputs, aux_acc = carry
         # neighbour hand-off: device s receives device s-1's last output
-        recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
+        recv = _edge_send(act, axis_name, up_perm, 1, False,
+                          edge_plan) if S > 1 else act
         # stage 0 injects micro-batch t (clamped; ticks ≥ M push don't-care
         # values that drain past the last stage after the loop window)
         xt = mbs[jnp.minimum(t, M - 1)]
@@ -202,6 +223,7 @@ def pipeline_train_1f1b(
     num_microbatches: int,
     with_aux: bool = False,
     aux_weight: float = 1.0,
+    edge_plan=None,
 ):
     """One-forward-one-backward (1F1B) pipelined training step.
 
@@ -245,6 +267,9 @@ def pipeline_train_1f1b(
       aux_weight: the coefficient the aux term carries in the training
         objective (gradient-side only; the RETURNED aux is unweighted so
         callers can report/compose it like ``pipeline_apply`` does).
+      edge_plan: as :func:`pipeline_apply` — lowers both the activation
+        (up) and cotangent (down) stage edges through the
+        collective-plan IR.
 
     Returns ``(loss, stage_grads, loss_grads, dx)`` — loss is the mean
     over micro-batches (replicated); ``stage_grads`` matches
@@ -283,7 +308,8 @@ def pipeline_train_1f1b(
         # ---- forward slot: stage s forwards micro-batch t − s -------- #
         m_f = t - stage
         fwd_active = (m_f >= 0) & (m_f < M)
-        recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
+        recv = _edge_send(act, axis_name, up_perm, 1, False,
+                          edge_plan) if S > 1 else act
         inp = jnp.where(stage == 0, mbs[jnp.clip(m_f, 0, M - 1)], recv)
         y, aux_f = raw_fn(params, inp)
         stash = jnp.where(
@@ -295,8 +321,8 @@ def pipeline_train_1f1b(
         # ---- backward slot: stage s backwards t − (2S−2−s) ----------- #
         m_b = t - (2 * S - 2 - stage)
         bwd_active = (m_b >= 0) & (m_b < M)
-        ct_recv = lax.ppermute(ct, axis_name, perm=down_perm) \
-            if S > 1 else ct
+        ct_recv = _edge_send(ct, axis_name, down_perm, -1, False,
+                             edge_plan) if S > 1 else ct
         inp_b = stash[jnp.clip(m_b, 0, M - 1) % K]
         tgt_b = tgts[jnp.clip(m_b, 0, M - 1)]
 
@@ -480,6 +506,7 @@ def pipeline_train_interleaved(
     num_chunks: int,
     with_aux: bool = False,
     aux_weight: float = 1.0,
+    edge_plan=None,
 ):
     """Interleaved 1F1B (Megatron virtual pipeline stages), one SPMD scan.
 
@@ -506,6 +533,8 @@ def pipeline_train_interleaved(
         ``stage_fn`` returns ``(mb, aux_scalar)`` per CHUNK; auxes sum
         over all ``S·V`` virtual stages, average over micro-batches,
         and their gradients flow with weight ``aux_weight``.
+      edge_plan: as :func:`pipeline_apply` — the interleaved ring's
+        wrap-around edges lower through the collective-plan IR.
 
     Returns ``(loss, stage_grads, loss_grads, dx)`` with the same
     conventions as :func:`pipeline_train_1f1b` (``(loss, aux, ...)``
@@ -546,7 +575,8 @@ def pipeline_train_interleaved(
         fa, fm, fc, ba, bm, bc = (a[stage, t] for a in tbl)
 
         # ---- forward slot ------------------------------------------- #
-        recv = lax.ppermute(act, axis_name, perm=up_perm) if S > 1 else act
+        recv = _edge_send(act, axis_name, up_perm, 1, True,
+                          edge_plan) if S > 1 else act
         inject = (stage == 0) & (fc == 0)
         inp = jnp.where(inject, mbs[fm], recv)
         y, aux_f = raw_fn(chunk_params(fc), inp)
@@ -558,8 +588,8 @@ def pipeline_train_interleaved(
         aux_acc = aux_acc + jnp.where(fa, aux_f, 0.0)
 
         # ---- backward slot ------------------------------------------ #
-        ct_recv = lax.ppermute(ct, axis_name, perm=down_perm) \
-            if S > 1 else ct
+        ct_recv = _edge_send(ct, axis_name, down_perm, -1, True,
+                             edge_plan) if S > 1 else ct
         inp_b = stash[bc * K + bm % K]
         tgt_b = tgts[bm]
         seed = is_last_dev & (bc == V - 1)

@@ -180,12 +180,27 @@ def _block_positions(rr, T, S, layout):
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
+def _rotate_kv(k_blk, v_blk, axis_name, ring, plan):
+    """One ring rotation of the visiting K/V pair — raw ppermutes, or
+    the collective-plan IR lowering when a tuned ``ring_permute`` plan
+    is supplied (separate-vs-fused ppermute candidates)."""
+    if plan is None:
+        return (lax.ppermute(k_blk, axis_name, perm=ring),
+                lax.ppermute(v_blk, axis_name, perm=ring))
+    from chainermn_tpu.ops import plan_ir
+
+    k_blk, v_blk = plan_ir.lower_ring_permute(
+        plan_ir.ensure_program(plan, "ring_permute"), (k_blk, v_blk),
+        axis_name=axis_name)
+    return k_blk, v_blk
+
+
 def ring_attention(q, k, v, *, axis_name: str = "seq",
                    causal: bool = False, window=None, remat: bool = True,
                    use_flash: bool = False, block_q: int = 1024,
                    block_k: int = 1024, bwd_block_q=None,
                    bwd_block_k=None, interpret: bool = False,
-                   layout: str = "contiguous"):
+                   layout: str = "contiguous", permute_plan=None):
     """Blockwise ring attention.  Call INSIDE ``shard_map`` over
     ``axis_name`` with Q/K/V sequence-sharded: ``(B, T_blk, H, D)`` each.
 
@@ -208,6 +223,12 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         ``r`` and ``2S−1−r`` — see :func:`zigzag_indices`; balances the
         causal workload across the ring so the 2× FLOP saving is also a
         wall-clock saving).
+      permute_plan: a tuned Plan from
+        ``autotune_pattern_plan(pattern="ring_permute")``, its
+        ``.program`` dict, or an ``ops.plan_ir.PlanProgram`` — lowers
+        the per-step K/V rotation through the collective-plan IR
+        (separate-vs-fused ppermute candidates) instead of the two raw
+        ``lax.ppermute`` calls.
 
     Returns ``(B, T_blk, H, D)`` — this device's attended block.
 
@@ -246,7 +267,8 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
                            bwd_block_q=bwd_block_q,
                            bwd_block_k=bwd_block_k,
                            interpret=interpret, S=S, r=r, ring=ring,
-                           layout=layout, n_steps=n_steps)
+                           layout=layout, n_steps=n_steps,
+                           permute_plan=permute_plan)
 
     def block_step(carry, i):
         k_blk, v_blk, num, den, m = carry
@@ -267,8 +289,8 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         den = den * alpha + p.sum(axis=-1)
         # rotate K/V to the next device; XLA overlaps this with the math
         if S > 1:
-            k_blk = lax.ppermute(k_blk, axis_name, perm=ring)
-            v_blk = lax.ppermute(v_blk, axis_name, perm=ring)
+            k_blk, v_blk = _rotate_kv(k_blk, v_blk, axis_name, ring,
+                                      permute_plan)
         return (k_blk, v_blk, num, den, m_new), None
 
     step = jax.checkpoint(block_step) if remat else block_step
@@ -297,7 +319,8 @@ def _merge_lse(o, lse, o_i, lse_i):
 
 def _ring_flash(q, k, v, *, axis_name, causal, window, remat, block_q,
                 block_k, interpret, S, r, ring, bwd_block_q=None,
-                bwd_block_k=None, layout="contiguous", n_steps=None):
+                bwd_block_k=None, layout="contiguous", n_steps=None,
+                permute_plan=None):
     """Ring schedule with the Pallas kernel as the per-pair compute.
 
     Every visiting K/V block is attended with the SAME kernel call,
@@ -380,8 +403,8 @@ def _ring_flash(q, k, v, *, axis_name, causal, window, remat, block_q,
 
     def block_step(carry, i):
         k_blk, v_blk, o, lse = carry
-        k_blk = lax.ppermute(k_blk, axis_name, perm=ring)
-        v_blk = lax.ppermute(v_blk, axis_name, perm=ring)
+        k_blk, v_blk = _rotate_kv(k_blk, v_blk, axis_name, ring,
+                                  permute_plan)
         src = (r - i) % S                                # block now held
         o_i, lse_i = attend_block(k_blk, v_blk, src)
         o, lse = _merge_lse(o, lse, o_i, lse_i)

@@ -59,7 +59,7 @@ import os
 import statistics
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -75,8 +75,10 @@ __all__ = [
     "Candidate",
     "Plan",
     "PlanCell",
+    "autotune_pattern_plan",
     "autotune_plan",
     "build_exchange_fn",
+    "build_pattern_probe_fn",
     "build_plan_probe",
     "default_cache_path",
     "enumerate_candidates",
@@ -94,7 +96,10 @@ __all__ = [
 # "overlap" + per-bucket eager/deferred modes); v1 plans carry no
 # schedule field and their measurements never saw the overlap
 # candidates, so they must re-tune.
-FORMAT_VERSION = 2
+# v3: plans gained the ``program`` field (collective-plan IR programs
+# for the pattern tuner below) — v2 caches are silently re-tuned, the
+# documented migration path (see docs/TUNING.md "Plan IR")
+FORMAT_VERSION = 3
 
 PLAN_CACHE_ENV = "CHAINERMN_TPU_PLAN_CACHE"
 
@@ -154,6 +159,10 @@ class Plan:
     # (strategy "overlap" with schedule=None derives the all-eager
     # default from bucket_bytes at trace time)
     schedule: Optional[list] = None
+    # collective-plan IR program (``ops.plan_ir.PlanProgram.to_dict``
+    # form) for pattern plans tuned by :func:`autotune_pattern_plan`;
+    # None for the classic allreduce-strategy plans
+    program: Optional[dict] = None
     measured_ms: Optional[float] = None
     key: Optional[str] = None
     link: Optional[Dict[str, float]] = None
@@ -167,6 +176,7 @@ class Plan:
             "bucket_bytes": int(self.bucket_bytes),
             "wire_dtype": self.wire_dtype,
             "schedule": self.schedule,
+            "program": self.program,
             "measured_ms": self.measured_ms,
             "key": self.key,
             "link": self.link,
@@ -180,6 +190,7 @@ class Plan:
             bucket_bytes=int(d["bucket_bytes"]),
             wire_dtype=d.get("wire_dtype"),
             schedule=d.get("schedule"),
+            program=d.get("program"),
             measured_ms=d.get("measured_ms"),
             key=d.get("key"),
             link=d.get("link"),
@@ -1141,6 +1152,417 @@ def autotune_plan(
 
 
 # --------------------------------------------------------------------- #
+# pattern plans — the collective-plan IR search (ops.plan_ir)
+# --------------------------------------------------------------------- #
+
+
+def _program_uses_inter(program) -> bool:
+    return any(st.axis == "inter" for st in program.steps)
+
+
+def _program_enriched_steps(program, payload_sig: dict) -> List[dict]:
+    """Plan-IR steps enriched with the launch counts and wire-dtype
+    byte scaling :func:`~chainermn_tpu.utils.comm_model.program_cost`
+    consumes — derived from the payload signature the same way the
+    interpreter's fuse/cast_wire steps transform the lanes."""
+    total = max(payload_sig["total_bytes"], 1)
+    lanes = max(payload_sig["n_nonempty"], 1)
+    from chainermn_tpu.utils.comm_model import PRIMITIVE_WIRE_KINDS
+
+    wire_scale = 1.0
+    fused = False
+    out = []
+    for st in program.steps:
+        if st.op == "cast_wire":
+            wire_scale = _wire_bytes_total(
+                payload_sig, st.get("dtype")) / total
+        elif st.op == "fuse":
+            fused = True
+        if st.op in PRIMITIVE_WIRE_KINDS:
+            launches = (max(len(payload_sig["groups"]), 1)
+                        if fused else lanes)
+            launches *= int(st.get("chunks", 1))
+            out.append({"op": st.op, "axis": st.axis or "main",
+                        "launches": launches,
+                        "bytes_scale": wire_scale})
+    return out
+
+
+def _pattern_axis_sizes(program, n: int, inter_size: int) \
+        -> Dict[str, int]:
+    if _program_uses_inter(program):
+        return {"main": max(n // max(inter_size, 1), 1),
+                "inter": max(inter_size, 1)}
+    return {"main": n, "inter": 1}
+
+
+def _pattern_model_cost(program, payload_sig: dict, n: int,
+                        inter_size: int, link=None) -> float:
+    from chainermn_tpu.utils.comm_model import program_cost
+
+    return program_cost(
+        _program_enriched_steps(program, payload_sig),
+        payload_sig["total_bytes"],
+        _pattern_axis_sizes(program, n, inter_size), link=link)
+
+
+def _program_wire_stats(program, payload_sig: dict, n: int,
+                        inter_size: int) -> Tuple[int, float]:
+    """(total launches, total wire bytes/device) — the link-fit
+    sample a probed program contributes."""
+    from chainermn_tpu.utils.comm_model import (
+        PRIMITIVE_WIRE_KINDS,
+        wire_bytes_per_device,
+    )
+
+    sizes = _pattern_axis_sizes(program, n, inter_size)
+    launches, wire = 0, 0.0
+    for st in _program_enriched_steps(program, payload_sig):
+        launches += st["launches"]
+        wire += wire_bytes_per_device(
+            PRIMITIVE_WIRE_KINDS[st["op"]],
+            payload_sig["total_bytes"] * st["bytes_scale"],
+            sizes[st["axis"]])
+    return launches, wire
+
+
+def _exact_ok(got, want) -> bool:
+    """Bitwise parity — native plan-IR candidates are pure data
+    movement, so anything short of exact equality is a lowering bug,
+    not noise."""
+    import jax
+
+    gl, wl = jax.tree.leaves(got), jax.tree.leaves(want)
+    if len(gl) != len(wl):
+        return False
+    for g, w in zip(gl, wl):
+        g, w = np.asarray(g), np.asarray(w)
+        if g.shape != w.shape or g.dtype != w.dtype \
+                or not np.array_equal(g, w):
+            return False
+    return True
+
+
+def build_pattern_probe_fn(mesh, axis_name: str, pattern: str, program,
+                           inter_axis_name: Optional[str] = None,
+                           **pattern_kw):
+    """One jitted ``shard_map`` lowering ``program`` for ``pattern`` on
+    a WORLD-STACKED payload (leading axis = mesh member) — the pattern
+    tuner's probe harness, ledger-labelled ``plan_ir/<pattern>`` so
+    every probe compile is attributed."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.ops import plan_ir
+
+    program = plan_ir.ensure_program(program, pattern)
+    axes = (inter_axis_name, axis_name) if inter_axis_name \
+        else (axis_name,)
+    spec = P(axes if len(axes) > 1 else axis_name)
+
+    if pattern == "fsdp_gather":
+        dims = pattern_kw["dims"]
+
+        def lower(local):
+            return plan_ir.lower_fsdp_gather(
+                program, local, dims, axis_name=axis_name,
+                inter_axis_name=inter_axis_name)
+    elif pattern == "moe_all_to_all":
+        sa = int(pattern_kw.get("split_axis", 0))
+        ca = int(pattern_kw.get("concat_axis", 1))
+
+        def lower(local):
+            return plan_ir.lower_moe_all_to_all(
+                program, local, axis_name=axis_name,
+                split_axis=sa, concat_axis=ca)
+    elif pattern == "ring_permute":
+        def lower(local):
+            leaves, treedef = jax.tree.flatten(local)
+            return treedef.unflatten(list(plan_ir.lower_ring_permute(
+                program, leaves, axis_name=axis_name)))
+    elif pattern == "pipeline_edge":
+        shift = int(pattern_kw.get("shift", 1))
+        wrap = bool(pattern_kw.get("wrap", False))
+
+        def lower(local):
+            return plan_ir.lower_pipeline_edge(
+                program, local, axis_name=axis_name, shift=shift,
+                wrap=wrap)
+    else:
+        raise ValueError(f"unknown pattern {pattern!r}")
+
+    def body(g):
+        local = jax.tree.map(lambda a: a[0], g)
+        out = lower(local)
+        return jax.tree.map(lambda a: a[None], out)
+
+    from chainermn_tpu.utils.programs import ledger_jit
+
+    return ledger_jit(jax.shard_map(
+        body, mesh=mesh, in_specs=spec, out_specs=spec),
+        label=f"plan_ir/{pattern}")
+
+
+def autotune_pattern_plan(
+    comm,
+    params,
+    *,
+    pattern: str,
+    axis_name: Optional[str] = None,
+    mesh=None,
+    hier_mesh=None,
+    inter_axis_name: Optional[str] = None,
+    allow_hierarchical: Optional[bool] = None,
+    wire_dtypes: Sequence = (None,),
+    cache_path: Optional[str] = None,
+    top_k: int = 6,
+    trials: int = 3,
+    warmup: int = 1,
+    max_chunks: int = 8,
+    force: bool = False,
+    seed: int = 0,
+    **pattern_kw,
+) -> Plan:
+    """Tune (or warm-start) a collective-plan IR program for one
+    communication ``pattern`` — the :func:`autotune_plan` search
+    applied to the ``ops.plan_ir`` candidate spaces, riding the SAME
+    plan-cache / rank-0-broadcast / drift-guard machinery.
+
+    Args:
+      comm / axis_name / mesh / hier_mesh / inter_axis_name /
+        cache_path / trials / warmup / force / seed: exactly as
+        :func:`autotune_plan`.
+      pattern: one of ``ops.plan_ir.PATTERNS`` (``"fsdp_gather"``,
+        ``"moe_all_to_all"``, ``"ring_permute"``, ``"pipeline_edge"``).
+      params: the pattern's LOCAL payload template (per-device shard
+        shapes): the sharded param subtree for ``fsdp_gather``, the
+        ``(E, C, D)`` slots array for ``moe_all_to_all``, the
+        ``(k, v)`` block pair for ``ring_permute``, the activation
+        micro-batch for ``pipeline_edge``.  Values are never read.
+      allow_hierarchical: include two-stage (intra→inter) candidates
+        (``fsdp_gather`` only; default: exactly when a 2-D mesh is
+        available).
+      wire_dtypes: wire-compression dtypes to enumerate (``None`` =
+        native; the non-float exemption applies per leaf).  Native
+        candidates must match the baseline BITWISE; wire candidates
+        get the usual tolerance.
+      top_k: candidates surviving the per-primitive cost-model pruning
+        (:func:`~chainermn_tpu.utils.comm_model.program_cost`).
+      max_chunks: largest axis-split chunk count enumerated for
+        ``moe_all_to_all``.
+      pattern_kw: pattern statics, part of the cache key — ``dims``
+        (``fsdp_gather``), ``split_axis``/``concat_axis``
+        (``moe_all_to_all``), ``shift``/``wrap`` (``pipeline_edge``).
+
+    Returns the winning :class:`Plan` with ``plan.program`` holding
+    the IR program dict (feed it to the pattern's ``plan=`` kwarg /
+    ``ops.plan_ir.lower_*``); ``from_cache`` / ``n_probes`` report
+    whether any probe executed.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.ops import plan_ir
+
+    if pattern not in plan_ir.PATTERNS:
+        raise ValueError(
+            f"unknown pattern {pattern!r}; expected one of "
+            f"{plan_ir.PATTERNS}")
+    if comm is not None:
+        axis_name = axis_name or comm.axis_name
+        mesh = mesh if mesh is not None else comm.mesh
+    if mesh is None or axis_name is None:
+        raise ValueError(
+            "autotune_pattern_plan needs comm, or mesh + axis_name")
+
+    leaves = jax.tree.leaves(params)
+    if any(isinstance(l, jax.core.Tracer) for l in leaves):
+        raise RuntimeError(
+            "autotune_pattern_plan called under tracing — the "
+            "autotuner runs REAL probe programs and cannot execute "
+            "inside jit/shard_map.  Resolve the plan eagerly first "
+            "and pass it in via the call site's plan= kwarg.")
+
+    devices = list(np.asarray(mesh.devices).reshape(-1))
+    n = len(devices)
+    flat_mesh = Mesh(np.asarray(devices, dtype=object), (axis_name,))
+    hmesh, inter_ax = _resolve_hier(comm, axis_name, inter_axis_name,
+                                    hier_mesh)
+    if allow_hierarchical is None:
+        allow_hierarchical = hmesh is not None \
+            and pattern == "fsdp_gather"
+    if allow_hierarchical and hmesh is None:
+        raise ValueError(
+            "allow_hierarchical=True but no 2-D (inter, intra) mesh is "
+            "available: pass hier_mesh or use a multi-host communicator")
+    hier_shape = (tuple(int(s) for s in np.asarray(hmesh.devices).shape)
+                  if (hmesh is not None and allow_hierarchical) else None)
+    inter_size = hier_shape[0] if hier_shape else 1
+
+    payload = payload_signature(params)
+    mesh_sig = mesh_signature(flat_mesh, hier_shape)
+    # pattern statics fold into the variant: two tunings of the same
+    # payload bytes under different dims / split axes / directions are
+    # different searches and must never serve each other
+    extras: Dict[str, Any] = {
+        "pattern": pattern,
+        "wire_dtypes": [None if w is None else str(np.dtype(w) if not
+                        isinstance(w, str) else w)
+                        for w in wire_dtypes],
+    }
+    for k, v in sorted(pattern_kw.items()):
+        if k == "dims":
+            treedef = jax.tree.structure(params)
+            extras["dims"] = treedef.flatten_up_to(v)
+        else:
+            extras[k] = v
+    variant = f"plan-ir/{pattern}/{_digest(extras)[:12]}"
+    key = plan_key(mesh_sig, payload, variant=variant)
+
+    from chainermn_tpu.utils.metrics import get_registry
+    from chainermn_tpu.utils.telemetry import get_recorder
+
+    reg = get_registry()
+    if not force:
+        cached = local_hit = load_cached_plan(key, cache_path)
+        if comm is not None:
+            # SPMD-agreed hit/miss — same discipline as autotune_plan:
+            # rank 0's verdict is authoritative so every process
+            # enters (or skips) the collective probing together
+            served = comm.bcast_obj(
+                cached.to_dict() if cached is not None else None,
+                root=0)
+            cached = (Plan.from_dict(served) if served is not None
+                      else None)
+            if cached is not None:
+                cached.from_cache = True
+                cached.n_probes = 0
+                if local_hit is None:
+                    try:
+                        store_plan(cached, cache_path)
+                    except OSError:
+                        pass
+        if cached is not None:
+            reg.inc("autotune/plan_cache_hits")
+            reg.inc(f"autotune/plan_cache_hits_{pattern}")
+            return cached
+        reg.inc("autotune/plan_cache_misses")
+        reg.inc(f"autotune/plan_cache_misses_{pattern}")
+
+    # -- enumerate + prune (per-primitive cost terms) ----------------- #
+    enum_kw: Dict[str, Any] = {"wire_dtypes": tuple(wire_dtypes)}
+    if pattern == "fsdp_gather":
+        enum_kw["allow_hierarchical"] = bool(allow_hierarchical)
+    elif pattern == "moe_all_to_all":
+        if len(leaves) != 1:
+            raise ValueError(
+                "moe_all_to_all payload must be the single slots "
+                f"array; got {len(leaves)} leaves")
+        enum_kw.update(
+            shape=tuple(int(s) for s in leaves[0].shape),
+            split_axis=int(pattern_kw.get("split_axis", 0)),
+            concat_axis=int(pattern_kw.get("concat_axis", 1)),
+            max_chunks=max_chunks)
+    progs = plan_ir.enumerate_pattern_programs(pattern, **enum_kw)
+    baseline, rest = progs[0], progs[1:]
+    rest.sort(key=lambda p: _pattern_model_cost(p, payload, n,
+                                                inter_size))
+    probed = [baseline] + rest[:max(top_k, 1)]
+
+    # -- measure ------------------------------------------------------ #
+    n_probes = 0
+    timings: List[dict] = []
+    results: List[Tuple[Any, float]] = []
+    ref_out = None
+    raw = _probe_tree(params, n, seed)
+    flat_data = _place(raw, flat_mesh, (axis_name,))
+    hier_data = None
+    tracer = get_recorder()
+    for prog in probed:
+        use_hier = _program_uses_inter(prog)
+        if use_hier and hier_data is None:
+            hier_data = _place(raw, hmesh, (inter_ax, axis_name))
+        data = hier_data if use_hier else flat_data
+        fn = build_pattern_probe_fn(
+            hmesh if use_hier else flat_mesh, axis_name, pattern, prog,
+            inter_axis_name=inter_ax if use_hier else None,
+            **pattern_kw)
+        with tracer.span("autotune/probe", cat="autotune",
+                         pattern=pattern, label=prog.label,
+                         wire_dtype=prog.wire_dtype) as probe_sp:
+            median_s, out = _time_candidate(fn, data, trials, warmup)
+            probe_sp.set(median_ms=round(median_s * 1e3, 4))
+        n_probes += max(trials, 1) + max(warmup, 1)
+        reg.inc("autotune/probes")
+        reg.observe("autotune/probe_time", median_s)
+        if prog is baseline:
+            ref_out = out
+            ok = True
+        elif prog.wire_dtype:
+            ok = _parity_ok(out, ref_out, prog.wire_dtype)
+        else:
+            # native candidates are pure data movement: bitwise or bust
+            ok = _exact_ok(out, ref_out)
+        timings.append({
+            "label": prog.label,
+            "wire_dtype": prog.wire_dtype,
+            "ms": round(median_s * 1e3, 4),
+            "modeled_ms": round(_pattern_model_cost(
+                prog, payload, n, inter_size) * 1e3, 4),
+            "parity_ok": bool(ok),
+        })
+        if ok:
+            results.append((prog, median_s))
+    winner, best_s = min(results, key=lambda r: r[1])
+
+    # -- fit measured link constants ---------------------------------- #
+    samples = []
+    for prog, t in results:
+        launches, wire = _program_wire_stats(prog, payload, n,
+                                             inter_size)
+        samples.append((launches, wire, t))
+    link = LinkParams.from_probes(samples)
+
+    plan = Plan(
+        strategy=winner.label,
+        bucket_bytes=0,
+        wire_dtype=winner.wire_dtype,
+        schedule=None,
+        program=winner.to_dict(),
+        measured_ms=round(best_s * 1e3, 4),
+        key=key,
+        link={"latency_s": link.latency_s,
+              "bandwidth_bytes_per_s": link.bandwidth_bytes_per_s},
+        meta={
+            "pattern": pattern,
+            "mesh": mesh_sig,
+            "payload": {k: v for k, v in payload.items()
+                        if k != "groups"},
+            "extras": {k: v for k, v in extras.items()
+                       if k != "pattern"},
+            "timings": timings,
+            "n_enumerated": len(progs),
+            "n_probed": len(probed),
+            "trials": trials,
+            "created": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+        },
+    )
+
+    # rank-0 decision broadcast + persist on every process — same
+    # rationale as autotune_plan
+    if comm is not None:
+        plan = Plan.from_dict(comm.bcast_obj(plan.to_dict(), root=0))
+    plan.n_probes = n_probes
+    plan.from_cache = False
+    try:
+        store_plan(plan, cache_path)
+    except OSError:
+        pass
+    return plan
+
+
+# --------------------------------------------------------------------- #
 # drift guard
 # --------------------------------------------------------------------- #
 
@@ -1178,6 +1600,11 @@ class PlanCell:
         # re-applies them so a drift re-tune can never adopt a plan the
         # program cannot run
         self.tune_kwargs: Dict[str, Any] = {}
+        # the search retune() re-runs: autotune_plan (the default,
+        # looked up at call time) for the optimizer exchange,
+        # autotune_pattern_plan for IR-lowered patterns (set by
+        # whoever resolves the cell, alongside tune_kwargs)
+        self.tuner: Optional[Callable[..., Plan]] = None
 
     def resolve(self, plan: Plan) -> None:
         self.plan = Plan.from_any(plan)
@@ -1220,6 +1647,7 @@ class PlanCell:
         The caller owns recompilation of anything that baked the old
         plan in (``StandardUpdater._step_cache``)."""
         merged = {**self.tune_kwargs, **kwargs}
-        plan = autotune_plan(comm, params, force=True, **merged)
+        tuner = self.tuner if self.tuner is not None else autotune_plan
+        plan = tuner(comm, params, force=True, **merged)
         self.resolve(plan)
         return plan

@@ -32,8 +32,11 @@ from typing import Dict, Optional
 __all__ = [
     "CollectiveStats",
     "LinkParams",
+    "PRIMITIVE_WIRE_KINDS",
     "collective_stats",
     "stablehlo_collective_stats",
+    "primitive_cost",
+    "program_cost",
     "wire_bytes_per_device",
     "axis_collective_report",
     "choose_accum_steps",
@@ -211,6 +214,58 @@ def wire_bytes_per_device(kind: str, tensor_bytes: float, n: int) -> float:
     if kind == "collective-permute":
         return float(tensor_bytes)
     raise ValueError(f"unknown collective kind {kind!r}")
+
+
+# ---------------------------------------------------------------------
+# per-primitive cost terms for the collective-plan IR
+# (``ops.plan_ir``): maps each wire primitive to its ring wire-bytes
+# formula so the pattern autotuner's pruning covers all-to-all and
+# ppermute/send_recv, not just the allreduce strategy space
+# ---------------------------------------------------------------------
+
+PRIMITIVE_WIRE_KINDS = {
+    "all_reduce": "all-reduce",
+    "reduce_scatter": "reduce-scatter",
+    "all_gather": "all-gather",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+    "send_recv": "collective-permute",
+}
+
+
+def primitive_cost(op: str, tensor_bytes: float, axis_size: int, *,
+                   launches: int = 1, link: Optional[LinkParams] = None) \
+        -> float:
+    """Modeled seconds for one plan-IR primitive step moving
+    ``tensor_bytes`` of payload over an ``axis_size``-member group in
+    ``launches`` separate collective launches.  Non-wire primitives
+    (``fuse`` / ``cast_wire`` / ``barrier``) cost zero — they are
+    on-device data movement the wire model does not see."""
+    kind = PRIMITIVE_WIRE_KINDS.get(op)
+    if kind is None:
+        return 0.0
+    link = link or LinkParams()
+    wire = wire_bytes_per_device(kind, float(tensor_bytes),
+                                 int(axis_size))
+    return (max(int(launches), 1) * link.latency_s
+            + wire / link.bandwidth_bytes_per_s)
+
+
+def program_cost(steps, tensor_bytes: float, axis_sizes: Dict[str, int],
+                 *, link: Optional[LinkParams] = None) -> float:
+    """Modeled seconds for a whole plan-IR program: the sum of its
+    steps' :func:`primitive_cost` terms.  ``steps`` is an iterable of
+    dict-likes with ``op``, ``axis`` (a role key into ``axis_sizes``),
+    and optional ``launches`` / ``bytes_scale`` (wire-dtype shrink)
+    enrichments the autotuner derives from the payload signature."""
+    total = 0.0
+    for st in steps:
+        n = int(axis_sizes.get(st.get("axis") or "main", 1))
+        total += primitive_cost(
+            st["op"], float(tensor_bytes) * float(
+                st.get("bytes_scale", 1.0)),
+            n, launches=int(st.get("launches", 1)), link=link)
+    return total
 
 
 # computation header: "%name (params) -> type {" (possibly "ENTRY %...")

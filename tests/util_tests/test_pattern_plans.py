@@ -1,0 +1,249 @@
+"""Pattern-plan autotuner (``autotune_pattern_plan``): the plan-IR
+candidate search riding the measured-plan cache.
+
+The cache-key discipline is the same as ``autotune_plan`` — and the new
+hazard here is the VARIANT: four patterns (and the legacy exchange
+tuner) can share one payload signature and one cache file, and a plan
+tuned for one must never serve another.  Second tunings of an exact
+match must serve with ZERO probe executions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.ops import plan_ir
+from chainermn_tpu.utils import autotune
+from chainermn_tpu.utils.metrics import MetricsRegistry, set_registry
+
+AX = "world"
+
+
+@pytest.fixture()
+def comm():
+    return cmn.create_communicator("tpu_xla", axis_name=AX)
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
+
+
+def fsdp_payload(width=16):
+    rng = np.random.RandomState(0)
+    params = {
+        "w": jnp.asarray(rng.randn(8, width, 4), jnp.float32),
+        "b": jnp.asarray(rng.randn(8, 2), jnp.float32),
+    }
+    dims = {"w": 0, "b": 0}
+    return params, dims
+
+
+def tune(comm, params, cache, **kw):
+    kw.setdefault("trials", 1)
+    kw.setdefault("warmup", 1)
+    return autotune.autotune_pattern_plan(comm, params,
+                                          cache_path=cache, **kw)
+
+
+class TestTuneAndCache:
+    def test_fsdp_tune_then_zero_probe_serve(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        plan = tune(comm, params, cache, pattern="fsdp_gather",
+                    dims=dims, wire_dtypes=(None, "bfloat16"))
+        assert not plan.from_cache and plan.n_probes > 0
+        assert isinstance(plan.program, dict)
+        assert plan.program["pattern"] == "fsdp_gather"
+        assert plan.meta["pattern"] == "fsdp_gather"
+        # every probed candidate passed parity — losing bitwise
+        # equality disqualifies, it doesn't warn
+        assert plan.meta["timings"]
+        assert all(t["parity_ok"] for t in plan.meta["timings"])
+        # the winner is a runnable program
+        prog = plan_ir.ensure_program(plan, "fsdp_gather")
+        assert prog.label == plan.strategy
+
+        again = tune(comm, params, cache, pattern="fsdp_gather",
+                     dims=dims, wire_dtypes=(None, "bfloat16"))
+        assert again.from_cache and again.n_probes == 0
+        assert again.program == plan.program
+        assert again.strategy == plan.strategy
+
+    @pytest.mark.parametrize("pattern,kw", [
+        ("moe_all_to_all", {"split_axis": 0, "concat_axis": 1}),
+        ("ring_permute", {}),
+        ("pipeline_edge", {"shift": 1, "wrap": False}),
+    ])
+    def test_other_patterns_tune_and_serve(self, comm, tmp_path,
+                                           pattern, kw):
+        cache = str(tmp_path / "plans.json")
+        payload = {
+            "moe_all_to_all": jnp.ones((8, 4, 8), jnp.float32),
+            "ring_permute": (jnp.ones((2, 8), jnp.float32),
+                             jnp.ones((2, 8), jnp.float32)),
+            "pipeline_edge": jnp.ones((4, 8), jnp.float32),
+        }[pattern]
+        plan = tune(comm, payload, cache, pattern=pattern, **kw)
+        assert not plan.from_cache and plan.n_probes > 0
+        assert plan.program["pattern"] == pattern
+        again = tune(comm, payload, cache, pattern=pattern, **kw)
+        assert again.from_cache and again.n_probes == 0
+
+    def test_force_retunes_despite_cache(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        tune(comm, params, cache, pattern="fsdp_gather", dims=dims)
+        forced = tune(comm, params, cache, pattern="fsdp_gather",
+                      dims=dims, force=True)
+        assert not forced.from_cache and forced.n_probes > 0
+
+
+class TestKeyDiscipline:
+    def test_pattern_statics_rekey(self, comm, tmp_path):
+        """dims / split axes / direction are part of the variant: the
+        same payload bytes under different statics is a different
+        search."""
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        tune(comm, params, cache, pattern="fsdp_gather", dims=dims)
+        other = tune(comm, params, cache, pattern="fsdp_gather",
+                     dims={"w": 1, "b": 0})
+        assert not other.from_cache  # dims change missed the cache
+
+        x = jnp.ones((8, 8, 4), jnp.float32)
+        tune(comm, x, cache, pattern="moe_all_to_all",
+             split_axis=0, concat_axis=1)
+        rev = tune(comm, x, cache, pattern="moe_all_to_all",
+                   split_axis=1, concat_axis=0)
+        assert not rev.from_cache
+
+    def test_patterns_never_cross_serve(self, comm, tmp_path):
+        """One payload, one cache file, two patterns: each serves only
+        its own entry."""
+        cache = str(tmp_path / "plans.json")
+        x = jnp.ones((8, 4, 8), jnp.float32)
+        moe = tune(comm, x, cache, pattern="moe_all_to_all",
+                   split_axis=0, concat_axis=1)
+        pipe = tune(comm, x, cache, pattern="pipeline_edge",
+                    shift=1, wrap=False)
+        assert not pipe.from_cache
+        assert moe.key != pipe.key
+        assert tune(comm, x, cache, pattern="moe_all_to_all",
+                    split_axis=0, concat_axis=1).from_cache
+        assert tune(comm, x, cache, pattern="pipeline_edge",
+                    shift=1, wrap=False).from_cache
+
+    def test_variant_separates_from_legacy_tuner(self, comm, tmp_path):
+        """The legacy exchange tuner and the pattern tuner share the
+        cache file but never each other's plans."""
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        legacy = autotune.autotune_plan(comm, params, cache_path=cache,
+                                        trials=1, warmup=1)
+        pattern = tune(comm, params, cache, pattern="fsdp_gather",
+                       dims=dims)
+        assert legacy.key != pattern.key
+        assert legacy.program is None and pattern.program is not None
+        # both still serve from the shared file
+        assert autotune.autotune_plan(
+            comm, params, cache_path=cache, trials=1,
+            warmup=1).from_cache
+        assert tune(comm, params, cache, pattern="fsdp_gather",
+                    dims=dims).from_cache
+
+    def test_format_version_rekeys(self, comm, tmp_path, monkeypatch):
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        tune(comm, params, cache, pattern="fsdp_gather", dims=dims)
+        monkeypatch.setattr(autotune, "FORMAT_VERSION",
+                            autotune.FORMAT_VERSION + 1)
+        bumped = tune(comm, params, cache, pattern="fsdp_gather",
+                      dims=dims)
+        assert not bumped.from_cache
+
+    def test_payload_change_rekeys(self, comm, tmp_path):
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        tune(comm, params, cache, pattern="fsdp_gather", dims=dims)
+        wide, _ = fsdp_payload(width=32)
+        assert not tune(comm, wide, cache, pattern="fsdp_gather",
+                        dims=dims).from_cache
+
+
+class TestObservability:
+    def test_per_pattern_hit_miss_counters(self, comm, tmp_path,
+                                           registry):
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        tune(comm, params, cache, pattern="fsdp_gather", dims=dims)
+        assert registry.counter(
+            "autotune/plan_cache_misses").value == 1
+        assert registry.counter(
+            "autotune/plan_cache_misses_fsdp_gather").value == 1
+        tune(comm, params, cache, pattern="fsdp_gather", dims=dims)
+        assert registry.counter(
+            "autotune/plan_cache_hits").value == 1
+        assert registry.counter(
+            "autotune/plan_cache_hits_fsdp_gather").value == 1
+        # a second pattern gets its own per-pattern counter
+        tune(comm, jnp.ones((4, 8), jnp.float32), cache,
+             pattern="pipeline_edge", shift=1, wrap=False)
+        assert registry.counter(
+            "autotune/plan_cache_misses_pipeline_edge").value == 1
+        assert registry.counter(
+            "autotune/plan_cache_misses").value == 2
+
+
+class TestGuards:
+    def test_tracer_guard(self, comm):
+        params, dims = fsdp_payload()
+
+        def bad(p):
+            return autotune.autotune_pattern_plan(
+                comm, p, pattern="fsdp_gather", dims=dims)
+
+        with pytest.raises(RuntimeError, match="under tracing"):
+            jax.jit(bad)(params)
+
+    def test_unknown_pattern_raises(self, comm):
+        with pytest.raises(ValueError, match="unknown pattern"):
+            autotune.autotune_pattern_plan(
+                comm, jnp.ones((4,)), pattern="bogus")
+
+    def test_moe_multi_leaf_payload_raises(self, comm):
+        with pytest.raises(ValueError):
+            autotune.autotune_pattern_plan(
+                comm, {"a": jnp.ones((8, 4, 8)),
+                       "b": jnp.ones((8, 4, 8))},
+                pattern="moe_all_to_all", trials=1, warmup=1,
+                split_axis=0, concat_axis=1)
+
+
+class TestPlanCellIntegration:
+    def test_cell_retunes_with_pattern_tuner(self, comm, tmp_path):
+        """A drift re-tune through PlanCell re-runs the PATTERN search
+        (not the legacy exchange search) when the cell was resolved
+        with one."""
+        cache = str(tmp_path / "plans.json")
+        params, dims = fsdp_payload()
+        plan = tune(comm, params, cache, pattern="fsdp_gather",
+                    dims=dims)
+        cell = autotune.PlanCell(plan)
+        cell.tuner = autotune.autotune_pattern_plan
+        cell.tune_kwargs = {"pattern": "fsdp_gather", "dims": dims,
+                            "cache_path": cache, "trials": 1,
+                            "warmup": 1}
+        gen = cell.generation
+        new = cell.retune(comm, params)
+        assert cell.generation == gen + 1
+        assert new.program is not None
+        assert new.program["pattern"] == "fsdp_gather"
+        assert not new.from_cache  # force=True bypasses the cache
