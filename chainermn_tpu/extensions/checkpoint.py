@@ -402,7 +402,8 @@ class MultiNodeCheckpointer:
             self.comm,
             params=getattr(updater, "params", None),
             opt_state=getattr(updater, "opt_state", None),
-            zero1=bool(getattr(updater, "zero1", False)))
+            zero1=bool(getattr(updater, "zero1", False)),
+            sharding=getattr(updater, "sharding", None))
 
     def save(self, updater, trainer=None) -> None:
         from chainermn_tpu.training._resume import collect_train_state

@@ -57,6 +57,7 @@ __all__ = [
     "PlanProgram",
     "step",
     "describe_payload",
+    "describe_state_payload",
     "ensure_program",
     "lower_fsdp_gather",
     "lower_moe_all_to_all",
@@ -144,6 +145,47 @@ def describe_payload(tree, layouts=None) -> Tuple[LeafDesc, ...]:
                                              jnp.asarray(leaf).dtype))),
                  layout=(None if d is None else int(d)))
         for leaf, d in zip(leaves, lay))
+
+
+def describe_state_payload(layouts, world: Optional[int] = None
+                           ) -> Tuple[LeafDesc, ...]:
+    """Payload descriptors for the LOCAL (per-member) shard payload a
+    sharded-state exchange moves, derived straight from per-leaf layout
+    signatures (``parallel.sharded_state.LeafLayout`` objects or their
+    record dicts + shape/dtype) — never from live arrays, so plans can
+    be tuned before any state is materialized.
+
+    Kind mapping: ``fsdp`` → the dim-sharded local slice with
+    ``layout`` = the shard dim (what ``lower_fsdp_gather`` widens);
+    ``shard`` → the flat ``(ceil(size/world),)`` ZeRO shard, gathered
+    along axis 0; ``rep``/``stack`` → the full leaf, no distributed
+    dim (rides the exchange unchanged).
+    """
+    descs = []
+    for spec in layouts:
+        get = (spec.get if isinstance(spec, dict)
+               else lambda k, _s=spec: getattr(_s, k, None))
+        kind = get("kind")
+        shape = tuple(int(s) for s in (get("shape") or ()))
+        dtype = str(get("dtype") or "float32")
+        w = int(world if world is not None else get("world") or 1)
+        if kind == "fsdp":
+            d = int(get("dim"))
+            if shape[d] % w:
+                raise ValueError(
+                    f"fsdp leaf dim {d} (length {shape[d]}) not "
+                    f"divisible by world {w}")
+            local = list(shape)
+            local[d] //= w
+            descs.append(LeafDesc(tuple(local), dtype, layout=d))
+        elif kind == "shard":
+            size = int(get("size"))
+            descs.append(LeafDesc((-(-size // w),), dtype, layout=0))
+        elif kind in ("rep", "stack"):
+            descs.append(LeafDesc(shape, dtype, layout=None))
+        else:
+            raise ValueError(f"unknown layout kind {kind!r}")
+    return tuple(descs)
 
 
 # --------------------------------------------------------------------- #

@@ -22,6 +22,11 @@ supplies all of them, designed for the TPU mesh from the start:
   head↔sequence all-to-all (DeepSpeed-Ulysses style).
 - :mod:`chainermn_tpu.parallel.expert` — expert parallelism: token
   dispatch/combine all-to-alls around per-device experts.
+- :mod:`chainermn_tpu.parallel.sharded_state` — the unified sharded-state
+  layer: per-leaf :class:`LeafLayout` signatures shared by ZeRO-1/2/3,
+  :class:`ShardedState` (ZeRO-3 residency + tuned ``fsdp_gather`` plans)
+  and :class:`LayerGatherStream` (JIT per-layer gathers with a prefetch
+  window).
 """
 
 from chainermn_tpu.parallel.mesh import MeshConfig
@@ -43,21 +48,35 @@ from chainermn_tpu.parallel.tensor import (
 from chainermn_tpu.parallel.ulysses import ulysses_attention
 from chainermn_tpu.parallel.expert import expert_parallel_moe
 from chainermn_tpu.parallel.fsdp import fsdp_dims, fsdp_gather, fsdp_specs
+from chainermn_tpu.parallel.sharded_state import (
+    LayerGatherStream,
+    LeafLayout,
+    ShardedState,
+    gather_state_leaves,
+    shard_state_leaves,
+    state_layout_table,
+)
 
 __all__ = [
+    "LayerGatherStream",
+    "LeafLayout",
     "MeshConfig",
+    "ShardedState",
     "column_parallel_dense",
     "expert_parallel_moe",
     "fsdp_dims",
     "fsdp_gather",
     "fsdp_specs",
+    "gather_state_leaves",
     "local_attention",
     "pipeline_apply",
     "pipeline_train_1f1b",
     "pipeline_train_interleaved",
     "ring_attention",
     "row_parallel_dense",
+    "shard_state_leaves",
     "stack_stage_params",
+    "state_layout_table",
     "ulysses_attention",
     "zigzag_indices",
 ]

@@ -1,0 +1,814 @@
+"""Unified sharded-state layer: per-leaf layout signatures driving
+ZeRO-2/3 state, plan-IR-tuned exchanges, and JIT per-layer gathers.
+
+PR 19 made every exchange a searchable plan-IR program; the *state*
+side stayed fragmented — ZeRO-1 in ``training/optimizers.py``, FSDP in
+``parallel/fsdp.py``, elastic re-layout speaking only the ZeRO-1 layout
+(``_zero1_leaf_layout``).  This module is the one signature in the
+spirit of "Automatic Cross-Replica Sharding" (PAPERS.md 2004.13336)
+that also drives the 2112.01075-style redistribution already in
+``relayout_state``:
+
+- :class:`LeafLayout` — one leaf's layout: tree path, kind, full
+  shape/dtype, world, shard dim.  ``to_record()`` emits exactly the
+  JSON records ``topology_signature`` stamps into snapshots (the
+  ZeRO-1 ``shard``/``stack``/``rep`` vocabulary, extended with
+  ``fsdp`` for dim-sharded ZeRO-3 leaves), so every consumer —
+  elastic re-layout, shard-only save sets, the plan IR's payload
+  descriptors, the memory accountant — reads the SAME source of truth.
+- :func:`state_layout_table` — the per-mode builder: ``zero1``/
+  ``zero2`` state is world-stacked flat shards (the
+  ``zero1_optimizer`` ``_leaf_shard`` layout, identified by the same
+  longest-path-suffix match ``shard_opt_state`` uses); ``zero3``
+  params and mirrored optimizer moments are dim-sharded per
+  ``fsdp_dims``.
+- :func:`gather_state_leaves` / :func:`shard_state_leaves` — the
+  host-side gather/scatter over ANY layout table (the unified layer
+  behind the deprecated ``gather_zero1_leaves``/``shard_zero1_leaves``
+  shims in ``training/elastic.py``).
+- :class:`ShardedState` — the ZeRO-3/FSDP plan: params (and their
+  elementwise optimizer state) live 1/world at rest, are gathered
+  just-in-time per layer through :class:`LayerGatherStream`, and the
+  gather program is tuned/cached via ``autotune_pattern_plan
+  (pattern="fsdp_gather")`` with the payload descriptors derived from
+  this table (``ops.plan_ir.describe_state_payload``).
+- :class:`LayerGatherStream` — the JIT layer gather with a PREFETCH
+  WINDOW: gathering layer ``i + window`` is gated (via
+  ``lax.optimization_barrier`` token threading — the barrier
+  transposes to itself, so AD's reduce-scatter is untouched) on layer
+  ``i``'s compute having retired, so at most ``window`` layers of
+  full-width params are live at once while the next layer's gather
+  overlaps the current layer's compute.
+  ``utils.comm_model.choose_gather_prefetch_depth`` sizes the window
+  from the latency/bandwidth model.
+
+ZeRO-2 itself lives with its siblings in ``training/optimizers.py``
+(:func:`~chainermn_tpu.training.optimizers.zero2_optimizer` — the
+per-bucket reduce-scatter IS the gradient exchange); its state layout
+is the ZeRO-1 table here, which is why elastic resize and shard-only
+snapshots handle it with zero new code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "LAYOUT_KINDS",
+    "LeafLayout",
+    "LayerGatherStream",
+    "ShardedState",
+    "gather_state_leaves",
+    "layout_records",
+    "shard_state_leaves",
+    "state_layout_table",
+    "zero_opt_layouts",
+]
+
+#: the layout vocabulary — ``shard``/``stack``/``rep`` are the ZeRO-1
+#: records every existing snapshot already carries; ``fsdp`` is the
+#: dim-sharded ZeRO-3 extension.
+LAYOUT_KINDS = ("rep", "stack", "shard", "fsdp")
+
+SHARDING_MODES = ("zero1", "zero2", "zero3")
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+# --------------------------------------------------------------------- #
+# the layout signature
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafLayout:
+    """One leaf's layout signature: how this param/grad/optimizer leaf
+    is laid out across ``world`` members.
+
+    ``shape``/``dtype`` describe the FULL (gathered) leaf; the at-rest
+    per-member view follows from ``kind``:
+
+    - ``rep`` — replicated, every member holds the full leaf;
+    - ``stack`` — a leading member axis over per-member replicas
+      (adam's ``count`` under the world-stacked carry);
+    - ``shard`` — a ``(world, ceil(size/world))`` stack of flat ZeRO-1/2
+      shards (``size`` = the mirrored parameter's true element count;
+      padding lanes zero);
+    - ``fsdp`` — dim-sharded ZeRO-3: dim ``dim`` split evenly over the
+      world (``shape[dim] % world == 0`` by ``fsdp_dims`` construction).
+
+    ``axis`` names the mesh axis the sharding lives on (``None`` for
+    ``rep``).  ``to_record()``/``from_record()`` round-trip the
+    JSON-stable form ``topology_signature`` stamps — bit-compatible
+    with the records ``_zero1_leaf_layout`` has always written.
+    """
+
+    path: Tuple[str, ...]
+    kind: str
+    shape: Tuple[int, ...]
+    dtype: str
+    world: int
+    dim: Optional[int] = None       # fsdp shard dim
+    size: Optional[int] = None      # shard true element count
+    axis: Optional[str] = None
+
+    def __post_init__(self):
+        if self.kind not in LAYOUT_KINDS:
+            raise ValueError(
+                f"unknown layout kind {self.kind!r}; expected one of "
+                f"{LAYOUT_KINDS}")
+        if self.kind == "shard" and self.size is None:
+            raise ValueError(f"{'/'.join(self.path)}: shard layout "
+                             "needs the true element count (size=)")
+        if self.kind == "fsdp" and self.dim is None:
+            raise ValueError(f"{'/'.join(self.path)}: fsdp layout "
+                             "needs the shard dim (dim=)")
+
+    # -- geometry ------------------------------------------------------ #
+
+    @property
+    def global_size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= int(s)
+        return n
+
+    def local_shape(self, world: Optional[int] = None) -> Tuple[int, ...]:
+        """The at-rest PER-MEMBER shape (one member's slice)."""
+        w = int(world if world is not None else self.world)
+        if self.kind == "shard":
+            return (_ceil_div(int(self.size), w),)
+        if self.kind == "fsdp":
+            shape = list(self.shape)
+            d = int(self.dim)
+            if shape[d] % w:
+                raise ValueError(
+                    f"{'/'.join(self.path)}: fsdp dim {d} (length "
+                    f"{shape[d]}) not divisible by world {w}")
+            shape[d] //= w
+            return tuple(shape)
+        # rep and stack both hold the full leaf per member (a stack's
+        # member rows are replicas)
+        return tuple(self.shape)
+
+    def local_bytes(self, world: Optional[int] = None) -> int:
+        n = 1
+        for s in self.local_shape(world):
+            n *= int(s)
+        return n * np.dtype(self.dtype).itemsize
+
+    def global_bytes(self) -> int:
+        return self.global_size * np.dtype(self.dtype).itemsize
+
+    # -- the JSON record ------------------------------------------------ #
+
+    def to_record(self) -> dict:
+        """The snapshot-stamped record — EXACTLY the
+        ``_zero1_leaf_layout`` vocabulary for the legacy kinds, so
+        every existing topology signature stays readable."""
+        if self.kind == "shard":
+            return {"kind": "shard", "size": int(self.size)}
+        if self.kind == "fsdp":
+            return {"kind": "fsdp", "dim": int(self.dim),
+                    "len": int(self.shape[self.dim])}
+        return {"kind": self.kind}
+
+    @classmethod
+    def from_record(cls, record: dict, *, path: Tuple[str, ...] = (),
+                    shape: Tuple[int, ...] = (), dtype: str = "float32",
+                    world: int = 1, axis: Optional[str] = None
+                    ) -> "LeafLayout":
+        kind = record.get("kind")
+        return cls(path=tuple(path), kind=kind,
+                   shape=tuple(int(s) for s in shape), dtype=str(dtype),
+                   world=int(world), dim=record.get("dim"),
+                   size=record.get("size"), axis=axis)
+
+
+def layout_records(layouts: Sequence) -> List[dict]:
+    """``to_record()`` over a layout sequence — accepts
+    :class:`LeafLayout` objects or already-built record dicts
+    (pass-through), so consumers can speak either form."""
+    return [l.to_record() if isinstance(l, LeafLayout) else dict(l)
+            for l in layouts]
+
+
+def _record(spec) -> dict:
+    return spec.to_record() if isinstance(spec, LeafLayout) else spec
+
+
+# --------------------------------------------------------------------- #
+# layout-table builders
+# --------------------------------------------------------------------- #
+
+
+def _leaf_paths(tree):
+    from jax.tree_util import tree_flatten_with_path
+
+    paths, _ = tree_flatten_with_path(tree)
+    return paths
+
+
+def _path_keys(path) -> Tuple[str, ...]:
+    return tuple(str(k) for k in path)
+
+
+def _leaf_meta(leaf) -> Tuple[Tuple[int, ...], str]:
+    shape = tuple(int(s) for s in np.shape(leaf))
+    dtype = getattr(leaf, "dtype", None)
+    return shape, str(np.dtype(dtype) if dtype is not None
+                      else np.asarray(leaf).dtype)
+
+
+def _suffix_match(keys: Tuple[str, ...], table: Dict[Tuple[str, ...], Any]):
+    """Longest matching path suffix, INCLUDING the empty suffix (a bare
+    jax.Array params "tree" has the empty path as its only key) — the
+    ``shard_opt_state`` discipline."""
+    for start in range(len(keys) + 1):
+        hit = table.get(keys[start:])
+        if hit is not None:
+            yield hit
+
+
+def zero_opt_layouts(opt_state, params, world: int,
+                     axis: Optional[str] = None) -> List[LeafLayout]:
+    """Layout table for a WORLD-STACKED ZeRO-1/2 optimizer-state tree,
+    in flattened-leaf order — the generalization of
+    ``training.elastic._zero1_leaf_layout`` (which now delegates here):
+    a ``(world, ceil(N/world))`` stack whose padded shard width matches
+    a suffix-identified parameter is a ``shard``; any other leading
+    member axis is a ``stack``; the rest are ``rep``.
+
+    Shapes only — never materializes a leaf: multi-process-sharded
+    arrays are not fully addressable and must not be pulled to host
+    just to record their layout.
+    """
+    by_path: Dict[Tuple[str, ...], int] = {}
+    for path, p in _leaf_paths(params):
+        shape = tuple(np.shape(p))
+        by_path[_path_keys(path)] = (
+            int(np.prod(shape, dtype=np.int64)) if shape else 1)
+
+    layouts: List[LeafLayout] = []
+    for path, leaf in _leaf_paths(opt_state):
+        shape, dtype = _leaf_meta(leaf)
+        keys = _path_keys(path)
+        spec: Optional[LeafLayout] = None
+        if len(shape) == 2 and shape[0] == world:
+            for n in _suffix_match(keys, by_path):
+                if _ceil_div(n, world) == shape[1]:
+                    spec = LeafLayout(keys, "shard", shape, dtype,
+                                      world, size=n, axis=axis)
+                    break
+        if spec is None:
+            kind = ("stack" if len(shape) >= 1 and shape[0] == world
+                    else "rep")
+            spec = LeafLayout(keys, kind, shape, dtype, world,
+                              axis=axis if kind != "rep" else None)
+        layouts.append(spec)
+    return layouts
+
+
+def _fsdp_param_layouts(params, dims, world: int,
+                        axis: Optional[str]) -> List[LeafLayout]:
+    import jax
+
+    leaves_p, treedef = jax.tree_util.tree_flatten_with_path(params)
+    dim_list = jax.tree.structure(params).flatten_up_to(dims)
+    out = []
+    for (path, leaf), d in zip(leaves_p, dim_list):
+        shape, dtype = _leaf_meta(leaf)
+        keys = _path_keys(path)
+        if d is None:
+            out.append(LeafLayout(keys, "rep", shape, dtype, world))
+        else:
+            out.append(LeafLayout(keys, "fsdp", shape, dtype, world,
+                                  dim=int(d), axis=axis))
+    del treedef
+    return out
+
+
+def _fsdp_opt_layouts(opt_state, params, dims, world: int,
+                      axis: Optional[str]) -> List[LeafLayout]:
+    """ZeRO-3 optimizer-state layouts: elementwise moments mirror their
+    parameter leaf-for-leaf (``shard_opt_state``'s contract), so each
+    state leaf inherits the dim of the suffix-identified param with an
+    EQUAL shape; scalars and unmatched leaves replicate — never a
+    shape-only guess (two same-shape params can shard different dims).
+    """
+    import jax
+
+    by_path: Dict[Tuple[str, ...], Tuple[Tuple[int, ...], Optional[int]]] = {}
+    dim_list = jax.tree.structure(params).flatten_up_to(dims)
+    for (path, p), d in zip(_leaf_paths(params), dim_list):
+        shape = tuple(int(s) for s in np.shape(p))
+        by_path[_path_keys(path)] = (shape, None if d is None else int(d))
+
+    out = []
+    for path, leaf in _leaf_paths(opt_state):
+        shape, dtype = _leaf_meta(leaf)
+        keys = _path_keys(path)
+        spec: Optional[LeafLayout] = None
+        for pshape, d in _suffix_match(keys, by_path):
+            if pshape == shape:
+                if d is None:
+                    spec = LeafLayout(keys, "rep", shape, dtype, world)
+                else:
+                    spec = LeafLayout(keys, "fsdp", shape, dtype, world,
+                                      dim=d, axis=axis)
+                break
+        if spec is None:
+            spec = LeafLayout(keys, "rep", shape, dtype, world)
+        out.append(spec)
+    return out
+
+
+def state_layout_table(mode: str, params, opt_state=None, *, world: int,
+                       dims=None, axis: Optional[str] = None
+                       ) -> Dict[str, List[LeafLayout]]:
+    """The per-mode layout table — the single source of truth the
+    ISSUE's three consumers read:
+
+    - plan-IR payload descriptors
+      (``ops.plan_ir.describe_state_payload``),
+    - elastic re-layout / shard-only snapshots (``topology_signature``
+      stamps ``layout_records`` of these),
+    - :class:`~chainermn_tpu.utils.programs.MemoryAccountant` gauges
+      (``LeafLayout.local_bytes`` sums to the per-chip claim).
+
+    Returns ``{"params": [...], "opt_state": [...]}`` in
+    flattened-leaf order.  ``mode``:
+
+    - ``"zero1"`` / ``"zero2"`` — params replicated, opt state the
+      world-stacked flat-shard layout (:func:`zero_opt_layouts`;
+      ZeRO-2's gradient shards are transient, never carried state);
+    - ``"zero3"`` — params (and mirrored opt moments) dim-sharded per
+      ``dims`` (an ``fsdp_dims`` tree — required).
+    """
+    if mode not in SHARDING_MODES:
+        raise ValueError(
+            f"unknown sharding mode {mode!r}; expected one of "
+            f"{SHARDING_MODES}")
+    world = int(world)
+    if mode in ("zero1", "zero2"):
+        table: Dict[str, List[LeafLayout]] = {"params": [
+            LeafLayout(_path_keys(path), "rep", *(_leaf_meta(leaf)),
+                       world)
+            for path, leaf in _leaf_paths(params)]}
+        if opt_state is not None:
+            table["opt_state"] = zero_opt_layouts(
+                opt_state, params, world, axis=axis)
+        return table
+    if dims is None:
+        raise ValueError(
+            "state_layout_table(mode='zero3') needs dims= (an "
+            "fsdp_dims tree) — the shard dims ARE the layout")
+    table = {"params": _fsdp_param_layouts(params, dims, world, axis)}
+    if opt_state is not None:
+        table["opt_state"] = _fsdp_opt_layouts(
+            opt_state, params, dims, world, axis)
+    return table
+
+
+# --------------------------------------------------------------------- #
+# host-side gather / scatter over any layout table
+# --------------------------------------------------------------------- #
+
+
+def gather_state_leaves(tree, layouts: Sequence):
+    """Gather a sharded state tree to its full host-side values per its
+    layout records: ``shard`` leaves → 1-D true-extent arrays,
+    ``stack`` leaves → one representative row, ``fsdp``/``rep`` leaves
+    unchanged (a ZeRO-3 leaf pulled to host via ``device_get`` is
+    already full-width — the NamedSharding reassembles it).  The
+    unified layer behind the deprecated ``gather_zero1_leaves``."""
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    from chainermn_tpu.training.elastic import RelayoutError
+
+    path_leaves, treedef = tree_flatten_with_path(tree)
+    if len(path_leaves) != len(layouts):
+        raise RelayoutError(
+            f"{len(layouts)} layout records for {len(path_leaves)} "
+            "leaves")
+    out = []
+    for (path, leaf), spec in zip(path_leaves, layouts):
+        rec = _record(spec)
+        kind = rec.get("kind")
+        arr = np.asarray(leaf)
+        if kind == "shard":
+            out.append(arr.reshape(-1)[: int(rec["size"])])
+        elif kind == "stack":
+            out.append(arr[0])
+        elif kind in ("rep", "fsdp"):
+            out.append(arr)
+        else:
+            raise RelayoutError(
+                f"leaf {keystr(path)}: unknown layout kind {kind!r}")
+    return jax.tree.unflatten(treedef, out)
+
+
+def shard_state_leaves(tree, layouts: Sequence, world: int):
+    """Inverse of :func:`gather_state_leaves`: lay a gathered state
+    onto ``world`` members from scratch — ``shard`` leaves pad to
+    ``ceil(N/world)·world`` and split contiguously, ``stack`` leaves
+    re-stack, ``fsdp``/``rep`` leaves pass through (the DEVICE
+    placement shards fsdp leaves; their host form is full-width).
+    This is the reference layout ``relayout_state`` must match
+    bitwise."""
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    from chainermn_tpu.training.elastic import RelayoutError
+
+    path_leaves, treedef = tree_flatten_with_path(tree)
+    if len(path_leaves) != len(layouts):
+        raise RelayoutError(
+            f"{len(layouts)} layout records for {len(path_leaves)} "
+            "leaves")
+    out = []
+    for (path, leaf), spec in zip(path_leaves, layouts):
+        rec = _record(spec)
+        kind = rec.get("kind")
+        arr = np.asarray(leaf)
+        if kind == "shard":
+            size = int(rec["size"])
+            s = _ceil_div(size, int(world))
+            flat = np.zeros((int(world) * s,), dtype=arr.dtype)
+            flat[:size] = arr.reshape(-1)[:size]
+            out.append(flat.reshape(int(world), s))
+        elif kind == "stack":
+            out.append(np.concatenate([arr[None]] * int(world), axis=0))
+        elif kind in ("rep", "fsdp"):
+            out.append(arr)
+        else:
+            raise RelayoutError(
+                f"leaf {keystr(path)}: unknown layout kind {kind!r}")
+    return jax.tree.unflatten(treedef, out)
+
+
+# --------------------------------------------------------------------- #
+# the JIT layer-gather stream (ZeRO-3's forward)
+# --------------------------------------------------------------------- #
+
+
+def _layer_groups(params, dims):
+    """Split a param tree into gather units.  A mapping's top-level
+    keys (sorted — deterministic across processes) are the layers; any
+    other tree is one group.  Returns ``[(name, subtree, subdims)]``."""
+    if isinstance(params, dict):
+        names = sorted(params)
+        return [(str(k), params[k], dims[k]) for k in names]
+    return [("all", params, dims)]
+
+
+class LayerGatherStream:
+    """Just-in-time per-layer parameter gathers with a prefetch window
+    — ZeRO-3's forward pass, built INSIDE the step's ``shard_map``.
+
+    The canonical loop::
+
+        stream = sharded.gather_stream(local_params, window=2)
+        for i in range(len(stream)):
+            full = stream.layer(i)        # this layer, full width
+            x = apply(full, x)
+            x = stream.retire(i, x)       # free it; release i+window
+
+    Memory discipline: ``layer(i)`` issues the gathers for layers
+    ``[i, i + window)``; each gather past the window is GATED — its
+    input shards ride one ``lax.optimization_barrier`` with the retire
+    token of layer ``i - window``, so XLA cannot hoist every gather to
+    the program head and resident full-width params stay bounded by
+    ``window`` layers.  ``retire(i, x)`` drops layer ``i``'s gathered
+    leaves (XLA frees buffers with no remaining uses) and mints the
+    token that releases layer ``i + window`` — threading ``x`` through
+    the barrier, which transposes to itself, so the backward's
+    reduce-scatter (the gather's AD transpose) is untouched.
+
+    The gather itself is either the legacy per-leaf ``fsdp_gather`` or
+    a tuned plan-IR program (``plan=``); gathers lowered from a
+    CACHE-SERVED plan count ``sharded/plan_cache_gathers`` (and every
+    issue counts ``sharded/layer_gathers``) — trace-time counters, one
+    per compiled gather program, visible on ``/programz``.
+    """
+
+    def __init__(self, params, dims, *, axis_name: str,
+                 window: int = 2, plan=None, wire_dtype=None,
+                 inter_axis_name: Optional[str] = None,
+                 plan_from_cache: bool = False):
+        from chainermn_tpu.parallel.fsdp import fsdp_gather
+
+        self._gather = fsdp_gather
+        self._groups = _layer_groups(params, dims)
+        self._axis_name = axis_name
+        self._inter_axis_name = inter_axis_name
+        self._window = max(1, int(window))
+        self._plan = plan
+        self._wire_dtype = wire_dtype
+        self._plan_from_cache = bool(plan_from_cache)
+        self._full: Dict[int, Any] = {}
+        self._tokens: Dict[int, Any] = {}
+
+    def __len__(self) -> int:
+        return len(self._groups)
+
+    @property
+    def names(self) -> List[str]:
+        return [name for name, _, _ in self._groups]
+
+    @property
+    def window(self) -> int:
+        return self._window
+
+    def _token0(self):
+        import jax.numpy as jnp
+
+        from chainermn_tpu.parallel._compat import pcast
+
+        return pcast(jnp.zeros((), jnp.int32), self._axis_name,
+                     to="varying")
+
+    def _gate(self, subtree, token):
+        """Tie every shard leaf's availability to ``token`` — the
+        scheduling fence that keeps the gather inside the window."""
+        import jax
+
+        from chainermn_tpu.ops.plan_ir import _pin
+
+        if token is None:
+            return subtree
+        leaves, treedef = jax.tree.flatten(subtree)
+        pinned = _pin(tuple(leaves) + (token,))
+        return treedef.unflatten(list(pinned[:-1]))
+
+    def _issue(self, i: int) -> None:
+        if i in self._full:
+            return
+        from chainermn_tpu.utils.metrics import get_registry
+
+        name, subtree, subdims = self._groups[i]
+        gate = self._tokens.get(i - self._window)
+        subtree = self._gate(subtree, gate)
+        reg = get_registry()
+        reg.inc("sharded/layer_gathers")
+        if self._plan_from_cache:
+            reg.inc("sharded/plan_cache_gathers")
+        self._full[i] = self._gather(
+            subtree, subdims, self._axis_name,
+            None if self._plan is not None else self._wire_dtype,
+            plan=self._plan, inter_axis_name=self._inter_axis_name)
+
+    def layer(self, i: int):
+        """The full-width params of layer ``i``; issues (prefetches)
+        gathers for layers ``[i, i + window)`` whose release token
+        already exists."""
+        n = len(self._groups)
+        if not 0 <= i < n:
+            raise IndexError(f"layer {i} of {n}")
+        self._issue(i)
+        for j in range(i + 1, min(i + self._window, n)):
+            if j - self._window < 0 or j - self._window in self._tokens:
+                self._issue(j)
+        return self._full[i]
+
+    def retire(self, i: int, x):
+        """Drop layer ``i``'s gathered params and mint the token that
+        releases layer ``i + window``'s gather; returns ``x`` (threaded
+        through the barrier — use the returned value)."""
+        from chainermn_tpu.ops.plan_ir import _pin
+
+        self._full.pop(i, None)
+        pinned = _pin((x, self._token0()))
+        x, token = pinned
+        self._tokens[i] = token
+        return x
+
+
+# --------------------------------------------------------------------- #
+# the ZeRO-3 plan
+# --------------------------------------------------------------------- #
+
+
+class ShardedState:
+    """ZeRO-3/FSDP sharded-state plan over one data axis: params and
+    their elementwise optimizer state live 1/world at rest
+    (``fsdp_dims``/``fsdp_specs`` pick the layout), are gathered
+    just-in-time per layer (:meth:`gather_stream`), and the gather
+    lowers through a TUNED plan-IR program (:meth:`tune_gather_plan`)
+    whose payload descriptors come straight off the layout table.
+
+    Usage (the ``tests/parallel_tests/test_sharded_state.py`` drill)::
+
+        sharded = ShardedState(params, comm)
+        params = sharded.place(params)             # 1/world at rest
+        opt_state = sharded.init_opt_state(tx)     # moments mirror it
+        sharded.tune_gather_plan(comm)             # cached plan-IR
+        # inside shard_map(in_specs=(sharded.specs, ...)):
+        stream = sharded.gather_stream(local_params)
+
+    The layout signature is the single source of truth three ways:
+    :meth:`layouts` feeds ``topology_signature(sharding="zero3")`` (so
+    elastic resize and shard-only snapshots re-lay this state),
+    :meth:`payload_descs` generates the plan-IR payload for the tuner,
+    and :meth:`register_memory` wires the placed state into the
+    memory accountant so the per-chip win is measured, not asserted
+    (``memory/<prefix>_params_bytes`` counts replication N× — see
+    ``programs._leaf_bytes``).
+    """
+
+    def __init__(self, params, comm=None, *, mesh=None,
+                 axis_name: Optional[str] = None, base_specs=None,
+                 min_size: int = 2, wire_dtype=None,
+                 window: Optional[int] = None):
+        import jax
+
+        from chainermn_tpu.parallel.fsdp import fsdp_dims, fsdp_specs
+        from chainermn_tpu.utils import autotune
+
+        if comm is not None:
+            mesh = mesh if mesh is not None else comm.mesh
+            axis_name = axis_name or comm.axis_name
+        if mesh is None or axis_name is None:
+            raise ValueError("ShardedState needs comm, or mesh + "
+                             "axis_name")
+        self.mesh = mesh
+        self.axis_name = axis_name
+        names = list(mesh.axis_names)
+        shape = tuple(int(s) for s in np.asarray(mesh.devices).shape)
+        self.world = int(shape[names.index(axis_name)])
+        self.wire_dtype = wire_dtype
+        self.dims = fsdp_dims(params, self.world, base_specs,
+                              min_size=min_size, axis=axis_name)
+        self.specs = fsdp_specs(params, self.dims, axis=axis_name,
+                                base_specs=base_specs)
+        self.window = 2 if window is None else max(1, int(window))
+        self.plan_cell = autotune.PlanCell()
+        self.params = None          # set by place()
+        self.opt_state = None       # set by init_opt_state()
+        self._template_meta = [
+            _leaf_meta(leaf) for leaf in jax.tree.leaves(params)]
+        self._treedef = jax.tree.structure(params)
+
+    # -- placement ------------------------------------------------------ #
+
+    def place(self, params):
+        """Device-put ``params`` into the at-rest 1/world layout; the
+        placed tree is kept as the accountant's root."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        placed = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+            params, self.specs)
+        self.params = placed
+        return placed
+
+    def init_opt_state(self, optimizer):
+        """Optimizer state pinned to the params' shardings
+        (``shard_opt_state`` — elementwise moments mirror the layout);
+        requires :meth:`place` first."""
+        from chainermn_tpu.training.optimizers import shard_opt_state
+
+        if self.params is None:
+            raise RuntimeError("init_opt_state before place(params)")
+        self.opt_state = shard_opt_state(optimizer, self.params)
+        return self.opt_state
+
+    # -- the signature --------------------------------------------------- #
+
+    def layouts(self, opt_state=None) -> Dict[str, List[LeafLayout]]:
+        params = self.params
+        if params is None:
+            params = self._treedef.unflatten([
+                np.zeros(shape, dtype)
+                for shape, dtype in self._template_meta])
+        return state_layout_table(
+            "zero3", params,
+            opt_state if opt_state is not None else self.opt_state,
+            world=self.world, dims=self.dims, axis=self.axis_name)
+
+    def payload_descs(self):
+        """Plan-IR payload descriptors for the LOCAL shard payload the
+        gather moves — derived from the layout table, never from live
+        arrays (``ops.plan_ir.describe_state_payload``)."""
+        from chainermn_tpu.ops import plan_ir
+
+        return plan_ir.describe_state_payload(
+            self.layouts()["params"], self.world)
+
+    def local_template(self):
+        """A host tree shaped like one member's at-rest shard — the
+        tuner's payload template (values never read)."""
+        descs = self.payload_descs()
+        return self._treedef.unflatten([
+            np.zeros(d.shape, d.dtype) for d in descs])
+
+    def local_bytes(self, world: Optional[int] = None) -> int:
+        """Analytic at-rest param+opt bytes PER CHIP from the layout
+        table (the accountant measures; this predicts)."""
+        table = self.layouts()
+        total = sum(l.local_bytes(world) for l in table["params"])
+        total += sum(l.local_bytes(world)
+                     for l in table.get("opt_state", []))
+        return total
+
+    # -- the tuned gather ------------------------------------------------ #
+
+    def tune_gather_plan(self, comm, *, cache_path: Optional[str] = None,
+                         wire_dtypes: Optional[Sequence] = None,
+                         **tune_kw):
+        """Tune (or cache warm-start) the ``fsdp_gather`` plan-IR
+        program for this layout — ``autotune_pattern_plan`` over the
+        payload :meth:`payload_descs` describes, keyed so sharded-state
+        plans never serve a foreign ``fsdp_gather`` call site.  The
+        winner lands in :attr:`plan_cell` (generation-bumped, drift-
+        guarded — the ``StandardUpdater`` contract)."""
+        from chainermn_tpu.utils import autotune
+
+        if wire_dtypes is None:
+            wire_dtypes = ((None,) if self.wire_dtype is None
+                           else (None, self.wire_dtype))
+        kwargs = dict(
+            pattern="fsdp_gather",
+            dims=self.dims,
+            wire_dtypes=tuple(wire_dtypes),
+            cache_path=cache_path,
+            variant_extra={"consumer": "sharded_state/zero3",
+                           "window": int(self.window)},
+            **tune_kw)
+        plan = autotune.autotune_pattern_plan(
+            comm, self.local_template(), **kwargs)
+        self.plan_cell.resolve(plan)
+        self.plan_cell.tuner = autotune.autotune_pattern_plan
+        self.plan_cell.tune_kwargs = kwargs
+        return plan
+
+    def auto_window(self, layer_compute_s: float,
+                    max_window: int = 4) -> int:
+        """Size the prefetch window from the tuned plan's measured link
+        constants and a per-layer compute time
+        (``utils.comm_model.choose_gather_prefetch_depth``); adopts and
+        returns the chosen depth."""
+        from chainermn_tpu.utils import comm_model
+
+        plan = self.plan_cell.plan
+        link = None
+        if plan is not None and plan.link:
+            link = comm_model.LinkParams(**plan.link)
+        n_groups = max(1, len(_layer_groups(
+            self.local_template(), self.dims)))
+        per_layer = self.local_bytes() * self.world / n_groups
+        self.window = comm_model.choose_gather_prefetch_depth(
+            per_layer, self.world, layer_compute_s, link=link,
+            max_window=max_window)
+        return self.window
+
+    # -- in-step surface ------------------------------------------------- #
+
+    def gather(self, local_params, *, plan="cell"):
+        """One whole-tree just-in-time gather (no layer streaming) —
+        ``fsdp_gather`` through the tuned program when one is
+        resolved.  Call INSIDE shard_map."""
+        from chainermn_tpu.parallel.fsdp import fsdp_gather
+
+        resolved = self.plan_cell.plan if plan == "cell" else plan
+        return fsdp_gather(
+            local_params, self.dims, self.axis_name,
+            None if resolved is not None else self.wire_dtype,
+            plan=resolved)
+
+    def gather_stream(self, local_params, *, window: Optional[int] = None,
+                      plan="cell") -> LayerGatherStream:
+        """A :class:`LayerGatherStream` over this layout — the ZeRO-3
+        forward.  Call INSIDE shard_map, once per step trace."""
+        resolved = self.plan_cell.plan if plan == "cell" else plan
+        from_cache = bool(getattr(resolved, "from_cache", False))
+        return LayerGatherStream(
+            local_params, self.dims, axis_name=self.axis_name,
+            window=self.window if window is None else window,
+            plan=resolved, wire_dtype=self.wire_dtype,
+            plan_from_cache=from_cache)
+
+    # -- accounting ------------------------------------------------------ #
+
+    def register_memory(self, accountant=None,
+                        prefix: str = "sharded") -> None:
+        """Register the placed state's device roots with the memory
+        accountant (``memory/<prefix>_params_bytes`` /
+        ``memory/<prefix>_opt_state_bytes`` gauges) — weakref-held, so
+        a retired plan samples as 0."""
+        from chainermn_tpu.utils.programs import (
+            get_accountant,
+            weakref_root,
+        )
+
+        acc = accountant if accountant is not None else get_accountant()
+        acc.register(f"{prefix}_params", weakref_root(self, "params"))
+        acc.register(f"{prefix}_opt_state",
+                     weakref_root(self, "opt_state"))

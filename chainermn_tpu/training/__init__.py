@@ -22,6 +22,7 @@ from .optimizers import (
     shard_opt_state,
     zero1_init,
     zero1_optimizer,
+    zero2_optimizer,
 )
 from .trainer import LogReport, PrintReport, Trainer, make_extension
 from .triggers import IntervalTrigger, get_trigger
@@ -53,4 +54,5 @@ __all__ = [
     "shard_opt_state",
     "zero1_init",
     "zero1_optimizer",
+    "zero2_optimizer",
 ]

@@ -105,8 +105,10 @@ def _leaf_paths(tree) -> List[tuple]:
 
 
 def _zero1_leaf_layout(opt_state, params, world: int) -> List[dict]:
-    """Per-leaf layout records for a world-stacked ZeRO-1 state tree, in
-    flattened-leaf order:
+    """Per-leaf layout records for a world-stacked ZeRO-1/2 state tree,
+    in flattened-leaf order — since PR 20 a thin delegate to the
+    unified signature table (``parallel.sharded_state``), which emits
+    the IDENTICAL records this function always wrote:
 
     - ``{"kind": "shard", "size": N}`` — a ``(world, ceil(N/world))``
       stack of 1-D parameter shards (``zero1_optimizer``'s
@@ -117,47 +119,45 @@ def _zero1_leaf_layout(opt_state, params, world: int) -> List[dict]:
       replicas (adam's ``count``): every row identical by construction.
     - ``{"kind": "rep"}`` — no member axis at all (replicated scalar).
     """
-    # shapes only — never np.asarray a leaf here: multi-process-sharded
-    # arrays are not fully addressable and must not be pulled to host
-    # just to record their layout
-    by_path: Dict[tuple, int] = {}
-    for path, p in _leaf_paths(params):
-        shape = tuple(np.shape(p))
-        by_path[tuple(str(k) for k in path)] = (
-            int(np.prod(shape, dtype=np.int64)) if shape else 1)
+    from chainermn_tpu.parallel.sharded_state import (
+        layout_records,
+        zero_opt_layouts,
+    )
 
-    layouts = []
-    for path, leaf in _leaf_paths(opt_state):
-        shape = tuple(np.shape(leaf))
-        keys = tuple(str(k) for k in path)
-        spec: dict = None
-        if len(shape) == 2 and shape[0] == world:
-            # longest matching path suffix whose padded shard width
-            # equals this stack's — includes the empty suffix for a
-            # bare-array params "tree"
-            for start in range(len(keys) + 1):
-                n = by_path.get(keys[start:])
-                if n is not None and _ceil_div(n, world) == shape[1]:
-                    spec = {"kind": "shard", "size": n}
-                    break
-        if spec is None:
-            if len(shape) >= 1 and shape[0] == world:
-                spec = {"kind": "stack"}
-            else:
-                spec = {"kind": "rep"}
-        layouts.append(spec)
-    return layouts
+    return layout_records(zero_opt_layouts(opt_state, params, world))
+
+
+def _sharding_mode(sig: Optional[dict]) -> Optional[str]:
+    """The normalized sharding mode of a signature: the explicit
+    ``sharding`` key when stamped (PR 20+), else the legacy ``zero1``
+    bool — so old ZeRO-1 snapshots compare equal to new ones."""
+    if sig is None:
+        return None
+    mode = sig.get("sharding")
+    if mode is not None:
+        return str(mode)
+    return "zero1" if sig.get("zero1") else None
 
 
 def topology_signature(comm, params=None, opt_state=None,
-                       zero1: bool = False) -> dict:
+                       zero1: bool = False,
+                       sharding: Optional[str] = None,
+                       layouts: Optional[dict] = None) -> dict:
     """The JSON-safe layout record a snapshot is stamped with.
 
     ``world_size`` is the mesh-member count (``comm.size`` — the axis
-    ZeRO-1 shards over), ``inter_size`` the process count; with
-    ``zero1`` and both trees given, ``opt_leaves`` records every
+    ZeRO shards over), ``inter_size`` the process count.  ``sharding``
+    names the state-sharding mode (``"zero1"``/``"zero2"``/``"zero3"``;
+    the legacy ``zero1`` bool still works and means ``"zero1"``).  With
+    a ZeRO mode and both trees given, ``opt_leaves`` records every
     optimizer-state leaf's shard layout so :func:`relayout_state` can
-    re-slice it onto a different world deterministically."""
+    re-slice it onto a different world deterministically; a ``layouts``
+    table (``parallel.sharded_state.state_layout_table``'s output)
+    overrides the derivation and — for ``"zero3"`` — additionally
+    stamps ``param_leaves`` so the shard-only snapshot container can
+    slice dim-sharded params too."""
+    mode = sharding if sharding is not None else (
+        "zero1" if zero1 else None)
     mesh = getattr(comm, "mesh", None)
     sig = {
         "format": TOPOLOGY_FORMAT,
@@ -167,9 +167,23 @@ def topology_signature(comm, params=None, opt_state=None,
                        else None),
         "mesh_shape": ([int(s) for s in np.asarray(mesh.devices).shape]
                        if mesh is not None else None),
-        "zero1": bool(zero1),
+        # legacy key: True for any world-stacked ZeRO carry, so a
+        # pre-PR-20 reader treats ZeRO-2 state with the ZeRO-1 rules
+        # (they are the same layout)
+        "zero1": mode in ("zero1", "zero2"),
     }
-    if zero1 and params is not None and opt_state is not None:
+    if mode is not None:
+        sig["sharding"] = mode
+    if layouts is not None:
+        from chainermn_tpu.parallel.sharded_state import layout_records
+
+        if layouts.get("opt_state") is not None:
+            sig["opt_leaves"] = layout_records(layouts["opt_state"])
+        recs = layout_records(layouts.get("params") or [])
+        if any(r.get("kind") == "fsdp" for r in recs):
+            sig["param_leaves"] = recs
+    elif mode in ("zero1", "zero2") and params is not None \
+            and opt_state is not None:
         sig["opt_leaves"] = _zero1_leaf_layout(
             opt_state, params, sig["world_size"])
     return sig
@@ -178,10 +192,13 @@ def topology_signature(comm, params=None, opt_state=None,
 def same_topology(a: Optional[dict], b: Optional[dict]) -> bool:
     """Whether two signatures describe the SAME topology (the exact
     bitwise resume path).  ``None`` (a pre-elastic snapshot) never
-    matches — the caller decides whether legacy rules apply."""
+    matches — the caller decides whether legacy rules apply.  The
+    sharding mode is compared NORMALIZED (:func:`_sharding_mode`), so
+    a pre-PR-20 ZeRO-1 signature still matches a new one."""
     if a is None or b is None:
         return False
-    return all(a.get(k) == b.get(k) for k in _COMPARE_KEYS)
+    return (all(a.get(k) == b.get(k) for k in _COMPARE_KEYS)
+            and _sharding_mode(a) == _sharding_mode(b))
 
 
 # --------------------------------------------------------------------- #
@@ -231,6 +248,25 @@ def _relayout_leaf(leaf, spec: dict, new_world: int, where: str):
             return arr[:new_world]
         reps = [arr] + [arr[:1]] * (new_world - arr.shape[0])
         return np.concatenate(reps, axis=0)
+    if kind == "fsdp":
+        # ZeRO-3 dim-sharded leaf: host-side state is FULL-width (the
+        # shard-only container reassembles it before re-layout), so
+        # re-laying onto a new world is a pass-through — the device
+        # placement at the new world re-slices the dim.  Validate the
+        # recorded extent so a sliced leaf cannot slip through as full.
+        dim = int(spec.get("dim", -1))
+        length = spec.get("len")
+        if dim < 0 or dim >= arr.ndim:
+            raise RelayoutError(
+                f"{where}: fsdp layout records shard dim {dim} but the "
+                f"leaf has shape {arr.shape}")
+        if length is not None and int(arr.shape[dim]) != int(length):
+            raise RelayoutError(
+                f"{where}: fsdp leaf holds {arr.shape[dim]} of the "
+                f"recorded {length} elements along dim {dim} — a "
+                "shard, not the assembled full leaf; assemble the "
+                "covering set first (assemble_shard_state)")
+        return arr
     raise RelayoutError(f"{where}: unknown layout kind {kind!r}")
 
 
@@ -246,33 +282,39 @@ def relayout_state(state: dict, topo_old: dict, topo_new: dict) -> dict:
     ``tests/extension_tests/test_elastic_checkpoint.py`` pins this);
     the snapshot-riding exchange plan is dropped so resume re-tunes for
     the new topology instead of replaying a stale program."""
-    if bool(topo_old.get("zero1")) != bool(topo_new.get("zero1")):
+    mode_old = _sharding_mode(topo_old)
+    mode_new = _sharding_mode(topo_new)
+    if mode_old != mode_new:
         raise RelayoutError(
-            f"snapshot was saved with zero1={topo_old.get('zero1')} but "
-            f"this job runs zero1={topo_new.get('zero1')} — elastic "
-            "resume re-lays a sharding, it does not convert between "
-            "replicated and ZeRO-1 optimizer state")
+            f"snapshot was saved with sharding={mode_old!r} but this "
+            f"job runs sharding={mode_new!r} — elastic resume re-lays "
+            "a sharding, it does not convert between layouts")
     new_world = int(topo_new["world_size"])
     out = dict(state)
-    if topo_old.get("zero1"):
+    if mode_old is not None:
         layouts = topo_old.get("opt_leaves")
         if layouts is None:
             raise RelayoutError(
-                "snapshot records zero1=True but carries no per-leaf "
-                "layout — it predates the elastic-resume format and "
-                "can only restart at its original topology")
+                f"snapshot records sharding={mode_old!r} but carries "
+                "no per-leaf layout — it predates the elastic-resume "
+                "format and can only restart at its original topology")
         import jax
+        from jax.tree_util import keystr, tree_flatten_with_path
 
-        leaves, treedef = jax.tree.flatten(state["opt_state"])
-        if len(leaves) != len(layouts):
+        path_leaves, treedef = tree_flatten_with_path(
+            state["opt_state"])
+        if len(path_leaves) != len(layouts):
             raise RelayoutError(
                 f"snapshot records {len(layouts)} optimizer-state "
-                f"leaves but the tree holds {len(leaves)} — the model "
-                "changed shape as well as the world; elastic resume "
-                "only re-lays the same model")
+                f"leaves but the tree holds {len(path_leaves)} — the "
+                "model changed shape as well as the world; elastic "
+                "resume only re-lays the same model")
+        # the leaf PATH rides every error: "opt_state['mu']['w1']
+        # recorded as a shard stack but..." beats "leaf 17"
         new_leaves = [
-            _relayout_leaf(leaf, spec, new_world, f"opt_state leaf {i}")
-            for i, (leaf, spec) in enumerate(zip(leaves, layouts))]
+            _relayout_leaf(leaf, spec, new_world,
+                           f"opt_state{keystr(path)}")
+            for (path, leaf), spec in zip(path_leaves, layouts)]
         out["opt_state"] = jax.tree.unflatten(treedef, new_leaves)
     ts = state.get("train_state")
     if isinstance(ts, dict) and "exchange_plan" in ts:
@@ -286,55 +328,50 @@ def relayout_state(state: dict, topo_old: dict, topo_new: dict) -> dict:
     return out
 
 
-def gather_zero1_leaves(opt_state, layouts: List[dict]):
-    """Gather a world-stacked ZeRO-1 state tree to its full flat values
-    (``shard`` leaves → 1-D true-extent arrays, ``stack`` leaves → one
-    representative row, ``rep`` leaves unchanged) — the host-side
-    equivalent of the in-program all-gather, used by the drills to
-    prove re-layout against a from-scratch gather."""
-    import jax
+# one-time (per process) deprecation notice for the ZeRO-1-named
+# gather/shard entry points — the unified layer replaced them in PR 20
+_ZERO1_LEAVES_WARNED = False
 
-    leaves, treedef = jax.tree.flatten(opt_state)
-    if len(leaves) != len(layouts):
-        raise RelayoutError(
-            f"{len(layouts)} layout records for {len(leaves)} leaves")
-    out = []
-    for leaf, spec in zip(leaves, layouts):
-        arr = np.asarray(leaf)
-        if spec["kind"] == "shard":
-            out.append(arr.reshape(-1)[: int(spec["size"])])
-        elif spec["kind"] == "stack":
-            out.append(arr[0])
-        else:
-            out.append(arr)
-    return jax.tree.unflatten(treedef, out)
+
+def _warn_zero1_leaves_deprecated(name: str) -> None:
+    global _ZERO1_LEAVES_WARNED
+    if _ZERO1_LEAVES_WARNED:
+        return
+    _ZERO1_LEAVES_WARNED = True
+    import warnings
+
+    warnings.warn(
+        f"training.elastic.{name} is deprecated: the unified "
+        "sharded-state layer (parallel.sharded_state."
+        "gather_state_leaves / shard_state_leaves) handles "
+        "ZeRO-1/2/3 layouts through one signature table; this shim "
+        "delegates there and will be removed (warning shown once per "
+        "process)", DeprecationWarning, stacklevel=3)
+
+
+def gather_zero1_leaves(opt_state, layouts: List[dict]):
+    """Deprecated shim: gather a world-stacked ZeRO-1/2 state tree to
+    its full flat values — delegates to the unified
+    :func:`chainermn_tpu.parallel.sharded_state.gather_state_leaves`
+    (identical behavior for ``shard``/``stack``/``rep`` records; the
+    unified layer additionally speaks ``fsdp``).  PR 10/12 call sites
+    keep working unchanged; warns once per process."""
+    from chainermn_tpu.parallel.sharded_state import gather_state_leaves
+
+    _warn_zero1_leaves_deprecated("gather_zero1_leaves")
+    return gather_state_leaves(opt_state, layouts)
 
 
 def shard_zero1_leaves(full_state, layouts: List[dict], world: int):
-    """Inverse of :func:`gather_zero1_leaves`: lay a gathered state onto
-    ``world`` members from scratch (pad to ``ceil(N/world)·world``,
-    split contiguously, re-stack) — the reference layout
-    :func:`relayout_state` must match bitwise."""
-    import jax
+    """Deprecated shim: inverse of :func:`gather_zero1_leaves` —
+    delegates to the unified
+    :func:`chainermn_tpu.parallel.sharded_state.shard_state_leaves`,
+    the reference layout :func:`relayout_state` must match bitwise.
+    Warns once per process."""
+    from chainermn_tpu.parallel.sharded_state import shard_state_leaves
 
-    leaves, treedef = jax.tree.flatten(full_state)
-    if len(leaves) != len(layouts):
-        raise RelayoutError(
-            f"{len(layouts)} layout records for {len(leaves)} leaves")
-    out = []
-    for leaf, spec in zip(leaves, layouts):
-        arr = np.asarray(leaf)
-        if spec["kind"] == "shard":
-            size = int(spec["size"])
-            s = _ceil_div(size, world)
-            flat = np.zeros((world * s,), dtype=arr.dtype)
-            flat[:size] = arr.reshape(-1)[:size]
-            out.append(flat.reshape(world, s))
-        elif spec["kind"] == "stack":
-            out.append(np.concatenate([arr[None]] * world, axis=0))
-        else:
-            out.append(arr)
-    return jax.tree.unflatten(treedef, out)
+    _warn_zero1_leaves_deprecated("shard_zero1_leaves")
+    return shard_state_leaves(full_state, layouts, world)
 
 
 # --------------------------------------------------------------------- #
@@ -801,7 +838,8 @@ class ResizeController:
             #    contract: live resize == save/restart at this boundary)
             topo_old = topology_signature(
                 upd.comm, params=upd.params, opt_state=upd.opt_state,
-                zero1=bool(getattr(upd, "zero1", False)))
+                zero1=bool(getattr(upd, "zero1", False)),
+                sharding=getattr(upd, "sharding", None))
             state = {
                 "iteration": it,
                 "world_size": int(getattr(upd.comm, "inter_size", 1)),
@@ -839,7 +877,8 @@ class ResizeController:
             topo_new = topology_signature(
                 new_comm, params=state["params"],
                 opt_state=state["opt_state"],
-                zero1=bool(getattr(upd, "zero1", False)))
+                zero1=bool(getattr(upd, "zero1", False)),
+                sharding=getattr(upd, "sharding", None))
             if not same_topology(topo_old, topo_new):
                 state = relayout_state(state, topo_old, topo_new)
             # 6. install and continue in the same process

@@ -34,11 +34,13 @@ import optax
 __all__ = [
     "PlannedOptimizer",
     "Zero1Transformation",
+    "Zero2Transformation",
     "cross_replica_mean",
     "create_multi_node_optimizer",
     "shard_opt_state",
     "zero1_optimizer",
     "zero1_init",
+    "zero2_optimizer",
     "DoubleBufferState",
 ]
 
@@ -393,6 +395,148 @@ def zero1_optimizer(
     return Zero1Transformation(init, update, overlap=bool(overlap))
 
 
+# --------------------------------------------------------------------- #
+# ZeRO-2: gradient + optimizer-state sharding over the data axis
+# --------------------------------------------------------------------- #
+
+
+class Zero2Transformation(NamedTuple):
+    """Type-marks the ZeRO-2 layout the same way
+    :class:`Zero1Transformation` marks ZeRO-1 — the optimizer STATE
+    layout is identical (world-stacked 1/N flat shards; ``zero1_init``
+    and the elastic/serialization machinery apply unchanged), what
+    differs is the gradient exchange: per-BUCKET reduce-scatters over
+    dtype-grouped leaf buckets instead of one collective per leaf, so
+    the full-width averaged gradient never materializes and each
+    bucket's scatter is join-free (depends only on its own leaves —
+    the property the PR 7 backward-overlap stream needs).
+    ``StandardUpdater`` carries ZeRO-2 state exactly like ZeRO-1."""
+
+    init: Callable
+    update: Callable
+    overlap: bool = False
+
+
+def _zero2_buckets(leaves, n: int, bucket_bytes: Optional[int]):
+    """Join-free exchange buckets over flattened-order ``leaves``:
+    grouped by dtype (a collective reduces one dtype), split so one
+    bucket's PER-MEMBER shard stays under ``bucket_bytes`` (``None`` =
+    one bucket per dtype).  Deterministic from tree order alone, so
+    every member builds the identical program."""
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    buckets = []
+    for dt, idxs in by_dtype.items():
+        cur, cur_b = [], 0
+        for i in idxs:
+            b = _ceil_div(leaves[i].size, n) * dt.itemsize
+            if cur and bucket_bytes is not None \
+                    and cur_b + b > bucket_bytes:
+                buckets.append((dt, cur))
+                cur, cur_b = [], 0
+            cur.append(i)
+            cur_b += b
+        if cur:
+            buckets.append((dt, cur))
+    return buckets
+
+
+def zero2_optimizer(
+    inner: optax.GradientTransformation,
+    axis_name: str,
+    wire_dtype=None,
+    overlap: bool = False,
+    bucket_bytes: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """ZeRO-2: shard gradients AND ``inner``'s optimiser state across
+    ``axis_name``.
+
+    ZeRO-1 (:func:`zero1_optimizer`) already never materializes the
+    full averaged gradient — its per-leaf ``psum_scatter`` IS the
+    exchange.  ZeRO-2 keeps the exact same state layout (flat 1/N
+    shards per leaf — ``zero1_init``, ``relayout_state`` and the
+    shard-only snapshots all apply verbatim) and upgrades the exchange
+    to the BUCKETED form: leaves are packed member-major into
+    dtype-grouped buckets (each leaf padded to ``n·s`` and reshaped
+    ``(n, s)``, buckets concatenated along the shard axis), one
+    reduce-scatter per bucket, then sliced back into per-leaf shards.
+    Per-element the sums cross the same members in the same order, so
+    the fp32 shards are BITWISE identical to ZeRO-1's — the win is
+    collective count (L leaves → B buckets) plus join-free buckets the
+    backward-overlap stream can hide one at a time.
+
+    Same contract as :func:`zero1_optimizer`: run inside ``shard_map``,
+    ``inner`` must be elementwise, padded lanes stay garbage-in-padding.
+    ``bucket_bytes`` caps one bucket's per-member shard bytes
+    (``utils.comm_model.choose_bucket_bytes`` picks a principled value);
+    ``None`` packs each dtype whole.
+    """
+
+    def init(params):
+        n = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        shards = jax.tree.map(lambda p: _leaf_shard(p, idx, n), params)
+        return inner.init(shards)
+
+    def update(grads, state, params=None):
+        n = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        leaves, treedef = jax.tree.flatten(grads)
+        widths = [_ceil_div(l.size, n) for l in leaves]
+
+        # -- bucketed reduce-scatter: the gradient exchange ---------- #
+        shard_leaves = [None] * len(leaves)
+        for dt, idxs in _zero2_buckets(leaves, n, bucket_bytes):
+            mats = []
+            for i in idxs:
+                flat = _ensure_varying(leaves[i].reshape(-1), axis_name)
+                flat = jnp.pad(flat, (0, widths[i] * n - flat.size))
+                mats.append(flat.reshape(n, widths[i]))
+            buf = (mats[0] if len(mats) == 1
+                   else jnp.concatenate(mats, axis=1)).reshape(-1)
+            if wire_dtype is not None and buf.dtype != wire_dtype:
+                red = jax.lax.psum_scatter(
+                    buf.astype(wire_dtype), axis_name, tiled=True)
+                red = (red / n).astype(dt)
+            else:
+                red = jax.lax.psum_scatter(buf, axis_name,
+                                           tiled=True) / n
+            off = 0
+            for i in idxs:
+                shard_leaves[i] = red[off:off + widths[i]]
+                off += widths[i]
+        grad_shards = treedef.unflatten(shard_leaves)
+
+        param_shards = None if params is None else jax.tree.map(
+            lambda p: _leaf_shard(p, idx, n), params)
+        upd_shards, state = inner.update(grad_shards, state,
+                                         param_shards)
+
+        # -- bucketed gather of the updates -------------------------- #
+        upd_leaves = jax.tree.leaves(upd_shards)
+        out = [None] * len(leaves)
+        for dt, idxs in _zero2_buckets(upd_leaves, n, bucket_bytes):
+            cat = (upd_leaves[idxs[0]] if len(idxs) == 1
+                   else jnp.concatenate([upd_leaves[i] for i in idxs]))
+            if wire_dtype is not None and cat.dtype != wire_dtype:
+                full = _all_gather_invariant(
+                    cat.astype(wire_dtype), axis_name,
+                    tiled=True).astype(dt)
+            else:
+                full = _all_gather_invariant(cat, axis_name, tiled=True)
+            mat = full.reshape(n, cat.size)
+            off = 0
+            for i in idxs:
+                ref = leaves[i]
+                out[i] = mat[:, off:off + widths[i]].reshape(
+                    -1)[: ref.size].reshape(ref.shape)
+                off += widths[i]
+        return treedef.unflatten(out), state
+
+    return Zero2Transformation(init, update, overlap=bool(overlap))
+
+
 def shard_opt_state(optimizer, params):
     """Initialise ``optimizer``'s state with the PARAMS' shardings.
 
@@ -498,6 +642,7 @@ def create_multi_node_optimizer(
     comm=None,
     double_buffering: bool = False,
     zero1: bool = False,
+    zero2: bool = False,
     accum_steps: int = 1,
     axis_name: Optional[str] = None,
     allreduce_grad_dtype=None,
@@ -520,6 +665,13 @@ def create_multi_node_optimizer(
         (:func:`zero1_optimizer`); replaces the pmean with a
         reduce-scatter/all-gather pair.  With ``double_buffering`` the
         stale-grad stash is also sharded (1/N memory).
+      zero2: ZeRO-2 (:func:`zero2_optimizer`) — same optimiser-state
+        layout as ``zero1`` (the updater/elastic/snapshot machinery is
+        shared), with the gradient exchange bucketed: dtype-grouped
+        join-free reduce-scatters instead of one collective per leaf,
+        so gradients too live at 1/N width between scatter and gather.
+        Mutually exclusive with ``zero1``; ``bucket_bytes`` caps the
+        per-member bucket shard.
       accum_steps: gradient accumulation — parameters update every
         ``accum_steps`` calls with the mean of the accumulated grads
         (global batch = ``world × local_batch × accum_steps``; the
@@ -595,7 +747,11 @@ def create_multi_node_optimizer(
         raise ValueError("need comm or axis_name")
     if accum_steps < 1:
         raise ValueError(f"accum_steps {accum_steps} must be >= 1")
-    if plan is not None and zero1:
+    if zero1 and zero2:
+        raise ValueError(
+            "zero1=True and zero2=True are mutually exclusive — "
+            "ZeRO-2 subsumes ZeRO-1's state sharding; pick one")
+    if plan is not None and (zero1 or zero2):
         # graceful fallback, not an error: plan="auto" must be safe to
         # set globally.  ZeRO-1's reduce-scatter/all-gather pair is its
         # own (analytic, per-leaf, join-free) exchange; the plan would
@@ -605,7 +761,7 @@ def create_multi_node_optimizer(
             _ZERO1_PLAN_WARNED = True
             warnings.warn(
                 "create_multi_node_optimizer: plan= is ignored under "
-                "zero1=True — ZeRO-1 exchanges gradients through its "
+                "zero1/zero2 — ZeRO exchanges gradients through its "
                 "own reduce-scatter/all-gather pair, so the analytic "
                 "path is used instead of the tuned plan (warning shown "
                 "once per process)", RuntimeWarning, stacklevel=2)
@@ -615,6 +771,12 @@ def create_multi_node_optimizer(
         inner = optax.chain(_double_buffer(), inner)
     if accum_steps > 1:
         inner = _grad_accumulation(inner, accum_steps, axis_name=ax)
+    if zero2:
+        # accumulation INSIDE zero2: the accumulator holds 1/N shards
+        return zero2_optimizer(inner, ax,
+                               wire_dtype=allreduce_grad_dtype,
+                               overlap=bool(overlap),
+                               bucket_bytes=bucket_bytes)
     if zero1:
         # accumulation INSIDE zero1: the accumulator holds 1/N shards
         return zero1_optimizer(inner, ax,

@@ -286,9 +286,22 @@ class StandardUpdater:
         # first-update weight broadcast of the reference, done at init
         self.params = comm.bcast_data(params)
         self.state = None if state is None else comm.bcast_data(state)
-        from .optimizers import Zero1Transformation, zero1_init
+        from .optimizers import (
+            Zero1Transformation,
+            Zero2Transformation,
+            zero1_init,
+        )
 
-        self.zero1 = isinstance(optimizer, Zero1Transformation)
+        # sharding mode from the transformation TYPE (never a repeated
+        # flag): ZeRO-2 carries its state exactly like ZeRO-1 (world-
+        # stacked 1/N shards — zero1_init and the P(ax) opt spec apply
+        # verbatim), so self.zero1 stays the "world-stacked ZeRO carry"
+        # switch for both
+        self.sharding = (
+            "zero2" if isinstance(optimizer, Zero2Transformation)
+            else "zero1" if isinstance(optimizer, Zero1Transformation)
+            else None)
+        self.zero1 = self.sharding in ("zero1", "zero2")
         if self.zero1:
             self.opt_state = zero1_init(
                 optimizer, self.params, comm.mesh, comm.axis_name)
@@ -512,6 +525,7 @@ class StandardUpdater:
             "steps_per_execution": int(self.steps_per_execution),
             "inflight_windows": len(self._inflight),
             "zero1": bool(self.zero1),
+            "sharding": self.sharding,
         }
 
     def mark_steady(self) -> None:
@@ -558,7 +572,7 @@ class StandardUpdater:
         draining in-flight windows FIRST (the old mesh's buffers must
         retire before the world changes) and installing the re-laid
         ``params`` / ``opt_state`` / ``state`` afterwards."""
-        from .optimizers import Zero1Transformation
+        from .optimizers import Zero1Transformation, Zero2Transformation
 
         if isinstance(self.iterator, PrefetchIterator):
             base = self.iterator._base
@@ -580,13 +594,18 @@ class StandardUpdater:
                 drop_remainder=self.drop_remainder)
         self.comm = comm
         self.optimizer = optimizer
-        was_zero1 = self.zero1
-        self.zero1 = isinstance(optimizer, Zero1Transformation)
-        if self.zero1 != was_zero1:
+        was_sharding = self.sharding
+        self.sharding = (
+            "zero2" if isinstance(optimizer, Zero2Transformation)
+            else "zero1" if isinstance(optimizer, Zero1Transformation)
+            else None)
+        self.zero1 = self.sharding in ("zero1", "zero2")
+        if self.sharding != was_sharding:
             raise ValueError(
-                "rebind_world cannot switch zero1 mode mid-run: the "
-                "carried optimizer state's layout would not match the "
-                "new transformation")
+                f"rebind_world cannot switch sharding mode mid-run "
+                f"({was_sharding!r} -> {self.sharding!r}): the carried "
+                f"optimizer state's layout would not match the new "
+                f"transformation")
         cell = getattr(optimizer, "plan_cell", None)
         if self.exchange_probe_every and cell is None:
             raise ValueError(

@@ -1321,6 +1321,7 @@ def autotune_pattern_plan(
     max_chunks: int = 8,
     force: bool = False,
     seed: int = 0,
+    variant_extra: Optional[Dict[str, Any]] = None,
     **pattern_kw,
 ) -> Plan:
     """Tune (or warm-start) a collective-plan IR program for one
@@ -1350,6 +1351,11 @@ def autotune_pattern_plan(
         (:func:`~chainermn_tpu.utils.comm_model.program_cost`).
       max_chunks: largest axis-split chunk count enumerated for
         ``moe_all_to_all``.
+      variant_extra: extra JSON-stable key/value pairs folded into the
+        cache key (NOT forwarded to lowering/probing) — consumers with
+        their own payload discipline (``parallel.sharded_state``'s
+        per-layer gather stream) namespace their plans so a tuning
+        never serves a call site with different runtime structure.
       pattern_kw: pattern statics, part of the cache key — ``dims``
         (``fsdp_gather``), ``split_axis``/``concat_axis``
         (``moe_all_to_all``), ``shift``/``wrap`` (``pipeline_edge``).
@@ -1416,6 +1422,9 @@ def autotune_pattern_plan(
             extras["dims"] = treedef.flatten_up_to(v)
         else:
             extras[k] = v
+    if variant_extra:
+        extras["variant_extra"] = {
+            str(k): variant_extra[k] for k in sorted(variant_extra)}
     variant = f"plan-ir/{pattern}/{_digest(extras)[:12]}"
     key = plan_key(mesh_sig, payload, variant=variant)
 

@@ -41,6 +41,7 @@ __all__ = [
     "axis_collective_report",
     "choose_accum_steps",
     "choose_bucket_bytes",
+    "choose_gather_prefetch_depth",
     "choose_prefetch_depth",
     "fused_collective_budget",
     "overlap_exposed_time",
@@ -616,6 +617,53 @@ def choose_prefetch_depth(host_time_s: float, device_time_s: float,
         return min_depth
     depth = -(-int(rho * (1.0 + jitter) * 1000) // 1000)  # ceil, fp-safe
     return max(min_depth, min(depth + 1, max_depth))
+
+
+def choose_gather_prefetch_depth(
+    layer_bytes: float,
+    axis_size: int,
+    layer_compute_s: float,
+    latency_s: float = _DEFAULT_LATENCY_S,
+    bandwidth_bytes_per_s: float = _DEFAULT_BANDWIDTH,
+    link: Optional[LinkParams] = None,
+    min_window: int = 1,
+    max_window: int = 4,
+) -> int:
+    """ZeRO-3 layer-gather prefetch window from the latency-bandwidth
+    model (``ShardedState.auto_window`` / ``LayerGatherStream(window=)``).
+
+    A window of ``W`` means layer ``i``'s all-gather is issued ``W``
+    layers ahead, so it has ``W`` layers' compute to hide behind.  One
+    gather of a layer's ``s = layer_bytes`` params over ``n`` devices
+    costs ``t_g = alpha + s (n-1) / (n * beta)`` on the ring; the
+    smallest window that fully hides it is ``1 + ceil(t_g / t_c)`` for
+    per-layer compute ``t_c`` (the ``+1`` is the layer currently being
+    consumed — classic double buffering at ``t_g <= t_c``).  Clamped to
+    ``[min_window, max_window]``: each extra slot keeps one more layer's
+    FULL params resident, which is exactly the memory ZeRO-3 exists to
+    shed.  Defaults model ICI; a :class:`LinkParams` via ``link`` (e.g.
+    ``LinkParams(**plan.link)`` from the measured autotuner) overrides
+    both scalars.
+    """
+    if link is not None:
+        latency_s = link.latency_s
+        bandwidth_bytes_per_s = link.bandwidth_bytes_per_s
+    if layer_bytes < 0 or layer_compute_s < 0:
+        raise ValueError(
+            f"need layer_bytes >= 0 and layer_compute_s >= 0, got "
+            f"{layer_bytes} / {layer_compute_s}")
+    if min_window < 1 or max_window < min_window:
+        raise ValueError(f"bad window bounds [{min_window}, {max_window}]")
+    if axis_size <= 1:
+        return min_window          # nothing to gather, nothing to hide
+    t_g = latency_s + layer_bytes * (axis_size - 1) / (
+        axis_size * bandwidth_bytes_per_s)
+    if layer_compute_s == 0:
+        # no compute measured yet (first-step probe): nothing to hide
+        # behind, so take the deepest window the memory budget allows.
+        return max_window
+    depth = 1 + math.ceil(t_g / layer_compute_s - 1e-9)
+    return max(min_window, min(depth, max_window))
 
 
 def choose_accum_steps(

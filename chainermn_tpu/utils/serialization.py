@@ -47,7 +47,8 @@ import jax
 import numpy as np
 
 __all__ = ["SHARD_PART_FORMAT", "ShardSetError", "SnapshotCorruptError",
-           "assemble_shard_state", "build_shard_part", "load_state",
+           "assemble_shard_state", "build_shard_part",
+           "fsdp_leaf_entries", "load_state",
            "load_state_with_stamps", "load_state_with_topology",
            "read_shard_part", "read_topology", "save_state",
            "verify_state"]
@@ -70,8 +71,12 @@ class ShardSetError(RuntimeError):
 
 #: Version of the ``shard_part`` meta record.  A reader that does not
 #: recognise the version must refuse the part (conservative, like the
-#: topology format).
-SHARD_PART_FORMAT = 1
+#: topology format).  v2 (PR 20) adds dim-sharded ZeRO-3/FSDP leaves
+#: (``fsdp_opt_leaves``/``fsdp_param_leaves`` record entries); the
+#: reader still accepts v1 sets (``_SHARD_PART_ACCEPTED``), whose
+#: records simply carry no fsdp entries.
+SHARD_PART_FORMAT = 2
+_SHARD_PART_ACCEPTED = (1, 2)
 
 
 def _host_view(x):
@@ -317,6 +322,62 @@ def shard_leaf_indices(topology) -> list:
             if spec.get("kind") == "shard"]
 
 
+def fsdp_leaf_entries(topology, key: str = "opt_leaves") -> list:
+    """Flat ``(leaf index, shard dim)`` pairs for the dim-sharded
+    ZeRO-3/FSDP leaves the topology signature records under ``key``
+    (``"opt_leaves"`` or ``"param_leaves"`` — the unified layout
+    table's ``{"kind": "fsdp", "dim": d}`` records).  Disjoint from
+    :func:`shard_leaf_indices` by construction (one record per leaf,
+    one kind per record)."""
+    layouts = (topology or {}).get(key) or []
+    return [(i, int(spec["dim"])) for i, spec in enumerate(layouts)
+            if spec.get("kind") == "fsdp"]
+
+
+def _dim_rows(leaf, lo: int, hi: int, world: int, dim: int):
+    """Host copy of members ``[lo, hi)``'s slice of a dim-sharded
+    (ZeRO-3/FSDP) leaf: elements ``[lo·L/W, hi·L/W)`` along ``dim``
+    (``fsdp_dims`` guarantees ``L % W == 0``).
+
+    Mirrors :func:`_member_rows` for the not-fully-addressable case:
+    the slice is extracted from this process's addressable shards
+    (only ``dim`` may be sharded — the fsdp layout's contract), and a
+    request for members this process does not hold raises."""
+    shape = tuple(np.shape(leaf))
+    if dim < 0 or dim >= len(shape) or shape[dim] % world:
+        raise ValueError(
+            f"fsdp leaf has shape {shape}; expected dim {dim} "
+            f"divisible by world {world}")
+    w = shape[dim] // world
+    a, b = lo * w, hi * w
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        out = np.empty(shape[:dim] + (b - a,) + shape[dim + 1:],
+                       dtype=np.dtype(leaf.dtype))
+        have = np.zeros((b - a,), bool)
+        for sh in leaf.addressable_shards:
+            idx = sh.index[dim]
+            start = 0 if idx.start is None else idx.start
+            stop = shape[dim] if idx.stop is None else idx.stop
+            s, e = max(start, a), min(stop, b)
+            if s < e:
+                data = np.asarray(sh.data)
+                sel_out = [slice(None)] * len(shape)
+                sel_out[dim] = slice(s - a, e - a)
+                sel_in = [slice(None)] * len(shape)
+                sel_in[dim] = slice(s - start, e - start)
+                out[tuple(sel_out)] = data[tuple(sel_in)]
+                have[s - a:e - a] = True
+        if not have.all():
+            raise ValueError(
+                f"members [{lo}, {hi})'s dim-{dim} slice is not "
+                "addressable from this process — shard-only saves "
+                "write only locally held slices")
+        return out
+    sel = [slice(None)] * len(shape)
+    sel[dim] = slice(a, b)
+    return np.asarray(np.asarray(leaf)[tuple(sel)])
+
+
 def _member_rows(leaf, lo: int, hi: int, world: int):
     """Host copy of member rows ``[lo, hi)`` of a world-stacked leaf.
 
@@ -362,25 +423,57 @@ def build_shard_part(state: dict, topology: dict, lo: int, hi: int,
     The record names the covered range, the world, and the shard leaf
     indices, so :func:`assemble_shard_state` is self-describing —
     assembly never re-derives the layout from live code that may have
-    moved on."""
+    moved on.
+
+    ZeRO-3/FSDP topologies additionally record dim-sharded leaves
+    (``{"kind": "fsdp"}`` in the layout table): those ``opt_state``
+    leaves are sliced along their shard dim, and the PARAMS' fsdp
+    leaves are sliced the same way (params are only 1/world resident
+    per member at rest, so a full-param root would not exist anywhere).
+    Non-root parts then also carry ``{"param_shards": {...}}``."""
     world = int(topology["world_size"])
     if not 0 <= lo < hi <= world:
         raise ValueError(f"member range [{lo}, {hi}) not in [0, {world})")
     idxs = shard_leaf_indices(topology)
+    fsdp_opt = fsdp_leaf_entries(topology, "opt_leaves")
+    fsdp_par = fsdp_leaf_entries(topology, "param_leaves")
     leaves, treedef = jax.tree.flatten(state["opt_state"])
+    if fsdp_par:
+        p_leaves, p_treedef = jax.tree.flatten(state["params"])
     if root:
         new = list(leaves)
         for i in idxs:
             new[i] = _member_rows(leaves[i], lo, hi, world)
+        for i, dim in fsdp_opt:
+            new[i] = _dim_rows(leaves[i], lo, hi, world, dim)
         part = dict(state)
         part["opt_state"] = jax.tree.unflatten(treedef, new)
+        if fsdp_par:
+            p_new = list(p_leaves)
+            for i, dim in fsdp_par:
+                p_new[i] = _dim_rows(p_leaves[i], lo, hi, world, dim)
+            part["params"] = jax.tree.unflatten(p_treedef, p_new)
     else:
-        part = {"shards": {f"leaf_{i:05d}":
-                           _member_rows(leaves[i], lo, hi, world)
-                           for i in idxs}}
+        shards = {f"leaf_{i:05d}": _member_rows(leaves[i], lo, hi, world)
+                  for i in idxs}
+        shards.update({f"leaf_{i:05d}":
+                       _dim_rows(leaves[i], lo, hi, world, dim)
+                       for i, dim in fsdp_opt})
+        part = {"shards": shards}
+        if fsdp_par:
+            part["param_shards"] = {
+                f"leaf_{i:05d}": _dim_rows(p_leaves[i], lo, hi, world, dim)
+                for i, dim in fsdp_par}
     record = {"format": SHARD_PART_FORMAT, "members": [int(lo), int(hi)],
               "world": world, "root": bool(root),
               "shard_leaves": [int(i) for i in idxs]}
+    if fsdp_opt or fsdp_par:
+        record["fsdp_opt_leaves"] = [[int(i), int(d)] for i, d in fsdp_opt]
+        record["fsdp_param_leaves"] = [[int(i), int(d)] for i, d in fsdp_par]
+    else:
+        # Pure row-sharded (ZeRO-1/2) sets keep the v1 record shape so
+        # mixed-version fleets can still read each other's saves.
+        record["format"] = 1
     return part, record
 
 
@@ -402,17 +495,26 @@ def assemble_shard_state(parts) -> dict:
             f"covering set needs exactly one root part, got "
             f"{len(roots)}")
     root_rec, root_state = roots[0]
-    if int(root_rec.get("format", -1)) != SHARD_PART_FORMAT:
+    fmt = int(root_rec.get("format", -1))
+    if fmt not in _SHARD_PART_ACCEPTED:
         raise ShardSetError(
             f"unknown shard_part format {root_rec.get('format')!r} "
-            f"(this reader speaks {SHARD_PART_FORMAT})")
+            f"(this reader speaks {sorted(_SHARD_PART_ACCEPTED)})")
     world = int(root_rec["world"])
     idxs = [int(i) for i in root_rec["shard_leaves"]]
+    fsdp_opt = [(int(i), int(d))
+                for i, d in root_rec.get("fsdp_opt_leaves", [])]
+    fsdp_par = [(int(i), int(d))
+                for i, d in root_rec.get("fsdp_param_leaves", [])]
     ranges = []
     for rec, _ in parts:
         if int(rec.get("world", -1)) != world \
                 or [int(i) for i in rec.get("shard_leaves", [])] != idxs \
-                or int(rec.get("format", -1)) != SHARD_PART_FORMAT:
+                or [(int(i), int(d))
+                    for i, d in rec.get("fsdp_opt_leaves", [])] != fsdp_opt \
+                or [(int(i), int(d))
+                    for i, d in rec.get("fsdp_param_leaves", [])] != fsdp_par \
+                or int(rec.get("format", -1)) != fmt:
             raise ShardSetError(
                 "shard parts disagree on world/leaf layout — files "
                 "from different sets were mixed")
@@ -431,24 +533,37 @@ def assemble_shard_state(parts) -> dict:
         raise ShardSetError(
             f"member ranges stop at {cursor}, but the set's world is "
             f"{world} — the covering set is incomplete")
-    leaves, treedef = jax.tree.flatten(root_state["opt_state"])
-    new = list(leaves)
-    for i in idxs:
+    def _collect(i, container_key, state_key):
         key = f"leaf_{i:05d}"
         rows = []
         for k in order:
             rec, st = parts[k]
             if rec.get("root"):
-                sub, _ = jax.tree.flatten(st["opt_state"])
+                sub, _ = jax.tree.flatten(st[state_key])
                 rows.append(np.asarray(sub[i]))
             else:
                 try:
-                    rows.append(np.asarray(st["shards"][key]))
+                    rows.append(np.asarray(st[container_key][key]))
                 except KeyError:
                     raise ShardSetError(
                         f"part covering {rec['members']} is missing "
                         f"shard leaf {key}") from None
-        new[i] = np.concatenate(rows, axis=0)
+        return rows
+
+    leaves, treedef = jax.tree.flatten(root_state["opt_state"])
+    new = list(leaves)
+    for i in idxs:
+        new[i] = np.concatenate(_collect(i, "shards", "opt_state"), axis=0)
+    for i, dim in fsdp_opt:
+        new[i] = np.concatenate(_collect(i, "shards", "opt_state"),
+                                axis=dim)
     out = dict(root_state)
     out["opt_state"] = jax.tree.unflatten(treedef, new)
+    if fsdp_par:
+        p_leaves, p_treedef = jax.tree.flatten(root_state["params"])
+        p_new = list(p_leaves)
+        for i, dim in fsdp_par:
+            p_new[i] = np.concatenate(
+                _collect(i, "param_shards", "params"), axis=dim)
+        out["params"] = jax.tree.unflatten(p_treedef, p_new)
     return out

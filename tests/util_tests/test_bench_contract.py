@@ -176,6 +176,10 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
      ["--n-layers", "4", "--d-model", "16", "--vocab", "256",
       "--capacity", "4", "--slot-dim", "16", "--trials", "1",
       "--rounds", "1", "--iters", "1", "--top-k", "4"], "x"),
+    ("bench_zero.py",
+     ["--n-layers", "2", "--d-model", "64", "--vocab", "256",
+      "--trials", "1", "--rounds", "1", "--iters", "1",
+      "--top-k", "4"], "x"),
     ("bench_telemetry.py",
      ["--batch", "8", "--dim", "64", "--hidden", "128", "--warmup", "1",
       "--iters", "4", "--rounds", "1"], "x"),
@@ -216,7 +220,7 @@ def test_cache_records_and_falls_back(tmp_path, monkeypatch, capsys):
       "1", "--iters", "4", "--rounds", "1"], "x"),
 ], ids=["transformer", "decode", "attention", "seq2seq", "levers",
         "fused_allreduce", "pipeline", "resilience", "accum",
-        "autotune", "plan_ir", "telemetry", "metrics_registry", "overlap",
+        "autotune", "plan_ir", "zero", "telemetry", "metrics_registry", "overlap",
         "overload", "fleet", "elastic", "live_elastic", "obs_plane",
         "programs"])
 def test_other_benches_contract(script, args, unit):
